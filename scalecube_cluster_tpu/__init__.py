@@ -1,0 +1,44 @@
+"""scalecube_cluster_tpu — a TPU-native SWIM cluster-membership framework.
+
+Capability parity with ``scalecube-cluster`` (decentralized membership,
+random-probe failure detection, infection-style gossip, SYNC anti-entropy)
+built TPU-first: the protocol engine is a vectorized JAX/XLA tick kernel over
+sharded state tensors (see ``ops/`` and ``parallel/``), while a scalar
+asyncio engine (``cluster/``) provides the reference-equivalent per-node
+implementation behind the same pluggable ``Transport`` boundary
+(``transport/``).
+
+Public API mirrors the reference ``Cluster`` facade (``Cluster.java:10-151``).
+"""
+
+from .config import (
+    ClusterConfig,
+    FailureDetectorConfig,
+    GossipConfig,
+    MembershipConfig,
+    SimConfig,
+    TransportConfig,
+)
+from .models.events import FailureDetectorEvent, MembershipEvent, MembershipEventType
+from .models.member import Member, MemberStatus, new_member_id
+from .models.message import Message
+from .models.record import MembershipRecord
+from .version import __version__
+
+__all__ = [
+    "ClusterConfig",
+    "FailureDetectorConfig",
+    "GossipConfig",
+    "MembershipConfig",
+    "TransportConfig",
+    "SimConfig",
+    "Member",
+    "MemberStatus",
+    "MembershipRecord",
+    "MembershipEvent",
+    "MembershipEventType",
+    "FailureDetectorEvent",
+    "Message",
+    "new_member_id",
+    "__version__",
+]

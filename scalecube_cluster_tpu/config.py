@@ -1,0 +1,247 @@
+"""Immutable configuration with LAN / WAN / local / sim profiles.
+
+Parity with reference cluster-api configs:
+
+* ``ClusterConfig`` (``ClusterConfig.java:25-428``) — root config with nested
+  sub-configs mutated through functional lenses (``UnaryOperator`` in the
+  reference; plain ``cfg.membership(lambda m: m.replace(...))`` here),
+  member-id generator, alias, external host/port NAT mapping.
+* ``FailureDetectorConfig`` (``FailureDetectorConfig.java:9-21``) — LAN
+  1000/500/3, WAN 5000/3000/3, local 1000/200/1 (ms).
+* ``GossipConfig`` (``GossipConfig.java:9-20``) — LAN 200ms/f3/m3, WAN fanout
+  4, local 100ms/m2; segmentation threshold 1000.
+* ``MembershipConfig`` (``MembershipConfig.java:14-32``) — LAN 30s/3s/5, WAN
+  60s/6, local 15s/3; namespace "default"; removed-history 42.
+* ``TransportConfig`` (``TransportConfig.java:8-22``) — port 0, connect
+  timeout 3s, max frame 2MB, pluggable codec/factory.
+
+Additional ``sim`` profile (new, no reference analogue): knobs for the
+vectorized TPU simulation — tick granularity, dense-link emulation, member
+capacity, rumor-slot count.
+
+All times are float seconds (the reference uses ms ints; seconds compose
+better with asyncio and with tick-time mapping in the kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, replace
+from typing import Callable, List, Optional, Sequence
+
+from .models.member import new_member_id
+from .utils.namespaces import validate_namespace
+
+DEFAULT_NAMESPACE = "default"
+
+
+@dataclass(frozen=True)
+class FailureDetectorConfig:
+    """Random-probe failure detector knobs (reference FailureDetectorConfig.java)."""
+
+    ping_interval: float = 1.0
+    ping_timeout: float = 0.5
+    ping_req_members: int = 3
+
+    def replace(self, **kw) -> "FailureDetectorConfig":
+        return replace(self, **kw)
+
+    @staticmethod
+    def default_lan() -> "FailureDetectorConfig":
+        return FailureDetectorConfig()
+
+    @staticmethod
+    def default_wan() -> "FailureDetectorConfig":
+        return FailureDetectorConfig(ping_interval=5.0, ping_timeout=3.0)
+
+    @staticmethod
+    def default_local() -> "FailureDetectorConfig":
+        return FailureDetectorConfig(ping_interval=1.0, ping_timeout=0.2, ping_req_members=1)
+
+
+@dataclass(frozen=True)
+class GossipConfig:
+    """Infection-style dissemination knobs (reference GossipConfig.java)."""
+
+    gossip_interval: float = 0.2
+    gossip_fanout: int = 3
+    gossip_repeat_mult: int = 3
+    gossip_segmentation_threshold: int = 1000
+
+    def replace(self, **kw) -> "GossipConfig":
+        return replace(self, **kw)
+
+    @staticmethod
+    def default_lan() -> "GossipConfig":
+        return GossipConfig()
+
+    @staticmethod
+    def default_wan() -> "GossipConfig":
+        return GossipConfig(gossip_fanout=4)
+
+    @staticmethod
+    def default_local() -> "GossipConfig":
+        return GossipConfig(gossip_interval=0.1, gossip_repeat_mult=2)
+
+
+@dataclass(frozen=True)
+class MembershipConfig:
+    """SWIM membership + suspicion + SYNC knobs (reference MembershipConfig.java)."""
+
+    seed_members: Sequence[str] = ()
+    sync_interval: float = 30.0
+    sync_timeout: float = 3.0
+    suspicion_mult: int = 5
+    namespace: str = DEFAULT_NAMESPACE
+    removed_members_history_size: int = 42
+
+    def replace(self, **kw) -> "MembershipConfig":
+        return replace(self, **kw)
+
+    @staticmethod
+    def default_lan() -> "MembershipConfig":
+        return MembershipConfig()
+
+    @staticmethod
+    def default_wan() -> "MembershipConfig":
+        return MembershipConfig(sync_interval=60.0, suspicion_mult=6)
+
+    @staticmethod
+    def default_local() -> "MembershipConfig":
+        return MembershipConfig(sync_interval=15.0, suspicion_mult=3)
+
+
+@dataclass(frozen=True)
+class TransportConfig:
+    """Transport knobs (reference TransportConfig.java:8-22)."""
+
+    port: int = 0  # 0 = ephemeral
+    host: str = "127.0.0.1"
+    connect_timeout: float = 3.0
+    max_frame_length: int = 2 * 1024 * 1024
+    message_codec: str = "jdk"  # codec registry key, see transport/codecs.py
+    transport_factory: Optional[str] = None  # factory registry key; None -> default
+
+    def replace(self, **kw) -> "TransportConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Vectorized-simulation knobs (new; no reference analogue).
+
+    ``tick_interval`` is the wall-clock meaning of one kernel tick; by default
+    equal to the gossip interval so one tick = one gossip period and FD /
+    sync rounds fire every ``ping_interval / tick_interval`` ticks.
+    """
+
+    capacity: int = 0  # max member rows; 0 -> derived from initial cluster size
+    tick_interval: float = 0.2
+    rumor_slots: int = 64  # concurrent user-rumor capacity per cluster
+    record_queue: int = 32  # per-node piggyback queue for membership records
+    dense_links: bool = True  # dense NxN loss/delay matrices (sim emulator)
+    seed: int = 0
+
+    def replace(self, **kw) -> "SimConfig":
+        return replace(self, **kw)
+
+
+Lens = Callable
+
+
+@dataclass(frozen=True)
+class ClusterConfig:
+    """Root config. Copy-on-write: every ``xxx()`` lens returns a new config
+    (reference ClusterConfig fluent API, ClusterImpl.java:143-226)."""
+
+    failure_detector: FailureDetectorConfig = field(default_factory=FailureDetectorConfig)
+    gossip: GossipConfig = field(default_factory=GossipConfig)
+    membership: MembershipConfig = field(default_factory=MembershipConfig)
+    transport: TransportConfig = field(default_factory=TransportConfig)
+    sim: SimConfig = field(default_factory=SimConfig)
+
+    member_alias: Optional[str] = None
+    external_host: Optional[str] = None  # container NAT mapping (ClusterConfig.java:236-300)
+    external_port: Optional[int] = None
+    metadata: Optional[bytes] = None
+    metadata_timeout: float = 3.0
+    metadata_codec: str = "jdk"
+    member_id_generator: Callable[[], str] = field(default=new_member_id, compare=False)
+
+    # -- profiles (reference ClusterConfig.java:54-93) ---------------------
+    @staticmethod
+    def default_lan() -> "ClusterConfig":
+        return ClusterConfig()
+
+    @staticmethod
+    def default_wan() -> "ClusterConfig":
+        return ClusterConfig(
+            failure_detector=FailureDetectorConfig.default_wan(),
+            gossip=GossipConfig.default_wan(),
+            membership=MembershipConfig.default_wan(),
+        )
+
+    @staticmethod
+    def default_local() -> "ClusterConfig":
+        return ClusterConfig(
+            failure_detector=FailureDetectorConfig.default_local(),
+            gossip=GossipConfig.default_local(),
+            membership=MembershipConfig.default_local(),
+        )
+
+    @staticmethod
+    def default_sim() -> "ClusterConfig":
+        """Profile for the vectorized simulation: local-ish timers, tick-aligned."""
+        cfg = ClusterConfig.default_local()
+        return dataclasses.replace(cfg, sim=SimConfig(tick_interval=cfg.gossip.gossip_interval))
+
+    # -- functional lenses over sub-configs --------------------------------
+    def with_failure_detector(self, op: Lens) -> "ClusterConfig":
+        return replace(self, failure_detector=op(self.failure_detector))
+
+    def with_gossip(self, op: Lens) -> "ClusterConfig":
+        return replace(self, gossip=op(self.gossip))
+
+    def with_membership(self, op: Lens) -> "ClusterConfig":
+        return replace(self, membership=op(self.membership))
+
+    def with_transport(self, op: Lens) -> "ClusterConfig":
+        return replace(self, transport=op(self.transport))
+
+    def with_sim(self, op: Lens) -> "ClusterConfig":
+        return replace(self, sim=op(self.sim))
+
+    def replace(self, **kw) -> "ClusterConfig":
+        return replace(self, **kw)
+
+    # -- validation (reference ClusterImpl.validateConfiguration :314-354) -
+    def validate(self) -> "ClusterConfig":
+        validate_namespace(self.membership.namespace)
+        if self.failure_detector.ping_interval <= 0:
+            raise ValueError("ping_interval must be > 0")
+        if self.failure_detector.ping_timeout <= 0:
+            raise ValueError("ping_timeout must be > 0")
+        if self.failure_detector.ping_timeout >= self.failure_detector.ping_interval:
+            raise ValueError("ping_timeout must be < ping_interval")
+        if self.gossip.gossip_interval <= 0:
+            raise ValueError("gossip_interval must be > 0")
+        if self.gossip.gossip_fanout <= 0:
+            raise ValueError("gossip_fanout must be > 0")
+        if self.gossip.gossip_repeat_mult <= 0:
+            raise ValueError("gossip_repeat_mult must be > 0")
+        if self.membership.sync_interval <= 0:
+            raise ValueError("sync_interval must be > 0")
+        if self.membership.suspicion_mult <= 0:
+            raise ValueError("suspicion_mult must be > 0")
+        if self.metadata_timeout <= 0:
+            raise ValueError("metadata_timeout must be > 0")
+        return self
+
+
+def suspicion_timeout_for(config: ClusterConfig, cluster_size: int) -> float:
+    """Suspicion timeout derived from config + cluster size (seconds)."""
+    from .utils.cluster_math import suspicion_timeout
+
+    return suspicion_timeout(
+        config.membership.suspicion_mult, cluster_size, config.failure_detector.ping_interval
+    )

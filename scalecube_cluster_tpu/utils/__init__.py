@@ -1,0 +1,11 @@
+from . import cluster_math
+from .intervals import SequenceIdCollector
+from .namespaces import are_namespaces_related, is_valid_namespace, validate_namespace
+
+__all__ = [
+    "cluster_math",
+    "SequenceIdCollector",
+    "are_namespaces_related",
+    "is_valid_namespace",
+    "validate_namespace",
+]

@@ -1,0 +1,66 @@
+"""ClusterMath parity tests — values cross-checked against the analytic table
+in BASELINE.md (derived from reference ClusterMath.java)."""
+
+import math
+
+import pytest
+
+from scalecube_cluster_tpu.utils import cluster_math as cm
+
+
+def test_ceil_log2_matches_reference_bit_trick():
+    # reference: 32 - numberOfLeadingZeros(n) == bit_length(n)
+    assert cm.ceil_log2(0) == 0
+    assert cm.ceil_log2(1) == 1
+    assert cm.ceil_log2(2) == 2
+    assert cm.ceil_log2(3) == 2
+    assert cm.ceil_log2(4) == 3
+    assert cm.ceil_log2(255) == 8
+    assert cm.ceil_log2(256) == 9
+    assert cm.ceil_log2(100_000) == 17
+
+
+@pytest.mark.parametrize(
+    "n,expected_rounds",
+    [(256, 27), (1000, 30), (10_000, 42), (100_000, 51)],
+)
+def test_gossip_periods_to_spread_baseline_table(n, expected_rounds):
+    assert cm.gossip_periods_to_spread(3, n) == expected_rounds
+
+
+@pytest.mark.parametrize("n,expected", [(256, 56), (1000, 62), (10_000, 86), (100_000, 104)])
+def test_gossip_periods_to_sweep_baseline_table(n, expected):
+    assert cm.gossip_periods_to_sweep(3, n) == expected
+
+
+@pytest.mark.parametrize("n,expected", [(256, 81), (1000, 90), (10_000, 126), (100_000, 153)])
+def test_max_messages_per_node_baseline_table(n, expected):
+    assert cm.max_messages_per_gossip_per_node(3, 3, n) == expected
+    assert cm.max_messages_per_gossip_total(3, 3, n) == n * expected
+
+
+def test_dissemination_time():
+    assert cm.gossip_dissemination_time(3, 10_000, 0.2) == pytest.approx(8.4)
+    assert cm.gossip_dissemination_time(3, 100_000, 0.2) == pytest.approx(10.2)
+
+
+def test_suspicion_timeout():
+    assert cm.suspicion_timeout(5, 256, 1.0) == pytest.approx(45.0)
+    assert cm.suspicion_timeout(3, 256, 1.0) == pytest.approx(27.0)
+
+
+def test_convergence_probability_monotone_in_loss():
+    # N small enough that the loss term is above float epsilon
+    p0 = cm.gossip_convergence_probability(3, 3, 10, 0.0)
+    p25 = cm.gossip_convergence_probability(3, 3, 10, 0.25)
+    p50 = cm.gossip_convergence_probability(3, 3, 10, 0.50)
+    assert p0 > p25 > p50
+    assert 0.999 < p0 <= 1.0
+    assert cm.gossip_convergence_percent(3, 3, 10, 0.0) == pytest.approx(p0 * 100)
+
+
+def test_convergence_probability_formula():
+    # direct formula check: (N - N^-(f(1-loss)*mult - 2)) / N
+    n, f, m, loss = 1000, 3, 3, 0.1
+    expected = (n - math.pow(n, -((1 - loss) * f * m - 2))) / n
+    assert cm.gossip_convergence_probability(f, m, n, loss) == pytest.approx(expected)

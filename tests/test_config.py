@@ -1,0 +1,80 @@
+"""Config profile and validation tests (reference ClusterConfig profiles +
+ClusterImpl.validateConfiguration + ClusterNamespacesTest invalid formats)."""
+
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.utils.namespaces import (
+    are_namespaces_related,
+    is_valid_namespace,
+)
+
+
+def test_lan_defaults():
+    c = ClusterConfig.default_lan().validate()
+    assert c.failure_detector.ping_interval == 1.0
+    assert c.failure_detector.ping_timeout == 0.5
+    assert c.failure_detector.ping_req_members == 3
+    assert c.gossip.gossip_interval == 0.2
+    assert c.gossip.gossip_fanout == 3
+    assert c.gossip.gossip_repeat_mult == 3
+    assert c.membership.sync_interval == 30.0
+    assert c.membership.suspicion_mult == 5
+    assert c.membership.removed_members_history_size == 42
+
+
+def test_wan_profile():
+    c = ClusterConfig.default_wan().validate()
+    assert c.failure_detector.ping_interval == 5.0
+    assert c.failure_detector.ping_timeout == 3.0
+    assert c.gossip.gossip_fanout == 4
+    assert c.membership.sync_interval == 60.0
+    assert c.membership.suspicion_mult == 6
+
+
+def test_local_profile():
+    c = ClusterConfig.default_local().validate()
+    assert c.failure_detector.ping_timeout == 0.2
+    assert c.failure_detector.ping_req_members == 1
+    assert c.gossip.gossip_interval == 0.1
+    assert c.gossip.gossip_repeat_mult == 2
+    assert c.membership.sync_interval == 15.0
+    assert c.membership.suspicion_mult == 3
+
+
+def test_copy_on_write_lenses():
+    c0 = ClusterConfig.default_lan()
+    c1 = c0.with_gossip(lambda g: g.replace(gossip_fanout=7))
+    assert c0.gossip.gossip_fanout == 3
+    assert c1.gossip.gossip_fanout == 7
+    assert c1.failure_detector == c0.failure_detector
+
+
+def test_validation_rejects_bad_namespace():
+    c = ClusterConfig.default_lan().with_membership(lambda m: m.replace(namespace="-bad-"))
+    with pytest.raises(ValueError):
+        c.validate()
+
+
+@pytest.mark.parametrize("ns", ["develop", "develop/reg-1", "a/b/c", "x1/y-2.z"])
+def test_valid_namespaces(ns):
+    assert is_valid_namespace(ns)
+
+
+@pytest.mark.parametrize("ns", ["", "/", "/a", "a b", "-a", "a-", "$x"])
+def test_invalid_namespaces(ns):
+    assert not is_valid_namespace(ns)
+
+
+def test_namespace_relatedness_hierarchy():
+    assert are_namespaces_related("develop", "develop")
+    assert are_namespaces_related("develop", "develop/reg-1")
+    assert are_namespaces_related("develop/reg-1/zone-2", "develop")
+    assert not are_namespaces_related("develop", "master")
+    assert not are_namespaces_related("develop/reg-1", "develop/reg-2")
+    assert not are_namespaces_related("develop/reg-1", "master/reg-1")
+
+
+def test_sim_profile_tick_aligned():
+    c = ClusterConfig.default_sim()
+    assert c.sim.tick_interval == c.gossip.gossip_interval

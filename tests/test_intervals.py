@@ -1,0 +1,60 @@
+"""SequenceIdCollector tests — mirrors reference SequenceIdCollectorTest
+(interval merging, duplicate detection, segmentation count)."""
+
+import random
+
+from scalecube_cluster_tpu.utils.intervals import SequenceIdCollector
+
+
+def test_add_and_duplicate():
+    c = SequenceIdCollector()
+    assert c.add(5)
+    assert not c.add(5)
+    assert 5 in c
+    assert 4 not in c
+
+
+def test_contiguous_merge_forward():
+    c = SequenceIdCollector()
+    for i in range(10):
+        assert c.add(i)
+    assert c.size() == 1
+    assert c.intervals() == [(0, 9)]
+
+
+def test_gap_then_bridge():
+    c = SequenceIdCollector()
+    c.add(1)
+    c.add(3)
+    assert c.size() == 2
+    c.add(2)  # bridges [1,1] and [3,3]
+    assert c.size() == 1
+    assert c.intervals() == [(1, 3)]
+
+
+def test_extend_next_interval_backwards():
+    c = SequenceIdCollector()
+    c.add(10)
+    c.add(9)
+    assert c.intervals() == [(9, 10)]
+
+
+def test_random_permutation_converges_to_single_interval():
+    c = SequenceIdCollector()
+    ids = list(range(200))
+    random.Random(42).shuffle(ids)
+    for i in ids:
+        assert c.add(i)
+    for i in ids:
+        assert not c.add(i)
+    assert c.size() == 1
+    assert c.intervals() == [(0, 199)]
+
+
+def test_segmentation_count_tracks_gaps():
+    c = SequenceIdCollector()
+    for i in range(0, 100, 2):  # all evens: 50 singleton intervals
+        c.add(i)
+    assert c.size() == 50
+    c.clear()
+    assert c.size() == 0

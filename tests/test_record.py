@@ -1,0 +1,80 @@
+"""MembershipRecord precedence-lattice tests.
+
+Mirrors reference ``MembershipRecordTest`` scenarios plus an exhaustive sweep
+of the (status, incarnation) truth table — the same table the vectorized
+kernel must reproduce (see test_ops_lattice.py)."""
+
+import itertools
+
+import pytest
+
+from scalecube_cluster_tpu.models.member import Member, MemberStatus
+from scalecube_cluster_tpu.models.record import MembershipRecord, overrides_codes
+
+A = Member(id="a", address="127.0.0.1:1")
+B = Member(id="b", address="127.0.0.1:2")
+
+
+def r(status, inc, member=A):
+    return MembershipRecord(member, status, inc)
+
+
+def test_vs_absent_record_only_alive_or_leaving():
+    assert r(MemberStatus.ALIVE, 0).overrides(None)
+    assert r(MemberStatus.LEAVING, 0).overrides(None)
+    assert not r(MemberStatus.SUSPECT, 0).overrides(None)
+    assert not r(MemberStatus.DEAD, 0).overrides(None)
+
+
+def test_identical_record_never_overrides():
+    for s in MemberStatus:
+        assert not r(s, 3).overrides(r(s, 3))
+
+
+def test_dead_is_absorbing():
+    for s in MemberStatus:
+        for inc in (0, 5):
+            # nothing overrides DEAD
+            assert not r(s, inc).overrides(r(MemberStatus.DEAD, 1))
+    # DEAD overrides everything not DEAD, regardless of incarnation
+    for s in (MemberStatus.ALIVE, MemberStatus.SUSPECT, MemberStatus.LEAVING):
+        assert r(MemberStatus.DEAD, 0).overrides(r(s, 99))
+
+
+def test_higher_incarnation_wins():
+    assert r(MemberStatus.ALIVE, 2).overrides(r(MemberStatus.SUSPECT, 1))
+    assert r(MemberStatus.ALIVE, 2).overrides(r(MemberStatus.ALIVE, 1))
+    assert not r(MemberStatus.ALIVE, 1).overrides(r(MemberStatus.SUSPECT, 2))
+
+
+def test_equal_incarnation_suspect_beats_alive_and_leaving():
+    assert r(MemberStatus.SUSPECT, 1).overrides(r(MemberStatus.ALIVE, 1))
+    assert r(MemberStatus.SUSPECT, 1).overrides(r(MemberStatus.LEAVING, 1))
+    assert not r(MemberStatus.ALIVE, 1).overrides(r(MemberStatus.SUSPECT, 1))
+    assert not r(MemberStatus.LEAVING, 1).overrides(r(MemberStatus.ALIVE, 1))
+    assert not r(MemberStatus.ALIVE, 1).overrides(r(MemberStatus.LEAVING, 1))
+
+
+def test_cross_member_comparison_rejected():
+    with pytest.raises(ValueError):
+        r(MemberStatus.ALIVE, 0).overrides(MembershipRecord(B, MemberStatus.ALIVE, 0))
+
+
+def test_overrides_codes_matches_object_form_exhaustively():
+    statuses = list(MemberStatus)
+    incs = [0, 1, 2]
+    for ns, ni, os_, oi in itertools.product(statuses, incs, statuses, incs):
+        obj = r(ns, ni).overrides(r(os_, oi))
+        code = overrides_codes(int(ns), ni, int(os_), oi)
+        assert obj == code, f"mismatch at new=({ns},{ni}) old=({os_},{oi})"
+
+
+def test_no_override_cycles_at_equal_incarnation():
+    # antisymmetry: for distinct records at same incarnation, at most one direction overrides
+    statuses = list(MemberStatus)
+    for s1, s2 in itertools.product(statuses, statuses):
+        if s1 == s2:
+            continue
+        fwd = r(s1, 1).overrides(r(s2, 1))
+        bwd = r(s2, 1).overrides(r(s1, 1))
+        assert not (fwd and bwd)
