@@ -1,0 +1,36 @@
+from . import codecs, local, tcp  # register factories/codecs (ServiceLoader analogue)
+from .api import (
+    Listeners,
+    PeerUnavailableError,
+    Transport,
+    TransportError,
+    bind_transport,
+    create_transport,
+    register_transport_factory,
+    transport_factories,
+)
+from .emulator import (
+    NetworkEmulator,
+    NetworkEmulatorError,
+    NetworkEmulatorTransport,
+)
+from .local import MemoryTransport, MemoryTransportRegistry
+from .tcp import TcpTransport
+
+__all__ = [
+    "Transport",
+    "TransportError",
+    "PeerUnavailableError",
+    "Listeners",
+    "bind_transport",
+    "create_transport",
+    "register_transport_factory",
+    "transport_factories",
+    "NetworkEmulator",
+    "NetworkEmulatorError",
+    "NetworkEmulatorTransport",
+    "MemoryTransport",
+    "MemoryTransportRegistry",
+    "TcpTransport",
+    "codecs",
+]

@@ -1,0 +1,158 @@
+"""NetworkEmulator tests — mirror reference NetworkEmulatorTest (settings
+resolution) plus decorator behavior: loss, block in/out, counters, sender
+stamping."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import TransportConfig
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulator,
+    NetworkEmulatorError,
+    NetworkEmulatorTransport,
+    bind_transport,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+async def emu_pair():
+    a = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+    b = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+    return a, b
+
+
+def test_settings_resolution():
+    em = NetworkEmulator("mem://0")
+    assert em.outbound_settings("x").loss_percent == 0
+    em.set_default_outbound_settings(25, 0.1)
+    assert em.outbound_settings("x").loss_percent == 25
+    em.set_outbound_settings("y", 50, 0.2)
+    assert em.outbound_settings("y").loss_percent == 50
+    assert em.outbound_settings("x").loss_percent == 25
+    assert em.inbound_settings("x").shall_pass
+    em.block_all_inbound()
+    assert not em.inbound_settings("x").shall_pass
+    em.unblock_all_inbound()
+    assert em.inbound_settings("x").shall_pass
+
+
+def test_full_loss_drops_and_counts():
+    async def run():
+        a, b = await emu_pair()
+        try:
+            a.network_emulator.block_outbound([b.address])
+            with pytest.raises(NetworkEmulatorError):
+                await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+            assert a.network_emulator.total_message_sent_count == 1
+            assert a.network_emulator.total_message_lost_count == 1
+            a.network_emulator.unblock_outbound([b.address])
+            inbox = b.listen().stream()
+            await a.send(b.address, Message.with_data("y", qualifier="q/y"))
+            msg = await asyncio.wait_for(inbox.get(), 2)
+            assert msg.data == "y"
+            assert a.network_emulator.total_message_lost_count == 1
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_sender_header_stamped():
+    async def run():
+        a, b = await emu_pair()
+        try:
+            inbox = b.listen().stream()
+            await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+            msg = await asyncio.wait_for(inbox.get(), 2)
+            assert msg.sender == a.address
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_inbound_block_filters_listen():
+    async def run():
+        a, b = await emu_pair()
+        try:
+            b.network_emulator.block_inbound([a.address])
+            got = []
+            b.listen().subscribe(lambda m: got.append(m))
+            await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+            await asyncio.sleep(0.05)
+            assert got == []
+            b.network_emulator.unblock_inbound([a.address])
+            await a.send(b.address, Message.with_data("y", qualifier="q/y"))
+            await asyncio.sleep(0.05)
+            assert [m.data for m in got] == ["y"]
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_statistical_loss_rate():
+    """50% loss: drop count within binomial bounds (seeded RNG)."""
+
+    async def run():
+        a, b = await emu_pair()
+        try:
+            a.network_emulator._rng.seed(7)
+            a.network_emulator.set_default_outbound_settings(50, 0)
+            lost = 0
+            for _ in range(400):
+                try:
+                    await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+                except NetworkEmulatorError:
+                    lost += 1
+            assert 140 <= lost <= 260  # ~6 sigma around 200
+            assert a.network_emulator.total_message_lost_count == lost
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_exponential_delay_applied():
+    async def run():
+        a, b = await emu_pair()
+        try:
+            a.network_emulator.set_outbound_settings(b.address, 0, 0.01)
+            inbox = b.listen().stream()
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            for _ in range(20):
+                await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+            for _ in range(20):
+                await asyncio.wait_for(inbox.get(), 5)
+            assert loop.time() - t0 > 0.02  # some cumulative delay observed
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_codec_roundtrip():
+    from scalecube_cluster_tpu.transport.codecs import message_codec
+
+    for name in ("jdk", "json"):
+        codec = message_codec(name)
+        msg = Message.with_data({"k": [1, 2, 3]}, qualifier="q/x", cid="42")
+        out = codec.decode(codec.encode(msg))
+        assert out.data == {"k": [1, 2, 3]}
+        assert out.qualifier == "q/x"
+        assert out.correlation_id == "42"

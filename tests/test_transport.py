@@ -1,0 +1,162 @@
+"""Transport SPI tests — mirror reference TcpTransportTest /
+TcpTransportSendOrderTest scenarios over both the memory and tcp transports:
+request/response, ping-pong, unresolved peer, send-after-stop, 1000-message
+ordering."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import TransportConfig
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    PeerUnavailableError,
+    TransportError,
+    bind_transport,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+FACTORIES = ["memory", "tcp"]
+
+
+def cfg(factory):
+    return TransportConfig(transport_factory=factory)
+
+
+async def start_pair(factory):
+    a = await bind_transport(cfg(factory))
+    b = await bind_transport(cfg(factory))
+    return a, b
+
+
+@pytest.mark.parametrize("factory", FACTORIES)
+def test_send_and_listen(factory):
+    async def run():
+        a, b = await start_pair(factory)
+        try:
+            inbox = b.listen().stream()
+            await a.send(b.address, Message.with_data("hello", qualifier="q/hi"))
+            msg = await asyncio.wait_for(inbox.get(), 2)
+            assert msg.data == "hello"
+            assert msg.qualifier == "q/hi"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("factory", FACTORIES)
+def test_request_response(factory):
+    async def run():
+        a, b = await start_pair(factory)
+        try:
+            def echo(msg):
+                if msg.qualifier == "q/echo":
+                    reply = Message.with_data(
+                        msg.data + "-pong", qualifier="q/echo-ack", cid=msg.correlation_id
+                    )
+                    asyncio.ensure_future(b.send(msg.header("reply_to"), reply))
+
+            b.listen().subscribe(echo)
+            req = Message.with_data("ping", qualifier="q/echo", reply_to=a.address)
+            resp = await a.request_response(b.address, req, timeout=2)
+            assert resp.data == "ping-pong"
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("factory", FACTORIES)
+def test_request_response_timeout(factory):
+    async def run():
+        a, b = await start_pair(factory)
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await a.request_response(
+                    b.address, Message.with_data(None, qualifier="q/noreply"), timeout=0.1
+                )
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize(
+    "factory,bogus",
+    [("memory", "mem://99999"), ("tcp", "tcp://127.0.0.1:1")],
+)
+def test_unreachable_peer(factory, bogus):
+    async def run():
+        a = await bind_transport(cfg(factory))
+        try:
+            with pytest.raises(PeerUnavailableError):
+                await a.send(bogus, Message.with_data("x", qualifier="q/x"))
+        finally:
+            await a.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("factory", FACTORIES)
+def test_send_after_stop_rejected(factory):
+    async def run():
+        a, b = await start_pair(factory)
+        await a.stop()
+        with pytest.raises(TransportError):
+            await a.send(b.address, Message.with_data("x", qualifier="q/x"))
+        await b.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("factory", FACTORIES)
+def test_send_order_1000_messages(factory):
+    """Reference TcpTransportSendOrderTest.java:42-220 — in-order delivery."""
+
+    async def run():
+        a, b = await start_pair(factory)
+        try:
+            received = []
+            done = asyncio.Event()
+
+            def collect(msg):
+                received.append(msg.data)
+                if len(received) == 1000:
+                    done.set()
+
+            b.listen().subscribe(collect)
+            for i in range(1000):
+                await a.send(b.address, Message.with_data(i, qualifier="q/seq"))
+            await asyncio.wait_for(done.wait(), 10)
+            assert received == list(range(1000))
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_memory_fixed_port_rebind():
+    """Restart-on-same-address scenario (reference ClusterTest fixed port)."""
+
+    async def run():
+        t1 = await bind_transport(TransportConfig(port=4801, transport_factory="memory"))
+        assert t1.address == "mem://4801"
+        await t1.stop()
+        t2 = await bind_transport(TransportConfig(port=4801, transport_factory="memory"))
+        assert t2.address == "mem://4801"
+        await t2.stop()
+
+    asyncio.run(run())
