@@ -41,6 +41,7 @@ SYSTEM_QUALIFIERS = frozenset(
         Q_PING,
         Q_PING_REQ,
         Q_PING_ACK,
+        Q_GOSSIP_REQ,
         Q_MEMBERSHIP_SYNC,
         Q_MEMBERSHIP_SYNC_ACK,
         Q_METADATA_REQ,

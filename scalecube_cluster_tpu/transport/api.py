@@ -16,14 +16,17 @@ sockets without protocol changes.
 from __future__ import annotations
 
 import asyncio
-import itertools
 from abc import ABC, abstractmethod
-from typing import Any, Awaitable, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from ..config import TransportConfig
 from ..models.message import HEADER_CORRELATION_ID, Message, new_correlation_id
+from ..utils.streams import EventStream
 
 MessageHandler = Callable[[Message], Any]
+
+#: Hot fan-out of inbound messages (the ``listen()`` flux analogue).
+Listeners = EventStream  # type: ignore[misc]
 
 
 class TransportError(Exception):
@@ -32,42 +35,6 @@ class TransportError(Exception):
 
 class PeerUnavailableError(TransportError):
     """Destination address cannot be reached (no such peer / connect refused)."""
-
-
-class Listeners:
-    """Hot fan-out of inbound messages (the ``listen()`` flux analogue).
-
-    Subscribers are sync callbacks invoked in subscription order on the event
-    loop; exceptions in one subscriber do not affect others.
-    """
-
-    def __init__(self) -> None:
-        self._subs: Dict[int, MessageHandler] = {}
-        self._ids = itertools.count()
-
-    def subscribe(self, handler: MessageHandler) -> Callable[[], None]:
-        sid = next(self._ids)
-        self._subs[sid] = handler
-
-        def unsubscribe() -> None:
-            self._subs.pop(sid, None)
-
-        return unsubscribe
-
-    def emit(self, message: Message) -> None:
-        for handler in list(self._subs.values()):
-            try:
-                handler(message)
-            except Exception:  # noqa: BLE001 - one bad subscriber must not break fan-out
-                import logging
-
-                logging.getLogger(__name__).exception("listener failed on %s", message)
-
-    def stream(self) -> "asyncio.Queue[Message]":
-        """Queue-backed view of the stream (for tests / user iteration)."""
-        q: asyncio.Queue[Message] = asyncio.Queue()
-        self.subscribe(q.put_nowait)
-        return q
 
 
 class Transport(ABC):

@@ -1,0 +1,215 @@
+"""Facade-level integration tests over the loopback transport — the
+Alice/Bob/Carol joinAwait scenario of the reference README quick-start
+(README.md:22-37) plus scenarios from reference ClusterTest: metadata
+propagation via UPDATED events, graceful shutdown -> LEAVING/REMOVED,
+messaging, gossip."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.transport import MemoryTransportRegistry
+from scalecube_cluster_tpu.cluster import new_cluster
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+def make_test_config():
+    """Shrunk timers (reference MembershipProtocolTest.java:49-50 style)."""
+    return (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(sync_interval=0.5, sync_timeout=0.5))
+        .with_failure_detector(
+            lambda f: f.replace(ping_interval=0.2, ping_timeout=0.1, ping_req_members=2)
+        )
+        .with_gossip(lambda g: g.replace(gossip_interval=0.05))
+    )
+
+
+async def start_cluster(seeds=(), metadata=None, alias=None):
+    cfg = make_test_config().with_membership(lambda m: m.replace(seed_members=list(seeds)))
+    if metadata is not None:
+        cfg = cfg.replace(metadata=metadata)
+    if alias is not None:
+        cfg = cfg.replace(member_alias=alias)
+    return await new_cluster(cfg).start()
+
+
+async def await_until(predicate, timeout=5.0, interval=0.05):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def test_alice_bob_carol_join():
+    """Driver config #1: 3-node joinAwait over loopback."""
+
+    async def run():
+        alice = await start_cluster(alias="Alice", metadata={"name": "Alice"})
+        bob = await start_cluster([alice.address], alias="Bob", metadata={"name": "Bob"})
+        carol = await start_cluster(
+            [alice.address, bob.address], alias="Carol", metadata={"name": "Carol"}
+        )
+        try:
+            assert await await_until(
+                lambda: len(alice.members()) == 3
+                and len(bob.members()) == 3
+                and len(carol.members()) == 3
+            ), f"sizes: {len(alice.members())},{len(bob.members())},{len(carol.members())}"
+            # metadata visible everywhere
+            bob_seen_by_alice = alice.member_by_address(bob.address)
+            assert bob_seen_by_alice is not None
+            assert alice.metadata_of(bob_seen_by_alice) == {"name": "Bob"}
+            carol_seen_by_bob = bob.member_by_address(carol.address)
+            assert bob.metadata_of(carol_seen_by_bob) == {"name": "Carol"}
+            # member lookup by id
+            assert alice.member_by_id(bob.member().id) == bob.member()
+        finally:
+            await asyncio.gather(alice.shutdown(), bob.shutdown(), carol.shutdown())
+
+    asyncio.run(run())
+
+
+def test_messaging_between_members():
+    """Reference MessagingExample: send + request_response via cluster API."""
+
+    async def run():
+        alice = await start_cluster()
+        bob = await start_cluster([alice.address])
+        try:
+            await await_until(lambda: len(bob.other_members()) == 1)
+            inbox = alice.listen_messages().stream()
+
+            def responder(msg):
+                if msg.qualifier == "greeting":
+                    reply = Message.with_data(
+                        f"hello {msg.data}", qualifier="greeting-ack", cid=msg.correlation_id
+                    )
+                    asyncio.ensure_future(alice.send(msg.sender, reply))
+
+            alice.listen_messages().subscribe(responder)
+            # fire-and-forget
+            await bob.send(alice.member_by_address(alice.address) or alice.member(),
+                           Message.with_data("ping", qualifier="notify"))
+            msg = await asyncio.wait_for(inbox.get(), 2)
+            assert msg.data == "ping"
+            # request-response
+            resp = await bob.request_response(
+                alice.address, Message.with_data("bob", qualifier="greeting"), timeout=2
+            )
+            assert resp.data == "hello bob"
+        finally:
+            await asyncio.gather(alice.shutdown(), bob.shutdown())
+
+    asyncio.run(run())
+
+
+def test_gossip_delivery():
+    """Reference GossipExample: user rumor reaches all other members."""
+
+    async def run():
+        alice = await start_cluster()
+        bob = await start_cluster([alice.address])
+        carol = await start_cluster([alice.address])
+        try:
+            await await_until(
+                lambda: len(alice.members()) == 3 and len(bob.members()) == 3 and len(carol.members()) == 3
+            )
+            got_bob, got_carol = [], []
+            bob.listen_gossip().subscribe(lambda m: got_bob.append(m.data))
+            carol.listen_gossip().subscribe(lambda m: got_carol.append(m.data))
+            fut = alice.spread_gossip(Message.with_data("rumor-1", qualifier="news"))
+            assert await await_until(lambda: got_bob == ["rumor-1"] and got_carol == ["rumor-1"])
+            await asyncio.wait_for(fut, 10)  # spread future resolves
+        finally:
+            await asyncio.gather(alice.shutdown(), bob.shutdown(), carol.shutdown())
+
+    asyncio.run(run())
+
+
+def test_metadata_update_propagates():
+    """Reference ClusterTest metadata update -> UPDATED event at peers."""
+
+    async def run():
+        alice = await start_cluster(metadata={"v": 1})
+        bob = await start_cluster([alice.address])
+        try:
+            await await_until(lambda: len(bob.other_members()) == 1)
+            updated = []
+            bob.listen_membership().subscribe(
+                lambda e: updated.append(e) if e.is_updated else None
+            )
+            await alice.update_metadata({"v": 2})
+            assert await await_until(lambda: len(updated) >= 1)
+            alice_at_bob = bob.member_by_address(alice.address)
+            assert await await_until(lambda: bob.metadata_of(alice_at_bob) == {"v": 2})
+        finally:
+            await asyncio.gather(alice.shutdown(), bob.shutdown())
+
+    asyncio.run(run())
+
+
+def test_graceful_shutdown_emits_leaving_and_removed():
+    """Reference ClusterTest graceful shutdown -> LEAVING observed."""
+
+    async def run():
+        alice = await start_cluster()
+        bob = await start_cluster([alice.address])
+        try:
+            await await_until(lambda: len(alice.other_members()) == 1)
+            events = []
+            alice.listen_membership().subscribe(events.append)
+            await bob.shutdown()
+            assert await await_until(
+                lambda: any(e.is_leaving for e in events), timeout=5
+            ), f"events: {events}"
+            # After suspicion timeout the member is removed
+            assert await await_until(
+                lambda: any(e.is_removed for e in events), timeout=10
+            ), f"events: {events}"
+            assert alice.other_members() == []
+        finally:
+            await alice.shutdown()
+
+    asyncio.run(run())
+
+
+def test_self_seed_is_filtered():
+    """Reference: seed equal to own address must not break startup."""
+
+    async def run():
+        cfg = make_test_config().with_membership(
+            lambda m: m.replace(seed_members=["mem://1"])
+        )
+        alice = await new_cluster(cfg).start()  # gets mem://1 itself
+        try:
+            assert alice.address == "mem://1"
+            assert len(alice.members()) == 1
+        finally:
+            await alice.shutdown()
+
+    asyncio.run(run())
+
+
+def test_absent_seed_join_still_starts():
+    """Reference ClusterTest: joining a dead seed doesn't block startup."""
+
+    async def run():
+        alice = await start_cluster(seeds=["mem://7777"])
+        try:
+            assert len(alice.members()) == 1
+        finally:
+            await alice.shutdown()
+
+    asyncio.run(run())
