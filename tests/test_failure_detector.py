@@ -1,0 +1,161 @@
+"""Component-level FD tests — reference FailureDetectorTest pattern: real FD
+instances over emulator-wrapped loopback transports, membership fed by a
+synthetic ADDED stream (FailureDetectorTest.java:415-427)."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import FailureDetectorConfig, TransportConfig
+from scalecube_cluster_tpu.models.events import MembershipEvent
+from scalecube_cluster_tpu.models.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster.failure_detector import FailureDetector
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+    bind_transport,
+)
+from scalecube_cluster_tpu.utils.streams import EventStream
+
+FD_CONFIG = FailureDetectorConfig(ping_interval=0.2, ping_timeout=0.1, ping_req_members=2)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+async def make_fd_network(n, config=FD_CONFIG):
+    """n FD instances, fully meshed via synthetic ADDED events."""
+    transports, members = [], []
+    for i in range(n):
+        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+        transports.append(t)
+        members.append(Member(id=f"m{i}", address=t.address))
+    fds, verdicts = [], []
+    for i in range(n):
+        events = EventStream()
+        fd = FailureDetector(members[i], transports[i], events, config)
+        log = []
+        fd.listen().subscribe(lambda e, log=log: log.append(e))
+        for j in range(n):
+            if j != i:
+                events.emit(MembershipEvent.added(members[j]))
+        fds.append(fd)
+        verdicts.append(log)
+    return transports, members, fds, verdicts
+
+
+async def stop_all(transports, fds):
+    for fd in fds:
+        fd.stop()
+    for t in transports:
+        await t.stop()
+
+
+async def await_until(predicate, timeout=5.0, interval=0.05):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def last_status_for(verdict_log, member):
+    statuses = [e.status for e in verdict_log if e.member.id == member.id]
+    return statuses[-1] if statuses else None
+
+
+def test_trusted_trio_all_alive():
+    """Reference testTrusted: healthy trio yields only ALIVE verdicts."""
+
+    async def run():
+        transports, members, fds, verdicts = await make_fd_network(3)
+        try:
+            for fd in fds:
+                fd.start()
+            await asyncio.sleep(1.5)
+            for i in range(3):
+                assert verdicts[i], f"node {i} produced no verdicts"
+                assert all(e.status == MemberStatus.ALIVE for e in verdicts[i]), verdicts[i]
+        finally:
+            await stop_all(transports, fds)
+
+    asyncio.run(run())
+
+
+def test_fully_blocked_member_suspected():
+    """Block every link to/from node 2 -> others verdict SUSPECT."""
+
+    async def run():
+        transports, members, fds, verdicts = await make_fd_network(3)
+        try:
+            for t in (transports[0], transports[1]):
+                t.network_emulator.block_outbound([members[2].address])
+            transports[2].network_emulator.block_all_outbound()
+            for fd in fds:
+                fd.start()
+            assert await await_until(
+                lambda: last_status_for(verdicts[0], members[2]) == MemberStatus.SUSPECT
+                and last_status_for(verdicts[1], members[2]) == MemberStatus.SUSPECT,
+                timeout=5,
+            )
+            # nodes 0<->1 still trust each other
+            assert last_status_for(verdicts[0], members[1]) in (None, MemberStatus.ALIVE)
+            assert last_status_for(verdicts[1], members[0]) in (None, MemberStatus.ALIVE)
+        finally:
+            await stop_all(transports, fds)
+
+    asyncio.run(run())
+
+
+def test_indirect_probe_saves_one_way_partition():
+    """Block only the direct 0->2 link: relay 1 confirms 2 is ALIVE
+    (the heart of SWIM's indirect probing)."""
+
+    async def run():
+        transports, members, fds, verdicts = await make_fd_network(3)
+        try:
+            transports[0].network_emulator.block_outbound([members[2].address])
+            for fd in fds:
+                fd.start()
+            # wait until node 0 has actually probed node 2 a few times
+            await asyncio.sleep(2.0)
+            statuses = [e.status for e in verdicts[0] if e.member.id == members[2].id]
+            assert statuses, "node 0 never probed node 2"
+            assert MemberStatus.ALIVE in statuses, statuses
+            assert MemberStatus.DEAD not in statuses
+        finally:
+            await stop_all(transports, fds)
+
+    asyncio.run(run())
+
+
+def test_restarted_member_detected_dead():
+    """A different member id answering on the same address -> DEST_GONE -> DEAD
+    (reference restart-on-same-port scenario)."""
+
+    async def run():
+        transports, members, fds, verdicts = await make_fd_network(2)
+        try:
+            # Replace node 1's FD with one owning a *different* member id on
+            # the same transport/address.
+            fds[1].stop()
+            impostor = Member(id="m1-restarted", address=members[1].address)
+            events = EventStream()
+            fd_new = FailureDetector(impostor, transports[1], events, FD_CONFIG)
+            fds[1] = fd_new
+            fds[0].start()
+            fd_new.start()
+            assert await await_until(
+                lambda: last_status_for(verdicts[0], members[1]) == MemberStatus.DEAD,
+                timeout=5,
+            ), verdicts[0]
+        finally:
+            await stop_all(transports, fds)
+
+    asyncio.run(run())

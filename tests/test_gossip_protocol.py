@@ -1,0 +1,200 @@
+"""Component-level gossip tests — reference GossipProtocolTest pattern:
+parameterized {N, loss%, delay} experiment matrix over emulator transports
+(GossipProtocolTest.java:47-63); asserts full delivery, zero double delivery,
+and a dissemination-time bound (:146-208). Also the GossipDelayTest
+no-redelivery scenario."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import GossipConfig, TransportConfig
+from scalecube_cluster_tpu.models.events import MembershipEvent
+from scalecube_cluster_tpu.models.member import Member
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+    bind_transport,
+)
+from scalecube_cluster_tpu.utils.cluster_math import gossip_timeout_to_sweep
+from scalecube_cluster_tpu.utils.streams import EventStream
+
+GOSSIP_CONFIG = GossipConfig(gossip_interval=0.05, gossip_fanout=3, gossip_repeat_mult=3)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+async def make_gossip_network(n, loss_percent=0.0, mean_delay=0.002, config=GOSSIP_CONFIG):
+    transports, members = [], []
+    for i in range(n):
+        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+        t.network_emulator.set_default_outbound_settings(loss_percent, mean_delay)
+        transports.append(t)
+        members.append(Member(id=f"g{i}", address=t.address))
+    protocols, received = [], []
+    for i in range(n):
+        events = EventStream()
+        gp = GossipProtocol(members[i], transports[i], events, config)
+        inbox = []
+        gp.listen().subscribe(lambda m, inbox=inbox: inbox.append(m.data))
+        for j in range(n):
+            if j != i:
+                events.emit(MembershipEvent.added(members[j]))
+        protocols.append(gp)
+        received.append(inbox)
+    return transports, members, protocols, received
+
+
+async def stop_all(transports, protocols):
+    for gp in protocols:
+        gp.stop()
+    for t in transports:
+        await t.stop()
+
+
+async def await_until(predicate, timeout, interval=0.05):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+@pytest.mark.parametrize(
+    "n,loss",
+    [(4, 0.0), (10, 0.0), (10, 25.0), (20, 0.0), (20, 10.0)],
+)
+def test_gossip_full_delivery_matrix(n, loss):
+    """Experiment matrix: full delivery to N-1 members within 2x sweep
+    timeout, zero double delivery (reference :49-63, 155-174)."""
+
+    async def run():
+        transports, members, protocols, received = await make_gossip_network(n, loss)
+        try:
+            for gp in protocols:
+                gp.start()
+            protocols[0].spread(Message.with_data("payload", qualifier="test/rumor"))
+            sweep_time = gossip_timeout_to_sweep(
+                GOSSIP_CONFIG.gossip_repeat_mult, n, GOSSIP_CONFIG.gossip_interval
+            )
+            delivered = await await_until(
+                lambda: all(received[i] == ["payload"] for i in range(1, n)),
+                timeout=2 * sweep_time + 2,
+            )
+            counts = [len(received[i]) for i in range(1, n)]
+            assert delivered, f"delivery counts: {counts}"
+            # zero double delivery — wait one extra sweep to be sure
+            await asyncio.sleep(0.5)
+            assert all(len(received[i]) == 1 for i in range(1, n)), counts
+        finally:
+            await stop_all(transports, protocols)
+
+    asyncio.run(run())
+
+
+def test_multiple_rumors_all_delivered_once():
+    async def run():
+        n = 8
+        transports, members, protocols, received = await make_gossip_network(n)
+        try:
+            for gp in protocols:
+                gp.start()
+            for k in range(5):
+                protocols[k % n].spread(Message.with_data(f"r{k}", qualifier="test/rumor"))
+            ok = await await_until(
+                lambda: all(sorted(received[i]) == [f"r{k}" for k in range(5)] or
+                            len(received[i]) >= 5 - (1 if i == (0 % n) else 0)
+                            for i in range(n)),
+                timeout=10,
+            )
+            # originators don't deliver their own rumor to themselves
+            for k in range(5):
+                origin = k % n
+                expected = sorted(f"r{j}" for j in range(5) if j % n != origin)
+                assert sorted(received[origin]) == expected, (origin, received[origin])
+        finally:
+            await stop_all(transports, protocols)
+
+    asyncio.run(run())
+
+
+def test_spread_future_resolves_after_dissemination():
+    async def run():
+        transports, members, protocols, received = await make_gossip_network(4)
+        try:
+            for gp in protocols:
+                gp.start()
+            fut = protocols[0].spread(Message.with_data("x", qualifier="test/rumor"))
+            gid = await asyncio.wait_for(fut, 10)
+            assert gid == f"{members[0].id}-0"
+            assert all(received[i] == ["x"] for i in range(1, 4))
+        finally:
+            await stop_all(transports, protocols)
+
+    asyncio.run(run())
+
+
+def test_delayed_links_no_redelivery():
+    """Reference GossipDelayTest.java:33-70: mean delay comparable to sweep
+    time must not cause redelivery; slow node still gets all rumors."""
+
+    async def run():
+        n = 4
+        transports, members, protocols, received = await make_gossip_network(
+            n, loss_percent=0.0, mean_delay=0.0
+        )
+        try:
+            # node 3's inbound links are slow: delay ~ sweep time
+            for i in range(3):
+                transports[i].network_emulator.set_outbound_settings(
+                    members[3].address, 0.0, 0.4
+                )
+            for gp in protocols:
+                gp.start()
+            for k in range(3):
+                protocols[0].spread(Message.with_data(f"d{k}", qualifier="test/rumor"))
+            ok = await await_until(
+                lambda: all(len(received[i]) == 3 for i in range(1, n)), timeout=15
+            )
+            assert ok, [received[i] for i in range(n)]
+            await asyncio.sleep(1.0)  # late duplicates would land here
+            assert all(sorted(received[i]) == ["d0", "d1", "d2"] for i in range(1, n))
+        finally:
+            await stop_all(transports, protocols)
+
+    asyncio.run(run())
+
+
+def test_segmentation_counter():
+    """Dedup gap count is exposed (segmentation signal, reference
+    checkGossipSegmentation :217-236)."""
+
+    async def run():
+        transports, members, protocols, received = await make_gossip_network(2)
+        try:
+            gp = protocols[1]
+            # simulate receiving seq 0 and 2 from origin g0 (gap at 1)
+            from scalecube_cluster_tpu.cluster.gossip import Gossip, GossipRequest
+
+            req = GossipRequest(
+                [
+                    Gossip("g0", 0, Message.with_data("a", qualifier="x")),
+                    Gossip("g0", 2, Message.with_data("b", qualifier="x")),
+                ],
+                "g0",
+            )
+            gp._on_message(Message.with_data(req, qualifier="sc/gossip/req"))
+            assert gp.gossip_segmentation("g0") == 2
+        finally:
+            await stop_all(transports, protocols)
+
+    asyncio.run(run())

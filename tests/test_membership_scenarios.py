@@ -1,0 +1,241 @@
+"""Membership scenario families — reference MembershipProtocolTest: network
+partitions with recover/remove via emulator fault injection, restart on same
+address, namespace visibility (ClusterNamespacesTest)."""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig, TransportConfig
+from scalecube_cluster_tpu.models.member import MemberStatus
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+    MemoryTransport,
+)
+from scalecube_cluster_tpu.utils.cluster_math import suspicion_timeout
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+def make_test_config(seeds=(), namespace="default"):
+    return (
+        ClusterConfig.default_local()
+        .with_membership(
+            lambda m: m.replace(
+                seed_members=list(seeds), sync_interval=0.4, sync_timeout=0.4,
+                namespace=namespace,
+            )
+        )
+        .with_failure_detector(
+            lambda f: f.replace(ping_interval=0.2, ping_timeout=0.1, ping_req_members=2)
+        )
+        .with_gossip(lambda g: g.replace(gossip_interval=0.05))
+    )
+
+
+async def start_emulated(seeds=(), namespace="default", port=0):
+    """Cluster node whose transport is wrapped in NetworkEmulatorTransport
+    (reference BaseTest.createTransport, BaseTest.java:49-55)."""
+    emu = NetworkEmulatorTransport(MemoryTransport(TransportConfig(port=port)))
+    cluster = (
+        new_cluster(make_test_config(seeds, namespace)).transport_factory(lambda: emu)
+    )
+    started = await cluster.start()
+    return started, emu.network_emulator
+
+
+def awaited_suspicion(cluster_size):
+    """awaitSuspicion analogue (reference BaseTest.java:41-47)."""
+    return suspicion_timeout(3, cluster_size, 0.2) + 1.0
+
+
+async def await_until(predicate, timeout=5.0, interval=0.05):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def trusted(cluster):
+    return {r.member.id for r in cluster.membership_protocol.membership_records() if r.is_alive}
+
+
+def suspected(cluster):
+    return {r.member.id for r in cluster.membership_protocol.membership_records() if r.is_suspect}
+
+
+def test_initial_sync_trio_all_trusted():
+    async def run():
+        a, _ = await start_emulated()
+        b, _ = await start_emulated([a.address])
+        c, _ = await start_emulated([a.address])
+        try:
+            assert await await_until(
+                lambda: all(len(x.members()) == 3 for x in (a, b, c))
+            )
+            ids = {a.member().id, b.member().id, c.member().id}
+            for x in (a, b, c):
+                assert trusted(x) == ids
+                assert suspected(x) == set()
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_partition_then_recover_before_timeout():
+    """Block all links of one node -> SUSPECT at peers; unblock before
+    suspicion timeout -> trusted again, never removed
+    (reference partition-with-recover family)."""
+
+    async def run():
+        a, em_a = await start_emulated()
+        b, em_b = await start_emulated([a.address])
+        c, em_c = await start_emulated([a.address])
+        try:
+            await await_until(lambda: all(len(x.members()) == 3 for x in (a, b, c)))
+            removed = []
+            a.listen_membership().subscribe(lambda e: removed.append(e) if e.is_removed else None)
+            # isolate c
+            em_c.block_all_outbound()
+            em_c.block_all_inbound()
+            assert await await_until(
+                lambda: c.member().id in suspected(a) and c.member().id in suspected(b),
+                timeout=5,
+            ), f"a suspects {suspected(a)}, b suspects {suspected(b)}"
+            # recover quickly (before ~1.2s suspicion timeout elapses from
+            # SUSPECT transition we still have margin)
+            em_c.unblock_all_outbound()
+            em_c.unblock_all_inbound()
+            assert await await_until(
+                lambda: c.member().id in trusted(a) and c.member().id in trusted(b),
+                timeout=10,
+            ), f"a trusts {trusted(a)}"
+            assert removed == []
+            assert len(a.members()) == 3
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_partition_until_removed():
+    """Keep the partition past the suspicion timeout -> REMOVED everywhere
+    (reference partition-with-remove family)."""
+
+    async def run():
+        a, em_a = await start_emulated()
+        b, em_b = await start_emulated([a.address])
+        c, em_c = await start_emulated([a.address])
+        try:
+            await await_until(lambda: all(len(x.members()) == 3 for x in (a, b, c)))
+            em_c.block_all_outbound()
+            em_c.block_all_inbound()
+            assert await await_until(
+                lambda: len(a.members()) == 2 and len(b.members()) == 2,
+                timeout=awaited_suspicion(3) + 5,
+            ), f"a: {len(a.members())}, b: {len(b.members())}"
+            assert c.member().id not in trusted(a)
+            assert c.member().id not in trusted(b)
+            # c, isolated, eventually drops a and b too
+            assert await await_until(
+                lambda: len(c.members()) == 1, timeout=awaited_suspicion(3) + 5
+            )
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_suspected_node_refutes_with_incarnation_bump():
+    """One-way inbound block at b for a's traffic makes b suspect a; when the
+    suspicion rumor reaches a it bumps incarnation and re-spreads ALIVE
+    (reference self-refutation via onSelfMemberDetected)."""
+
+    async def run():
+        a, em_a = await start_emulated()
+        b, em_b = await start_emulated([a.address])
+        c, em_c = await start_emulated([a.address])
+        try:
+            await await_until(lambda: all(len(x.members()) == 3 for x in (a, b, c)))
+            inc0 = a.membership_protocol.incarnation
+            # a's acks/gossip can't leave, but it still hears peer traffic —
+            # so b/c suspect a, the SUSPECT rumor reaches a, and a refutes by
+            # bumping its incarnation (onSelfMemberDetected).
+            em_a.block_all_outbound()
+            assert await await_until(
+                lambda: a.membership_protocol.incarnation > inc0, timeout=8
+            ), f"suspected(b)={suspected(b)}, inc={a.membership_protocol.incarnation}"
+            em_a.unblock_all_outbound()
+            # a refutes: incarnation bump observed and a stays/becomes trusted
+            assert await await_until(
+                lambda: a.membership_protocol.incarnation > inc0
+                and a.member().id in trusted(b),
+                timeout=10,
+            ), f"inc: {a.membership_protocol.incarnation}, trusted(b): {trusted(b)}"
+            assert len(b.members()) == 3
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_restart_on_same_address_is_new_member():
+    """Restarted node on the same address = new member id: old one removed,
+    new one added (reference restart-on-same-port scenarios)."""
+
+    async def run():
+        a, _ = await start_emulated(port=9001)
+        b, _ = await start_emulated([a.address], port=9002)
+        try:
+            await await_until(lambda: len(a.members()) == 2)
+            old_id = b.member().id
+            await b.shutdown()
+            b2, _ = await start_emulated([a.address], port=9002)
+            try:
+                assert await await_until(
+                    lambda: b2.member().id in trusted(a) and old_id not in trusted(a),
+                    timeout=awaited_suspicion(2) + 5,
+                ), f"trusted(a): {trusted(a)}"
+                assert b2.address == b.address
+                assert b2.member().id != old_id
+            finally:
+                await b2.shutdown()
+        finally:
+            await a.shutdown()
+
+    asyncio.run(run())
+
+
+def test_namespace_visibility():
+    """Hierarchy gate: parent/child namespaces see each other, siblings don't
+    (reference ClusterNamespacesTest.java:57-251)."""
+
+    async def run():
+        parent, _ = await start_emulated(namespace="develop")
+        child1, _ = await start_emulated([parent.address], namespace="develop/reg-1")
+        child2, _ = await start_emulated([parent.address], namespace="develop/reg-2")
+        try:
+            # parent sees both children; each child sees parent
+            assert await await_until(lambda: len(parent.members()) == 3, timeout=8)
+            assert await await_until(lambda: len(child1.members()) >= 2)
+            assert parent.member().id in trusted(child1)
+            assert parent.member().id in trusted(child2)
+            # siblings are unrelated namespaces: never trusted
+            await asyncio.sleep(1.0)
+            assert child2.member().id not in trusted(child1)
+            assert child1.member().id not in trusted(child2)
+        finally:
+            await asyncio.gather(parent.shutdown(), child1.shutdown(), child2.shutdown())
+
+    asyncio.run(run())
