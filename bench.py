@@ -1,0 +1,102 @@
+"""Headline benchmark: full-SWIM simulation speed vs. protocol real time.
+
+Scenario (BASELINE.md target #2 scaled up): a 4096-member cluster running
+the complete SWIM stack — random-probe FD with indirect probes, suspicion,
+infection-style gossip, SYNC anti-entropy — with a rumor spread from one
+member. The reference executes this protocol in real time: one gossip period
+= 200 ms of wall clock (GossipConfig.java:9), so N members converge a rumor
+in ``3·ceil_log2(N+1)`` periods of real time (ClusterMath.java:111-113) and
+there is no way to run it faster — the baseline "simulation rate" is 1× real
+time by construction (and the reference tops out at N≈50 in its own
+experiment matrix, GossipProtocolTest.java:47-63).
+
+Metric: simulated protocol seconds per wall-clock second on one TPU chip
+(ticks/s × 0.2 s/tick), measured over a steady-state window after verifying
+the rumor actually converges within the analytic bound. vs_baseline is the
+same number: how many times faster than the reference's real-time execution.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+
+from scalecube_cluster_tpu.ops.kernel import tick
+from scalecube_cluster_tpu.ops.state import SimParams, init_state
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_sweep
+
+N = 4096
+TICK_SECONDS = 0.2  # one tick = one default-LAN gossip period
+MEASURE_TICKS = 300
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    params = SimParams(
+        capacity=N,
+        fanout=3,
+        repeat_mult=3,
+        ping_req_k=3,
+        fd_every=5,
+        sync_every=150,
+        suspicion_mult=5,
+        rumor_slots=8,
+        seed_rows=(0,),
+    )
+    state = init_state(params, N, warm=True)
+    state = S.spread_rumor(state, 0, origin=0)
+    step = jax.jit(partial(tick, params=params), donate_argnums=0)
+    key = jax.random.PRNGKey(0)
+
+    # --- correctness gate: the rumor must fully converge within the sweep
+    # window (the reference test suite's own assertion, GossipProtocolTest).
+    budget = gossip_periods_to_sweep(params.repeat_mult, N)
+    converged_at = None
+    for t in range(budget):
+        key, k = jax.random.split(key)
+        state, metrics = step(state, k)
+        if converged_at is None and float(metrics["rumor_coverage"][0]) >= 1.0:
+            converged_at = t + 1
+            break
+    log(f"rumor coverage 1.0 at tick {converged_at} (budget {budget})")
+    if converged_at is None:
+        print(json.dumps({"metric": "sim_speedup_vs_realtime", "value": 0.0,
+                          "unit": "x", "vs_baseline": 0.0, "error": "no convergence"}))
+        return
+
+    # --- steady-state timing window (compile already done above).
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_TICKS):
+        key, k = jax.random.split(key)
+        state, metrics = step(state, k)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    ticks_per_s = MEASURE_TICKS / dt
+    speedup = ticks_per_s * TICK_SECONDS
+    log(f"{ticks_per_s:.1f} ticks/s at N={N} -> {speedup:.1f}x real time")
+    print(
+        json.dumps(
+            {
+                "metric": f"swim_sim_speedup_vs_realtime_n{N}",
+                "value": round(speedup, 2),
+                "unit": "x",
+                "vs_baseline": round(speedup, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

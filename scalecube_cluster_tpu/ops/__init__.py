@@ -1,0 +1,39 @@
+"""Vectorized TPU simulation kernel for the SWIM protocol.
+
+This package is the TPU-native core mandated by SURVEY.md §2.3/§7 stage 4:
+the reference's three periodic per-node loops (failure detector ping round
+``FailureDetectorImpl.java:101-106``, gossip round
+``GossipProtocolImpl.java:106-114``, periodic SYNC
+``MembershipProtocolImpl.java:478-483``) fused into one pure
+``tick(state, key) -> (state, metrics)`` transition over all N simulated
+members, jit-compiled by XLA and shardable over a device mesh on the member
+axis.
+
+Modules:
+
+* :mod:`lattice`  — the ``isOverrides`` record-precedence lattice as int32
+  key packing (scatter-max-joinable).
+* :mod:`rand`     — per-tick random draw layout shared by kernel and oracle.
+* :mod:`state`    — ``SimState`` pytree + ``SimParams`` static config + host
+  mutation helpers (join/crash/leave/rumor/link control).
+* :mod:`tick`     — the tick kernel itself (FD, suspicion, gossip, SYNC,
+  refutation, rumor sweep phases).
+* :mod:`oracle`   — scalar NumPy reimplementation of identical tick
+  semantics, used by equivalence tests.
+* :mod:`sharding` — mesh construction + sharded jit of the tick.
+"""
+
+from .lattice import DEAD_KEY, UNKNOWN, decode_key, precedence_key
+from .state import SimParams, SimState, init_state
+from .kernel import tick
+
+__all__ = [
+    "DEAD_KEY",
+    "UNKNOWN",
+    "decode_key",
+    "precedence_key",
+    "SimParams",
+    "SimState",
+    "init_state",
+    "tick",
+]

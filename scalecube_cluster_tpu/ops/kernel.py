@@ -1,0 +1,397 @@
+"""The vectorized SWIM tick: all N nodes' protocol rounds as one XLA program.
+
+One call advances simulated time by one gossip period and runs, in order:
+
+1. **FD phase** (every ``fd_every`` ticks) — each up node picks a probe
+   target uniformly from its live view (the reference's shuffled round-robin,
+   ``FailureDetectorImpl.selectPingMember:352-361``; random-without-
+   replacement has the same per-round marginal), direct ping succeeds with
+   probability ``(1-loss_ij)(1-loss_ji)`` iff the target is up; on failure,
+   ``k`` relays run the indirect probe (``doPingReq:173-210``); all-fail ⇒
+   SUSPECT verdict, any-ack ⇒ ALIVE verdict carrying the target's current
+   self-incarnation (the effect of the reference's ALIVE-again SYNC,
+   ``MembershipProtocolImpl.onFailureDetectorEvent:427-442``). The
+   sub-interval ping timeout + remainder-of-interval indirect window
+   collapse into phases of a single tick (SURVEY.md §7 hard part i).
+2. **Suspicion sweep** — SUSPECT entries older than
+   ``suspicion_mult * ceil_log2(n_i) * fd_every`` ticks become DEAD
+   (``ClusterMath.suspicionTimeout`` in tick units; timer-per-entry matrix
+   compared against the tick counter, SURVEY.md §2.2).
+3. **Gossip phase** (every tick) — each up node picks ``fanout`` distinct
+   peers (``selectGossipMembers:322-343``) and sends one message carrying
+   (a) every membership record changed within the last
+   ``repeat_mult*ceil_log2(n_i)`` ticks (``selectGossipsToSend:311-320``)
+   and (b) every young user rumor it's infected with. Delivery is one
+   Bernoulli draw per edge. Receivers fold records in via the scatter-max
+   precedence-key join (:mod:`.lattice`) and OR in rumor infections (bitmap
+   OR = the SequenceIdCollector dedup — double delivery is impossible).
+4. **SYNC phase** — nodes whose stagger slot matches (or with
+   ``force_sync``, the join bootstrap) pick one random live peer and run the
+   full-table exchange: request merge into the peer, then the peer's merged
+   table back into the caller (``doSync:339-357``, ``onSync:394-415``).
+   Anti-entropy: this is what heals partitions the rumor window missed.
+5. **Refutation** — any up node whose own diagonal record turned SUSPECT
+   bumps its incarnation and re-announces ALIVE
+   (``onSelfMemberDetected:686-708``), which re-enters the gossip stream via
+   ``changed_at``.
+6. **Rumor sweep** — slots older than ``2*(spread+1)`` periods deactivate
+   (``getGossipsToRemove:350-358``).
+
+Everything is static-shaped and branch-free (masks, no Python control flow
+on traced values); the per-tick cost is O(N²·fanout) elementwise work — no
+MXU, pure VPU/HBM, which is why the dense formulation stays fast to ~16k
+members on one chip and shards row-wise beyond that (see :mod:`.sharding`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .lattice import (
+    ALIVE,
+    DEAD,
+    LEAVING,
+    NO_CANDIDATE,
+    SUSPECT,
+    UNKNOWN,
+    UNKNOWN_KEY,
+    decode_key,
+    precedence_key,
+)
+from .rand import TickRandoms, draw_tick_randoms
+from .state import SimParams, SimState
+
+
+def ceil_log2(n: jnp.ndarray) -> jnp.ndarray:
+    """Reference ``ClusterMath.ceilLog2 = 32 - numberOfLeadingZeros(n)``
+    (``ClusterMath.java:133-135``), exactly, via integer compare-and-count."""
+    n = n.astype(jnp.int32)
+    return (n[..., None] >= (1 << jnp.arange(31, dtype=jnp.int32))).sum(-1).astype(jnp.int32)
+
+
+def _live_view_mask(state: SimState) -> jax.Array:
+    """candidates[i, j] — j is in node i's member list (known, not DEAD, not
+    self): the FD ping list / gossip member list / SYNC address pool, which
+    the reference maintains from ADDED/REMOVED events
+    (``FailureDetectorImpl.java:321-333``)."""
+    n = state.capacity
+    known_live = state.view_status <= LEAVING  # ALIVE(0)/SUSPECT(1)/LEAVING(2)
+    return known_live & ~jnp.eye(n, dtype=bool)
+
+
+def _cluster_size(state: SimState) -> jax.Array:
+    """Node i's view of cluster size (incl. itself) — drives the log2 knobs."""
+    return (state.view_status <= LEAVING).sum(axis=1).astype(jnp.int32)
+
+
+def _merge(
+    state: SimState,
+    recv_key: jax.Array,
+    receiver_up: jax.Array,
+) -> tuple[SimState, jax.Array]:
+    """Fold delivered candidate keys into receivers' tables.
+
+    ``recv_key[i, j]`` is the max precedence key delivered to node i about
+    member j this phase (NO_CANDIDATE where nothing arrived). Applies the
+    overrides gate (key strictly greater, and SUSPECT/DEAD rejected for
+    unknown members — ``MembershipRecord.isOverrides`` null-record rule) and
+    stamps ``changed_at`` / ``suspect_since``. Returns (state, accepted mask).
+    """
+    own_key = precedence_key(state.view_status, state.view_inc)
+    known = state.view_status != UNKNOWN
+    cand_status, cand_inc = decode_key(recv_key, state.view_inc)
+    alive_or_leaving = (cand_status == ALIVE) | (cand_status == LEAVING)
+    accept = (
+        (recv_key > own_key)
+        & (recv_key > NO_CANDIDATE)
+        & (known | alive_or_leaving)
+        & receiver_up[:, None]
+    )
+    new_status = jnp.where(accept, cand_status, state.view_status)
+    new_inc = jnp.where(accept, cand_inc, state.view_inc)
+    newly_suspect = accept & (cand_status == SUSPECT)
+    return (
+        state.replace(
+            view_status=new_status,
+            view_inc=new_inc,
+            changed_at=jnp.where(accept, state.tick, state.changed_at),
+            suspect_since=jnp.where(newly_suspect, state.tick, state.suspect_since),
+        ),
+        accept,
+    )
+
+
+def _select_topk(scores: jax.Array, mask: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Per-row top-k of uniform scores over ``mask`` — k distinct uniform
+    picks without replacement. Returns (idx [N,k], valid [N,k])."""
+    masked = jnp.where(mask, scores, -1.0)
+    vals, idx = jax.lax.top_k(masked, k)
+    return idx, vals >= 0.0
+
+
+def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -> jax.Array:
+    """Delivery draw for a directed message src->dst (sender+receiver up,
+    Bernoulli on outbound loss — NetworkEmulator.java:349-369)."""
+    p = 1.0 - state.loss[src, dst]
+    return state.up[src] & state.up[dst] & (draw < p)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _fd_phase(
+    state: SimState, r: TickRandoms, params: SimParams
+) -> tuple[SimState, dict[str, jax.Array]]:
+    n = state.capacity
+    rows = jnp.arange(n)
+    fd_on = (state.tick % params.fd_every) == 0
+
+    cand = _live_view_mask(state)
+    sel_idx, sel_valid = _select_topk(r.fd_scores, cand, 1 + params.ping_req_k)
+    tgt = sel_idx[:, 0]
+    has_tgt = sel_valid[:, 0] & state.up & fd_on
+
+    # Direct ping: PING out + ACK back must both survive (request-response).
+    p_direct = (1.0 - state.loss[rows, tgt]) * (1.0 - state.loss[tgt, rows])
+    direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
+
+    # Indirect probe via k relays: PING_REQ -> transit PING -> transit ACK ->
+    # forwarded ACK (four hops, FailureDetectorImpl.java:173-315).
+    relays = sel_idx[:, 1:]  # [N, k]
+    relay_valid = sel_valid[:, 1:]
+    tgt_b = tgt[:, None]
+    p_relay = (
+        (1.0 - state.loss[rows[:, None], relays])
+        * (1.0 - state.loss[relays, tgt_b])
+        * (1.0 - state.loss[tgt_b, relays])
+        * (1.0 - state.loss[relays, rows[:, None]])
+    )
+    relay_ok = (
+        relay_valid
+        & state.up[relays]
+        & state.up[tgt_b]
+        & (r.fd_relay < p_relay)
+    )
+    ack = direct_ok | relay_ok.any(axis=1)
+
+    # Verdict records, written at (i, tgt_i) through the overrides gate.
+    own_status = state.view_status[rows, tgt]
+    own_inc = state.view_inc[rows, tgt]
+    own_key = precedence_key(own_status, own_inc)
+    cand_status = jnp.where(ack, jnp.int8(ALIVE), jnp.int8(SUSPECT))
+    # ALIVE verdict carries the target's self-incarnation (the ALIVE-again
+    # SYNC effect); SUSPECT suspects the incarnation we currently know.
+    cand_inc = jnp.where(ack, state.view_inc[tgt, tgt], own_inc)
+    cand_key = precedence_key(cand_status.astype(jnp.int32), cand_inc)
+    accept = has_tgt & (cand_key > own_key)
+
+    new_status = jnp.where(accept, cand_status, own_status)
+    new_inc = jnp.where(accept, cand_inc, own_inc)
+    newly_suspect = accept & ~ack
+    st = state.replace(
+        view_status=state.view_status.at[rows, tgt].set(new_status),
+        view_inc=state.view_inc.at[rows, tgt].set(new_inc),
+        changed_at=state.changed_at.at[rows, tgt].set(
+            jnp.where(accept, state.tick, state.changed_at[rows, tgt])
+        ),
+        suspect_since=state.suspect_since.at[rows, tgt].set(
+            jnp.where(newly_suspect, state.tick, state.suspect_since[rows, tgt])
+        ),
+    )
+    metrics = {
+        "fd_probes": has_tgt.sum(),
+        "fd_new_suspects": newly_suspect.sum(),
+    }
+    return st, metrics
+
+
+def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
+    timeout = params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
+    expired = (
+        (state.view_status == SUSPECT)
+        & (state.tick - state.suspect_since >= timeout[:, None])
+        & state.up[:, None]
+    )
+    return state.replace(
+        view_status=jnp.where(expired, jnp.int8(DEAD), state.view_status),
+        changed_at=jnp.where(expired, state.tick, state.changed_at),
+    )
+
+
+def _removal_phase(state: SimState, params: SimParams) -> SimState:
+    """DEAD records age out of the table: once a DEAD record is older than
+    the gossip-spread window (its rumor has stopped circulating), the entry
+    reverts to UNKNOWN — the sim analogue of the reference's removal of
+    member+record on death (``onDeadMemberDetected:740-767``). This is what
+    lets a partitioned-then-healed member be re-accepted as a fresh ALIVE
+    record (reference partition-recovery scenarios): ALIVE-against-absent is
+    accepted, while DEAD tombstones would absorb forever. The node's own
+    diagonal DEAD is kept — a dead identity cannot rejoin (rejoin = new
+    member id, ``FailureDetectorTest.java:393-401``)."""
+    n = state.capacity
+    spread = params.repeat_mult * ceil_log2(_cluster_size(state))
+    stale_dead = (
+        (state.view_status == DEAD)
+        & (state.tick - state.changed_at >= spread[:, None])
+        & state.up[:, None]
+        & ~jnp.eye(n, dtype=bool)
+    )
+    return state.replace(
+        view_status=jnp.where(stale_dead, jnp.int8(UNKNOWN), state.view_status),
+        view_inc=jnp.where(stale_dead, 0, state.view_inc),
+    )
+
+
+def _gossip_phase(
+    state: SimState, r: TickRandoms, params: SimParams
+) -> tuple[SimState, dict[str, jax.Array]]:
+    n = state.capacity
+    rows = jnp.arange(n)
+    spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
+
+    peers, peer_valid = _select_topk(r.gossip_scores, _live_view_mask(state), params.fanout)
+
+    known = state.view_status != UNKNOWN
+    young = known & (state.tick - state.changed_at < spread[:, None])
+    key_matrix = precedence_key(state.view_status, state.view_inc)
+    piggyback = jnp.where(young, key_matrix, NO_CANDIDATE)  # [N, N]
+
+    rumor_young = (
+        state.infected
+        & state.rumor_active[None, :]
+        & (state.tick - state.infected_at < spread[:, None])
+    )  # [N, R]
+
+    recv_key = jnp.full((n, n), NO_CANDIDATE)
+    recv_inf = jnp.zeros_like(state.infected)
+    sent = jnp.int32(0)
+    for s in range(params.fanout):
+        p = peers[:, s]
+        ok = peer_valid[:, s] & _edge_ok(state, rows, p, r.gossip_edge[:, s])
+        sent = sent + ok.sum()
+        recv_key = recv_key.at[p].max(jnp.where(ok[:, None], piggyback, NO_CANDIDATE))
+        recv_inf = recv_inf.at[p].max(rumor_young & ok[:, None])
+
+    st, _ = _merge(state, recv_key, state.up)
+
+    newly_inf = recv_inf & ~st.infected & st.up[:, None] & st.rumor_active[None, :]
+    st = st.replace(
+        infected=st.infected | newly_inf,
+        infected_at=jnp.where(newly_inf, st.tick, st.infected_at),
+    )
+    return st, {"gossip_msgs": sent, "rumor_deliveries": newly_inf.sum()}
+
+
+def _sync_phase(
+    state: SimState, r: TickRandoms, params: SimParams
+) -> tuple[SimState, dict[str, jax.Array]]:
+    n = state.capacity
+    rows = jnp.arange(n)
+    due = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
+    due = (due | state.force_sync) & state.up
+
+    # SYNC peers come from the live view PLUS the configured seeds
+    # (selectSyncAddress: seedMembers ∪ members) — seeds re-bridge healed
+    # partitions after mutual removal.
+    cand = _live_view_mask(state)
+    if params.seed_rows:
+        seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
+        cand = (cand | seed_mask[None, :]) & ~jnp.eye(n, dtype=bool)
+    peer_idx, peer_valid = _select_topk(r.sync_scores, cand, 1)
+    peer = peer_idx[:, 0]
+    # Round trip: SYNC out and SYNC_ACK back must both survive.
+    p_rt = (1.0 - state.loss[rows, peer]) * (1.0 - state.loss[peer, rows])
+    ok = due & peer_valid[:, 0] & state.up[peer] & (r.sync_edge < p_rt)
+
+    known = state.view_status != UNKNOWN
+    key_matrix = precedence_key(state.view_status, state.view_inc)
+    full_table = jnp.where(known, key_matrix, NO_CANDIDATE)
+
+    # SYNC request: caller's full table scattered into peers (several callers
+    # may hit one peer; scatter-max resolves, as the peer's sequential merges
+    # would — the join is associative).
+    recv_req = jnp.full((n, n), NO_CANDIDATE).at[peer].max(
+        jnp.where(ok[:, None], full_table, NO_CANDIDATE)
+    )
+    st, _ = _merge(state, recv_req, state.up)
+
+    # SYNC_ACK: the peer's (post-merge) table straight back to each caller.
+    known2 = st.view_status != UNKNOWN
+    key2 = jnp.where(known2, precedence_key(st.view_status, st.view_inc), NO_CANDIDATE)
+    recv_ack = jnp.where(ok[:, None], key2[peer], NO_CANDIDATE)
+    st, _ = _merge(st, recv_ack, st.up)
+
+    # A joiner's bootstrap SYNC retries every tick until one round-trip
+    # actually lands (a lost initial SYNC must not strand the joiner until
+    # its periodic stagger slot — cf. the reference's initial-sync-to-seeds
+    # start phase, MembershipProtocolImpl.start0:250-291).
+    st = st.replace(force_sync=st.force_sync & ~ok)
+    return st, {"sync_roundtrips": ok.sum()}
+
+
+def _refute_phase(state: SimState) -> SimState:
+    n = state.capacity
+    rows = jnp.arange(n)
+    self_status = state.view_status[rows, rows]
+    need = state.up & (self_status == SUSPECT)
+    new_inc = jnp.where(need, state.view_inc[rows, rows] + 1, state.view_inc[rows, rows])
+    return state.replace(
+        view_status=state.view_status.at[rows, rows].set(
+            jnp.where(need, jnp.int8(ALIVE), self_status)
+        ),
+        view_inc=state.view_inc.at[rows, rows].set(new_inc),
+        changed_at=state.changed_at.at[rows, rows].set(
+            jnp.where(need, state.tick, state.changed_at[rows, rows])
+        ),
+    )
+
+
+def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
+    n_up = state.up.sum().astype(jnp.int32)
+    sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
+    keep = state.rumor_active & (state.tick - state.rumor_created <= sweep)
+    return state.replace(rumor_active=keep)
+
+
+# ---------------------------------------------------------------------------
+
+
+def tick(
+    state: SimState, key: jax.Array, params: SimParams
+) -> tuple[SimState, dict[str, Any]]:
+    """Advance the whole cluster by one gossip period. Pure; jit/shard me."""
+    state = state.replace(tick=state.tick + 1)
+    r = draw_tick_randoms(key, state.capacity, params.fanout, params.ping_req_k)
+
+    state, fd_m = _fd_phase(state, r, params)
+    state = _suspicion_phase(state, params)
+    state = _removal_phase(state, params)
+    state, g_m = _gossip_phase(state, r, params)
+    state, s_m = _sync_phase(state, r, params)
+    state = _refute_phase(state)
+    state = _rumor_sweep(state, params)
+
+    up2 = state.up[:, None] & state.up[None, :]
+    pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
+    off_diag = ~jnp.eye(state.capacity, dtype=bool)
+    alive_pairs = (up2 & off_diag & (state.view_status == ALIVE)).sum()
+    false_suspects = (
+        up2 & off_diag & (state.view_status == SUSPECT)
+    ).sum()
+    coverage = (
+        (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
+        / jnp.maximum(state.up.sum(), 1)
+    )
+    metrics = {
+        **fd_m,
+        **g_m,
+        **s_m,
+        "n_up": state.up.sum(),
+        "alive_view_fraction": alive_pairs.astype(jnp.float32) / pairs,
+        "false_suspect_pairs": false_suspects,
+        "rumor_coverage": coverage,  # [R]
+    }
+    return state, metrics

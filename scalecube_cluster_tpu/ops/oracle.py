@@ -1,0 +1,289 @@
+"""Scalar (per-node-loop NumPy) oracle of the tick semantics.
+
+SURVEY.md §4's equivalence strategy: "run K scalar-Python SWIM nodes and the
+vectorized kernel with identical RNG seeds/link matrices and assert identical
+state trajectories". This module re-implements :mod:`.kernel`'s tick with
+explicit per-node Python loops — structured like the reference's per-node
+protocol objects, not like the tensor kernel — consuming byte-identical
+random draws from :func:`.rand.draw_tick_randoms`. Equivalence tests step
+both and compare full states every tick.
+
+Float comparisons (delivery draws vs. loss products) are done in float32 in
+the same association order as the kernel so thresholds match bit-exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .lattice import ALIVE, DEAD, LEAVING, SUSPECT, UNKNOWN
+from .rand import draw_tick_randoms
+from .state import SimParams, SimState
+
+_DEAD_KEY = 1 << 30
+
+
+def _key(status: int, inc: int) -> int:
+    if status == UNKNOWN:
+        return -1
+    if status == DEAD:
+        return _DEAD_KEY
+    rank = {ALIVE: 0, LEAVING: 1, SUSPECT: 2}[status]
+    return inc * 4 + rank
+
+
+def _ceil_log2(n: int) -> int:
+    return int(n).bit_length() if n > 0 else 0
+
+
+def _topk_desc(scores: np.ndarray, mask: np.ndarray, k: int):
+    """Match jax.lax.top_k on masked scores: descending, stable on ties."""
+    masked = np.where(mask, scores, np.float32(-1.0))
+    order = np.argsort(-masked, kind="stable")[:k]
+    return order, masked[order] >= 0.0
+
+
+class _O:
+    """Mutable numpy mirror of SimState."""
+
+    def __init__(self, state: SimState):
+        self.tick = int(state.tick)
+        self.up = np.asarray(state.up).copy()
+        self.status = np.asarray(state.view_status).copy()
+        self.inc = np.asarray(state.view_inc).copy()
+        self.changed = np.asarray(state.changed_at).copy()
+        self.since = np.asarray(state.suspect_since).copy()
+        self.force_sync = np.asarray(state.force_sync).copy()
+        self.r_active = np.asarray(state.rumor_active).copy()
+        self.r_origin = np.asarray(state.rumor_origin).copy()
+        self.r_created = np.asarray(state.rumor_created).copy()
+        self.infected = np.asarray(state.infected).copy()
+        self.infected_at = np.asarray(state.infected_at).copy()
+        self.loss = np.asarray(state.loss).copy()
+
+    def snap(self):
+        import copy
+
+        return copy.deepcopy(self)
+
+
+def _live_mask(o: _O, i: int) -> np.ndarray:
+    m = o.status[i] <= LEAVING
+    m[i] = False
+    return m
+
+
+def _cluster_size(o: _O, i: int) -> int:
+    return int((o.status[i] <= LEAVING).sum())
+
+
+def _accept_into(o: _O, i: int, j: int, cand_key: int) -> None:
+    """The overrides gate + write, identical to kernel._merge for one cell."""
+    own = _key(int(o.status[i, j]), int(o.inc[i, j]))
+    if cand_key <= own:
+        return
+    known = o.status[i, j] != UNKNOWN
+    if cand_key == _DEAD_KEY:
+        st_new, inc_new = DEAD, int(o.inc[i, j])
+    else:
+        rank = cand_key & 3
+        st_new = {0: ALIVE, 1: LEAVING, 2: SUSPECT}[rank]
+        inc_new = cand_key >> 2
+    if not known and st_new not in (ALIVE, LEAVING):
+        return
+    o.status[i, j] = st_new
+    o.inc[i, j] = inc_new
+    o.changed[i, j] = o.tick
+    if st_new == SUSPECT:
+        o.since[i, j] = o.tick
+
+
+def oracle_tick(state: SimState, key, params: SimParams) -> _O:
+    """One tick of the scalar oracle; returns the mutated numpy mirror."""
+    n, f, k = params.capacity, params.fanout, params.ping_req_k
+    o = _O(state)
+    o.tick += 1
+    t = o.tick
+    r = draw_tick_randoms(key, n, f, k)
+    r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    # ---- FD phase (reads a pre-phase snapshot, like the kernel) ----
+    pre = o.snap()
+    fd_on = (t % params.fd_every) == 0
+    if fd_on:
+        for i in range(n):
+            if not pre.up[i]:
+                continue
+            sel, valid = _topk_desc(r["fd_scores"][i], _live_mask(pre, i), 1 + k)
+            if not valid[0]:
+                continue
+            tgt = int(sel[0])
+            p_direct = (np.float32(1.0) - pre.loss[i, tgt]) * (
+                np.float32(1.0) - pre.loss[tgt, i]
+            )
+            ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
+            for s in range(k):
+                if ack:
+                    break
+                if not valid[1 + s]:
+                    continue
+                rl = int(sel[1 + s])
+                p4 = (
+                    (np.float32(1.0) - pre.loss[i, rl])
+                    * (np.float32(1.0) - pre.loss[rl, tgt])
+                    * (np.float32(1.0) - pre.loss[tgt, rl])
+                    * (np.float32(1.0) - pre.loss[rl, i])
+                )
+                if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
+                    ack = True
+            if ack:
+                cand = _key(ALIVE, int(pre.inc[tgt, tgt]))
+            else:
+                cand = _key(SUSPECT, int(pre.inc[i, tgt]))
+            own = _key(int(pre.status[i, tgt]), int(pre.inc[i, tgt]))
+            if cand > own:
+                if ack:
+                    o.status[i, tgt], o.inc[i, tgt] = ALIVE, int(pre.inc[tgt, tgt])
+                else:
+                    o.status[i, tgt] = SUSPECT
+                    o.since[i, tgt] = t
+                o.changed[i, tgt] = t
+
+    # ---- suspicion sweep ----
+    for i in range(n):
+        if not o.up[i]:
+            continue
+        timeout = params.suspicion_mult * _ceil_log2(_cluster_size(o, i)) * params.fd_every
+        for j in range(n):
+            if o.status[i, j] == SUSPECT and t - o.since[i, j] >= timeout:
+                o.status[i, j] = DEAD
+                o.changed[i, j] = t
+
+    # ---- removal of stale DEAD records ----
+    for i in range(n):
+        if not o.up[i]:
+            continue
+        spread = params.repeat_mult * _ceil_log2(_cluster_size(o, i))
+        for j in range(n):
+            if j != i and o.status[i, j] == DEAD and t - o.changed[i, j] >= spread:
+                o.status[i, j] = UNKNOWN
+                o.inc[i, j] = 0
+
+    # ---- gossip phase ----
+    pre = o.snap()
+    recv_key = np.full((n, n), np.iinfo(np.int64).min, dtype=np.int64)
+    recv_inf = np.zeros_like(pre.infected)
+    for i in range(n):
+        if not pre.up[i]:
+            continue
+        spread = params.repeat_mult * _ceil_log2(_cluster_size(pre, i))
+        peers, valid = _topk_desc(r["gossip_scores"][i], _live_mask(pre, i), f)
+        for s in range(f):
+            if not valid[s]:
+                continue
+            p = int(peers[s])
+            if not pre.up[p]:
+                continue
+            if not r["gossip_edge"][i, s] < (np.float32(1.0) - pre.loss[i, p]):
+                continue
+            for j in range(n):
+                if pre.status[i, j] != UNKNOWN and t - pre.changed[i, j] < spread:
+                    cand = _key(int(pre.status[i, j]), int(pre.inc[i, j]))
+                    recv_key[p, j] = max(recv_key[p, j], cand)
+            for ru in range(params.rumor_slots):
+                if (
+                    pre.infected[i, ru]
+                    and pre.r_active[ru]
+                    and t - pre.infected_at[i, ru] < spread
+                ):
+                    recv_inf[p, ru] = True
+    for i in range(n):
+        if not pre.up[i]:
+            continue
+        for j in range(n):
+            if recv_key[i, j] > np.iinfo(np.int64).min:
+                _accept_into(o, i, j, int(recv_key[i, j]))
+        for ru in range(params.rumor_slots):
+            if recv_inf[i, ru] and pre.r_active[ru] and not o.infected[i, ru]:
+                o.infected[i, ru] = True
+                o.infected_at[i, ru] = t
+
+    # ---- SYNC phase ----
+    pre = o.snap()
+    callers = []
+    for i in range(n):
+        if not pre.up[i]:
+            continue
+        due = ((t + i * params.sync_stagger) % params.sync_every) == 0 or bool(
+            pre.force_sync[i]
+        )
+        if not due:
+            continue
+        sync_cand = _live_mask(pre, i)
+        for srow in params.seed_rows:
+            if srow != i:
+                sync_cand[srow] = True
+        peers, valid = _topk_desc(r["sync_scores"][i], sync_cand, 1)
+        if not valid[0]:
+            continue
+        p = int(peers[0])
+        p_rt = (np.float32(1.0) - pre.loss[i, p]) * (np.float32(1.0) - pre.loss[p, i])
+        if pre.up[p] and r["sync_edge"][i] < p_rt:
+            # bootstrap force_sync clears only on a successful round-trip
+            o.force_sync[i] = False
+            callers.append((i, p))
+    # request: all callers' tables (pre-snapshot) merged into peers
+    recv_key = {}
+    for i, p in callers:
+        for j in range(n):
+            if pre.status[i, j] != UNKNOWN:
+                cand = _key(int(pre.status[i, j]), int(pre.inc[i, j]))
+                recv_key[(p, j)] = max(recv_key.get((p, j), cand), cand)
+    for (p, j), cand in recv_key.items():
+        _accept_into(o, p, j, cand)
+    # ack: peers' post-request tables back to callers (one snapshot for all)
+    mid = o.snap()
+    for i, p in callers:
+        for j in range(n):
+            if mid.status[p, j] != UNKNOWN:
+                _accept_into(o, i, j, _key(int(mid.status[p, j]), int(mid.inc[p, j])))
+
+    # ---- refutation ----
+    for i in range(n):
+        if o.up[i] and o.status[i, i] == SUSPECT:
+            o.inc[i, i] += 1
+            o.status[i, i] = ALIVE
+            o.changed[i, i] = t
+
+    # ---- rumor sweep ----
+    n_up = int(o.up.sum())
+    sweep = 2 * (params.repeat_mult * _ceil_log2(n_up) + 1)
+    for ru in range(params.rumor_slots):
+        if o.r_active[ru] and t - o.r_created[ru] > sweep:
+            o.r_active[ru] = False
+
+    return o
+
+
+def assert_equivalent(state: SimState, o: _O) -> None:
+    """Raise AssertionError with a field name if kernel and oracle diverge."""
+    pairs = {
+        "tick": (int(state.tick), o.tick),
+        "up": (np.asarray(state.up), o.up),
+        "view_status": (np.asarray(state.view_status), o.status),
+        "view_inc": (np.asarray(state.view_inc), o.inc),
+        "changed_at": (np.asarray(state.changed_at), o.changed),
+        "suspect_since": (np.asarray(state.suspect_since), o.since),
+        "force_sync": (np.asarray(state.force_sync), o.force_sync),
+        "rumor_active": (np.asarray(state.rumor_active), o.r_active),
+        "infected": (np.asarray(state.infected), o.infected),
+        "infected_at": (np.asarray(state.infected_at), o.infected_at),
+    }
+    for name, (a, b) in pairs.items():
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            diff = np.argwhere(np.asarray(a) != np.asarray(b))
+            raise AssertionError(
+                f"kernel/oracle divergence in {name} at {diff[:10].tolist()} "
+                f"(kernel={np.asarray(a)[tuple(diff[0])] if diff.size else a}, "
+                f"oracle={np.asarray(b)[tuple(diff[0])] if diff.size else b})"
+            )

@@ -1,0 +1,52 @@
+"""Per-tick random draw layout, shared by the kernel and the scalar oracle.
+
+All of a tick's randomness is materialized up-front in a fixed order and
+shape, so the NumPy oracle (:mod:`.oracle`) can call this same function and
+replay byte-identical draws — the lockstep-equivalence strategy of
+SURVEY.md §4 ("identical RNG seeds/link matrices → identical state
+trajectories").
+
+Draws per tick (N members, fanout f, ping-req k):
+
+* ``fd_scores``    [N, N]  — Gumbel-free uniform scores for probe target +
+  relay selection (top-(k+1) over masked scores = sample w/o replacement).
+* ``fd_direct``    [N]     — direct-ping delivery draw.
+* ``fd_relay``     [N, k]  — per-relay indirect-probe delivery draws.
+* ``gossip_scores``[N, N]  — fanout peer selection scores.
+* ``gossip_edge``  [N, f]  — per-gossip-edge delivery draws (one message per
+  edge carries both membership records and user rumors, exactly as the
+  reference's single GOSSIP_REQ does — so one draw per edge).
+* ``sync_scores``  [N, N]  — SYNC peer selection scores.
+* ``sync_edge``    [N]     — SYNC round-trip delivery draw.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class TickRandoms(NamedTuple):
+    fd_scores: jax.Array
+    fd_direct: jax.Array
+    fd_relay: jax.Array
+    gossip_scores: jax.Array
+    gossip_edge: jax.Array
+    sync_scores: jax.Array
+    sync_edge: jax.Array
+
+
+def draw_tick_randoms(key: jax.Array, n: int, fanout: int, ping_req_k: int) -> TickRandoms:
+    """Split ``key`` into the tick's uniform draws (fixed order and shapes)."""
+    k1, k2, k3, k4, k5, k6, k7 = jax.random.split(key, 7)
+    return TickRandoms(
+        fd_scores=jax.random.uniform(k1, (n, n), dtype=jnp.float32),
+        fd_direct=jax.random.uniform(k2, (n,), dtype=jnp.float32),
+        fd_relay=jax.random.uniform(k3, (n, ping_req_k), dtype=jnp.float32),
+        gossip_scores=jax.random.uniform(k4, (n, n), dtype=jnp.float32),
+        gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
+        sync_scores=jax.random.uniform(k6, (n, n), dtype=jnp.float32),
+        sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
+    )

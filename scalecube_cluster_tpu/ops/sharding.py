@@ -1,0 +1,79 @@
+"""Device-mesh sharding of the simulation: N members row-sharded over chips.
+
+SURVEY.md §2.3: the member axis is the domain's one parallelism axis (the
+DP analogue). Every ``[N, ...]`` state tensor is sharded on its first
+(member-row) dimension over the ``"members"`` mesh axis with
+``jax.sharding.NamedSharding``; rumor-slot vectors, scalars, and per-tick
+metrics stay replicated. Cross-shard message delivery (gossip/SYNC
+scatter-max into receiver rows, FD gathers of target columns) lowers to XLA
+collectives over ICI automatically under GSPMD — the TPU-native equivalent
+of the reference's loopback/NCCL-style delivery, per the sharding recipe:
+pick a mesh, annotate shardings, let XLA insert collectives.
+
+The driver's ``dryrun_multichip`` runs exactly this on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .kernel import tick
+from .state import SimParams, SimState
+
+MEMBER_AXIS = "members"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (MEMBER_AXIS,))
+
+
+def state_shardings(mesh: Mesh) -> SimState:
+    """A SimState-shaped pytree of NamedShardings: member-axis tensors split
+    on rows, small per-rumor/scalar leaves replicated."""
+    row = NamedSharding(mesh, P(MEMBER_AXIS))
+    row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    return SimState(
+        tick=rep,
+        up=row,
+        view_status=row2d,
+        view_inc=row2d,
+        changed_at=row2d,
+        suspect_since=row2d,
+        force_sync=row,
+        rumor_active=rep,
+        rumor_origin=rep,
+        rumor_created=rep,
+        infected=row2d,
+        infected_at=row2d,
+        loss=row2d,
+    )
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place an existing (host/single-device) state onto the mesh."""
+    return jax.device_put(state, state_shardings(mesh))
+
+
+def make_sharded_tick(mesh: Mesh, params: SimParams):
+    """jit the tick with explicit in/out shardings over ``mesh``.
+
+    Capacity must be divisible by the mesh size (pad rows and leave them
+    ``up=False`` otherwise — masks make padding free).
+    """
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    sh = state_shardings(mesh)
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        partial(tick, params=params),
+        in_shardings=(sh, rep),
+        out_shardings=(sh, None),
+    )

@@ -1,0 +1,264 @@
+"""Simulation state tensors + static parameters + host-side mutation helpers.
+
+The state is the struct-of-arrays encoding of N SWIM nodes' *replicated*
+views (SURVEY.md §2.2 "membership table = N×N replicated-state tensor"):
+row ``i`` of every ``view_*`` matrix is node i's local membership table, the
+TPU analogue of the reference's per-node ``membershipTable``
+(``MembershipProtocolImpl.java:88-91``). All shapes are static (capacity N);
+dynamic membership (joins, crashes, leaves) is masks + host edits between
+ticks — no retracing (SURVEY.md §7 hard part iii).
+
+Wall-clock → tick-time mapping (hard part ii): one tick = one gossip period
+(``SimConfig.tick_interval``); the FD round fires every
+``fd_every = ping_interval / tick_interval`` ticks and SYNC every
+``sync_every = sync_interval / tick_interval`` ticks, per-node staggered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import ClusterConfig
+from .lattice import ALIVE, LEAVING, UNKNOWN
+
+NEVER = jnp.int32(-(1 << 30))  # "changed long ago" sentinel for changed_at
+FAR_FUTURE = jnp.int32(1 << 30)  # "no suspicion running" sentinel
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Static (hashable) kernel parameters, derived from ``ClusterConfig``.
+
+    Mirrors the reference's config surface in tick units:
+    fanout/repeat_mult (``GossipConfig.java:9-11``), ping_req_k
+    (``FailureDetectorConfig.java:11``), suspicion_mult + sync interval
+    (``MembershipConfig.java:14-16``).
+    """
+
+    capacity: int
+    fanout: int = 3
+    repeat_mult: int = 3
+    ping_req_k: int = 3
+    fd_every: int = 5  # ping_interval / tick_interval
+    sync_every: int = 150  # sync_interval / tick_interval
+    sync_stagger: int = 1
+    suspicion_mult: int = 5
+    rumor_slots: int = 64
+    # Rows that act as configured seed members: always in the SYNC peer pool
+    # even when absent from the local view (the reference's selectSyncAddress
+    # draws from seedMembers ∪ members, MembershipProtocolImpl.java:461-472 —
+    # this is what re-bridges a fully partitioned cluster after both sides
+    # removed each other).
+    seed_rows: tuple = ()
+
+    @staticmethod
+    def from_config(
+        config: ClusterConfig,
+        capacity: int | None = None,
+        initial_size: int | None = None,
+        seed_rows: tuple = (0,),
+    ) -> "SimParams":
+        """Derive kernel params from a ClusterConfig. Capacity resolution:
+        explicit ``capacity`` arg > ``config.sim.capacity`` > ``initial_size``
+        (the documented capacity==0 fallback in SimConfig). ``seed_rows``
+        default to row 0 — a seedless sim cannot re-bridge healed partitions
+        (see the SYNC-peer note in kernel._sync_phase)."""
+        sim = config.sim
+        cap = capacity or sim.capacity or (initial_size or 0)
+        if cap <= 1:
+            raise ValueError(
+                "sim capacity must be > 1 (set config.sim.capacity, or pass "
+                "capacity= / initial_size=)"
+            )
+        dt = sim.tick_interval
+        return SimParams(
+            capacity=cap,
+            fanout=config.gossip.gossip_fanout,
+            repeat_mult=config.gossip.gossip_repeat_mult,
+            ping_req_k=config.failure_detector.ping_req_members,
+            fd_every=max(1, round(config.failure_detector.ping_interval / dt)),
+            sync_every=max(1, round(config.membership.sync_interval / dt)),
+            suspicion_mult=config.membership.suspicion_mult,
+            rumor_slots=sim.rumor_slots,
+            seed_rows=tuple(seed_rows),
+        )
+
+
+class SimState(struct.PyTreeNode):
+    """One cluster simulation: N nodes' replicated SWIM state + rumor pool.
+
+    ``view_status[i, j]`` / ``view_inc[i, j]`` — node i's record for j
+    (UNKNOWN=4 when i has no record). ``changed_at[i, j]`` — tick at which
+    i's record for j last changed; a record is piggybacked on gossip while
+    ``tick - changed_at < repeat_mult * ceil_log2(cluster_size_i)``, the
+    reference's gossip-age rule (``GossipProtocolImpl.java:311-320``).
+    ``suspect_since[i, j]`` — tick at which the current suspicion began
+    (suspicion timer, ``MembershipProtocolImpl.java:805-823``).
+
+    Rumor pool: R slots of user gossip (``spreadGossip``), infection bitmap
+    ``infected[i, r]`` + ``infected_at`` for the forwarding-age rule; dedup
+    (the reference's ``SequenceIdCollector``) is the OR-semantics of the
+    bitmap itself.
+
+    ``loss[i, j]`` — directed link drop probability (the NetworkEmulator's
+    outbound loss, ``NetworkEmulator.java:349-369``, as a dense matrix;
+    block = loss 1.0).
+    """
+
+    tick: jax.Array  # i32 scalar
+    up: jax.Array  # bool [N] — process running (host/churn controlled)
+    view_status: jax.Array  # i8  [N, N]
+    view_inc: jax.Array  # i32 [N, N]
+    changed_at: jax.Array  # i32 [N, N]
+    suspect_since: jax.Array  # i32 [N, N]
+    force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
+    rumor_active: jax.Array  # bool [R]
+    rumor_origin: jax.Array  # i32 [R]
+    rumor_created: jax.Array  # i32 [R]
+    infected: jax.Array  # bool [N, R]
+    infected_at: jax.Array  # i32 [N, R]
+    loss: jax.Array  # f32 [N, N]
+
+    @property
+    def capacity(self) -> int:
+        return self.up.shape[0]
+
+
+def init_state(params: SimParams, n_initial: int, warm: bool = True) -> SimState:
+    """Fresh simulation with rows ``0..n_initial-1`` up.
+
+    ``warm=True``: a converged cluster (everyone holds ALIVE@0 records for
+    everyone) — the right starting point for FD / gossip / churn benches.
+    ``warm=False``: cold rows know only themselves; use :func:`join_row` /
+    seed knowledge + SYNC to converge (join-path tests).
+    """
+    n = params.capacity
+    r = params.rumor_slots
+    up = jnp.arange(n) < n_initial
+    if warm:
+        known = up[:, None] & up[None, :]
+        status = jnp.where(known, jnp.int8(ALIVE), jnp.int8(UNKNOWN))
+    else:
+        diag = jnp.eye(n, dtype=bool) & up[:, None]
+        status = jnp.where(diag, jnp.int8(ALIVE), jnp.int8(UNKNOWN))
+    return SimState(
+        tick=jnp.int32(0),
+        up=up,
+        view_status=status,
+        view_inc=jnp.zeros((n, n), jnp.int32),
+        changed_at=jnp.full((n, n), NEVER),
+        suspect_since=jnp.full((n, n), FAR_FUTURE),
+        force_sync=jnp.zeros((n,), bool),
+        rumor_active=jnp.zeros((r,), bool),
+        rumor_origin=jnp.zeros((r,), jnp.int32),
+        rumor_created=jnp.zeros((r,), jnp.int32),
+        infected=jnp.zeros((n, r), bool),
+        infected_at=jnp.zeros((n, r), jnp.int32),
+        loss=jnp.zeros((n, n), jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side mutation helpers (pure state -> state, called between ticks).
+# These are the sim analogues of lifecycle APIs: Cluster start/shutdown,
+# leaveCluster (MembershipProtocolImpl.java:233-242), spreadGossip
+# (GossipProtocolImpl.java:126-130), NetworkEmulator block/loss controls.
+# ---------------------------------------------------------------------------
+
+
+def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> SimState:
+    """Activate ``row`` as a fresh member that knows only itself + the seeds.
+
+    Seeds are recorded as ALIVE@0 placeholders (the reference treats seeds as
+    bare addresses, ``MembershipProtocolImpl.start0:250-291``); the forced
+    initial SYNC then pulls the real table, like the reference's startup SYNC.
+    """
+    seed_rows = jnp.asarray(seed_rows, jnp.int32)
+    row_status = (
+        jnp.full((state.capacity,), jnp.int8(UNKNOWN))
+        .at[seed_rows]
+        .set(jnp.int8(ALIVE))
+        .at[row]
+        .set(jnp.int8(ALIVE))
+    )
+    return state.replace(
+        up=state.up.at[row].set(True),
+        view_status=state.view_status.at[row].set(row_status),
+        view_inc=state.view_inc.at[row].set(0),
+        changed_at=state.changed_at.at[row].set(NEVER).at[row, row].set(state.tick),
+        suspect_since=state.suspect_since.at[row].set(FAR_FUTURE),
+        force_sync=state.force_sync.at[row].set(True),
+        infected=state.infected.at[row].set(False),
+    )
+
+
+def crash_row(state: SimState, row: int) -> SimState:
+    """Hard-kill ``row`` (no goodbye — peers must detect via FD + suspicion)."""
+    return state.replace(up=state.up.at[row].set(False))
+
+
+def begin_leave(state: SimState, row: int) -> SimState:
+    """Graceful leave: announce LEAVING (self record), keep running so the
+    rumor spreads; call :func:`crash_row` a few ticks later to stop.
+    Mirrors leaveCluster's LEAVING gossip (``MembershipProtocolImpl.java:233-242``)."""
+    return state.replace(
+        view_status=state.view_status.at[row, row].set(jnp.int8(LEAVING)),
+        changed_at=state.changed_at.at[row, row].set(state.tick),
+    )
+
+
+def update_metadata(state: SimState, row: int) -> SimState:
+    """Metadata update = own-incarnation bump re-announced ALIVE, exactly the
+    reference's ``ClusterImpl.updateMetadata`` path (bump incarnation → peers
+    accept the higher-incarnation ALIVE → refetch metadata → UPDATED events,
+    ``ClusterImpl.java:497-501``). Peers' UPDATED events are host-side diffs
+    of ``view_inc`` increases at ALIVE status; blob versions live on host."""
+    return state.replace(
+        view_inc=state.view_inc.at[row, row].add(1),
+        changed_at=state.changed_at.at[row, row].set(state.tick),
+    )
+
+
+def spread_rumor(state: SimState, slot: int, origin: int) -> SimState:
+    """Start a user rumor from ``origin`` in ``slot`` (Cluster.spreadGossip)."""
+    return state.replace(
+        rumor_active=state.rumor_active.at[slot].set(True),
+        rumor_origin=state.rumor_origin.at[slot].set(origin),
+        rumor_created=state.rumor_created.at[slot].set(state.tick),
+        infected=state.infected.at[:, slot].set(False).at[origin, slot].set(True),
+        infected_at=state.infected_at.at[origin, slot].set(state.tick),
+    )
+
+
+def set_link_loss(state: SimState, src, dst, loss: float) -> SimState:
+    """Set outbound loss on directed link(s) src->dst (emulator
+    setOutboundSettings); scalars or sequences on either side."""
+    src = jnp.atleast_1d(jnp.asarray(src))
+    dst = jnp.atleast_1d(jnp.asarray(dst))
+    return state.replace(loss=state.loss.at[src[:, None], dst[None, :]].set(loss))
+
+
+def block_partition(state: SimState, group_a, group_b) -> SimState:
+    """Symmetric partition: drop all traffic between the two groups."""
+    s = set_link_loss(state, group_a, group_b, 1.0)
+    return set_link_loss(s, group_b, group_a, 1.0)
+
+
+def heal_partition(state: SimState, group_a, group_b) -> SimState:
+    s = set_link_loss(state, group_a, group_b, 0.0)
+    return set_link_loss(s, group_b, group_a, 0.0)
+
+
+def snapshot(state: SimState) -> dict[str, np.ndarray]:
+    """Host checkpoint: the full state as numpy arrays (SURVEY.md §5.4 —
+    checkpoint/resume is an addition over the reference, whose state is soft)."""
+    return {f.name: np.asarray(getattr(state, f.name)) for f in dataclasses.fields(SimState)}
+
+
+def restore(arrays: dict[str, np.ndarray]) -> SimState:
+    return SimState(**{k: jnp.asarray(v) for k, v in arrays.items()})

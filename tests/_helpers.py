@@ -1,0 +1,18 @@
+"""Shared async test helpers (single source — keep the suites drift-free)."""
+
+from __future__ import annotations
+
+import asyncio
+
+
+async def await_until(predicate, timeout=5.0, interval=0.05):
+    """Poll ``predicate`` until true or ``timeout`` elapses; returns the final
+    predicate value (so callers can assert it). Mirrors the polling assertion
+    helpers of the reference suite (MembershipProtocolTest.java:1205-1258)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()

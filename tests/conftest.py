@@ -8,9 +8,23 @@ Multi-chip hardware is unavailable in CI; sharding tests run over
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment's sitecustomize (PYTHONPATH=/root/.axon_site) imports jax
+# and registers the axon TPU backend at interpreter startup — before this
+# conftest runs — so jax has already read JAX_PLATFORMS=axon from the env.
+# Setting env vars alone is too late; update jax.config directly (backends
+# are not initialized until first use, so this still takes effect). XLA_FLAGS
+# is read at CPU-client creation, so setting it here still works.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+except ImportError:  # pure-Python protocol suites don't need jax
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -13,6 +13,8 @@ from scalecube_cluster_tpu.models.message import Message
 from scalecube_cluster_tpu.transport import MemoryTransportRegistry
 from scalecube_cluster_tpu.cluster import new_cluster
 
+from _helpers import await_until
+
 
 @pytest.fixture(autouse=True)
 def fresh_registry():
@@ -40,16 +42,6 @@ async def start_cluster(seeds=(), metadata=None, alias=None):
     if alias is not None:
         cfg = cfg.replace(member_alias=alias)
     return await new_cluster(cfg).start()
-
-
-async def await_until(predicate, timeout=5.0, interval=0.05):
-    loop = asyncio.get_running_loop()
-    deadline = loop.time() + timeout
-    while loop.time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(interval)
-    return predicate()
 
 
 def test_alice_bob_carol_join():

@@ -17,6 +17,8 @@ from scalecube_cluster_tpu.transport import (
 )
 from scalecube_cluster_tpu.utils.streams import EventStream
 
+from _helpers import await_until
+
 FD_CONFIG = FailureDetectorConfig(ping_interval=0.2, ping_timeout=0.1, ping_req_members=2)
 
 
@@ -53,16 +55,6 @@ async def stop_all(transports, fds):
         fd.stop()
     for t in transports:
         await t.stop()
-
-
-async def await_until(predicate, timeout=5.0, interval=0.05):
-    loop = asyncio.get_running_loop()
-    deadline = loop.time() + timeout
-    while loop.time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(interval)
-    return predicate()
 
 
 def last_status_for(verdict_log, member):

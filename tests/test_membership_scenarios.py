@@ -16,6 +16,8 @@ from scalecube_cluster_tpu.transport import (
 )
 from scalecube_cluster_tpu.utils.cluster_math import suspicion_timeout
 
+from _helpers import await_until
+
 
 @pytest.fixture(autouse=True)
 def fresh_registry():
@@ -54,16 +56,6 @@ async def start_emulated(seeds=(), namespace="default", port=0):
 def awaited_suspicion(cluster_size):
     """awaitSuspicion analogue (reference BaseTest.java:41-47)."""
     return suspicion_timeout(3, cluster_size, 0.2) + 1.0
-
-
-async def await_until(predicate, timeout=5.0, interval=0.05):
-    loop = asyncio.get_running_loop()
-    deadline = loop.time() + timeout
-    while loop.time() < deadline:
-        if predicate():
-            return True
-        await asyncio.sleep(interval)
-    return predicate()
 
 
 def trusted(cluster):
