@@ -1,0 +1,21 @@
+"""Host-side bridge over the vectorized simulation (SURVEY.md §7 stage 5).
+
+Exposes the simulated mesh through the same surfaces the scalar engine has:
+
+* :class:`SimDriver` — owns the device state and the jitted tick; host loop,
+  id↔row mapping, per-observer membership-event extraction, churn helpers,
+  metrics history, checkpoint/resume.
+* :class:`SimCluster` / :class:`SimNode` — ``Cluster``-facade-shaped handles
+  over individual simulated members (members/other_members/metadata/
+  spread_gossip/update_metadata/leave/shutdown/event streams).
+* :class:`SimTransport` — the 4-method Transport SPI (send/request_response/
+  listen/stop) between simulated members, honoring the sim's link-loss
+  matrix — the sibling of the memory/TCP transports that lets user-messaging
+  code and testlib scenarios run unmodified against the simulated mesh.
+"""
+
+from .driver import SimDriver
+from .cluster import SimCluster, SimNode
+from .transport import SimTransport
+
+__all__ = ["SimDriver", "SimCluster", "SimNode", "SimTransport"]

@@ -1,0 +1,313 @@
+"""SimDriver: the host loop around the device-resident SWIM simulation.
+
+The reference's per-node schedulers (``Schedulers.newSingle`` per node,
+``ClusterImpl.java:257``) collapse into one host loop calling the compiled
+tick (SURVEY.md §2.3 "Host-driver loop"); everything protocol-ish happens on
+device. The driver owns:
+
+* the jitted (optionally mesh-sharded) step and the RNG key chain;
+* the id↔row mapping (``Member`` handles with ``sim://row`` addresses);
+* membership-event extraction for *watched* rows — per-tick host diffs of
+  those rows' views, emitting the reference's ADDED / LEAVING / REMOVED /
+  UPDATED stream (``MembershipEvent.java:15-20``) without pulling the whole
+  N×N state off-device;
+* metrics history (per-tick scalars from the kernel);
+* checkpoint/resume of the full state (SURVEY.md §5.4 — an addition over
+  the reference, whose state is soft).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..models.events import MembershipEvent
+from ..models.member import Member, MemberStatus
+from ..utils.streams import EventStream
+from ..ops import kernel as _kernel
+from ..ops import state as _state
+from ..ops.lattice import ALIVE, DEAD, LEAVING, SUSPECT, UNKNOWN
+from ..ops.state import SimParams, SimState
+
+
+def row_address(row: int) -> str:
+    return f"sim://{row}"
+
+
+@dataclass
+class _Watch:
+    row: int
+    prev_status: np.ndarray  # [N] int8
+    prev_inc: np.ndarray  # [N] int32
+    stream: EventStream = field(default_factory=EventStream)
+    log: List[MembershipEvent] = field(default_factory=list)
+    # Member handle captured when the observer first learned each row, so
+    # later events name the identity the observer actually knew — a reused
+    # row (crash + rejoin) must not retroactively relabel old records.
+    known: Dict[int, Member] = field(default_factory=dict)
+
+
+class SimDriver:
+    """Drive one simulated cluster; all mutation goes through this object."""
+
+    def __init__(
+        self,
+        params: SimParams,
+        n_initial: int,
+        warm: bool = True,
+        seed: int = 0,
+        mesh=None,
+        record_metrics: bool = False,
+    ):
+        self.params = params
+        self.mesh = mesh
+        self.record_metrics = record_metrics
+        if mesh is not None:
+            from ..ops.sharding import make_sharded_tick, shard_state
+
+            self._step = make_sharded_tick(mesh, params)
+            self.state: SimState = shard_state(
+                _state.init_state(params, n_initial, warm=warm), mesh
+            )
+        else:
+            self._step = jax.jit(partial(_kernel.tick, params=params))
+            self.state = _state.init_state(params, n_initial, warm=warm)
+        self._key = jax.random.PRNGKey(seed)
+        self._rng = np.random.default_rng(seed ^ 0x5EED)  # host-side (transport) draws
+        self.n_initial = n_initial
+        self.members: Dict[int, Member] = {
+            r: Member(id=f"sim-{r}", address=row_address(r)) for r in range(n_initial)
+        }
+        self.metrics_history: List[dict] = []
+        self._watches: Dict[int, _Watch] = {}
+        self._rumor_payloads: Dict[int, object] = {}
+        self._next_member_ordinal = n_initial
+        self._transports: Dict[int, object] = {}  # row -> SimTransport
+
+    # -- time ---------------------------------------------------------------
+    @property
+    def tick(self) -> int:
+        return int(self.state.tick)
+
+    # -- stepping -----------------------------------------------------------
+    def step(self, n_ticks: int = 1) -> dict:
+        """Advance the sim; returns the last tick's metrics (host arrays).
+
+        Per-tick metrics stay on device unless ``record_metrics=True`` was
+        passed at construction — a forced device→host sync every tick would
+        serialize JAX's async dispatch on long runs."""
+        device_metrics = {}
+        for _ in range(n_ticks):
+            self._key, k = jax.random.split(self._key)
+            self.state, device_metrics = self._step(self.state, k)
+            if self.record_metrics:
+                self.metrics_history.append(
+                    {name: np.asarray(v) for name, v in device_metrics.items()}
+                )
+            self._extract_events()
+        return {name: np.asarray(v) for name, v in device_metrics.items()}
+
+    def run_until(
+        self, predicate: Callable[["SimDriver"], bool], max_ticks: int = 10_000
+    ) -> bool:
+        for _ in range(max_ticks):
+            if predicate(self):
+                return True
+            self.step()
+        return predicate(self)
+
+    # -- membership events (host-side diff of watched rows) ----------------
+    def watch(self, row: int) -> EventStream:
+        """Start emitting MembershipEvents as observed by node ``row``."""
+        if row not in self._watches:
+            status = np.asarray(self.state.view_status[row])
+            w = _Watch(
+                row=row,
+                prev_status=status,
+                prev_inc=np.asarray(self.state.view_inc[row]),
+            )
+            for j in np.nonzero(status != UNKNOWN)[0]:
+                w.known[int(j)] = self._member_handle(int(j))
+            self._watches[row] = w
+        return self._watches[row].stream
+
+    def events_of(self, row: int) -> List[MembershipEvent]:
+        self.watch(row)
+        return self._watches[row].log
+
+    def _member_handle(self, row: int) -> Member:
+        if row not in self.members:
+            self.members[row] = Member(id=f"sim-{row}", address=row_address(row))
+        return self.members[row]
+
+    def _extract_events(self) -> None:
+        if not self._watches:
+            return
+        rows = sorted(self._watches)
+        status = np.asarray(self.state.view_status[np.array(rows)])
+        inc = np.asarray(self.state.view_inc[np.array(rows)])
+        for i, row in enumerate(rows):
+            w = self._watches[row]
+            self._diff_row(w, status[i], inc[i])
+            w.prev_status, w.prev_inc = status[i], inc[i]
+
+    def _diff_row(self, w: _Watch, status: np.ndarray, inc: np.ndarray) -> None:
+        changed = (status != w.prev_status) | (inc != w.prev_inc)
+        for j in np.nonzero(changed)[0]:
+            j = int(j)
+            old_s, new_s = int(w.prev_status[j]), int(status[j])
+            ev: Optional[MembershipEvent] = None
+            # old DEAD counts as "not a member": REMOVED already fired when
+            # the record went DEAD; a DEAD->ALIVE flip within one tick (the
+            # removal phase runs before the merge phases) is a fresh ADDED.
+            if old_s in (UNKNOWN, DEAD) and new_s in (ALIVE, SUSPECT, LEAVING):
+                w.known[j] = self._member_handle(j)
+                ev = MembershipEvent.added(w.known[j])
+            elif new_s == LEAVING and old_s != LEAVING:
+                ev = MembershipEvent.leaving(w.known.get(j, self._member_handle(j)))
+            elif new_s == DEAD and old_s != DEAD:
+                # reference removes member+record on death and publishes
+                # REMOVED (onDeadMemberDetected:740-767); the later
+                # DEAD->UNKNOWN table cleanup is internal, not an event
+                ev = MembershipEvent.removed(w.known.pop(j, self._member_handle(j)))
+            elif (
+                new_s == ALIVE
+                and old_s in (ALIVE, SUSPECT)
+                and int(inc[j]) > int(w.prev_inc[j])
+            ):
+                # incarnation bump while alive = metadata/refutation update
+                ev = MembershipEvent.updated(
+                    w.known.get(j, self._member_handle(j)), None, None
+                )
+            if ev is not None:
+                w.log.append(ev)
+                w.stream.emit(ev)
+
+    # -- lifecycle / churn --------------------------------------------------
+    def join(self, seed_rows: Sequence[int] = (0,)) -> int:
+        """Activate a free row as a fresh member; returns its row."""
+        up = np.asarray(self.state.up)
+        free = np.nonzero(~up)[0]
+        if len(free) == 0:
+            raise RuntimeError("no free rows (capacity exhausted)")
+        row = int(free[0])
+        self.state = _state.join_row(self.state, row, list(seed_rows))
+        # a restart reuses the row but is a NEW member identity (reference:
+        # rejoin after restart gets a fresh member id)
+        self.members[row] = Member(
+            id=f"sim-{self._next_member_ordinal}", address=row_address(row)
+        )
+        self._next_member_ordinal += 1
+        return row
+
+    def crash(self, row: int) -> None:
+        self.state = _state.crash_row(self.state, row)
+
+    def leave(self, row: int, crash_after_ticks: int = 0) -> None:
+        self.state = _state.begin_leave(self.state, row)
+        if crash_after_ticks:
+            self.step(crash_after_ticks)
+            self.crash(row)
+
+    def update_metadata(self, row: int) -> None:
+        self.state = _state.update_metadata(self.state, row)
+
+    # -- rumors (spreadGossip) ----------------------------------------------
+    def spread_rumor(self, origin: int, payload: object) -> int:
+        """Start a user rumor; returns its slot. Payloads live host-side."""
+        active = np.asarray(self.state.rumor_active)
+        free = np.nonzero(~active)[0]
+        if len(free) == 0:
+            raise RuntimeError("no free rumor slots")
+        slot = int(free[0])
+        self.state = _state.spread_rumor(self.state, slot, origin)
+        self._rumor_payloads[slot] = payload
+        return slot
+
+    def rumor_coverage(self, slot: int) -> float:
+        inf = np.asarray(self.state.infected[:, slot])
+        up = np.asarray(self.state.up)
+        return float(inf[up].sum() / max(up.sum(), 1))
+
+    def rumor_payload(self, slot: int) -> object:
+        return self._rumor_payloads.get(slot)
+
+    # -- links (NetworkEmulator surface) ------------------------------------
+    def set_link_loss(self, src, dst, loss: float) -> None:
+        self.state = _state.set_link_loss(self.state, src, dst, loss)
+
+    def block_partition(self, group_a, group_b) -> None:
+        self.state = _state.block_partition(self.state, group_a, group_b)
+
+    def heal_partition(self, group_a, group_b) -> None:
+        self.state = _state.heal_partition(self.state, group_a, group_b)
+
+    def link_loss(self, src: int, dst: int) -> float:
+        return float(self.state.loss[src, dst])
+
+    # -- views --------------------------------------------------------------
+    def view_of(self, row: int) -> tuple[np.ndarray, np.ndarray]:
+        """(status, incarnation) of node ``row``'s table — one device gather."""
+        return (
+            np.asarray(self.state.view_status[row]),
+            np.asarray(self.state.view_inc[row]),
+        )
+
+    def status_of(self, observer: int, subject: int) -> MemberStatus | None:
+        s = int(self.state.view_status[observer, subject])
+        return None if s == UNKNOWN else MemberStatus(s)
+
+    def is_up(self, row: int) -> bool:
+        return bool(self.state.up[row])
+
+    # -- checkpoint/resume ---------------------------------------------------
+    def checkpoint(self, path: str) -> None:
+        """Full resumable snapshot: device state + RNG chains + the host-side
+        identity map and rumor payloads (restoring into a fresh driver must
+        reproduce the same member ids and payloads, not refabricate them)."""
+        import pickle
+
+        host = {
+            "members": dict(self.members),
+            "rumor_payloads": dict(self._rumor_payloads),
+            "next_member_ordinal": self._next_member_ordinal,
+            "rng": self._rng.bit_generator.state,
+            "metrics_len": len(self.metrics_history),
+        }
+        np.savez_compressed(
+            path,
+            **_state.snapshot(self.state),
+            _key=np.asarray(self._key),
+            _host=np.frombuffer(pickle.dumps(host), dtype=np.uint8),
+        )
+
+    def restore(self, path: str) -> None:
+        import pickle
+
+        data = dict(np.load(path))
+        self._key = jax.numpy.asarray(data.pop("_key"))
+        host = pickle.loads(data.pop("_host").tobytes())
+        self.members = host["members"]
+        self._rumor_payloads = host["rumor_payloads"]
+        self._next_member_ordinal = host["next_member_ordinal"]
+        self._rng = np.random.default_rng()
+        self._rng.bit_generator.state = host["rng"]
+        del self.metrics_history[host["metrics_len"] :]  # drop abandoned timeline
+        state = _state.restore(data)
+        if self.mesh is not None:
+            from ..ops.sharding import shard_state
+
+            state = shard_state(state, self.mesh)
+        self.state = state
+        # re-baseline watches so restore doesn't emit phantom events
+        for w in self._watches.values():
+            w.prev_status = np.asarray(self.state.view_status[w.row])
+            w.prev_inc = np.asarray(self.state.view_inc[w.row])
+            w.known = {
+                int(j): self.members.get(int(j), self._member_handle(int(j)))
+                for j in np.nonzero(w.prev_status != UNKNOWN)[0]
+            }
