@@ -61,7 +61,13 @@ from .lattice import (
     decode_key,
     precedence_key,
 )
-from .rand import TickRandoms, draw_tick_randoms
+from .rand import (
+    FdRandoms,
+    RoundRandoms,
+    draw_fd_randoms,
+    draw_round_randoms,
+    split_tick_key,
+)
 from .state import SimParams, SimState
 
 
@@ -143,16 +149,15 @@ def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -
 
 
 def _fd_phase(
-    state: SimState, r: TickRandoms, params: SimParams
+    state: SimState, r: FdRandoms, params: SimParams
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     rows = jnp.arange(n)
-    fd_on = (state.tick % params.fd_every) == 0
 
     cand = _live_view_mask(state)
     sel_idx, sel_valid = _select_topk(r.fd_scores, cand, 1 + params.ping_req_k)
     tgt = sel_idx[:, 0]
-    has_tgt = sel_valid[:, 0] & state.up & fd_on
+    has_tgt = sel_valid[:, 0] & state.up
 
     # Direct ping: PING out + ACK back must both survive (request-response).
     p_direct = (1.0 - state.loss[rows, tgt]) * (1.0 - state.loss[tgt, rows])
@@ -246,7 +251,7 @@ def _removal_phase(state: SimState, params: SimParams) -> SimState:
 
 
 def _gossip_phase(
-    state: SimState, r: TickRandoms, params: SimParams
+    state: SimState, r: RoundRandoms, params: SimParams
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     rows = jnp.arange(n)
@@ -286,7 +291,7 @@ def _gossip_phase(
 
 
 def _sync_phase(
-    state: SimState, r: TickRandoms, params: SimParams
+    state: SimState, r: RoundRandoms, params: SimParams
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     rows = jnp.arange(n)
@@ -364,9 +369,23 @@ def tick(
 ) -> tuple[SimState, dict[str, Any]]:
     """Advance the whole cluster by one gossip period. Pure; jit/shard me."""
     state = state.replace(tick=state.tick + 1)
-    r = draw_tick_randoms(key, state.capacity, params.fanout, params.ping_req_k)
+    fd_key, round_key = split_tick_key(key)
+    r = draw_round_randoms(round_key, state.capacity, params.fanout)
 
-    state, fd_m = _fd_phase(state, r, params)
+    # The FD round only fires every fd_every ticks; lax.cond skips both the
+    # phase and its [N,N] random draws entirely on the other ticks (the
+    # draws live under fd_key, so skipping them never perturbs the
+    # gossip/SYNC stream).
+    def _fd_on(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
+        fd_r = draw_fd_randoms(fd_key, st.capacity, params.ping_req_k)
+        return _fd_phase(st, fd_r, params)
+
+    def _fd_off(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
+        return st, {"fd_probes": jnp.int32(0), "fd_new_suspects": jnp.int32(0)}
+
+    state, fd_m = jax.lax.cond(
+        (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state
+    )
     state = _suspicion_phase(state, params)
     state = _removal_phase(state, params)
     state, g_m = _gossip_phase(state, r, params)

@@ -28,7 +28,22 @@ import jax
 import jax.numpy as jnp
 
 
+class FdRandoms(NamedTuple):
+    fd_scores: jax.Array
+    fd_direct: jax.Array
+    fd_relay: jax.Array
+
+
+class RoundRandoms(NamedTuple):
+    gossip_scores: jax.Array
+    gossip_edge: jax.Array
+    sync_scores: jax.Array
+    sync_edge: jax.Array
+
+
 class TickRandoms(NamedTuple):
+    """Union view used by the scalar oracle (kernel consumes the parts)."""
+
     fd_scores: jax.Array
     fd_direct: jax.Array
     fd_relay: jax.Array
@@ -38,15 +53,38 @@ class TickRandoms(NamedTuple):
     sync_edge: jax.Array
 
 
-def draw_tick_randoms(key: jax.Array, n: int, fanout: int, ping_req_k: int) -> TickRandoms:
-    """Split ``key`` into the tick's uniform draws (fixed order and shapes)."""
-    k1, k2, k3, k4, k5, k6, k7 = jax.random.split(key, 7)
-    return TickRandoms(
+def split_tick_key(key: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(fd_key, round_key). FD draws live under their own subkey so the
+    kernel can skip generating them entirely on non-FD ticks (lax.cond)
+    without perturbing the gossip/SYNC draw stream — the oracle derives the
+    same two subkeys and stays lockstep."""
+    k = jax.random.split(key, 2)
+    return k[0], k[1]
+
+
+def draw_fd_randoms(key: jax.Array, n: int, ping_req_k: int) -> FdRandoms:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return FdRandoms(
         fd_scores=jax.random.uniform(k1, (n, n), dtype=jnp.float32),
         fd_direct=jax.random.uniform(k2, (n,), dtype=jnp.float32),
         fd_relay=jax.random.uniform(k3, (n, ping_req_k), dtype=jnp.float32),
+    )
+
+
+def draw_round_randoms(key: jax.Array, n: int, fanout: int) -> RoundRandoms:
+    k4, k5, k6, k7 = jax.random.split(key, 4)
+    return RoundRandoms(
         gossip_scores=jax.random.uniform(k4, (n, n), dtype=jnp.float32),
         gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
         sync_scores=jax.random.uniform(k6, (n, n), dtype=jnp.float32),
         sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
     )
+
+
+def draw_tick_randoms(key: jax.Array, n: int, fanout: int, ping_req_k: int) -> TickRandoms:
+    """All of a tick's draws (oracle-side convenience; matches the kernel's
+    two-subkey layout exactly)."""
+    fd_key, round_key = split_tick_key(key)
+    fd = draw_fd_randoms(fd_key, n, ping_req_k)
+    rd = draw_round_randoms(round_key, n, fanout)
+    return TickRandoms(*fd, *rd)
