@@ -1,0 +1,55 @@
+"""Shared plumbing for the five driver benchmark configs (BASELINE.md §Targets).
+
+Each config script prints human progress to stderr and one JSON result line
+per experiment to stdout, so results are machine-collectable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+
+from scalecube_cluster_tpu.ops.kernel import tick
+from scalecube_cluster_tpu.ops.state import SimParams, SimState, init_state
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def make_step(params: SimParams, donate: bool = True):
+    return jax.jit(partial(tick, params=params), donate_argnums=0 if donate else ())
+
+
+class TickLoop:
+    """Minimal stepping harness (the SimDriver without host-side extras —
+    benchmark loops must not force per-tick device syncs)."""
+
+    def __init__(self, params: SimParams, n_initial: int, seed: int = 0, **init_kw):
+        self.params = params
+        self.state: SimState = init_state(params, n_initial, **init_kw)
+        self.step_fn = make_step(params)
+        self.key = jax.random.PRNGKey(seed)
+        self.metrics = {}
+
+    def step(self, n: int = 1):
+        for _ in range(n):
+            self.key, k = jax.random.split(self.key)
+            self.state, self.metrics = self.step_fn(self.state, k)
+        return self.metrics
+
+    def timed_ticks(self, n: int) -> float:
+        """Wall seconds for n ticks (blocks at the end only)."""
+        jax.block_until_ready(self.state)
+        t0 = time.perf_counter()
+        self.step(n)
+        jax.block_until_ready(self.state)
+        return time.perf_counter() - t0

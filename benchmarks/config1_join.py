@@ -1,0 +1,63 @@
+"""Driver config #1: 3-node Alice/Bob/Carol joinAwait over loopback transport.
+
+The reference quick-start (README.md:22-37): Alice starts, Bob and Carol
+join via Alice as seed, everyone sees everyone. Runs the REAL scalar
+protocol engine (asyncio event loops, memory transport) — functional parity,
+not simulation. Reports time-to-full-membership.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import asyncio
+import time
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.transport import MemoryTransportRegistry
+
+
+from common import emit, log
+
+
+async def run() -> dict:
+    MemoryTransportRegistry.reset_default()
+    cfg = ClusterConfig.default_local()
+    t0 = time.perf_counter()
+    alice = await new_cluster(cfg.replace(member_alias="Alice")).start()
+    bob = await new_cluster(
+        cfg.replace(member_alias="Bob").with_membership(
+            lambda m: m.replace(seed_members=(alice.address,))
+        )
+    ).start()
+    carol = await new_cluster(
+        cfg.replace(member_alias="Carol").with_membership(
+            lambda m: m.replace(seed_members=(alice.address,))
+        )
+    ).start()
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        if all(len(c.members()) == 3 for c in (alice, bob, carol)):
+            break
+        await asyncio.sleep(0.02)
+    join_time = time.perf_counter() - t0
+    ok = all(len(c.members()) == 3 for c in (alice, bob, carol))
+    for c in (alice, bob, carol):
+        await c.shutdown()
+    return {"ok": ok, "join_seconds": round(join_time, 3)}
+
+
+def main() -> None:
+    result = asyncio.run(run())
+    log(f"3-node join: {result}")
+    emit({"config": 1, "metric": "three_node_join_seconds",
+          "value": result["join_seconds"], "ok": result["ok"]})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Driver config #2: 256-member rumor convergence vs ClusterMath.
+
+BASELINE.md target: convergence rounds within the analytic dissemination
+window ``3·ceil_log2(N+1)`` (ClusterMath.java:111-113), across seeds and the
+reference's loss matrix {0, 10, 25, 50}% (GossipProtocolTest.java:47-63).
+Reports rounds-to-full-coverage per trial + the analytic bound.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import sys
+
+import numpy as np
+
+from scalecube_cluster_tpu.ops.state import SimParams
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.utils.cluster_math import (
+    gossip_periods_to_spread,
+    gossip_periods_to_sweep,
+)
+
+
+from common import TickLoop, emit, log
+
+N = 256
+TRIALS = 5
+
+
+def run_trial(seed: int, loss: float) -> int | None:
+    params = SimParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=4, seed_rows=(0,),
+    )
+    loop = TickLoop(params, N, seed=seed, dense_links=False, uniform_loss=loss)
+    loop.state = S.spread_rumor(loop.state, 0, origin=seed % N)
+    budget = 2 * gossip_periods_to_sweep(3, N)
+    for t in range(budget):
+        m = loop.step()
+        if float(np.asarray(m["rumor_coverage"])[0]) >= 1.0:
+            return t + 1
+    return None
+
+
+def main() -> None:
+    spread_bound = gossip_periods_to_spread(3, N)
+    for loss_pct in (0, 10, 25, 50):
+        rounds = []
+        for seed in range(TRIALS):
+            r = run_trial(seed, loss_pct / 100.0)
+            rounds.append(r)
+            log(f"loss={loss_pct}% seed={seed}: converged in {r} rounds "
+                f"(analytic spread window {spread_bound})")
+        ok = all(r is not None for r in rounds)
+        emit({
+            "config": 2, "metric": "gossip_convergence_rounds", "n": N,
+            "loss_pct": loss_pct, "rounds": rounds,
+            "analytic_spread_rounds": spread_bound, "all_converged": ok,
+        })
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Driver config #4: 10k-member partition detect + SYNC recovery.
+
+BASELINE.md target: a 30-simulated-second partition is detected per
+suspicion math and fully recovered after healing (the reference's
+network-partition scenario family, MembershipProtocolTest). A 10%/90% split
+is blocked both ways; after mutual removal the partition heals and the
+periodic seed-SYNC re-bridges both sides.
+
+Dense links are required for per-group blocking: at N=10k the loss matrix
+is 400 MB — fine on one chip.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+
+import numpy as np
+
+from scalecube_cluster_tpu.ops.state import SimParams
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.utils.cluster_math import suspicion_timeout
+
+from common import TickLoop, emit, log
+
+N = 10_000
+SPLIT = N // 10  # minority group size
+
+
+def main() -> None:
+    params = SimParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=2, seed_rows=(0, 1),
+    )
+    loop = TickLoop(params, N, seed=0, dense_links=True)
+    minority = list(range(SPLIT))
+    majority = list(range(SPLIT, N))
+
+    loop.state = S.block_partition(loop.state, minority, majority)
+    # suspicion timeout in ticks + dissemination slack
+    to_ticks = params.suspicion_mult * (N.bit_length()) * params.fd_every
+    detect_budget = int(to_ticks * 2.5)
+    detected_at = None
+    for t in range(detect_budget):
+        m = loop.step()
+        vs = np.asarray(loop.state.view_status[N - 1])  # one majority observer
+        if (vs[:SPLIT] >= 3).all() or (vs[:SPLIT] == 4).all():
+            detected_at = t + 1
+            break
+    log(f"partition fully detected by majority observer at tick {detected_at} "
+        f"(suspicion math {to_ticks} ticks)")
+
+    loop.state = S.heal_partition(loop.state, minority, majority)
+    # bulk recovery is rumor-exponential; the last stragglers (nodes that
+    # must learn of their own premature death via their periodic seed-SYNC
+    # and refute) are anti-entropy-limited, so budget several sync intervals
+    recover_budget = params.sync_every * 8
+    recovered_bulk_at = recovered_at = None
+    frac = 0.0
+    for t in range(recover_budget):
+        m = loop.step()
+        frac = float(np.asarray(m["alive_view_fraction"]))
+        if (t + 1) % 100 == 0:
+            log(f"post-heal tick {t+1}: alive_view_fraction {frac:.5f}")
+        if recovered_bulk_at is None and frac >= 0.99:
+            recovered_bulk_at = t + 1
+        if frac >= 0.9999:
+            recovered_at = t + 1
+            break
+    log(f"recovered: bulk(99%) at {recovered_bulk_at}, full at {recovered_at} "
+        f"ticks after heal (final frac {frac:.5f})")
+    emit({
+        "config": 4, "metric": "partition_detect_recover_ticks", "n": N,
+        "detected_ticks": detected_at, "suspicion_math_ticks": to_ticks,
+        "recovered_bulk_ticks": recovered_bulk_at,
+        "recovered_full_ticks": recovered_at, "final_alive_fraction": round(frac, 5),
+        "ok": detected_at is not None and recovered_bulk_at is not None,
+    })
+
+
+if __name__ == "__main__":
+    main()

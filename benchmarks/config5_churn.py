@@ -1,0 +1,98 @@
+"""Driver config #5: large-scale full-SWIM churn sweep.
+
+BASELINE.md north star: 100k members with 1%/s churn converging < 60 s
+wall-clock on a v5e-8 slice. On a single chip this runs the same protocol at
+the largest N that fits dense state (default 16384; --n to override, --mesh
+to shard rows over all visible devices for the full-scale run).
+
+Churn: every simulated second (1/tick_interval ticks), crash 1% of a
+second's worth of members and join replacements. Reports steady-state
+convergence (mutual-ALIVE fraction among up members) and wall-clock rate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+
+import numpy as np
+
+from scalecube_cluster_tpu.ops.state import SimParams
+import scalecube_cluster_tpu.ops.state as S
+
+from common import TickLoop, emit, log
+
+TICKS_PER_SECOND = 5  # tick = 200ms
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=16384)
+    ap.add_argument("--seconds", type=int, default=60)
+    ap.add_argument("--churn-pct-per-s", type=float, default=1.0)
+    ap.add_argument("--mesh", action="store_true", help="shard over all devices")
+    args = ap.parse_args()
+
+    n = args.n
+    params = SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=2,
+        seed_rows=(0, 1, 2, 3),
+    )
+    import jax
+
+    if args.mesh:
+        from scalecube_cluster_tpu.ops.sharding import (
+            make_mesh, make_sharded_tick, shard_state,
+        )
+
+        mesh = make_mesh()
+        loop = TickLoop(params, n - n // 100, seed=0, dense_links=False)
+        loop.state = shard_state(loop.state, mesh)
+        loop.step_fn = make_sharded_tick(mesh, params, dense_links=False)
+        log(f"sharded over {mesh.size} devices")
+    else:
+        loop = TickLoop(params, n - n // 100, seed=0, dense_links=False)
+
+    rng = np.random.default_rng(0)
+    churn_per_s = max(1, int(n * args.churn_pct_per_s / 100))
+    import time
+
+    t0 = time.perf_counter()
+    fracs = []
+    for sec in range(args.seconds):
+        # churn burst: crash churn_per_s random up rows, join replacements
+        up = np.asarray(loop.state.up)
+        up_rows = np.nonzero(up)[0]
+        crash = rng.choice(up_rows, size=min(churn_per_s, len(up_rows) - 8), replace=False)
+        crash = crash[~np.isin(crash, params.seed_rows)]
+        st = loop.state
+        st = st.replace(up=st.up.at[np.asarray(crash)].set(False))
+        free = np.nonzero(~np.asarray(st.up))[0][: len(crash)]
+        for row in free:
+            st = S.join_row(st, int(row), list(params.seed_rows))
+        loop.state = st
+        m = loop.step(TICKS_PER_SECOND)
+        frac = float(np.asarray(m["alive_view_fraction"]))
+        fracs.append(frac)
+        if (sec + 1) % 10 == 0:
+            log(f"sim-second {sec+1}: alive_view_fraction={frac:.4f}")
+    wall = time.perf_counter() - t0
+    steady = float(np.mean(fracs[len(fracs) // 2 :]))
+    emit({
+        "config": 5, "metric": "churn_steady_state", "n": n,
+        "churn_pct_per_s": args.churn_pct_per_s,
+        "sim_seconds": args.seconds, "wall_seconds": round(wall, 2),
+        "speedup_vs_realtime": round(args.seconds / wall, 2),
+        "steady_alive_view_fraction": round(steady, 4),
+        "ok": steady > 0.98,
+    })
+
+
+if __name__ == "__main__":
+    main()

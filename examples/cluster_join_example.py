@@ -1,0 +1,32 @@
+"""Alice/Bob/Carol seed join — the reference quick-start
+(ClusterJoinExamples.java / README.md:22-37)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    alice = await new_cluster(cfg.replace(member_alias="Alice")).start()
+    print(f"Alice started at {alice.address}")
+
+    join_alice = cfg.with_membership(lambda m: m.replace(seed_members=(alice.address,)))
+    bob = await new_cluster(join_alice.replace(member_alias="Bob")).start()
+    carol = await new_cluster(join_alice.replace(member_alias="Carol")).start()
+
+    await asyncio.sleep(1.0)
+    for c in (alice, bob, carol):
+        names = sorted(m.alias or m.id[:8] for m in c.members())
+        print(f"{c.member().alias} sees: {names}")
+    for c in (alice, bob, carol):
+        await c.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

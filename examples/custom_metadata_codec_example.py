@@ -1,0 +1,47 @@
+"""Plug a custom metadata codec into the registry
+(CustomMetadataEncodingExample.java — the reference registers a custom
+MetadataCodec through META-INF/services; here it's the codec registry)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.transport.codecs import (
+    MetadataCodec,
+    register_metadata_codec,
+)
+
+
+class CsvMetadataCodec(MetadataCodec):
+    """Encodes a dict as 'k=v,k=v' — deliberately minimal wire format."""
+
+    def serialize(self, metadata) -> bytes:
+        return ",".join(f"{k}={v}" for k, v in sorted(metadata.items())).encode()
+
+    def deserialize(self, payload: bytes):
+        return dict(kv.split("=", 1) for kv in payload.decode().split(",") if kv)
+
+
+async def main() -> None:
+    register_metadata_codec("csv", CsvMetadataCodec())
+    cfg = ClusterConfig.default_local().replace(metadata_codec="csv")
+
+    a = await new_cluster(cfg.replace(member_alias="A", metadata={"role": "seed"})).start()
+    b = await new_cluster(
+        cfg.replace(member_alias="B", metadata={"role": "worker"}).with_membership(
+            lambda m: m.replace(seed_members=(a.address,))
+        )
+    ).start()
+    await asyncio.sleep(1.0)
+    print("A sees B's metadata:", a.metadata_of(a.member_by_id(b.member().id)))
+    print("B sees A's metadata:", b.metadata_of(b.member_by_id(a.member().id)))
+    await b.shutdown()
+    await a.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

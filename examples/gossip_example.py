@@ -1,0 +1,37 @@
+"""Spread a rumor to every member via infection-style gossip
+(GossipExample.java)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.message import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    alice = await new_cluster(cfg.replace(member_alias="Alice")).start()
+    join = cfg.with_membership(lambda m: m.replace(seed_members=(alice.address,)))
+
+    members = [alice]
+    for name in ("Bob", "Carol", "Dave", "Eve"):
+        node = await new_cluster(join.replace(member_alias=name)).start()
+        node.listen_gossip().subscribe(
+            lambda msg, who=name: print(f"[{who}] heard gossip: {msg.data!r}")
+        )
+        members.append(node)
+    await asyncio.sleep(1.0)
+
+    await alice.spread_gossip(Message.with_data("Joe Dirt", qualifier="gossip/example"))
+    await asyncio.sleep(2.0)
+
+    for node in members:
+        await node.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,36 @@
+"""Custom member-id generator and alias (MemberIdExample.java)."""
+
+import asyncio
+import itertools
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+
+counter = itertools.count(1)
+
+
+def sequential_id() -> str:
+    return f"node-{next(counter):04d}"
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local().replace(member_id_generator=sequential_id)
+    a = await new_cluster(cfg.replace(member_alias="first")).start()
+    b = await new_cluster(
+        cfg.replace(member_alias="second").with_membership(
+            lambda m: m.replace(seed_members=(a.address,))
+        )
+    ).start()
+    await asyncio.sleep(0.5)
+    for c in (a, b):
+        print(f"alias={c.member().alias} id={c.member().id} address={c.address}")
+    await b.shutdown()
+    await a.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,35 @@
+"""Watch the membership event stream while members join and leave
+(MembershipEventsExample.java)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    observer = await new_cluster(cfg.replace(member_alias="Observer")).start()
+    observer.listen_membership().subscribe(
+        lambda ev: print(f"[Observer] {ev.type.name}: {ev.member.alias or ev.member.id[:8]}")
+    )
+
+    join = cfg.with_membership(lambda m: m.replace(seed_members=(observer.address,)))
+    alice = await new_cluster(join.replace(member_alias="Alice")).start()
+    bob = await new_cluster(join.replace(member_alias="Bob")).start()
+    await asyncio.sleep(1.0)
+
+    print("-- Alice leaves gracefully --")
+    await alice.shutdown()
+    await asyncio.sleep(2.0)
+
+    await bob.shutdown()
+    await observer.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

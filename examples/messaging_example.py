@@ -1,0 +1,48 @@
+"""Point-to-point messaging between members: fire-and-forget send and
+correlated request/response (MessagingExample.java)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.message import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    ping_pong_count = 3
+
+    pong_side = await new_cluster(cfg.replace(member_alias="Pong")).start()
+
+    def on_message(msg: Message) -> None:
+        if msg.qualifier == "ping":
+            print(f"Pong got {msg.data!r}, replying")
+            reply = Message.with_data("pong!", qualifier="pong", cid=msg.correlation_id)
+            asyncio.ensure_future(pong_side.send(msg.sender, reply))
+
+    pong_side.listen_messages().subscribe(on_message)
+
+    ping_side = await new_cluster(
+        cfg.replace(member_alias="Ping").with_membership(
+            lambda m: m.replace(seed_members=(pong_side.address,))
+        )
+    ).start()
+    await asyncio.sleep(0.5)
+
+    target = ping_side.other_members()[0]
+    for i in range(ping_pong_count):
+        resp = await ping_side.request_response(
+            target, Message.with_data(f"ping #{i}", qualifier="ping")
+        )
+        print(f"Ping got {resp.data!r}")
+
+    await ping_side.shutdown()
+    await pong_side.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,44 @@
+"""Share and update member metadata; peers observe UPDATED events and fetch
+the new value (ClusterMetadataExample.java)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    joe = await new_cluster(
+        cfg.replace(member_alias="Joe", metadata={"name": "Joe"})
+    ).start()
+
+    caller = await new_cluster(
+        cfg.replace(member_alias="Caller").with_membership(
+            lambda m: m.replace(seed_members=(joe.address,))
+        )
+    ).start()
+
+    def on_event(ev) -> None:
+        if ev.is_updated:
+            print(f"[Caller] metadata UPDATED for {ev.member.alias or ev.member.id[:8]}: "
+                  f"{caller.metadata_of(ev.member)}")
+
+    caller.listen_membership().subscribe(on_event)
+    await asyncio.sleep(1.0)
+    joe_member = caller.member_by_id(joe.member().id)
+    print(f"[Caller] initial metadata of Joe: {caller.metadata_of(joe_member)}")
+
+    await joe.update_metadata({"name": "Joe", "status": "on vacation"})
+    await asyncio.sleep(2.0)
+
+    await caller.shutdown()
+    await joe.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,37 @@
+"""Hierarchical namespace visibility: parent/child namespaces form one
+cluster, siblings stay invisible (ClusterJoinNamespacesExamples.java)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+
+
+async def start(alias: str, namespace: str, seeds=()):
+    cfg = ClusterConfig.default_local().with_membership(
+        lambda m: m.replace(seed_members=tuple(seeds), namespace=namespace)
+    )
+    return await new_cluster(cfg.replace(member_alias=alias)).start()
+
+
+async def main() -> None:
+    root = await start("root", "develop")
+    child1 = await start("child1", "develop/child1", [root.address])
+    child2 = await start("child2", "develop/child2", [root.address])
+    await asyncio.sleep(1.0)
+
+    for c in (root, child1, child2):
+        names = sorted(m.alias or m.id[:8] for m in c.members())
+        print(f"{c.member().alias} ({c.member().namespace}) sees: {names}")
+    # root sees both children; each child sees root but NOT its sibling
+
+    for c in (root, child1, child2):
+        await c.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,45 @@
+"""Messaging over the second wire transport — real TCP sockets instead of
+the in-process memory transport (the reference demonstrates transport
+plurality with WebsocketMessagingExample; here the alternate wire is TCP)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.message import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local().with_transport(
+        lambda t: t.replace(transport_factory="tcp")
+    )
+    server = await new_cluster(cfg.replace(member_alias="server")).start()
+    print(f"server on real socket {server.address}")
+
+    def on_message(msg: Message) -> None:
+        if msg.qualifier == "hello":
+            reply = Message.with_data("world", qualifier="hello/ack", cid=msg.correlation_id)
+            asyncio.ensure_future(server.send(msg.sender, reply))
+
+    server.listen_messages().subscribe(on_message)
+
+    client = await new_cluster(
+        cfg.replace(member_alias="client").with_membership(
+            lambda m: m.replace(seed_members=(server.address,))
+        )
+    ).start()
+    await asyncio.sleep(1.0)
+    resp = await client.request_response(
+        client.other_members()[0], Message.with_data("hello", qualifier="hello")
+    )
+    print(f"client got {resp.data!r} over TCP")
+    await client.shutdown()
+    await server.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,185 @@
+"""Cluster introspection endpoint — the JMX monitor analogue.
+
+The reference registers a per-node MBean ``io.scalecube.cluster:name=<id>``
+exposing config, cluster size, incarnation, and alive/suspected/removed
+member lists (``ClusterMonitorMBean.java:3``, ``ClusterMonitorModel.java:10``,
+wired in ``ClusterImpl.startJmxMonitor:363-375``). The TPU-native equivalents
+(SURVEY.md §2.2 Monitor row):
+
+* :func:`cluster_snapshot` / :func:`sim_snapshot` — the MBean attribute set
+  as a plain dict (JSON-ready), pulled from the scalar engine's state or
+  from the device arrays in one gather.
+* :class:`MonitorServer` — an optional stdlib asyncio HTTP endpoint serving
+  those snapshots at ``/nodes`` and ``/nodes/<i>`` (JMX's remote access
+  analogue; JSON instead of RMI).
+* :class:`TickLogger` — structured per-tick event log (SURVEY.md §5.1: the
+  reference's ``[localMember][period]`` DEBUG trace, as JSON lines).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+
+# -- snapshots ---------------------------------------------------------------
+
+
+def cluster_snapshot(cluster) -> Dict[str, Any]:
+    """MBean attribute set for one scalar-engine Cluster instance."""
+    mp = cluster.membership_protocol
+    member = cluster.member()
+    return {
+        "member": {"id": member.id, "alias": member.alias, "address": member.address,
+                   "namespace": member.namespace},
+        "cluster_size": len(mp.members()),
+        "incarnation": mp.incarnation,
+        "alive_members": [m.id for m in mp.alive_members()],
+        "suspected_members": [m.id for m in mp.suspected_members()],
+        "removed_members": [m.id for m in mp.removed_members()],
+        "config": {
+            "namespace": cluster._config.membership.namespace,
+            "sync_interval": cluster._config.membership.sync_interval,
+            "suspicion_mult": cluster._config.membership.suspicion_mult,
+            "ping_interval": cluster._config.failure_detector.ping_interval,
+            "gossip_interval": cluster._config.gossip.gossip_interval,
+            "gossip_fanout": cluster._config.gossip.gossip_fanout,
+        },
+    }
+
+
+def sim_snapshot(driver, row: int) -> Dict[str, Any]:
+    """MBean attribute set for one simulated member (one device gather)."""
+    import numpy as np
+
+    from .ops.lattice import ALIVE, DEAD, LEAVING, SUSPECT
+
+    status, inc = driver.view_of(row)
+    member = driver._member_handle(row)
+
+    def ids(mask: "np.ndarray") -> List[str]:
+        return [driver._member_handle(int(j)).id for j in np.nonzero(mask)[0]]
+
+    return {
+        "member": {"id": member.id, "address": member.address},
+        "row": row,
+        "up": driver.is_up(row),
+        "tick": driver.tick,
+        "cluster_size": int((status <= LEAVING).sum()),
+        "incarnation": int(inc[row]),
+        "alive_members": ids(status == ALIVE),
+        "suspected_members": ids(status == SUSPECT),
+        # DEAD tombstones ARE the removed set (reference removedMembersHistory)
+        "removed_members": ids(status == DEAD),
+        "config": dataclasses.asdict(driver.params),
+    }
+
+
+# -- HTTP endpoint -----------------------------------------------------------
+
+
+class MonitorServer:
+    """Minimal JSON-over-HTTP introspection server (stdlib asyncio only).
+
+    ``providers`` maps a name to a zero-arg callable returning a JSON-able
+    snapshot. Routes: ``/`` (name list), ``/nodes`` (all snapshots),
+    ``/nodes/<name>`` (one).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._providers: Dict[str, Callable[[], Dict[str, Any]]] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
+        self._providers[name] = provider
+
+    def register_cluster(self, cluster) -> None:
+        self.register(cluster.member().id, lambda: cluster_snapshot(cluster))
+
+    def register_sim(self, driver, rows) -> None:
+        for row in rows:
+            self.register(
+                driver._member_handle(row).id,
+                lambda r=row: sim_snapshot(driver, r),
+            )
+
+    async def start(self) -> "MonitorServer":
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass  # drain headers
+            path = request.split()[1].decode() if len(request.split()) > 1 else "/"
+            status, body = self._route(path)
+            payload = json.dumps(body).encode()
+            writer.write(
+                b"HTTP/1.1 " + status + b"\r\nContent-Type: application/json\r\n"
+                + f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+        except Exception:  # noqa: BLE001 - monitor must never take a node down
+            _log.exception("monitor request failed")
+        finally:
+            writer.close()
+
+    def _route(self, path: str) -> tuple[bytes, Any]:
+        if path == "/":
+            return b"200 OK", {"nodes": sorted(self._providers)}
+        if path == "/nodes":
+            return b"200 OK", {n: p() for n, p in self._providers.items()}
+        if path.startswith("/nodes/"):
+            name = path[len("/nodes/") :]
+            if name in self._providers:
+                return b"200 OK", self._providers[name]()
+            return b"404 Not Found", {"error": f"unknown node {name!r}"}
+        return b"404 Not Found", {"error": f"no route {path!r}"}
+
+
+# -- structured per-tick log -------------------------------------------------
+
+
+class TickLogger:
+    """JSON-lines log of per-tick metrics + host interventions, the
+    structured analogue of the reference's causally ordered
+    ``[localMember][period]`` DEBUG trace (SURVEY.md §5.1)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "a", buffering=1)
+
+    def log_tick(self, tick: int, metrics: Dict[str, Any]) -> None:
+        record = {"t": tick, "ts": time.time()}
+        for name, v in metrics.items():
+            try:
+                record[name] = v.item() if hasattr(v, "item") and getattr(v, "ndim", 1) == 0 else (
+                    [float(x) for x in v] if hasattr(v, "__iter__") else v
+                )
+            except Exception:  # noqa: BLE001
+                record[name] = str(v)
+        self._fh.write(json.dumps(record) + "\n")
+
+    def log_event(self, tick: int, kind: str, **fields: Any) -> None:
+        self._fh.write(json.dumps({"t": tick, "event": kind, **fields}) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()

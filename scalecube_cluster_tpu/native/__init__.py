@@ -1,0 +1,61 @@
+"""Native (C) runtime components with pure-Python fallbacks.
+
+``load_codec()`` returns the compiled ``_sc_codec`` extension, building it
+on first use with the system compiler (no pip/installation involved); if no
+compiler is available the caller falls back to the pure-Python
+implementation of the identical wire format.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import pathlib
+import subprocess
+import sysconfig
+
+_log = logging.getLogger(__name__)
+_DIR = pathlib.Path(__file__).parent
+_SO = _DIR / "_sc_codec.so"
+
+
+def build_codec() -> bool:
+    """Compile codec.c into _sc_codec.so next to this file. Returns success."""
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "cc", "-O2", "-shared", "-fPIC",
+        f"-I{include}",
+        str(_DIR / "codec.c"),
+        "-o", str(_SO),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        _log.info("native codec build failed (%s); using pure-Python fallback", e)
+        return False
+
+
+_BUILD_FAILED = False
+
+
+def load_codec():
+    """Import the native codec module, building it if needed; None if
+    unavailable. A failed build is cached for the process lifetime so
+    callers don't repeatedly shell out to the compiler."""
+    global _BUILD_FAILED
+    if _BUILD_FAILED:
+        return None
+    if not _SO.exists():
+        if not build_codec():
+            _BUILD_FAILED = True
+            return None
+    spec = importlib.util.spec_from_file_location("_sc_codec", _SO)
+    if spec is None or spec.loader is None:
+        return None
+    module = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(module)
+    except ImportError:
+        return None
+    return module

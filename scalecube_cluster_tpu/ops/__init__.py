@@ -23,12 +23,11 @@ Modules:
 * :mod:`sharding` — mesh construction + sharded jit of the tick.
 """
 
-from .lattice import DEAD_KEY, UNKNOWN, decode_key, precedence_key
+from .lattice import UNKNOWN, decode_key, precedence_key
 from .state import SimParams, SimState, init_state
 from .kernel import tick
 
 __all__ = [
-    "DEAD_KEY",
     "UNKNOWN",
     "decode_key",
     "precedence_key",

@@ -98,17 +98,27 @@ def _merge(
     recv_key: jax.Array,
     receiver_up: jax.Array,
 ) -> tuple[SimState, jax.Array]:
-    """Fold delivered candidate keys into receivers' tables.
+    """Fold delivered candidate keys into receivers' tables + rumor stream.
 
     ``recv_key[i, j]`` is the max precedence key delivered to node i about
-    member j this phase (NO_CANDIDATE where nothing arrived). Applies the
-    overrides gate (key strictly greater, and SUSPECT/DEAD rejected for
-    unknown members — ``MembershipRecord.isOverrides`` null-record rule) and
-    stamps ``changed_at`` / ``suspect_since``. Returns (state, accepted mask).
+    member j this phase (NO_CANDIDATE where nothing arrived). The TABLE
+    accepts on the overrides gate (key strictly greater, and SUSPECT/DEAD
+    rejected for unknown members — ``MembershipRecord.isOverrides``
+    null-record rule). The RUMOR layer updates independently:
+
+    Accepted updates (re-)enter the gossip stream via ``changed_at``
+    (receivers forward a newly learned record for their own spread window —
+    the reference's per-receiver rumor forwarding). Because each cell's key
+    is strictly monotone (DEAD is a kept tombstone, never removed — see
+    ``lattice.py`` deviation 2), a given key is accepted at most once per
+    cell, so every rumor's forwarding is bounded (SIR) and the whole system
+    converges monotonically — no death-rumor/refutation cycles.
+
+    Returns (state, accepted mask).
     """
     own_key = precedence_key(state.view_status, state.view_inc)
     known = state.view_status != UNKNOWN
-    cand_status, cand_inc = decode_key(recv_key, state.view_inc)
+    cand_status, cand_inc = decode_key(recv_key)
     alive_or_leaving = (cand_status == ALIVE) | (cand_status == LEAVING)
     accept = (
         (recv_key > own_key)
@@ -138,10 +148,20 @@ def _select_topk(scores: jax.Array, mask: jax.Array, k: int) -> tuple[jax.Array,
     return idx, vals >= 0.0
 
 
+def _loss_at(state: SimState, i, j) -> jnp.ndarray:
+    """Directed-link loss lookup. ``state.loss`` is either the dense [N, N]
+    matrix (emulator mode) or a 0-d scalar (uniform loss — the memory-lean
+    mode for very large N, where a dense float32 matrix would dominate HBM:
+    40 GB at N=100k)."""
+    if state.loss.ndim == 0:
+        return jnp.broadcast_to(state.loss, jnp.shape(i))
+    return state.loss[i, j]
+
+
 def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -> jax.Array:
     """Delivery draw for a directed message src->dst (sender+receiver up,
     Bernoulli on outbound loss — NetworkEmulator.java:349-369)."""
-    p = 1.0 - state.loss[src, dst]
+    p = 1.0 - _loss_at(state, src, dst)
     return state.up[src] & state.up[dst] & (draw < p)
 
 
@@ -160,7 +180,7 @@ def _fd_phase(
     has_tgt = sel_valid[:, 0] & state.up
 
     # Direct ping: PING out + ACK back must both survive (request-response).
-    p_direct = (1.0 - state.loss[rows, tgt]) * (1.0 - state.loss[tgt, rows])
+    p_direct = (1.0 - _loss_at(state, rows, tgt)) * (1.0 - _loss_at(state, tgt, rows))
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     # Indirect probe via k relays: PING_REQ -> transit PING -> transit ACK ->
@@ -169,10 +189,10 @@ def _fd_phase(
     relay_valid = sel_valid[:, 1:]
     tgt_b = tgt[:, None]
     p_relay = (
-        (1.0 - state.loss[rows[:, None], relays])
-        * (1.0 - state.loss[relays, tgt_b])
-        * (1.0 - state.loss[tgt_b, relays])
-        * (1.0 - state.loss[relays, rows[:, None]])
+        (1.0 - _loss_at(state, rows[:, None], relays))
+        * (1.0 - _loss_at(state, relays, tgt_b))
+        * (1.0 - _loss_at(state, tgt_b, relays))
+        * (1.0 - _loss_at(state, relays, rows[:, None]))
     )
     relay_ok = (
         relay_valid
@@ -223,30 +243,6 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
     return state.replace(
         view_status=jnp.where(expired, jnp.int8(DEAD), state.view_status),
         changed_at=jnp.where(expired, state.tick, state.changed_at),
-    )
-
-
-def _removal_phase(state: SimState, params: SimParams) -> SimState:
-    """DEAD records age out of the table: once a DEAD record is older than
-    the gossip-spread window (its rumor has stopped circulating), the entry
-    reverts to UNKNOWN — the sim analogue of the reference's removal of
-    member+record on death (``onDeadMemberDetected:740-767``). This is what
-    lets a partitioned-then-healed member be re-accepted as a fresh ALIVE
-    record (reference partition-recovery scenarios): ALIVE-against-absent is
-    accepted, while DEAD tombstones would absorb forever. The node's own
-    diagonal DEAD is kept — a dead identity cannot rejoin (rejoin = new
-    member id, ``FailureDetectorTest.java:393-401``)."""
-    n = state.capacity
-    spread = params.repeat_mult * ceil_log2(_cluster_size(state))
-    stale_dead = (
-        (state.view_status == DEAD)
-        & (state.tick - state.changed_at >= spread[:, None])
-        & state.up[:, None]
-        & ~jnp.eye(n, dtype=bool)
-    )
-    return state.replace(
-        view_status=jnp.where(stale_dead, jnp.int8(UNKNOWN), state.view_status),
-        view_inc=jnp.where(stale_dead, 0, state.view_inc),
     )
 
 
@@ -308,7 +304,7 @@ def _sync_phase(
     peer_idx, peer_valid = _select_topk(r.sync_scores, cand, 1)
     peer = peer_idx[:, 0]
     # Round trip: SYNC out and SYNC_ACK back must both survive.
-    p_rt = (1.0 - state.loss[rows, peer]) * (1.0 - state.loss[peer, rows])
+    p_rt = (1.0 - _loss_at(state, rows, peer)) * (1.0 - _loss_at(state, peer, rows))
     ok = due & peer_valid[:, 0] & state.up[peer] & (r.sync_edge < p_rt)
 
     known = state.view_status != UNKNOWN
@@ -338,14 +334,30 @@ def _sync_phase(
 
 
 def _refute_phase(state: SimState) -> SimState:
+    """A running node that finds itself SUSPECT — or even DEAD (a lingering
+    cross-partition death rumor can land after a heal) — re-announces ALIVE
+    with a bumped incarnation. The reference refutes ANY overriding record
+    about self this way, keeping its own liveness and bumping past the
+    rumor's incarnation (``onSelfMemberDetected:686-708``: r2 =
+    (self, status, max(inc)+1)); without the DEAD case a node declared dead
+    by others becomes a permanent zombie — up, but invisible forever.
+    Deliberate LEAVING (self-initiated) is not refuted."""
     n = state.capacity
     rows = jnp.arange(n)
     self_status = state.view_status[rows, rows]
-    need = state.up & (self_status == SUSPECT)
+    # a leaver whose diagonal was overwritten (or echoed back) also refutes —
+    # but re-announces LEAVING, not ALIVE: the reference keeps its own status
+    # (r2 = (self, r0.status, inc+1)), so a graceful leave is never cancelled
+    need = state.up & (
+        (self_status == SUSPECT)
+        | (self_status == DEAD)
+        | (state.leaving & (self_status != LEAVING))
+    )
+    announce = jnp.where(state.leaving, jnp.int8(LEAVING), jnp.int8(ALIVE))
     new_inc = jnp.where(need, state.view_inc[rows, rows] + 1, state.view_inc[rows, rows])
     return state.replace(
         view_status=state.view_status.at[rows, rows].set(
-            jnp.where(need, jnp.int8(ALIVE), self_status)
+            jnp.where(need, announce, self_status)
         ),
         view_inc=state.view_inc.at[rows, rows].set(new_inc),
         changed_at=state.changed_at.at[rows, rows].set(
@@ -387,7 +399,6 @@ def tick(
         (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state
     )
     state = _suspicion_phase(state, params)
-    state = _removal_phase(state, params)
     state, g_m = _gossip_phase(state, r, params)
     state, s_m = _sync_phase(state, r, params)
     state = _refute_phase(state)

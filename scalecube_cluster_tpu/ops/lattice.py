@@ -13,28 +13,37 @@ records for the same receiver row in one tick; the combiner must be
 commutative + associative), each ``(status, incarnation)`` is packed into one
 monotone int32 key::
 
-    key = DEAD_KEY                    if status == DEAD
-        = incarnation * 4 + rank      otherwise,
-          rank: ALIVE -> 0, LEAVING -> 1, SUSPECT -> 2
+    key = incarnation * 4 + rank
+    rank: ALIVE -> 0, LEAVING -> 1, SUSPECT -> 2, DEAD -> 3
 
-``new overrides old  <=>  key(new) > key(old)`` — exactly the reference truth
-table, with two deliberate, documented deviations forced by totalizing the
-(partial) order:
+``new overrides old  <=>  key(new) > key(old)`` — the reference truth table
+with three deliberate, documented deviations forced by totalizing the
+(partial) order over a table that, unlike the reference's, holds DEAD
+tombstones:
 
-1. At equal incarnation LEAVING gets rank 1 > ALIVE's 0, so a LEAVING
-   candidate beats a same-incarnation ALIVE record. In the reference neither
-   overrides the other; since LEAVING is only ever self-announced, the
-   conflicting pair originates from the same node and LEAVING is strictly the
-   newer fact, so resolving toward LEAVING is the faithful choice.
-2. A DEAD record never overrides an existing DEAD record regardless of
-   incarnation (reference: same — DEAD is terminal), so DEAD keys carry no
-   incarnation; on accepting DEAD the receiver keeps its previously-known
-   incarnation.
+1. At equal incarnation LEAVING (rank 1) beats ALIVE (rank 0); in the
+   reference neither overrides the other. LEAVING is only ever
+   self-announced, so the conflicting pair comes from the same node and
+   LEAVING is strictly the newer fact.
+2. **DEAD is absorbing per incarnation, not absolutely.** ``DEAD@i`` beats
+   every status at incarnation ``<= i`` but loses to any record with a
+   higher incarnation. The reference's DEAD is absolute — but its tables
+   never *hold* DEAD (the member is removed on the spot,
+   ``onDeadMemberDetected:740-767``) and its gossip layer dedups each death
+   rumor per receiver (``SequenceIdCollector``), so each node processes a
+   given death exactly once. This kernel keeps DEAD in the table for the
+   rumor-spread window instead; were DEAD absolute, a refuting node
+   (``onSelfMemberDetected`` bumps incarnation past the rumor) could chase
+   its own death rumor in sustained reinfection waves — absorbing-per-
+   incarnation makes the refuted ``ALIVE@i+1`` dominate everywhere, exactly
+   the reference's net outcome (death processed once, refutation wins).
+3. A stale ``DEAD@i`` does NOT override records at incarnation ``> i``
+   (consequence of 2); the reference would remove-and-readd instead.
 
 The "no record yet" case (reference: only ALIVE/LEAVING accepted against an
 absent record) is NOT part of the key: unknown entries get key ``-1`` and a
 separate accept gate blocks SUSPECT/DEAD candidates for unknown members
-(see ``tick._merge``).
+(see ``kernel._merge``).
 
 Incarnations must stay below ``2**28`` to fit the packing; they only grow by
 refutations/metadata bumps, so this is never a practical limit.
@@ -51,15 +60,13 @@ LEAVING = 2
 DEAD = 3
 UNKNOWN = 4  # kernel-internal: "I have no record for this member"
 
-DEAD_KEY = jnp.int32(1 << 30)
 UNKNOWN_KEY = jnp.int32(-1)
 NO_CANDIDATE = jnp.iinfo(jnp.int32).min  # scatter-max identity
 
-# rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1 (DEAD/UNKNOWN
-# handled separately but given harmless entries).
-_RANK = jnp.array([0, 2, 1, 0, 0], dtype=jnp.int32)
-# status lookup by rank: 0->ALIVE, 1->LEAVING, 2->SUSPECT
-_RANK_TO_STATUS = jnp.array([ALIVE, LEAVING, SUSPECT, ALIVE], dtype=jnp.int8)
+# rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1, DEAD->3
+_RANK = jnp.array([0, 2, 1, 3, 0], dtype=jnp.int32)
+# status lookup by rank: 0->ALIVE, 1->LEAVING, 2->SUSPECT, 3->DEAD
+_RANK_TO_STATUS = jnp.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=jnp.int8)
 
 
 def precedence_key(status: jnp.ndarray, incarnation: jnp.ndarray) -> jnp.ndarray:
@@ -69,22 +76,11 @@ def precedence_key(status: jnp.ndarray, incarnation: jnp.ndarray) -> jnp.ndarray
     them (the ALIVE/LEAVING-only gate is applied separately).
     """
     status = status.astype(jnp.int32)
-    live_key = incarnation.astype(jnp.int32) * 4 + _RANK[status]
-    key = jnp.where(status == DEAD, DEAD_KEY, live_key)
+    key = incarnation.astype(jnp.int32) * 4 + _RANK[status]
     return jnp.where(status == UNKNOWN, UNKNOWN_KEY, key)
 
 
-def decode_key(
-    key: jnp.ndarray, old_inc: jnp.ndarray
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Unpack a winning candidate key back to ``(status, incarnation)``.
-
-    DEAD keys carry no incarnation — the receiver keeps ``old_inc``
-    (deviation 2 in the module docstring).
-    """
-    is_dead = key == DEAD_KEY
-    inc = jnp.where(is_dead, old_inc, key >> 2)
-    status = jnp.where(
-        is_dead, jnp.int8(DEAD), _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
-    )
-    return status, inc.astype(jnp.int32)
+def decode_key(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Unpack a winning candidate key back to ``(status, incarnation)``."""
+    status = _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
+    return status, (key >> 2).astype(jnp.int32)

@@ -20,16 +20,14 @@ from .lattice import ALIVE, DEAD, LEAVING, SUSPECT, UNKNOWN
 from .rand import draw_tick_randoms
 from .state import SimParams, SimState
 
-_DEAD_KEY = 1 << 30
+_RANK = {ALIVE: 0, LEAVING: 1, SUSPECT: 2, DEAD: 3}
+_RANK_TO_STATUS = {0: ALIVE, 1: LEAVING, 2: SUSPECT, 3: DEAD}
 
 
 def _key(status: int, inc: int) -> int:
     if status == UNKNOWN:
         return -1
-    if status == DEAD:
-        return _DEAD_KEY
-    rank = {ALIVE: 0, LEAVING: 1, SUSPECT: 2}[status]
-    return inc * 4 + rank
+    return inc * 4 + _RANK[status]
 
 
 def _ceil_log2(n: int) -> int:
@@ -54,6 +52,7 @@ class _O:
         self.changed = np.asarray(state.changed_at).copy()
         self.since = np.asarray(state.suspect_since).copy()
         self.force_sync = np.asarray(state.force_sync).copy()
+        self.leaving = np.asarray(state.leaving).copy()
         self.r_active = np.asarray(state.rumor_active).copy()
         self.r_origin = np.asarray(state.rumor_origin).copy()
         self.r_created = np.asarray(state.rumor_created).copy()
@@ -67,6 +66,10 @@ class _O:
         return copy.deepcopy(self)
 
 
+def _loss(o: "_O", i: int, j: int) -> np.float32:
+    return np.float32(o.loss) if o.loss.ndim == 0 else o.loss[i, j]
+
+
 def _live_mask(o: _O, i: int) -> np.ndarray:
     m = o.status[i] <= LEAVING
     m[i] = False
@@ -77,25 +80,22 @@ def _cluster_size(o: _O, i: int) -> int:
     return int((o.status[i] <= LEAVING).sum())
 
 
-def _accept_into(o: _O, i: int, j: int, cand_key: int) -> None:
+def _accept_into(o: _O, i: int, j: int, cand_key: int) -> bool:
     """The overrides gate + write, identical to kernel._merge for one cell."""
     own = _key(int(o.status[i, j]), int(o.inc[i, j]))
     if cand_key <= own:
-        return
+        return False
     known = o.status[i, j] != UNKNOWN
-    if cand_key == _DEAD_KEY:
-        st_new, inc_new = DEAD, int(o.inc[i, j])
-    else:
-        rank = cand_key & 3
-        st_new = {0: ALIVE, 1: LEAVING, 2: SUSPECT}[rank]
-        inc_new = cand_key >> 2
+    st_new = _RANK_TO_STATUS[cand_key & 3]
+    inc_new = cand_key >> 2
     if not known and st_new not in (ALIVE, LEAVING):
-        return
+        return False
     o.status[i, j] = st_new
     o.inc[i, j] = inc_new
     o.changed[i, j] = o.tick
     if st_new == SUSPECT:
         o.since[i, j] = o.tick
+    return True
 
 
 def oracle_tick(state: SimState, key, params: SimParams) -> _O:
@@ -118,8 +118,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             if not valid[0]:
                 continue
             tgt = int(sel[0])
-            p_direct = (np.float32(1.0) - pre.loss[i, tgt]) * (
-                np.float32(1.0) - pre.loss[tgt, i]
+            p_direct = (np.float32(1.0) - _loss(pre, i, tgt)) * (
+                np.float32(1.0) - _loss(pre, tgt, i)
             )
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
             for s in range(k):
@@ -129,10 +129,10 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                     continue
                 rl = int(sel[1 + s])
                 p4 = (
-                    (np.float32(1.0) - pre.loss[i, rl])
-                    * (np.float32(1.0) - pre.loss[rl, tgt])
-                    * (np.float32(1.0) - pre.loss[tgt, rl])
-                    * (np.float32(1.0) - pre.loss[rl, i])
+                    (np.float32(1.0) - _loss(pre, i, rl))
+                    * (np.float32(1.0) - _loss(pre, rl, tgt))
+                    * (np.float32(1.0) - _loss(pre, tgt, rl))
+                    * (np.float32(1.0) - _loss(pre, rl, i))
                 )
                 if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
                     ack = True
@@ -159,16 +159,6 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 o.status[i, j] = DEAD
                 o.changed[i, j] = t
 
-    # ---- removal of stale DEAD records ----
-    for i in range(n):
-        if not o.up[i]:
-            continue
-        spread = params.repeat_mult * _ceil_log2(_cluster_size(o, i))
-        for j in range(n):
-            if j != i and o.status[i, j] == DEAD and t - o.changed[i, j] >= spread:
-                o.status[i, j] = UNKNOWN
-                o.inc[i, j] = 0
-
     # ---- gossip phase ----
     pre = o.snap()
     recv_key = np.full((n, n), np.iinfo(np.int64).min, dtype=np.int64)
@@ -184,7 +174,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             p = int(peers[s])
             if not pre.up[p]:
                 continue
-            if not r["gossip_edge"][i, s] < (np.float32(1.0) - pre.loss[i, p]):
+            if not r["gossip_edge"][i, s] < (np.float32(1.0) - _loss(pre, i, p)):
                 continue
             for j in range(n):
                 if pre.status[i, j] != UNKNOWN and t - pre.changed[i, j] < spread:
@@ -227,7 +217,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         if not valid[0]:
             continue
         p = int(peers[0])
-        p_rt = (np.float32(1.0) - pre.loss[i, p]) * (np.float32(1.0) - pre.loss[p, i])
+        p_rt = (np.float32(1.0) - _loss(pre, i, p)) * (np.float32(1.0) - _loss(pre, p, i))
         if pre.up[p] and r["sync_edge"][i] < p_rt:
             # bootstrap force_sync clears only on a successful round-trip
             o.force_sync[i] = False
@@ -248,11 +238,15 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             if mid.status[p, j] != UNKNOWN:
                 _accept_into(o, i, j, _key(int(mid.status[p, j]), int(mid.inc[p, j])))
 
-    # ---- refutation ----
+    # ---- refutation (SUSPECT/DEAD self-record, or overwritten leave intent;
+    # a leaver re-announces LEAVING — see kernel._refute_phase) ----
     for i in range(n):
-        if o.up[i] and o.status[i, i] == SUSPECT:
+        if not o.up[i]:
+            continue
+        st_self = o.status[i, i]
+        if st_self in (SUSPECT, DEAD) or (o.leaving[i] and st_self != LEAVING):
             o.inc[i, i] += 1
-            o.status[i, i] = ALIVE
+            o.status[i, i] = LEAVING if o.leaving[i] else ALIVE
             o.changed[i, i] = t
 
     # ---- rumor sweep ----
@@ -275,6 +269,7 @@ def assert_equivalent(state: SimState, o: _O) -> None:
         "changed_at": (np.asarray(state.changed_at), o.changed),
         "suspect_since": (np.asarray(state.suspect_since), o.since),
         "force_sync": (np.asarray(state.force_sync), o.force_sync),
+        "leaving": (np.asarray(state.leaving), o.leaving),
         "rumor_active": (np.asarray(state.rumor_active), o.r_active),
         "infected": (np.asarray(state.infected), o.infected),
         "infected_at": (np.asarray(state.infected_at), o.infected_at),

@@ -32,9 +32,11 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (MEMBER_AXIS,))
 
 
-def state_shardings(mesh: Mesh) -> SimState:
+def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
     """A SimState-shaped pytree of NamedShardings: member-axis tensors split
-    on rows, small per-rumor/scalar leaves replicated."""
+    on rows, small per-rumor/scalar leaves replicated. ``dense_links=False``
+    matches states built with a scalar uniform loss (the memory-lean
+    large-N mode), which must be replicated, not row-sharded."""
     row = NamedSharding(mesh, P(MEMBER_AXIS))
     row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
     rep = NamedSharding(mesh, P())
@@ -46,21 +48,22 @@ def state_shardings(mesh: Mesh) -> SimState:
         changed_at=row2d,
         suspect_since=row2d,
         force_sync=row,
+        leaving=row,
         rumor_active=rep,
         rumor_origin=rep,
         rumor_created=rep,
         infected=row2d,
         infected_at=row2d,
-        loss=row2d,
+        loss=row2d if dense_links else rep,
     )
 
 
 def shard_state(state: SimState, mesh: Mesh) -> SimState:
     """Place an existing (host/single-device) state onto the mesh."""
-    return jax.device_put(state, state_shardings(mesh))
+    return jax.device_put(state, state_shardings(mesh, state.loss.ndim != 0))
 
 
-def make_sharded_tick(mesh: Mesh, params: SimParams):
+def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
     """jit the tick with explicit in/out shardings over ``mesh``.
 
     Capacity must be divisible by the mesh size (pad rows and leave them
@@ -70,7 +73,7 @@ def make_sharded_tick(mesh: Mesh, params: SimParams):
         raise ValueError(
             f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
         )
-    sh = state_shardings(mesh)
+    sh = state_shardings(mesh, dense_links)
     rep = NamedSharding(mesh, P())
     return jax.jit(
         partial(tick, params=params),

@@ -93,12 +93,23 @@ class SimState(struct.PyTreeNode):
     """One cluster simulation: N nodes' replicated SWIM state + rumor pool.
 
     ``view_status[i, j]`` / ``view_inc[i, j]`` — node i's record for j
-    (UNKNOWN=4 when i has no record). ``changed_at[i, j]`` — tick at which
-    i's record for j last changed; a record is piggybacked on gossip while
-    ``tick - changed_at < repeat_mult * ceil_log2(cluster_size_i)``, the
-    reference's gossip-age rule (``GossipProtocolImpl.java:311-320``).
-    ``suspect_since[i, j]`` — tick at which the current suspicion began
-    (suspicion timer, ``MembershipProtocolImpl.java:805-823``).
+    (UNKNOWN=4 when i has no record). ``suspect_since[i, j]`` — tick at which
+    the current suspicion began (suspicion timer,
+    ``MembershipProtocolImpl.java:805-823``).
+
+    ``changed_at[i, j]`` — tick at which i's record for j last changed; a
+    record is piggybacked on gossip while ``tick - changed_at <
+    repeat_mult * ceil_log2(cluster_size_i)``, the reference's gossip-age
+    rule (``GossipProtocolImpl.java:311-320``). Because each cell's
+    precedence key is strictly monotone (DEAD records are kept as
+    tombstones, never removed — ``lattice.py`` deviation 2 makes them
+    beatable by a higher-incarnation refutation), a given record is accepted
+    — and therefore forwarded — at most once per cell: every rumor's total
+    circulation is bounded (SIR) and the cluster state converges
+    monotonically, with no death-rumor/refutation cycles and no stale-record
+    resurrection. DEAD = "removed" at the membership-API level (the driver
+    emits REMOVED on the DEAD transition, exactly when the reference removes
+    the member, ``onDeadMemberDetected:740-767``).
 
     Rumor pool: R slots of user gossip (``spreadGossip``), infection bitmap
     ``infected[i, r]`` + ``infected_at`` for the forwarding-age rule; dedup
@@ -117,6 +128,7 @@ class SimState(struct.PyTreeNode):
     changed_at: jax.Array  # i32 [N, N]
     suspect_since: jax.Array  # i32 [N, N]
     force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
+    leaving: jax.Array  # bool [N] — graceful-leave intent (survives record overwrites)
     rumor_active: jax.Array  # bool [R]
     rumor_origin: jax.Array  # i32 [R]
     rumor_created: jax.Array  # i32 [R]
@@ -129,13 +141,24 @@ class SimState(struct.PyTreeNode):
         return self.up.shape[0]
 
 
-def init_state(params: SimParams, n_initial: int, warm: bool = True) -> SimState:
+def init_state(
+    params: SimParams,
+    n_initial: int,
+    warm: bool = True,
+    dense_links: bool = True,
+    uniform_loss: float = 0.0,
+) -> SimState:
     """Fresh simulation with rows ``0..n_initial-1`` up.
 
     ``warm=True``: a converged cluster (everyone holds ALIVE@0 records for
     everyone) — the right starting point for FD / gossip / churn benches.
     ``warm=False``: cold rows know only themselves; use :func:`join_row` /
     seed knowledge + SYNC to converge (join-path tests).
+
+    ``dense_links=False`` stores the link loss as one scalar
+    (``uniform_loss``) instead of the [N, N] matrix — required at very large
+    N (the dense float32 matrix alone is 40 GB at N=100k); per-link emulator
+    controls then raise until densified.
     """
     n = params.capacity
     r = params.rumor_slots
@@ -154,12 +177,17 @@ def init_state(params: SimParams, n_initial: int, warm: bool = True) -> SimState
         changed_at=jnp.full((n, n), NEVER),
         suspect_since=jnp.full((n, n), FAR_FUTURE),
         force_sync=jnp.zeros((n,), bool),
+        leaving=jnp.zeros((n,), bool),
         rumor_active=jnp.zeros((r,), bool),
         rumor_origin=jnp.zeros((r,), jnp.int32),
         rumor_created=jnp.zeros((r,), jnp.int32),
         infected=jnp.zeros((n, r), bool),
         infected_at=jnp.zeros((n, r), jnp.int32),
-        loss=jnp.zeros((n, n), jnp.float32),
+        loss=(
+            jnp.full((n, n), uniform_loss, jnp.float32)
+            if dense_links
+            else jnp.float32(uniform_loss)
+        ),
     )
 
 
@@ -193,6 +221,7 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
         changed_at=state.changed_at.at[row].set(NEVER).at[row, row].set(state.tick),
         suspect_since=state.suspect_since.at[row].set(FAR_FUTURE),
         force_sync=state.force_sync.at[row].set(True),
+        leaving=state.leaving.at[row].set(False),
         infected=state.infected.at[row].set(False),
     )
 
@@ -205,10 +234,14 @@ def crash_row(state: SimState, row: int) -> SimState:
 def begin_leave(state: SimState, row: int) -> SimState:
     """Graceful leave: announce LEAVING (self record), keep running so the
     rumor spreads; call :func:`crash_row` a few ticks later to stop.
-    Mirrors leaveCluster's LEAVING gossip (``MembershipProtocolImpl.java:233-242``)."""
+    Mirrors leaveCluster's LEAVING gossip (``MembershipProtocolImpl.java:233-242``).
+    The ``leaving`` mask records the intent outside the overwritable record,
+    so refutation re-announces LEAVING (the reference keeps its OWN status,
+    ``onSelfMemberDetected``'s r0.status), never resurrecting a leaver."""
     return state.replace(
         view_status=state.view_status.at[row, row].set(jnp.int8(LEAVING)),
         changed_at=state.changed_at.at[row, row].set(state.tick),
+        leaving=state.leaving.at[row].set(True),
     )
 
 
@@ -238,6 +271,10 @@ def spread_rumor(state: SimState, slot: int, origin: int) -> SimState:
 def set_link_loss(state: SimState, src, dst, loss: float) -> SimState:
     """Set outbound loss on directed link(s) src->dst (emulator
     setOutboundSettings); scalars or sequences on either side."""
+    if state.loss.ndim == 0:
+        raise ValueError(
+            "per-link loss needs dense links; init_state(dense_links=True)"
+        )
     src = jnp.atleast_1d(jnp.asarray(src))
     dst = jnp.atleast_1d(jnp.asarray(dst))
     return state.replace(loss=state.loss.at[src[:, None], dst[None, :]].set(loss))

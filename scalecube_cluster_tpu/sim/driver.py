@@ -69,10 +69,9 @@ class SimDriver:
         if mesh is not None:
             from ..ops.sharding import make_sharded_tick, shard_state
 
-            self._step = make_sharded_tick(mesh, params)
-            self.state: SimState = shard_state(
-                _state.init_state(params, n_initial, warm=warm), mesh
-            )
+            init = _state.init_state(params, n_initial, warm=warm)
+            self._step = make_sharded_tick(mesh, params, init.loss.ndim != 0)
+            self.state: SimState = shard_state(init, mesh)
         else:
             self._step = jax.jit(partial(_kernel.tick, params=params))
             self.state = _state.init_state(params, n_initial, warm=warm)
@@ -162,8 +161,8 @@ class SimDriver:
             old_s, new_s = int(w.prev_status[j]), int(status[j])
             ev: Optional[MembershipEvent] = None
             # old DEAD counts as "not a member": REMOVED already fired when
-            # the record went DEAD; a DEAD->ALIVE flip within one tick (the
-            # removal phase runs before the merge phases) is a fresh ADDED.
+            # the record went DEAD; a later DEAD->ALIVE flip (a zombie/rejoin
+            # refutation beating the tombstone) is a fresh ADDED.
             if old_s in (UNKNOWN, DEAD) and new_s in (ALIVE, SUSPECT, LEAVING):
                 w.known[j] = self._member_handle(j)
                 ev = MembershipEvent.added(w.known[j])
@@ -189,12 +188,21 @@ class SimDriver:
 
     # -- lifecycle / churn --------------------------------------------------
     def join(self, seed_rows: Sequence[int] = (0,)) -> int:
-        """Activate a free row as a fresh member; returns its row."""
+        """Activate a free row as a fresh member; returns its row.
+
+        Prefers a row no up member still has records about — reusing a row
+        whose previous occupant is still SUSPECT/DEAD in peers' tables would
+        conflate the two identities (the reference's restart-on-same-address
+        gets a fresh member id precisely to avoid this)."""
         up = np.asarray(self.state.up)
         free = np.nonzero(~up)[0]
         if len(free) == 0:
             raise RuntimeError("no free rows (capacity exhausted)")
-        row = int(free[0])
+        remembered = np.asarray(  # [N] — some up member still has a record
+            ((self.state.view_status != UNKNOWN) & self.state.up[:, None]).any(axis=0)
+        )
+        forgotten = free[~remembered[free]]
+        row = int(forgotten[0]) if len(forgotten) else int(free[0])
         self.state = _state.join_row(self.state, row, list(seed_rows))
         # a restart reuses the row but is a NEW member identity (reference:
         # rejoin after restart gets a fresh member id)

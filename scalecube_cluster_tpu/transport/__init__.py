@@ -1,4 +1,4 @@
-from . import codecs, local, tcp  # register factories/codecs (ServiceLoader analogue)
+from . import codecs, local, native_codec, tcp  # register factories/codecs (ServiceLoader analogue)
 from .api import (
     Listeners,
     PeerUnavailableError,

@@ -1,0 +1,99 @@
+"""Monitor endpoint tests (the JMX MBean analogue, SURVEY.md §2.2)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.monitor import (
+    MonitorServer,
+    TickLogger,
+    cluster_snapshot,
+    sim_snapshot,
+)
+from scalecube_cluster_tpu.ops.state import SimParams
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.transport import MemoryTransportRegistry
+
+from _helpers import await_until
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    MemoryTransportRegistry.reset_default()
+    yield
+    MemoryTransportRegistry.reset_default()
+
+
+def _http_get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_cluster_snapshot_and_http_endpoint():
+    async def run():
+        cfg = ClusterConfig.default_local()
+        a = await new_cluster(cfg.replace(member_alias="A")).start()
+        b = await new_cluster(
+            cfg.replace(member_alias="B").with_membership(
+                lambda m: m.replace(seed_members=(a.address,))
+            )
+        ).start()
+        await await_until(lambda: len(a.members()) == 2)
+
+        snap = cluster_snapshot(a)
+        assert snap["cluster_size"] == 2
+        assert snap["member"]["alias"] == "A"
+        assert len(snap["alive_members"]) == 2
+        assert snap["config"]["gossip_fanout"] == 3
+
+        server = await MonitorServer().start()
+        server.register_cluster(a)
+        server.register_cluster(b)
+        loop = asyncio.get_running_loop()
+        index = await loop.run_in_executor(None, _http_get, server.url + "/")
+        assert sorted(index["nodes"]) == sorted([a.member().id, b.member().id])
+        one = await loop.run_in_executor(
+            None, _http_get, f"{server.url}/nodes/{a.member().id}"
+        )
+        assert one["cluster_size"] == 2
+        missing = await loop.run_in_executor(None, _http_get, server.url + "/nodes")
+        assert len(missing) == 2
+        await server.stop()
+        await b.shutdown()
+        await a.shutdown()
+
+    asyncio.run(run())
+
+
+def test_sim_snapshot():
+    params = SimParams(capacity=8, fd_every=1, sync_every=4, rumor_slots=2, seed_rows=(0,))
+    d = SimDriver(params, n_initial=6, warm=True)
+    d.step(3)
+    snap = sim_snapshot(d, 2)
+    assert snap["cluster_size"] == 6
+    assert snap["up"] is True
+    assert snap["tick"] == 3
+    assert len(snap["alive_members"]) == 6
+    assert snap["config"]["capacity"] == 8
+
+
+def test_tick_logger(tmp_path):
+    params = SimParams(capacity=8, fd_every=1, sync_every=4, rumor_slots=2, seed_rows=(0,))
+    d = SimDriver(params, n_initial=6, warm=True)
+    path = str(tmp_path / "ticks.jsonl")
+    logger = TickLogger(path)
+    for _ in range(3):
+        m = d.step()
+        logger.log_tick(d.tick, m)
+    logger.log_event(d.tick, "crash", row=5)
+    logger.close()
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 4
+    assert lines[0]["t"] == 1 and "fd_probes" in lines[0]
+    assert lines[-1]["event"] == "crash"

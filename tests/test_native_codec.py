@@ -1,0 +1,110 @@
+"""Binary codec tests: C extension <-> pure-Python format interop, facade use.
+
+The reference demonstrates codec plurality via its jackson/jackson-smile
+modules registered through META-INF/services; here the second full codec is
+the native binary one (C fast path + identical-format Python fallback).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.transport.native_codec import (
+    BinaryMessageCodec,
+    _PyWire,
+    _load_wire,
+)
+
+MESSAGES = [
+    Message.with_data(b"raw-bytes", qualifier="q/bytes", cid="c-1"),
+    Message.with_data("unicode-строка", qualifier="q/str"),
+    Message.with_data({"nested": [1, 2, {"x": None}]}, qualifier="q/obj"),
+    Message.with_data(None),
+    Message(headers={}, data=b""),
+]
+
+
+@pytest.mark.parametrize("msg", MESSAGES)
+def test_python_fallback_roundtrip(msg):
+    codec = BinaryMessageCodec(wire=_PyWire)
+    out = codec.decode(codec.encode(msg))
+    assert out.headers == msg.headers
+    assert out.data == msg.data
+
+
+def test_native_builds_and_roundtrips():
+    wire = _load_wire()
+    if wire is _PyWire:
+        pytest.skip("no C compiler available")
+    codec = BinaryMessageCodec(wire=wire)
+    assert codec.is_native
+    for msg in MESSAGES:
+        out = codec.decode(codec.encode(msg))
+        assert out.headers == msg.headers
+        assert out.data == msg.data
+
+
+def test_native_and_python_formats_are_identical():
+    wire = _load_wire()
+    if wire is _PyWire:
+        pytest.skip("no C compiler available")
+    headers = {"q": "test/qualifier", "cid": "abc-123", "sender": "tcp://h:1"}
+    payload = b"\x00\x01binary\xff"
+    assert wire.encode(headers, payload) == _PyWire.encode(headers, payload)
+    # cross-decode both directions
+    assert wire.decode(_PyWire.encode(headers, payload)) == (headers, payload)
+    assert _PyWire.decode(wire.encode(headers, payload)) == (headers, payload)
+
+
+def test_corrupt_frames_rejected():
+    codec = BinaryMessageCodec(wire=_PyWire)
+    with pytest.raises(ValueError):
+        codec.decode(b"XX garbage")
+    good = codec.encode(Message.with_data("x", qualifier="q"))
+    with pytest.raises(ValueError):
+        codec.decode(good[: len(good) - 2])  # truncated
+    wire = _load_wire()
+    if wire is not _PyWire:
+        with pytest.raises(ValueError):
+            wire.decode(b"XX garbage")
+        with pytest.raises(ValueError):
+            wire.decode(good[: len(good) - 2])
+
+
+def test_binary_codec_over_tcp_cluster():
+    """Two real-TCP nodes talking through the binary codec end-to-end."""
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    async def run():
+        cfg = ClusterConfig.default_local().with_transport(
+            lambda t: t.replace(transport_factory="tcp", message_codec="binary")
+        )
+        a = await new_cluster(cfg.replace(member_alias="A")).start()
+        b = await new_cluster(
+            cfg.replace(member_alias="B").with_membership(
+                lambda m: m.replace(seed_members=(a.address,))
+            )
+        ).start()
+
+        def responder(msg):
+            if msg.qualifier == "ping":
+                reply = Message.with_data(
+                    {"echo": msg.data}, qualifier="pong", cid=msg.correlation_id
+                )
+                asyncio.ensure_future(a.send(msg.sender, reply))
+
+        a.listen_messages().subscribe(responder)
+        await asyncio.sleep(0.8)
+        target = b.member_by_id(a.member().id)
+        resp = await b.request_response(
+            target, Message.with_data([1, "two", 3.0], qualifier="ping")
+        )
+        assert resp.data == {"echo": [1, "two", 3.0]}
+        await b.shutdown()
+        await a.shutdown()
+
+    asyncio.run(run())

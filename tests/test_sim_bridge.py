@@ -51,8 +51,9 @@ def test_membership_events_on_crash_and_join():
     d.step(20)
     kinds = [(e.type, e.member.id) for e in events]
     assert (MembershipEventType.ADDED, joined_id) in kinds
-    # a reused row gets a fresh member identity (restart = new member)
-    assert row == 5 and joined_id != "sim-5"
+    # a never-used row is preferred over the tombstoned one, and the joiner
+    # gets a fresh identity either way
+    assert row != 5 and joined_id != "sim-5"
 
 
 def test_leaving_event_then_removed():
@@ -170,8 +171,11 @@ def test_checkpoint_restore_resumes_identically(tmp_path):
 
 def test_row_reuse_does_not_relabel_old_records():
     """An observer that still holds records about a row's previous occupant
-    must emit events for the OLD identity even after the row is reused."""
-    d = make_driver()
+    must emit events for the OLD identity even after the row is reused.
+    Capacity is full, so the crashed row MUST be reused; the newcomer's
+    ALIVE@0 is rejected by the tombstone until its seed-SYNC-triggered
+    refutation pushes the incarnation past it."""
+    d = make_driver(n=16)  # full capacity: no never-used rows
     events = d.events_of(1)  # observer watches from the start
     old_id = d.members[5].id
     d.crash(5)
@@ -179,7 +183,7 @@ def test_row_reuse_does_not_relabel_old_records():
     row = d.join(seed_rows=[0])
     assert row == 5
     new_id = d.members[5].id
-    d.step(20)
+    d.step(40)
     removed = [e.member.id for e in events if e.type == MembershipEventType.REMOVED]
     added = [e.member.id for e in events if e.type == MembershipEventType.ADDED]
     assert removed == [old_id]

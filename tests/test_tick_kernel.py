@@ -76,8 +76,9 @@ def test_crash_suspect_dead_removed(step):
         saw_dead |= (col == DEAD).any()
     col = np.asarray(st.view_status)[np.asarray(st.up), 5]
     assert saw_suspect and saw_dead
-    # DEAD records age out of tables (reference removes member+record).
-    assert (col == UNKNOWN).all(), col
+    # DEAD records persist as tombstones ("removed" at the API level —
+    # monotone cells are what guarantee rumor extinction; lattice.py dev. 2)
+    assert (col == DEAD).all(), col
 
 
 def test_refutation_bumps_incarnation(step):
@@ -141,7 +142,7 @@ def test_graceful_leave_then_gone(step):
     assert saw_leaving
     vs = np.asarray(st.view_status)
     up = np.asarray(st.up)
-    assert (vs[up, 7] == UNKNOWN).all()  # detected dead, then removed
+    assert (vs[up, 7] == DEAD).all()  # detected dead (tombstoned = removed)
 
 
 def test_partition_detect_heal_rejoin(step):
@@ -151,9 +152,9 @@ def test_partition_detect_heal_rejoin(step):
     st = S.block_partition(st, half_a, half_b)
     st, key, _ = run(step, st, key, 45)
     vs = np.asarray(st.view_status)
-    # each side fully removed the other
-    assert (vs[np.ix_(half_a, half_b)] == UNKNOWN).all()
-    assert (vs[np.ix_(half_b, half_a)] == UNKNOWN).all()
+    # each side fully declared the other dead
+    assert (vs[np.ix_(half_a, half_b)] == DEAD).all()
+    assert (vs[np.ix_(half_b, half_a)] == DEAD).all()
     # and stayed converged internally
     assert (vs[np.ix_(half_a, half_a)] == ALIVE).all()
     # heal: periodic SYNC to the seed row re-bridges both sides
@@ -164,6 +165,25 @@ def test_partition_detect_heal_rejoin(step):
     cross = vs[np.ix_(half_a, half_b)]
     assert (cross == ALIVE).all(), np.unique(cross, return_counts=True)
     assert (vs[np.ix_(half_b, half_a)] == ALIVE).all()
+
+
+def test_zombie_refutes_dead_self_record(step):
+    """A running node that merges a DEAD record about itself (lingering
+    cross-partition death rumor arriving after a heal) must refute and
+    become visible again — not stay a permanent zombie."""
+    st = S.init_state(PARAMS, 12, warm=True)
+    key = jax.random.PRNGKey(11)
+    # plant the death rumor directly in the victim's own table
+    st = st.replace(
+        view_status=st.view_status.at[6, 6].set(DEAD),
+        changed_at=st.changed_at.at[6, 6].set(st.tick),
+    )
+    st, key, _ = run(step, st, key, 60)
+    vs = np.asarray(st.view_status)
+    vi = np.asarray(st.view_inc)
+    up = np.asarray(st.up)
+    assert vs[6, 6] == ALIVE and vi[6, 6] >= 1
+    assert (vs[up, 6] == ALIVE).all()  # everyone sees it alive again
 
 
 def test_metadata_update_propagates_as_incarnation(step):
@@ -192,7 +212,10 @@ def test_checkpoint_roundtrip(step):
 
 def test_lattice_matches_scalar_overrides():
     """Keyed join == MembershipRecord.isOverrides truth table, except the
-    documented LEAVING-vs-ALIVE equal-incarnation tie (lattice.py)."""
+    three documented deviations (lattice.py module docstring):
+    1. LEAVING beats ALIVE at equal incarnation;
+    2/3. DEAD is absorbing per incarnation, not absolutely — higher
+    incarnation beats DEAD, stale DEAD doesn't kill newer records."""
     import jax.numpy as jnp
 
     from scalecube_cluster_tpu.ops.lattice import precedence_key
@@ -206,11 +229,17 @@ def test_lattice_matches_scalar_overrides():
                     ko = int(precedence_key(jnp.int32(old_s), jnp.int32(old_i)))
                     keyed = kn > ko
                     ref = overrides_codes(new_s, new_i, old_s, old_i)
-                    if (
-                        new_s == MemberStatus.LEAVING
-                        and old_s == MemberStatus.ALIVE
-                        and new_i == old_i
-                    ):
-                        assert keyed and not ref  # documented deviation
+                    deviation = (
+                        # 1: LEAVING vs ALIVE at equal incarnation
+                        (new_s == MemberStatus.LEAVING and old_s == MemberStatus.ALIVE
+                         and new_i == old_i)
+                        # 2: higher incarnation beats DEAD (zombie refutation)
+                        or (old_s == MemberStatus.DEAD and new_i > old_i)
+                        # 3: stale DEAD doesn't kill newer records
+                        or (new_s == MemberStatus.DEAD and old_s != MemberStatus.DEAD
+                            and new_i < old_i)
+                    )
+                    if deviation:
+                        assert keyed != ref, (new_s, new_i, old_s, old_i)
                     else:
                         assert keyed == ref, (new_s, new_i, old_s, old_i)
