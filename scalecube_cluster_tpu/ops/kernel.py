@@ -140,12 +140,43 @@ def _merge(
     )
 
 
-def _select_topk(scores: jax.Array, mask: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
-    """Per-row top-k of uniform scores over ``mask`` — k distinct uniform
-    picks without replacement. Returns (idx [N,k], valid [N,k])."""
-    masked = jnp.where(mask, scores, -1.0)
-    vals, idx = jax.lax.top_k(masked, k)
-    return idx, vals >= 0.0
+def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row k distinct uniform picks (without replacement) from the
+    candidate set ``mask[i]``, consuming one uniform per pick (``u`` is
+    [N, k]) instead of a full [N, N] score matrix.
+
+    Exact sampling without replacement by rank insertion: the s-th draw
+    picks a rank in ``[0, c_i - s)`` and is shifted up past the ``s``
+    already-taken ranks in ascending order; ranks map to column indices
+    through the mask's per-row cumsum (binary search). Same per-round
+    uniform marginal as the reference's shuffled-cursor selection
+    (``FailureDetectorImpl.selectPingMember:352-361``) and as round 1's
+    masked top-k — but O(N·k + N²) cheap elementwise work in place of the
+    O(N²) threefry + O(N²·log N) sort that dominated the round-1 tick.
+
+    Returns (idx [N, k], valid [N, k]); invalid slots hold a clipped
+    in-bounds index and must stay masked by the caller. Invalid slots can
+    only follow valid ones (slot s is valid iff ``s < c_i``), so garbage
+    ranks never perturb valid draws.
+    """
+    n = mask.shape[1]
+    k = u.shape[1]
+    c = mask.sum(axis=1).astype(jnp.int32)  # [N] candidate counts
+    cs = jnp.cumsum(mask.astype(jnp.int32), axis=1)  # [N, N]
+    ranks: list[jax.Array] = []
+    for s in range(k):
+        avail = jnp.maximum(c - s, 1)
+        x = (u[:, s] * avail.astype(jnp.float32)).astype(jnp.int32)
+        x = jnp.minimum(x, avail - 1)
+        if ranks:
+            prev = jnp.sort(jnp.stack(ranks, 0), axis=0)  # [s, N] ascending
+            for t in range(len(ranks)):
+                x = x + (x >= prev[t]).astype(jnp.int32)
+        ranks.append(x)
+    rank_mat = jnp.stack(ranks, 1)  # [N, k]
+    valid = jnp.arange(k, dtype=jnp.int32)[None, :] < c[:, None]
+    idx = jax.vmap(jnp.searchsorted)(cs, rank_mat + 1)
+    return jnp.minimum(idx, n - 1).astype(jnp.int32), valid
 
 
 def _loss_at(state: SimState, i, j) -> jnp.ndarray:
@@ -175,7 +206,7 @@ def _fd_phase(
     rows = jnp.arange(n)
 
     cand = _live_view_mask(state)
-    sel_idx, sel_valid = _select_topk(r.fd_scores, cand, 1 + params.ping_req_k)
+    sel_idx, sel_valid = _sample_distinct(cand, r.fd_sel)
     tgt = sel_idx[:, 0]
     has_tgt = sel_valid[:, 0] & state.up
 
@@ -253,7 +284,7 @@ def _gossip_phase(
     rows = jnp.arange(n)
     spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
 
-    peers, peer_valid = _select_topk(r.gossip_scores, _live_view_mask(state), params.fanout)
+    peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
 
     known = state.view_status != UNKNOWN
     young = known & (state.tick - state.changed_at < spread[:, None])
@@ -301,7 +332,7 @@ def _sync_phase(
     if params.seed_rows:
         seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
         cand = (cand | seed_mask[None, :]) & ~jnp.eye(n, dtype=bool)
-    peer_idx, peer_valid = _select_topk(r.sync_scores, cand, 1)
+    peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[:, None])
     peer = peer_idx[:, 0]
     # Round trip: SYNC out and SYNC_ACK back must both survive.
     p_rt = (1.0 - _loss_at(state, rows, peer)) * (1.0 - _loss_at(state, peer, rows))

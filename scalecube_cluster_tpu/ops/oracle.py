@@ -34,11 +34,30 @@ def _ceil_log2(n: int) -> int:
     return int(n).bit_length() if n > 0 else 0
 
 
-def _topk_desc(scores: np.ndarray, mask: np.ndarray, k: int):
-    """Match jax.lax.top_k on masked scores: descending, stable on ties."""
-    masked = np.where(mask, scores, np.float32(-1.0))
-    order = np.argsort(-masked, kind="stable")[:k]
-    return order, masked[order] >= 0.0
+def _sample_distinct_row(mask: np.ndarray, u: np.ndarray):
+    """Scalar mirror of ``kernel._sample_distinct`` for one row.
+
+    Must be bit-exact: rank draw = float32(u) * float32(avail) truncated,
+    insertion shift over the already-taken ranks in ascending order, rank →
+    column via left searchsorted on the mask cumsum."""
+    n = mask.shape[0]
+    c = int(mask.sum())
+    cs = np.cumsum(mask.astype(np.int32))
+    k = len(u)
+    idx = np.zeros(k, np.int32)
+    valid = np.zeros(k, bool)
+    taken: list = []
+    for s in range(k):
+        avail = max(c - s, 1)
+        x = int(np.float32(u[s]) * np.float32(avail))
+        x = min(x, avail - 1)
+        for p in sorted(taken):
+            if x >= p:
+                x += 1
+        taken.append(x)
+        valid[s] = s < c
+        idx[s] = min(int(np.searchsorted(cs, x + 1, side="left")), n - 1)
+    return idx, valid
 
 
 class _O:
@@ -114,7 +133,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         for i in range(n):
             if not pre.up[i]:
                 continue
-            sel, valid = _topk_desc(r["fd_scores"][i], _live_mask(pre, i), 1 + k)
+            sel, valid = _sample_distinct_row(_live_mask(pre, i), r["fd_sel"][i])
             if not valid[0]:
                 continue
             tgt = int(sel[0])
@@ -167,7 +186,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         if not pre.up[i]:
             continue
         spread = params.repeat_mult * _ceil_log2(_cluster_size(pre, i))
-        peers, valid = _topk_desc(r["gossip_scores"][i], _live_mask(pre, i), f)
+        peers, valid = _sample_distinct_row(_live_mask(pre, i), r["gossip_sel"][i])
         for s in range(f):
             if not valid[s]:
                 continue
@@ -213,7 +232,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         for srow in params.seed_rows:
             if srow != i:
                 sync_cand[srow] = True
-        peers, valid = _topk_desc(r["sync_scores"][i], sync_cand, 1)
+        peers, valid = _sample_distinct_row(sync_cand, np.asarray([r["sync_sel"][i]]))
         if not valid[0]:
             continue
         p = int(peers[0])

@@ -8,16 +8,21 @@ trajectories").
 
 Draws per tick (N members, fanout f, ping-req k):
 
-* ``fd_scores``    [N, N]  — Gumbel-free uniform scores for probe target +
-  relay selection (top-(k+1) over masked scores = sample w/o replacement).
-* ``fd_direct``    [N]     — direct-ping delivery draw.
-* ``fd_relay``     [N, k]  — per-relay indirect-probe delivery draws.
-* ``gossip_scores``[N, N]  — fanout peer selection scores.
-* ``gossip_edge``  [N, f]  — per-gossip-edge delivery draws (one message per
+* ``fd_sel``      [N, 1+k] — rank draws for probe-target + relay selection
+  (distinct sampling without replacement over the live view, see
+  ``kernel._sample_distinct``).
+* ``fd_direct``   [N]      — direct-ping delivery draw.
+* ``fd_relay``    [N, k]   — per-relay indirect-probe delivery draws.
+* ``gossip_sel``  [N, f]   — fanout peer rank draws.
+* ``gossip_edge`` [N, f]   — per-gossip-edge delivery draws (one message per
   edge carries both membership records and user rumors, exactly as the
   reference's single GOSSIP_REQ does — so one draw per edge).
-* ``sync_scores``  [N, N]  — SYNC peer selection scores.
-* ``sync_edge``    [N]     — SYNC round-trip delivery draw.
+* ``sync_sel``    [N]      — SYNC peer rank draw.
+* ``sync_edge``   [N]      — SYNC round-trip delivery draw.
+
+Total per-tick randomness is O(N·(f+k)). The round-1 layout instead drew
+three full [N, N] score matrices and top_k-sorted them just to pick ≤4
+distinct peers per row — the dominant O(N²·log N) term of the tick.
 """
 
 from __future__ import annotations
@@ -29,27 +34,27 @@ import jax.numpy as jnp
 
 
 class FdRandoms(NamedTuple):
-    fd_scores: jax.Array
+    fd_sel: jax.Array
     fd_direct: jax.Array
     fd_relay: jax.Array
 
 
 class RoundRandoms(NamedTuple):
-    gossip_scores: jax.Array
+    gossip_sel: jax.Array
     gossip_edge: jax.Array
-    sync_scores: jax.Array
+    sync_sel: jax.Array
     sync_edge: jax.Array
 
 
 class TickRandoms(NamedTuple):
     """Union view used by the scalar oracle (kernel consumes the parts)."""
 
-    fd_scores: jax.Array
+    fd_sel: jax.Array
     fd_direct: jax.Array
     fd_relay: jax.Array
-    gossip_scores: jax.Array
+    gossip_sel: jax.Array
     gossip_edge: jax.Array
-    sync_scores: jax.Array
+    sync_sel: jax.Array
     sync_edge: jax.Array
 
 
@@ -65,7 +70,7 @@ def split_tick_key(key: jax.Array) -> tuple[jax.Array, jax.Array]:
 def draw_fd_randoms(key: jax.Array, n: int, ping_req_k: int) -> FdRandoms:
     k1, k2, k3 = jax.random.split(key, 3)
     return FdRandoms(
-        fd_scores=jax.random.uniform(k1, (n, n), dtype=jnp.float32),
+        fd_sel=jax.random.uniform(k1, (n, 1 + ping_req_k), dtype=jnp.float32),
         fd_direct=jax.random.uniform(k2, (n,), dtype=jnp.float32),
         fd_relay=jax.random.uniform(k3, (n, ping_req_k), dtype=jnp.float32),
     )
@@ -74,9 +79,9 @@ def draw_fd_randoms(key: jax.Array, n: int, ping_req_k: int) -> FdRandoms:
 def draw_round_randoms(key: jax.Array, n: int, fanout: int) -> RoundRandoms:
     k4, k5, k6, k7 = jax.random.split(key, 4)
     return RoundRandoms(
-        gossip_scores=jax.random.uniform(k4, (n, n), dtype=jnp.float32),
+        gossip_sel=jax.random.uniform(k4, (n, fanout), dtype=jnp.float32),
         gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
-        sync_scores=jax.random.uniform(k6, (n, n), dtype=jnp.float32),
+        sync_sel=jax.random.uniform(k6, (n,), dtype=jnp.float32),
         sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
     )
 
