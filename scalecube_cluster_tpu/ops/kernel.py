@@ -51,15 +51,11 @@ import jax
 import jax.numpy as jnp
 
 from .lattice import (
-    ALIVE,
-    DEAD,
-    LEAVING,
     NO_CANDIDATE,
-    SUSPECT,
-    UNKNOWN,
-    UNKNOWN_KEY,
-    decode_key,
-    precedence_key,
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEAVING,
+    RANK_SUSPECT,
 )
 from .rand import (
     FdRandoms,
@@ -82,15 +78,16 @@ def _live_view_mask(state: SimState) -> jax.Array:
     """candidates[i, j] — j is in node i's member list (known, not DEAD, not
     self): the FD ping list / gossip member list / SYNC address pool, which
     the reference maintains from ADDED/REMOVED events
-    (``FailureDetectorImpl.java:321-333``)."""
+    (``FailureDetectorImpl.java:321-333``). Rank != DEAD alone suffices: the
+    only negative table key is -1 (unknown), whose rank bits also read 3."""
     n = state.capacity
-    known_live = state.view_status <= LEAVING  # ALIVE(0)/SUSPECT(1)/LEAVING(2)
+    known_live = (state.view_key & 3) != RANK_DEAD
     return known_live & ~jnp.eye(n, dtype=bool)
 
 
 def _cluster_size(state: SimState) -> jax.Array:
     """Node i's view of cluster size (incl. itself) — drives the log2 knobs."""
-    return (state.view_status <= LEAVING).sum(axis=1).astype(jnp.int32)
+    return ((state.view_key & 3) != RANK_DEAD).sum(axis=1).astype(jnp.int32)
 
 
 def _merge(
@@ -116,25 +113,19 @@ def _merge(
 
     Returns (state, accepted mask).
     """
-    own_key = precedence_key(state.view_status, state.view_inc)
-    known = state.view_status != UNKNOWN
-    cand_status, cand_inc = decode_key(recv_key)
-    alive_or_leaving = (cand_status == ALIVE) | (cand_status == LEAVING)
+    own = state.view_key
+    known = own >= 0
+    alive_or_leaving = (recv_key & 3) <= RANK_LEAVING
     accept = (
-        (recv_key > own_key)
+        (recv_key > own)
         & (recv_key > NO_CANDIDATE)
         & (known | alive_or_leaving)
         & receiver_up[:, None]
     )
-    new_status = jnp.where(accept, cand_status, state.view_status)
-    new_inc = jnp.where(accept, cand_inc, state.view_inc)
-    newly_suspect = accept & (cand_status == SUSPECT)
     return (
         state.replace(
-            view_status=new_status,
-            view_inc=new_inc,
+            view_key=jnp.where(accept, recv_key, own),
             changed_at=jnp.where(accept, state.tick, state.changed_at),
-            suspect_since=jnp.where(newly_suspect, state.tick, state.suspect_since),
         ),
         accept,
     )
@@ -234,45 +225,43 @@ def _fd_phase(
     ack = direct_ok | relay_ok.any(axis=1)
 
     # Verdict records, written at (i, tgt_i) through the overrides gate.
-    own_status = state.view_status[rows, tgt]
-    own_inc = state.view_inc[rows, tgt]
-    own_key = precedence_key(own_status, own_inc)
-    cand_status = jnp.where(ack, jnp.int8(ALIVE), jnp.int8(SUSPECT))
     # ALIVE verdict carries the target's self-incarnation (the ALIVE-again
     # SYNC effect); SUSPECT suspects the incarnation we currently know.
-    cand_inc = jnp.where(ack, state.view_inc[tgt, tgt], own_inc)
-    cand_key = precedence_key(cand_status.astype(jnp.int32), cand_inc)
+    # Targets come from the live view, so own_key >= 0 wherever has_tgt.
+    own_key = state.view_key[rows, tgt]
+    alive_key = (state.view_key[tgt, tgt] >> 2) << 2
+    suspect_key = ((own_key >> 2) << 2) | RANK_SUSPECT
+    cand_key = jnp.where(ack, alive_key, suspect_key)
     accept = has_tgt & (cand_key > own_key)
 
-    new_status = jnp.where(accept, cand_status, own_status)
-    new_inc = jnp.where(accept, cand_inc, own_inc)
-    newly_suspect = accept & ~ack
     st = state.replace(
-        view_status=state.view_status.at[rows, tgt].set(new_status),
-        view_inc=state.view_inc.at[rows, tgt].set(new_inc),
+        view_key=state.view_key.at[rows, tgt].set(
+            jnp.where(accept, cand_key, own_key)
+        ),
         changed_at=state.changed_at.at[rows, tgt].set(
             jnp.where(accept, state.tick, state.changed_at[rows, tgt])
-        ),
-        suspect_since=state.suspect_since.at[rows, tgt].set(
-            jnp.where(newly_suspect, state.tick, state.suspect_since[rows, tgt])
         ),
     )
     metrics = {
         "fd_probes": has_tgt.sum(),
-        "fd_new_suspects": newly_suspect.sum(),
+        "fd_new_suspects": (accept & ~ack).sum(),
     }
     return st, metrics
 
 
 def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
+    """SUSPECT cells whose suspicion window expired become DEAD at the same
+    incarnation (rank 2 -> 3 is key+1). ``changed_at`` is the suspicion
+    start: every accepted change that leaves a cell SUSPECT also (re)stamps
+    it, so a separate suspect_since plane would always equal it."""
     timeout = params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
     expired = (
-        (state.view_status == SUSPECT)
-        & (state.tick - state.suspect_since >= timeout[:, None])
+        ((state.view_key & 3) == RANK_SUSPECT)
+        & (state.tick - state.changed_at >= timeout[:, None])
         & state.up[:, None]
     )
     return state.replace(
-        view_status=jnp.where(expired, jnp.int8(DEAD), state.view_status),
+        view_key=jnp.where(expired, state.view_key + 1, state.view_key),
         changed_at=jnp.where(expired, state.tick, state.changed_at),
     )
 
@@ -286,10 +275,9 @@ def _gossip_phase(
 
     peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
 
-    known = state.view_status != UNKNOWN
+    known = state.view_key >= 0
     young = known & (state.tick - state.changed_at < spread[:, None])
-    key_matrix = precedence_key(state.view_status, state.view_inc)
-    piggyback = jnp.where(young, key_matrix, NO_CANDIDATE)  # [N, N]
+    piggyback = jnp.where(young, state.view_key, NO_CANDIDATE)  # [N, N]
 
     rumor_young = (
         state.infected
@@ -338,22 +326,18 @@ def _sync_phase(
     p_rt = (1.0 - _loss_at(state, rows, peer)) * (1.0 - _loss_at(state, peer, rows))
     ok = due & peer_valid[:, 0] & state.up[peer] & (r.sync_edge < p_rt)
 
-    known = state.view_status != UNKNOWN
-    key_matrix = precedence_key(state.view_status, state.view_inc)
-    full_table = jnp.where(known, key_matrix, NO_CANDIDATE)
-
     # SYNC request: caller's full table scattered into peers (several callers
     # may hit one peer; scatter-max resolves, as the peer's sequential merges
-    # would — the join is associative).
+    # would — the join is associative). The table IS view_key: unknown cells
+    # are -1, which no receiver ever accepts (-1 > own requires own < -1,
+    # impossible), so no masking pass is needed.
     recv_req = jnp.full((n, n), NO_CANDIDATE).at[peer].max(
-        jnp.where(ok[:, None], full_table, NO_CANDIDATE)
+        jnp.where(ok[:, None], state.view_key, NO_CANDIDATE)
     )
     st, _ = _merge(state, recv_req, state.up)
 
     # SYNC_ACK: the peer's (post-merge) table straight back to each caller.
-    known2 = st.view_status != UNKNOWN
-    key2 = jnp.where(known2, precedence_key(st.view_status, st.view_inc), NO_CANDIDATE)
-    recv_ack = jnp.where(ok[:, None], key2[peer], NO_CANDIDATE)
+    recv_ack = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)
     st, _ = _merge(st, recv_ack, st.up)
 
     # A joiner's bootstrap SYNC retries every tick until one round-trip
@@ -375,22 +359,20 @@ def _refute_phase(state: SimState) -> SimState:
     Deliberate LEAVING (self-initiated) is not refuted."""
     n = state.capacity
     rows = jnp.arange(n)
-    self_status = state.view_status[rows, rows]
+    diag = state.view_key[rows, rows]
+    rank = diag & 3
     # a leaver whose diagonal was overwritten (or echoed back) also refutes —
     # but re-announces LEAVING, not ALIVE: the reference keeps its own status
     # (r2 = (self, r0.status, inc+1)), so a graceful leave is never cancelled
     need = state.up & (
-        (self_status == SUSPECT)
-        | (self_status == DEAD)
-        | (state.leaving & (self_status != LEAVING))
+        (rank == RANK_SUSPECT)
+        | (rank == RANK_DEAD)
+        | (state.leaving & (rank != RANK_LEAVING))
     )
-    announce = jnp.where(state.leaving, jnp.int8(LEAVING), jnp.int8(ALIVE))
-    new_inc = jnp.where(need, state.view_inc[rows, rows] + 1, state.view_inc[rows, rows])
+    announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
+    new_diag = (((diag >> 2) + 1) << 2) | announce_rank
     return state.replace(
-        view_status=state.view_status.at[rows, rows].set(
-            jnp.where(need, announce, self_status)
-        ),
-        view_inc=state.view_inc.at[rows, rows].set(new_inc),
+        view_key=state.view_key.at[rows, rows].set(jnp.where(need, new_diag, diag)),
         changed_at=state.changed_at.at[rows, rows].set(
             jnp.where(need, state.tick, state.changed_at[rows, rows])
         ),
@@ -438,10 +420,9 @@ def tick(
     up2 = state.up[:, None] & state.up[None, :]
     pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
     off_diag = ~jnp.eye(state.capacity, dtype=bool)
-    alive_pairs = (up2 & off_diag & (state.view_status == ALIVE)).sum()
-    false_suspects = (
-        up2 & off_diag & (state.view_status == SUSPECT)
-    ).sum()
+    rank = state.view_key & 3  # -1 (unknown) reads rank 3, never ALIVE/SUSPECT
+    alive_pairs = (up2 & off_diag & (rank == RANK_ALIVE)).sum()
+    false_suspects = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
     coverage = (
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)

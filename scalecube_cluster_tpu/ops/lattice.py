@@ -63,6 +63,14 @@ UNKNOWN = 4  # kernel-internal: "I have no record for this member"
 UNKNOWN_KEY = jnp.int32(-1)
 NO_CANDIDATE = jnp.iinfo(jnp.int32).min  # scatter-max identity
 
+# Ranks inside the packed key (key & 3). Note -1 (UNKNOWN_KEY) & 3 == 3, so
+# rank tests against ALIVE/LEAVING/SUSPECT are safe without a key >= 0 guard;
+# only DEAD tests must also check key >= 0.
+RANK_ALIVE = 0
+RANK_LEAVING = 1
+RANK_SUSPECT = 2
+RANK_DEAD = 3
+
 # rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1, DEAD->3
 _RANK = jnp.array([0, 2, 1, 3, 0], dtype=jnp.int32)
 # status lookup by rank: 0->ALIVE, 1->LEAVING, 2->SUSPECT, 3->DEAD
@@ -84,3 +92,15 @@ def decode_key(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Unpack a winning candidate key back to ``(status, incarnation)``."""
     status = _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
     return status, (key >> 2).astype(jnp.int32)
+
+
+def key_status(key: jnp.ndarray) -> jnp.ndarray:
+    """Status code of a packed table key; UNKNOWN where no record (key < 0)."""
+    return jnp.where(
+        key < 0, jnp.int8(UNKNOWN), _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
+    )
+
+
+def key_inc(key: jnp.ndarray) -> jnp.ndarray:
+    """Incarnation of a packed table key; 0 where no record."""
+    return jnp.where(key < 0, 0, key >> 2).astype(jnp.int32)

@@ -16,7 +16,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from .lattice import ALIVE, DEAD, LEAVING, SUSPECT, UNKNOWN
+from .lattice import (
+    ALIVE,
+    DEAD,
+    LEAVING,
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEAVING,
+    RANK_SUSPECT,
+    SUSPECT,
+    UNKNOWN,
+)
 from .rand import draw_tick_randoms
 from .state import SimParams, SimState
 
@@ -61,15 +71,13 @@ def _sample_distinct_row(mask: np.ndarray, u: np.ndarray):
 
 
 class _O:
-    """Mutable numpy mirror of SimState."""
+    """Mutable numpy mirror of SimState (packed-key table layout)."""
 
     def __init__(self, state: SimState):
         self.tick = int(state.tick)
         self.up = np.asarray(state.up).copy()
-        self.status = np.asarray(state.view_status).copy()
-        self.inc = np.asarray(state.view_inc).copy()
+        self.key = np.asarray(state.view_key).copy()
         self.changed = np.asarray(state.changed_at).copy()
-        self.since = np.asarray(state.suspect_since).copy()
         self.force_sync = np.asarray(state.force_sync).copy()
         self.leaving = np.asarray(state.leaving).copy()
         self.r_active = np.asarray(state.rumor_active).copy()
@@ -90,30 +98,25 @@ def _loss(o: "_O", i: int, j: int) -> np.float32:
 
 
 def _live_mask(o: _O, i: int) -> np.ndarray:
-    m = o.status[i] <= LEAVING
+    m = (o.key[i] & 3) != RANK_DEAD  # -1 (unknown) reads rank 3 too
     m[i] = False
     return m
 
 
 def _cluster_size(o: _O, i: int) -> int:
-    return int((o.status[i] <= LEAVING).sum())
+    return int(((o.key[i] & 3) != RANK_DEAD).sum())
 
 
 def _accept_into(o: _O, i: int, j: int, cand_key: int) -> bool:
     """The overrides gate + write, identical to kernel._merge for one cell."""
-    own = _key(int(o.status[i, j]), int(o.inc[i, j]))
+    own = int(o.key[i, j])
     if cand_key <= own:
         return False
-    known = o.status[i, j] != UNKNOWN
-    st_new = _RANK_TO_STATUS[cand_key & 3]
-    inc_new = cand_key >> 2
-    if not known and st_new not in (ALIVE, LEAVING):
+    known = own >= 0
+    if not known and (cand_key & 3) > RANK_LEAVING:
         return False
-    o.status[i, j] = st_new
-    o.inc[i, j] = inc_new
+    o.key[i, j] = cand_key
     o.changed[i, j] = o.tick
-    if st_new == SUSPECT:
-        o.since[i, j] = o.tick
     return True
 
 
@@ -155,17 +158,13 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 )
                 if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
                     ack = True
+            own = int(pre.key[i, tgt])  # targets come from the live view: >= 0
             if ack:
-                cand = _key(ALIVE, int(pre.inc[tgt, tgt]))
+                cand = (int(pre.key[tgt, tgt]) >> 2) << 2  # ALIVE @ target's self-inc
             else:
-                cand = _key(SUSPECT, int(pre.inc[i, tgt]))
-            own = _key(int(pre.status[i, tgt]), int(pre.inc[i, tgt]))
+                cand = ((own >> 2) << 2) | RANK_SUSPECT
             if cand > own:
-                if ack:
-                    o.status[i, tgt], o.inc[i, tgt] = ALIVE, int(pre.inc[tgt, tgt])
-                else:
-                    o.status[i, tgt] = SUSPECT
-                    o.since[i, tgt] = t
+                o.key[i, tgt] = cand
                 o.changed[i, tgt] = t
 
     # ---- suspicion sweep ----
@@ -174,8 +173,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             continue
         timeout = params.suspicion_mult * _ceil_log2(_cluster_size(o, i)) * params.fd_every
         for j in range(n):
-            if o.status[i, j] == SUSPECT and t - o.since[i, j] >= timeout:
-                o.status[i, j] = DEAD
+            if (o.key[i, j] & 3) == RANK_SUSPECT and t - o.changed[i, j] >= timeout:
+                o.key[i, j] += 1  # SUSPECT -> DEAD at the same incarnation
                 o.changed[i, j] = t
 
     # ---- gossip phase ----
@@ -196,9 +195,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             if not r["gossip_edge"][i, s] < (np.float32(1.0) - _loss(pre, i, p)):
                 continue
             for j in range(n):
-                if pre.status[i, j] != UNKNOWN and t - pre.changed[i, j] < spread:
-                    cand = _key(int(pre.status[i, j]), int(pre.inc[i, j]))
-                    recv_key[p, j] = max(recv_key[p, j], cand)
+                if pre.key[i, j] >= 0 and t - pre.changed[i, j] < spread:
+                    recv_key[p, j] = max(recv_key[p, j], int(pre.key[i, j]))
             for ru in range(params.rumor_slots):
                 if (
                     pre.infected[i, ru]
@@ -245,8 +243,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
     recv_key = {}
     for i, p in callers:
         for j in range(n):
-            if pre.status[i, j] != UNKNOWN:
-                cand = _key(int(pre.status[i, j]), int(pre.inc[i, j]))
+            if pre.key[i, j] >= 0:
+                cand = int(pre.key[i, j])
                 recv_key[(p, j)] = max(recv_key.get((p, j), cand), cand)
     for (p, j), cand in recv_key.items():
         _accept_into(o, p, j, cand)
@@ -254,18 +252,19 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
     mid = o.snap()
     for i, p in callers:
         for j in range(n):
-            if mid.status[p, j] != UNKNOWN:
-                _accept_into(o, i, j, _key(int(mid.status[p, j]), int(mid.inc[p, j])))
+            if mid.key[p, j] >= 0:
+                _accept_into(o, i, j, int(mid.key[p, j]))
 
     # ---- refutation (SUSPECT/DEAD self-record, or overwritten leave intent;
     # a leaver re-announces LEAVING — see kernel._refute_phase) ----
     for i in range(n):
         if not o.up[i]:
             continue
-        st_self = o.status[i, i]
-        if st_self in (SUSPECT, DEAD) or (o.leaving[i] and st_self != LEAVING):
-            o.inc[i, i] += 1
-            o.status[i, i] = LEAVING if o.leaving[i] else ALIVE
+        diag = int(o.key[i, i])
+        rank = diag & 3
+        if rank in (RANK_SUSPECT, RANK_DEAD) or (o.leaving[i] and rank != RANK_LEAVING):
+            new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
+            o.key[i, i] = (((diag >> 2) + 1) << 2) | new_rank
             o.changed[i, i] = t
 
     # ---- rumor sweep ----
@@ -283,10 +282,8 @@ def assert_equivalent(state: SimState, o: _O) -> None:
     pairs = {
         "tick": (int(state.tick), o.tick),
         "up": (np.asarray(state.up), o.up),
-        "view_status": (np.asarray(state.view_status), o.status),
-        "view_inc": (np.asarray(state.view_inc), o.inc),
+        "view_key": (np.asarray(state.view_key), o.key),
         "changed_at": (np.asarray(state.changed_at), o.changed),
-        "suspect_since": (np.asarray(state.suspect_since), o.since),
         "force_sync": (np.asarray(state.force_sync), o.force_sync),
         "leaving": (np.asarray(state.leaving), o.leaving),
         "rumor_active": (np.asarray(state.rumor_active), o.r_active),

@@ -43,10 +43,8 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
     return SimState(
         tick=rep,
         up=row,
-        view_status=row2d,
-        view_inc=row2d,
+        view_key=row2d,
         changed_at=row2d,
-        suspect_since=row2d,
         force_sync=row,
         leaving=row,
         rumor_active=rep,

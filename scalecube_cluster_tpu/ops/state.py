@@ -24,10 +24,11 @@ import numpy as np
 from flax import struct
 
 from ..config import ClusterConfig
-from .lattice import ALIVE, LEAVING, UNKNOWN
+from .lattice import RANK_LEAVING, UNKNOWN_KEY, key_inc, key_status
 
 NEVER = jnp.int32(-(1 << 30))  # "changed long ago" sentinel for changed_at
-FAR_FUTURE = jnp.int32(1 << 30)  # "no suspicion running" sentinel
+# ALIVE@incarnation-0 packed key (inc * 4 + rank_alive)
+ALIVE0_KEY = jnp.int32(0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,24 +93,32 @@ class SimParams:
 class SimState(struct.PyTreeNode):
     """One cluster simulation: N nodes' replicated SWIM state + rumor pool.
 
-    ``view_status[i, j]`` / ``view_inc[i, j]`` — node i's record for j
-    (UNKNOWN=4 when i has no record). ``suspect_since[i, j]`` — tick at which
-    the current suspicion began (suspicion timer,
-    ``MembershipProtocolImpl.java:805-823``).
+    ``view_key[i, j]`` — node i's record for j as the packed precedence key
+    ``incarnation * 4 + rank`` (:mod:`.lattice`), or ``UNKNOWN_KEY`` (-1)
+    when i has no record. Storing the key directly (rather than separate
+    status/incarnation planes) makes the merge a one-matrix scatter-max and
+    is the memory-lean layout for large N: 8 bytes/cell total with
+    ``changed_at``, so N=100k row-sharded fits a v5e-8 (~10 GB/chip).
+    Decoded ``view_status`` / ``view_inc`` views are provided as properties
+    for host-side consumers.
 
     ``changed_at[i, j]`` — tick at which i's record for j last changed; a
     record is piggybacked on gossip while ``tick - changed_at <
     repeat_mult * ceil_log2(cluster_size_i)``, the reference's gossip-age
-    rule (``GossipProtocolImpl.java:311-320``). Because each cell's
-    precedence key is strictly monotone (DEAD records are kept as
-    tombstones, never removed — ``lattice.py`` deviation 2 makes them
-    beatable by a higher-incarnation refutation), a given record is accepted
-    — and therefore forwarded — at most once per cell: every rumor's total
-    circulation is bounded (SIR) and the cluster state converges
-    monotonically, with no death-rumor/refutation cycles and no stale-record
-    resurrection. DEAD = "removed" at the membership-API level (the driver
-    emits REMOVED on the DEAD transition, exactly when the reference removes
-    the member, ``onDeadMemberDetected:740-767``).
+    rule (``GossipProtocolImpl.java:311-320``). For SUSPECT cells it doubles
+    as the suspicion-timer start (``MembershipProtocolImpl.java:805-823``):
+    every accepted change that leaves a cell SUSPECT is itself the start of
+    a (new) suspicion window, so the two stamps are provably equal whenever
+    the cell is SUSPECT and a separate ``suspect_since`` plane would be
+    redundant. Because each cell's precedence key is strictly monotone
+    (DEAD records are kept as tombstones, never removed — ``lattice.py``
+    deviation 2 makes them beatable by a higher-incarnation refutation), a
+    given record is accepted — and therefore forwarded — at most once per
+    cell: every rumor's total circulation is bounded (SIR) and the cluster
+    state converges monotonically, with no death-rumor/refutation cycles and
+    no stale-record resurrection. DEAD = "removed" at the membership-API
+    level (the driver emits REMOVED on the DEAD transition, exactly when the
+    reference removes the member, ``onDeadMemberDetected:740-767``).
 
     Rumor pool: R slots of user gossip (``spreadGossip``), infection bitmap
     ``infected[i, r]`` + ``infected_at`` for the forwarding-age rule; dedup
@@ -123,10 +132,8 @@ class SimState(struct.PyTreeNode):
 
     tick: jax.Array  # i32 scalar
     up: jax.Array  # bool [N] — process running (host/churn controlled)
-    view_status: jax.Array  # i8  [N, N]
-    view_inc: jax.Array  # i32 [N, N]
+    view_key: jax.Array  # i32 [N, N] — packed precedence key, -1 = unknown
     changed_at: jax.Array  # i32 [N, N]
-    suspect_since: jax.Array  # i32 [N, N]
     force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
     leaving: jax.Array  # bool [N] — graceful-leave intent (survives record overwrites)
     rumor_active: jax.Array  # bool [R]
@@ -139,6 +146,17 @@ class SimState(struct.PyTreeNode):
     @property
     def capacity(self) -> int:
         return self.up.shape[0]
+
+    @property
+    def view_status(self) -> jax.Array:
+        """Decoded status plane (i8, UNKNOWN where no record) — a derived
+        view for host-side consumers; the kernel works on ``view_key``."""
+        return key_status(self.view_key)
+
+    @property
+    def view_inc(self) -> jax.Array:
+        """Decoded incarnation plane (i32, 0 where no record)."""
+        return key_inc(self.view_key)
 
 
 def init_state(
@@ -165,17 +183,15 @@ def init_state(
     up = jnp.arange(n) < n_initial
     if warm:
         known = up[:, None] & up[None, :]
-        status = jnp.where(known, jnp.int8(ALIVE), jnp.int8(UNKNOWN))
+        view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY)
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
-        status = jnp.where(diag, jnp.int8(ALIVE), jnp.int8(UNKNOWN))
+        view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY)
     return SimState(
         tick=jnp.int32(0),
         up=up,
-        view_status=status,
-        view_inc=jnp.zeros((n, n), jnp.int32),
+        view_key=view_key,
         changed_at=jnp.full((n, n), NEVER),
-        suspect_since=jnp.full((n, n), FAR_FUTURE),
         force_sync=jnp.zeros((n,), bool),
         leaving=jnp.zeros((n,), bool),
         rumor_active=jnp.zeros((r,), bool),
@@ -207,19 +223,17 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
     initial SYNC then pulls the real table, like the reference's startup SYNC.
     """
     seed_rows = jnp.asarray(seed_rows, jnp.int32)
-    row_status = (
-        jnp.full((state.capacity,), jnp.int8(UNKNOWN))
+    row_key = (
+        jnp.full((state.capacity,), UNKNOWN_KEY)
         .at[seed_rows]
-        .set(jnp.int8(ALIVE))
+        .set(ALIVE0_KEY)
         .at[row]
-        .set(jnp.int8(ALIVE))
+        .set(ALIVE0_KEY)
     )
     return state.replace(
         up=state.up.at[row].set(True),
-        view_status=state.view_status.at[row].set(row_status),
-        view_inc=state.view_inc.at[row].set(0),
+        view_key=state.view_key.at[row].set(row_key),
         changed_at=state.changed_at.at[row].set(NEVER).at[row, row].set(state.tick),
-        suspect_since=state.suspect_since.at[row].set(FAR_FUTURE),
         force_sync=state.force_sync.at[row].set(True),
         leaving=state.leaving.at[row].set(False),
         infected=state.infected.at[row].set(False),
@@ -238,8 +252,10 @@ def begin_leave(state: SimState, row: int) -> SimState:
     The ``leaving`` mask records the intent outside the overwritable record,
     so refutation re-announces LEAVING (the reference keeps its OWN status,
     ``onSelfMemberDetected``'s r0.status), never resurrecting a leaver."""
+    own = state.view_key[row, row]
+    leaving_key = ((own >> 2) << 2) | RANK_LEAVING  # keep incarnation
     return state.replace(
-        view_status=state.view_status.at[row, row].set(jnp.int8(LEAVING)),
+        view_key=state.view_key.at[row, row].set(leaving_key),
         changed_at=state.changed_at.at[row, row].set(state.tick),
         leaving=state.leaving.at[row].set(True),
     )
@@ -252,7 +268,7 @@ def update_metadata(state: SimState, row: int) -> SimState:
     ``ClusterImpl.java:497-501``). Peers' UPDATED events are host-side diffs
     of ``view_inc`` increases at ALIVE status; blob versions live on host."""
     return state.replace(
-        view_inc=state.view_inc.at[row, row].add(1),
+        view_key=state.view_key.at[row, row].add(4),  # +1 incarnation, same rank
         changed_at=state.changed_at.at[row, row].set(state.tick),
     )
 

@@ -65,7 +65,8 @@ class SimNode:
 
     def incarnation_of(self, other: "SimNode | int") -> int:
         row = other.row if isinstance(other, SimNode) else other
-        return int(self._d.state.view_inc[self.row, row])
+        key = int(self._d.state.view_key[self.row, row])
+        return key >> 2 if key >= 0 else 0
 
     # -- gossip -------------------------------------------------------------
     def spread_gossip(self, payload: object) -> int:

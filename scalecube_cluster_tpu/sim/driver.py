@@ -38,11 +38,18 @@ def row_address(row: int) -> str:
     return f"sim://{row}"
 
 
+_RANK_TO_STATUS_NP = np.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=np.int8)
+
+
+def _status_of_key(k: int) -> int:
+    """Host-side decode of a packed table key (lattice.py layout)."""
+    return UNKNOWN if k < 0 else int(_RANK_TO_STATUS_NP[k & 3])
+
+
 @dataclass
 class _Watch:
     row: int
-    prev_status: np.ndarray  # [N] int8
-    prev_inc: np.ndarray  # [N] int32
+    prev_key: np.ndarray  # [N] int32 packed keys
     stream: EventStream = field(default_factory=EventStream)
     log: List[MembershipEvent] = field(default_factory=list)
     # Member handle captured when the observer first learned each row, so
@@ -123,13 +130,9 @@ class SimDriver:
     def watch(self, row: int) -> EventStream:
         """Start emitting MembershipEvents as observed by node ``row``."""
         if row not in self._watches:
-            status = np.asarray(self.state.view_status[row])
-            w = _Watch(
-                row=row,
-                prev_status=status,
-                prev_inc=np.asarray(self.state.view_inc[row]),
-            )
-            for j in np.nonzero(status != UNKNOWN)[0]:
+            key = np.asarray(self.state.view_key[row])
+            w = _Watch(row=row, prev_key=key)
+            for j in np.nonzero(key >= 0)[0]:
                 w.known[int(j)] = self._member_handle(int(j))
             self._watches[row] = w
         return self._watches[row].stream
@@ -147,18 +150,18 @@ class SimDriver:
         if not self._watches:
             return
         rows = sorted(self._watches)
-        status = np.asarray(self.state.view_status[np.array(rows)])
-        inc = np.asarray(self.state.view_inc[np.array(rows)])
+        keys = np.asarray(self.state.view_key[np.array(rows)])
         for i, row in enumerate(rows):
             w = self._watches[row]
-            self._diff_row(w, status[i], inc[i])
-            w.prev_status, w.prev_inc = status[i], inc[i]
+            self._diff_row(w, keys[i])
+            w.prev_key = keys[i]
 
-    def _diff_row(self, w: _Watch, status: np.ndarray, inc: np.ndarray) -> None:
-        changed = (status != w.prev_status) | (inc != w.prev_inc)
+    def _diff_row(self, w: _Watch, key: np.ndarray) -> None:
+        changed = key != w.prev_key
         for j in np.nonzero(changed)[0]:
             j = int(j)
-            old_s, new_s = int(w.prev_status[j]), int(status[j])
+            old_k, new_k = int(w.prev_key[j]), int(key[j])
+            old_s, new_s = _status_of_key(old_k), _status_of_key(new_k)
             ev: Optional[MembershipEvent] = None
             # old DEAD counts as "not a member": REMOVED already fired when
             # the record went DEAD; a later DEAD->ALIVE flip (a zombie/rejoin
@@ -176,7 +179,7 @@ class SimDriver:
             elif (
                 new_s == ALIVE
                 and old_s in (ALIVE, SUSPECT)
-                and int(inc[j]) > int(w.prev_inc[j])
+                and (new_k >> 2) > (old_k >> 2)
             ):
                 # incarnation bump while alive = metadata/refutation update
                 ev = MembershipEvent.updated(
@@ -199,7 +202,7 @@ class SimDriver:
         if len(free) == 0:
             raise RuntimeError("no free rows (capacity exhausted)")
         remembered = np.asarray(  # [N] — some up member still has a record
-            ((self.state.view_status != UNKNOWN) & self.state.up[:, None]).any(axis=0)
+            ((self.state.view_key >= 0) & self.state.up[:, None]).any(axis=0)
         )
         forgotten = free[~remembered[free]]
         row = int(forgotten[0]) if len(forgotten) else int(free[0])
@@ -260,13 +263,13 @@ class SimDriver:
     # -- views --------------------------------------------------------------
     def view_of(self, row: int) -> tuple[np.ndarray, np.ndarray]:
         """(status, incarnation) of node ``row``'s table — one device gather."""
-        return (
-            np.asarray(self.state.view_status[row]),
-            np.asarray(self.state.view_inc[row]),
-        )
+        key = np.asarray(self.state.view_key[row])
+        status = np.where(key < 0, np.int8(UNKNOWN), _RANK_TO_STATUS_NP[key & 3])
+        inc = np.where(key < 0, 0, key >> 2).astype(np.int32)
+        return status, inc
 
     def status_of(self, observer: int, subject: int) -> MemberStatus | None:
-        s = int(self.state.view_status[observer, subject])
+        s = _status_of_key(int(self.state.view_key[observer, subject]))
         return None if s == UNKNOWN else MemberStatus(s)
 
     def is_up(self, row: int) -> bool:
@@ -313,9 +316,8 @@ class SimDriver:
         self.state = state
         # re-baseline watches so restore doesn't emit phantom events
         for w in self._watches.values():
-            w.prev_status = np.asarray(self.state.view_status[w.row])
-            w.prev_inc = np.asarray(self.state.view_inc[w.row])
+            w.prev_key = np.asarray(self.state.view_key[w.row])
             w.known = {
                 int(j): self.members.get(int(j), self._member_handle(int(j)))
-                for j in np.nonzero(w.prev_status != UNKNOWN)[0]
+                for j in np.nonzero(w.prev_key >= 0)[0]
             }

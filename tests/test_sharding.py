@@ -38,7 +38,7 @@ def test_sharded_tick_runs_and_stays_sharded(mesh):
         key, k = jax.random.split(key)
         st, m = step(st, k)
     assert int(st.tick) == 3
-    assert st.view_status.sharding.spec == jax.sharding.PartitionSpec(SH.MEMBER_AXIS, None)
+    assert st.view_key.sharding.spec == jax.sharding.PartitionSpec(SH.MEMBER_AXIS, None)
     assert abs(float(m["alive_view_fraction"]) - 1.0) < 1e-5
 
 

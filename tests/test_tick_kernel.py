@@ -15,6 +15,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -85,9 +86,10 @@ def test_refutation_bumps_incarnation(step):
     st = S.init_state(PARAMS, 12, warm=True)
     key = jax.random.PRNGKey(2)
     # Plant a false SUSPECT rumor about (very alive) node 3 at node 0.
+    from scalecube_cluster_tpu.ops.lattice import precedence_key
+
     st = st.replace(
-        view_status=st.view_status.at[0, 3].set(SUSPECT),
-        suspect_since=st.suspect_since.at[0, 3].set(st.tick),
+        view_key=st.view_key.at[0, 3].set(precedence_key(jnp.int32(SUSPECT), jnp.int32(0))),
         changed_at=st.changed_at.at[0, 3].set(st.tick),
     )
     st, key, _ = run(step, st, key, 25)
@@ -174,8 +176,10 @@ def test_zombie_refutes_dead_self_record(step):
     st = S.init_state(PARAMS, 12, warm=True)
     key = jax.random.PRNGKey(11)
     # plant the death rumor directly in the victim's own table
+    from scalecube_cluster_tpu.ops.lattice import precedence_key
+
     st = st.replace(
-        view_status=st.view_status.at[6, 6].set(DEAD),
+        view_key=st.view_key.at[6, 6].set(precedence_key(jnp.int32(DEAD), jnp.int32(0))),
         changed_at=st.changed_at.at[6, 6].set(st.tick),
     )
     st, key, _ = run(step, st, key, 60)
