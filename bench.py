@@ -2,18 +2,28 @@
 
 Scenario (BASELINE.md target #2 scaled up): a 4096-member cluster running
 the complete SWIM stack — random-probe FD with indirect probes, suspicion,
-infection-style gossip, SYNC anti-entropy — with a rumor spread from one
-member. The reference executes this protocol in real time: one gossip period
-= 200 ms of wall clock (GossipConfig.java:9), so N members converge a rumor
-in ``3·ceil_log2(N+1)`` periods of real time (ClusterMath.java:111-113) and
-there is no way to run it faster — the baseline "simulation rate" is 1× real
+infection-style gossip, SYNC anti-entropy — driven through repeated rumor
+rounds: each round injects a fresh user rumor and runs the full sweep
+window, so the measured span covers active dissemination, the spread/sweep
+tail, and quiescent gaps exactly as a live cluster would. The reference
+executes this protocol in real time: one gossip period = 200 ms of wall
+clock (GossipConfig.java:9), so the baseline "simulation rate" is 1x real
 time by construction (and the reference tops out at N≈50 in its own
 experiment matrix, GossipProtocolTest.java:47-63).
 
+Each round asserts the rumor fully converges within the analytic sweep
+budget (the reference test suite's own assertion, GossipProtocolTest).
+
+Measurement notes: ticks are batched through ``run_ticks`` (one XLA call
+per round — per-tick host dispatch would otherwise dominate), and a dummy
+device→host read is issued BEFORE the timed span: on the tunneled TPU
+backend the first d2h transfer permanently switches the stream into
+synchronous dispatch, so timing before that read would measure enqueue
+rate, not execution.
+
 Metric: simulated protocol seconds per wall-clock second on one TPU chip
-(ticks/s × 0.2 s/tick), measured over a steady-state window after verifying
-the rumor actually converges within the analytic bound. vs_baseline is the
-same number: how many times faster than the reference's real-time execution.
+(ticks/s × 0.2 s/tick). vs_baseline is the same number: how many times
+faster than the reference's real-time execution.
 
 Prints exactly one JSON line.
 """
@@ -26,15 +36,17 @@ import time
 from functools import partial
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
-from scalecube_cluster_tpu.ops.kernel import tick
+from scalecube_cluster_tpu.ops.kernel import run_ticks
 from scalecube_cluster_tpu.ops.state import SimParams, init_state
 import scalecube_cluster_tpu.ops.state as S
 from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_sweep
 
 N = 4096
 TICK_SECONDS = 0.2  # one tick = one default-LAN gossip period
-MEASURE_TICKS = 300
+ROUNDS = 6
 
 
 def log(msg: str) -> None:
@@ -53,38 +65,51 @@ def main() -> None:
         rumor_slots=8,
         seed_rows=(0,),
     )
+    budget = gossip_periods_to_sweep(params.repeat_mult, N)
     state = init_state(params, N, warm=True)
-    state = S.spread_rumor(state, 0, origin=0)
-    step = jax.jit(partial(tick, params=params), donate_argnums=0)
+    step = jax.jit(partial(run_ticks, n_ticks=budget, params=params))
     key = jax.random.PRNGKey(0)
 
-    # --- correctness gate: the rumor must fully converge within the sweep
-    # window (the reference test suite's own assertion, GossipProtocolTest).
-    budget = gossip_periods_to_sweep(params.repeat_mult, N)
-    converged_at = None
-    for t in range(budget):
-        key, k = jax.random.split(key)
-        state, metrics = step(state, k)
-        if converged_at is None and float(metrics["rumor_coverage"][0]) >= 1.0:
-            converged_at = t + 1
-            break
-    log(f"rumor coverage 1.0 at tick {converged_at} (budget {budget})")
-    if converged_at is None:
-        print(json.dumps({"metric": "sim_speedup_vs_realtime", "value": 0.0,
-                          "unit": "x", "vs_baseline": 0.0, "error": "no convergence"}))
-        return
+    # Force synchronous dispatch BEFORE timing (see module docstring), then
+    # compile + warm one full round outside the timed span.
+    _ = float(jnp.zeros((), jnp.float32))
+    state = S.spread_rumor(state, 0, origin=0)
+    state, key, ms, _w = step(state, key)
+    warm_cov = np.asarray(ms["rumor_coverage"])[:, 0]
+    jax.block_until_ready(state)
 
-    # --- steady-state timing window (compile already done above).
-    jax.block_until_ready(state)
+    convergence_ticks = []
     t0 = time.perf_counter()
-    for _ in range(MEASURE_TICKS):
-        key, k = jax.random.split(key)
-        state, metrics = step(state, k)
-    jax.block_until_ready(state)
+    for r in range(ROUNDS):
+        state = S.spread_rumor(state, 0, origin=(r * 97) % N)
+        state, key, ms, _w = step(state, key)
+        cov = np.asarray(ms["rumor_coverage"])[:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        convergence_ticks.append(int(hit[0]) + 1 if hit.size else None)
     dt = time.perf_counter() - t0
 
-    ticks_per_s = MEASURE_TICKS / dt
+    if any(c is None for c in convergence_ticks):
+        log(f"convergence failures: {convergence_ticks} (budget {budget})")
+        print(
+            json.dumps(
+                {
+                    "metric": f"swim_sim_speedup_vs_realtime_n{N}",
+                    "value": 0.0,
+                    "unit": "x",
+                    "vs_baseline": 0.0,
+                    "error": "no convergence",
+                }
+            )
+        )
+        return
+
+    total_ticks = ROUNDS * budget
+    ticks_per_s = total_ticks / dt
     speedup = ticks_per_s * TICK_SECONDS
+    log(
+        f"{ROUNDS} rumor rounds x {budget} ticks, convergence at "
+        f"{convergence_ticks} (warm round: {int(np.argmax(warm_cov >= 1.0)) + 1})"
+    )
     log(f"{ticks_per_s:.1f} ticks/s at N={N} -> {speedup:.1f}x real time")
     print(
         json.dumps(

@@ -150,11 +150,11 @@ def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Arra
     only follow valid ones (slot s is valid iff ``s < c_i``), so garbage
     ranks never perturb valid draws.
     """
-    n = mask.shape[1]
     k = u.shape[1]
     c = mask.sum(axis=1).astype(jnp.int32)  # [N] candidate counts
     cs = jnp.cumsum(mask.astype(jnp.int32), axis=1)  # [N, N]
     ranks: list[jax.Array] = []
+    idxs: list[jax.Array] = []
     for s in range(k):
         avail = jnp.maximum(c - s, 1)
         x = (u[:, s] * avail.astype(jnp.float32)).astype(jnp.int32)
@@ -164,10 +164,13 @@ def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Arra
             for t in range(len(ranks)):
                 x = x + (x >= prev[t]).astype(jnp.int32)
         ranks.append(x)
-    rank_mat = jnp.stack(ranks, 1)  # [N, k]
+        # rank -> column: first j with cs[i, j] == x+1 — a streaming one-hot
+        # argmax, far cheaper on TPU than a batched binary search. Invalid
+        # slots (x+1 > c) find no hit and argmax yields 0: garbage the
+        # caller masks via `valid`.
+        idxs.append(jnp.argmax(cs >= (x + 1)[:, None], axis=1).astype(jnp.int32))
     valid = jnp.arange(k, dtype=jnp.int32)[None, :] < c[:, None]
-    idx = jax.vmap(jnp.searchsorted)(cs, rank_mat + 1)
-    return jnp.minimum(idx, n - 1).astype(jnp.int32), valid
+    return jnp.stack(idxs, 1), valid
 
 
 def _loss_at(state: SimState, i, j) -> jnp.ndarray:
@@ -234,13 +237,12 @@ def _fd_phase(
     cand_key = jnp.where(ack, alive_key, suspect_key)
     accept = has_tgt & (cand_key > own_key)
 
+    # One accepted verdict per row at column tgt_i — written as a streaming
+    # one-hot select (cheaper than a scattered copy-on-write of both planes).
+    hit = (rows[None, :] == tgt[:, None]) & accept[:, None]
     st = state.replace(
-        view_key=state.view_key.at[rows, tgt].set(
-            jnp.where(accept, cand_key, own_key)
-        ),
-        changed_at=state.changed_at.at[rows, tgt].set(
-            jnp.where(accept, state.tick, state.changed_at[rows, tgt])
-        ),
+        view_key=jnp.where(hit, cand_key[:, None], state.view_key),
+        changed_at=jnp.where(hit, state.tick, state.changed_at),
     )
     metrics = {
         "fd_probes": has_tgt.sum(),
@@ -254,16 +256,25 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
     incarnation (rank 2 -> 3 is key+1). ``changed_at`` is the suspicion
     start: every accepted change that leaves a cell SUSPECT also (re)stamps
     it, so a separate suspect_since plane would always equal it."""
-    timeout = params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
-    expired = (
-        ((state.view_key & 3) == RANK_SUSPECT)
-        & (state.tick - state.changed_at >= timeout[:, None])
-        & state.up[:, None]
-    )
-    return state.replace(
-        view_key=jnp.where(expired, state.view_key + 1, state.view_key),
-        changed_at=jnp.where(expired, state.tick, state.changed_at),
-    )
+    suspect = (state.view_key & 3) == RANK_SUSPECT
+
+    def _sweep(state: SimState) -> SimState:
+        timeout = (
+            params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
+        )
+        expired = (
+            suspect
+            & (state.tick - state.changed_at >= timeout[:, None])
+            & state.up[:, None]
+        )
+        return state.replace(
+            view_key=jnp.where(expired, state.view_key + 1, state.view_key),
+            changed_at=jnp.where(expired, state.tick, state.changed_at),
+        )
+
+    # No SUSPECT cell anywhere (the healthy steady state) -> nothing can
+    # expire; skip the timer compare + both plane writes.
+    return jax.lax.cond(suspect.any(), _sweep, lambda st: st, state)
 
 
 def _gossip_phase(
@@ -273,78 +284,159 @@ def _gossip_phase(
     rows = jnp.arange(n)
     spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
 
-    peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
-
     known = state.view_key >= 0
     young = known & (state.tick - state.changed_at < spread[:, None])
-    piggyback = jnp.where(young, state.view_key, NO_CANDIDATE)  # [N, N]
-
     rumor_young = (
         state.infected
         & state.rumor_active[None, :]
         & (state.tick - state.infected_at < spread[:, None])
     )  # [N, R]
+    # A node only sends a GOSSIP_REQ when it has something to put in it — the
+    # reference sends nothing when selectGossipsToSend comes back empty
+    # (doSpreadGossip:141-184 iterates selected gossips). So (a) message
+    # counts only tally payload-bearing sends, and (b) a fully quiescent
+    # cluster (converged steady state: nothing young, no live rumors) skips
+    # peer selection + delivery + merge entirely — the dominant per-tick cost
+    # drops out exactly when the real system would go quiet on the wire.
+    sender_has = young.any(axis=1) | rumor_young.any(axis=1)  # [N]
 
-    recv_key = jnp.full((n, n), NO_CANDIDATE)
-    recv_inf = jnp.zeros_like(state.infected)
-    sent = jnp.int32(0)
-    for s in range(params.fanout):
-        p = peers[:, s]
-        ok = peer_valid[:, s] & _edge_ok(state, rows, p, r.gossip_edge[:, s])
-        sent = sent + ok.sum()
-        recv_key = recv_key.at[p].max(jnp.where(ok[:, None], piggyback, NO_CANDIDATE))
-        recv_inf = recv_inf.at[p].max(rumor_young & ok[:, None])
+    def _deliver(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
+        peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
+        piggyback = jnp.where(young, state.view_key, NO_CANDIDATE)  # [N, N]
+        # Scatter-max deliveries directly onto a working copy of the table
+        # (buf = max(own, best delivered candidate) cellwise), then apply
+        # the overrides gate on the winner: buf > own ⟺ the best candidate
+        # overrides, in which case buf IS that candidate. Saves a separate
+        # recv buffer + merge pass.
+        buf = state.view_key
+        recv_inf = jnp.zeros_like(state.infected)
+        sent = jnp.int32(0)
+        for s in range(params.fanout):
+            p = peers[:, s]
+            ok = (
+                peer_valid[:, s]
+                & sender_has
+                & _edge_ok(state, rows, p, r.gossip_edge[:, s])
+            )
+            sent = sent + ok.sum()
+            buf = buf.at[p].max(jnp.where(ok[:, None], piggyback, NO_CANDIDATE))
+            recv_inf = recv_inf.at[p].max(rumor_young & ok[:, None])
 
-    st, _ = _merge(state, recv_key, state.up)
+        own = state.view_key
+        accept = (
+            (buf > own)
+            & ((own >= 0) | ((buf & 3) <= RANK_LEAVING))
+            & state.up[:, None]
+        )
+        st = state.replace(
+            view_key=jnp.where(accept, buf, own),
+            changed_at=jnp.where(accept, state.tick, state.changed_at),
+        )
 
-    newly_inf = recv_inf & ~st.infected & st.up[:, None] & st.rumor_active[None, :]
-    st = st.replace(
-        infected=st.infected | newly_inf,
-        infected_at=jnp.where(newly_inf, st.tick, st.infected_at),
-    )
-    return st, {"gossip_msgs": sent, "rumor_deliveries": newly_inf.sum()}
+        newly_inf = recv_inf & ~st.infected & st.up[:, None] & st.rumor_active[None, :]
+        st = st.replace(
+            infected=st.infected | newly_inf,
+            infected_at=jnp.where(newly_inf, st.tick, st.infected_at),
+        )
+        return st, {"gossip_msgs": sent, "rumor_deliveries": newly_inf.sum()}
+
+    def _quiet(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
+        return state, {
+            "gossip_msgs": jnp.int32(0),
+            "rumor_deliveries": jnp.int32(0),
+        }
+
+    return jax.lax.cond(sender_has.any(), _deliver, _quiet, state)
 
 
 def _sync_phase(
     state: SimState, r: RoundRandoms, params: SimParams
 ) -> tuple[SimState, dict[str, jax.Array]]:
+    """Anti-entropy full-table exchange for this tick's due callers.
+
+    Stagger makes only ~capacity/sync_every rows due per tick, so the due
+    set is compacted into K static caller slots (``jnp.nonzero(size=K)``,
+    ascending row order) and all per-caller work — peer selection, the
+    caller-table scatter, the ACK merge — happens on [K, N] gathers instead
+    of full [N, N] passes. Only the REQ-side merge stays full-matrix
+    (several callers may pick the same peer; the scatter-max + one merge
+    pass resolves duplicates exactly like the peer's sequential merges
+    would). Callers beyond K wait for their next slot (forced bootstraps
+    retry next tick — see SimParams.sync_slots)."""
     n = state.capacity
     rows = jnp.arange(n)
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
     due = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
     due = (due | state.force_sync) & state.up
+    (caller,) = jnp.nonzero(due, size=K, fill_value=n)
+    valid_c = caller < n
+    caller = jnp.minimum(caller, n - 1)  # in-bounds; masked by valid_c
 
     # SYNC peers come from the live view PLUS the configured seeds
     # (selectSyncAddress: seedMembers ∪ members) — seeds re-bridge healed
     # partitions after mutual removal.
-    cand = _live_view_mask(state)
+    caller_tables = state.view_key[caller]  # [K, N]
+    cand = (caller_tables & 3) != RANK_DEAD
     if params.seed_rows:
         seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
-        cand = (cand | seed_mask[None, :]) & ~jnp.eye(n, dtype=bool)
-    peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[:, None])
-    peer = peer_idx[:, 0]
+        cand = cand | seed_mask[None, :]
+    cand = cand & (rows[None, :] != caller[:, None])
+    peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[caller][:, None])
+    peer = peer_idx[:, 0]  # [K]
     # Round trip: SYNC out and SYNC_ACK back must both survive.
-    p_rt = (1.0 - _loss_at(state, rows, peer)) * (1.0 - _loss_at(state, peer, rows))
-    ok = due & peer_valid[:, 0] & state.up[peer] & (r.sync_edge < p_rt)
+    p_rt = (1.0 - _loss_at(state, caller, peer)) * (1.0 - _loss_at(state, peer, caller))
+    ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
-    # SYNC request: caller's full table scattered into peers (several callers
-    # may hit one peer; scatter-max resolves, as the peer's sequential merges
-    # would — the join is associative). The table IS view_key: unknown cells
-    # are -1, which no receiver ever accepts (-1 > own requires own < -1,
-    # impossible), so no masking pass is needed.
-    recv_req = jnp.full((n, n), NO_CANDIDATE).at[peer].max(
-        jnp.where(ok[:, None], state.view_key, NO_CANDIDATE)
+    # SYNC request: callers' full tables scattered into peers (several
+    # callers may hit one peer; scatter-max resolves duplicates, exactly as
+    # the peer's sequential merges would — the join is associative). The
+    # table IS view_key: unknown cells are -1, which no receiver ever
+    # accepts (-1 > own requires own < -1, impossible). The overrides gate
+    # is applied on the scatter-maxed winner per cell (buf > own ⟺ the best
+    # delivered candidate overrides), then written back row-locally: only
+    # the ≤K peer rows are touched, and duplicate peer slots recompute the
+    # identical row so the scatter-max write is conflict-free.
+    buf = state.view_key.at[peer].max(
+        jnp.where(ok[:, None], caller_tables, NO_CANDIDATE)
     )
-    st, _ = _merge(state, recv_req, state.up)
+    own_p = state.view_key[peer]  # [K, N]
+    buf_p = buf[peer]  # [K, N]
+    acc = (
+        (buf_p > own_p)
+        & ((own_p >= 0) | ((buf_p & 3) <= RANK_LEAVING))
+        & state.up[peer][:, None]
+    )
+    st = state.replace(
+        view_key=state.view_key.at[peer].max(jnp.where(acc, buf_p, own_p)),
+        changed_at=state.changed_at.at[peer].max(
+            jnp.where(acc, state.tick, jnp.int32(-(1 << 30)))
+        ),
+    )
 
     # SYNC_ACK: the peer's (post-merge) table straight back to each caller.
-    recv_ack = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)
-    st, _ = _merge(st, recv_ack, st.up)
+    # Row-local: accepted keys only grow, so scatter-max writes the merged
+    # caller rows without touching the rest of the matrix (invalid/duplicate
+    # slots contribute values that lose the max, a no-op).
+    ack_cand = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)  # [K, N]
+    own_rows = st.view_key[caller]
+    accept = (
+        (ack_cand > own_rows)
+        & ((own_rows >= 0) | ((ack_cand & 3) <= RANK_LEAVING))
+        & state.up[caller][:, None]
+    )
+    st = st.replace(
+        view_key=st.view_key.at[caller].max(jnp.where(accept, ack_cand, own_rows)),
+        changed_at=st.changed_at.at[caller].max(
+            jnp.where(accept, st.tick, jnp.int32(-(1 << 30)))
+        ),
+    )
 
     # A joiner's bootstrap SYNC retries every tick until one round-trip
     # actually lands (a lost initial SYNC must not strand the joiner until
     # its periodic stagger slot — cf. the reference's initial-sync-to-seeds
     # start phase, MembershipProtocolImpl.start0:250-291).
-    st = st.replace(force_sync=st.force_sync & ~ok)
+    ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
+    st = st.replace(force_sync=st.force_sync & ~ok_full)
     return st, {"sync_roundtrips": ok.sum()}
 
 
@@ -371,12 +463,18 @@ def _refute_phase(state: SimState) -> SimState:
     )
     announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
     new_diag = (((diag >> 2) + 1) << 2) | announce_rank
-    return state.replace(
-        view_key=state.view_key.at[rows, rows].set(jnp.where(need, new_diag, diag)),
-        changed_at=state.changed_at.at[rows, rows].set(
-            jnp.where(need, state.tick, state.changed_at[rows, rows])
-        ),
-    )
+
+    def _apply(st: SimState) -> SimState:
+        return st.replace(
+            view_key=st.view_key.at[rows, rows].set(jnp.where(need, new_diag, diag)),
+            changed_at=st.changed_at.at[rows, rows].set(
+                jnp.where(need, st.tick, st.changed_at[rows, rows])
+            ),
+        )
+
+    # In a healthy cluster nobody is refuting; skip the diagonal writes
+    # (which force a copy-on-write of both [N, N] planes) entirely then.
+    return jax.lax.cond(need.any(), _apply, lambda st: st, state)
 
 
 def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
@@ -437,3 +535,43 @@ def tick(
         "rumor_coverage": coverage,  # [R]
     }
     return state, metrics
+
+
+def run_ticks(
+    state: SimState,
+    key: jax.Array,
+    n_ticks: int,
+    params: SimParams,
+    watch_rows: jax.Array | None = None,
+) -> tuple[SimState, jax.Array, dict[str, Any], jax.Array | None]:
+    """Advance ``n_ticks`` gossip periods in ONE XLA call (``lax.scan``).
+
+    Dispatching tick-by-tick from Python costs a host round trip per period —
+    on a tunneled TPU that's ~100x the tick's actual device time. Batching is
+    the TPU-idiomatic driver loop: one dispatch runs the whole window
+    on-device and per-tick metrics come back stacked ([n_ticks, ...]) in a
+    single transfer at the end.
+
+    The key chain is ``key, k = split(key)`` per tick — byte-identical to
+    the host loop the tests and the scalar oracle use, so
+    ``run_ticks(s, key, n)`` reproduces exactly the trajectory of n calls to
+    :func:`tick` with host-side splitting. Returns the advanced key so
+    callers can continue the same chain.
+
+    ``watch_rows`` (static-shaped [W] row indices) additionally returns the
+    watched rows' ``view_key`` after every tick ([n_ticks, W, N]) so the
+    host can diff membership events for a whole window from one transfer
+    (the reference's per-node event streams, ``MembershipEvent.java:15-20``).
+    """
+
+    def body(carry, _):
+        st, k = carry
+        k, tick_key = jax.random.split(k)
+        st, m = tick(st, tick_key, params)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, k), m
+
+    (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched

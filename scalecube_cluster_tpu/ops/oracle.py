@@ -49,8 +49,7 @@ def _sample_distinct_row(mask: np.ndarray, u: np.ndarray):
 
     Must be bit-exact: rank draw = float32(u) * float32(avail) truncated,
     insertion shift over the already-taken ranks in ascending order, rank →
-    column via left searchsorted on the mask cumsum."""
-    n = mask.shape[0]
+    column via first-hit argmax on the mask cumsum."""
     c = int(mask.sum())
     cs = np.cumsum(mask.astype(np.int32))
     k = len(u)
@@ -66,7 +65,9 @@ def _sample_distinct_row(mask: np.ndarray, u: np.ndarray):
                 x += 1
         taken.append(x)
         valid[s] = s < c
-        idx[s] = min(int(np.searchsorted(cs, x + 1, side="left")), n - 1)
+        # first j with cs[j] >= x+1 (argmax of the bool row, like the kernel;
+        # all-False -> 0, garbage masked by valid)
+        idx[s] = int(np.argmax(cs >= x + 1))
     return idx, valid
 
 
@@ -218,6 +219,11 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
     # ---- SYNC phase ----
     pre = o.snap()
     callers = []
+    # Static caller-slot cap, mirroring kernel._sync_phase's nonzero(size=K)
+    # compaction: the first K due rows in ascending order get slots; the rest
+    # wait for their next stagger slot / retry via force_sync.
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
+    slots_used = 0
     for i in range(n):
         if not pre.up[i]:
             continue
@@ -226,6 +232,9 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         )
         if not due:
             continue
+        if slots_used >= K:
+            continue
+        slots_used += 1
         sync_cand = _live_mask(pre, i)
         for srow in params.seed_rows:
             if srow != i:

@@ -21,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .kernel import tick
+from .kernel import run_ticks, tick
 from .state import SimParams, SimState
 
 MEMBER_AXIS = "members"
@@ -78,3 +78,16 @@ def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
         in_shardings=(sh, rep),
         out_shardings=(sh, None),
     )
+
+
+def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: bool = True):
+    """jit the batched ``run_ticks`` window over ``mesh``.
+
+    Input state must already be placed via :func:`shard_state`; GSPMD
+    propagates the row sharding through the scan (stacked metrics and
+    watched-row keys come out replicated/gathered as XLA chooses)."""
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    return jax.jit(partial(run_ticks, n_ticks=n_ticks, params=params))

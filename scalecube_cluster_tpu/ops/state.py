@@ -48,6 +48,12 @@ class SimParams:
     fd_every: int = 5  # ping_interval / tick_interval
     sync_every: int = 150  # sync_interval / tick_interval
     sync_stagger: int = 1
+    # Static cap on SYNC callers processed per tick (0 = auto:
+    # capacity/sync_every + 32 headroom). Stagger spreads periodic syncs to
+    # ~capacity/sync_every per tick; the headroom absorbs join bootstraps.
+    # Overflowing callers simply wait: periodic ones hit their next stagger
+    # slot, forced ones (force_sync) retry next tick.
+    sync_slots: int = 0
     suspicion_mult: int = 5
     rumor_slots: int = 64
     # Rows that act as configured seed members: always in the SYNC peer pool

@@ -23,6 +23,7 @@ from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..models.events import MembershipEvent
@@ -74,14 +75,15 @@ class SimDriver:
         self.mesh = mesh
         self.record_metrics = record_metrics
         if mesh is not None:
-            from ..ops.sharding import make_sharded_tick, shard_state
+            from ..ops.sharding import shard_state
 
             init = _state.init_state(params, n_initial, warm=warm)
-            self._step = make_sharded_tick(mesh, params, init.loss.ndim != 0)
+            self._dense_links = init.loss.ndim != 0
             self.state: SimState = shard_state(init, mesh)
         else:
-            self._step = jax.jit(partial(_kernel.tick, params=params))
+            self._dense_links = True
             self.state = _state.init_state(params, n_initial, warm=warm)
+        self._step_cache: Dict[tuple, Callable] = {}
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed ^ 0x5EED)  # host-side (transport) draws
         self.n_initial = n_initial
@@ -100,22 +102,56 @@ class SimDriver:
         return int(self.state.tick)
 
     # -- stepping -----------------------------------------------------------
-    def step(self, n_ticks: int = 1) -> dict:
-        """Advance the sim; returns the last tick's metrics (host arrays).
+    def _get_step(self, n_ticks: int, n_watch: int) -> Callable:
+        """Cached jitted ``run_ticks`` executable per (window, watch-count).
 
-        Per-tick metrics stay on device unless ``record_metrics=True`` was
-        passed at construction — a forced device→host sync every tick would
-        serialize JAX's async dispatch on long runs."""
-        device_metrics = {}
-        for _ in range(n_ticks):
-            self._key, k = jax.random.split(self._key)
-            self.state, device_metrics = self._step(self.state, k)
-            if self.record_metrics:
-                self.metrics_history.append(
-                    {name: np.asarray(v) for name, v in device_metrics.items()}
+        The whole window runs as ONE device call (``lax.scan``) — per-tick
+        host dispatch costs a device round trip each, which on a tunneled
+        TPU dwarfs the tick itself. Watched rows' view keys come back
+        stacked per tick so membership events for the window are diffed
+        from a single transfer."""
+        cache_key = (n_ticks, n_watch)
+        if cache_key not in self._step_cache:
+            fn = partial(_kernel.run_ticks, n_ticks=n_ticks, params=self.params)
+            if self.mesh is not None:
+                from ..ops.sharding import make_sharded_run
+
+                self._step_cache[cache_key] = make_sharded_run(
+                    self.mesh, self.params, n_ticks, self._dense_links
                 )
-            self._extract_events()
-        return {name: np.asarray(v) for name, v in device_metrics.items()}
+            else:
+                self._step_cache[cache_key] = jax.jit(fn)
+        return self._step_cache[cache_key]
+
+    def step(self, n_ticks: int = 1) -> dict:
+        """Advance the sim ``n_ticks`` periods in one device call; returns
+        the last tick's metrics (host arrays).
+
+        The trajectory is identical to ``n_ticks`` single steps (the key
+        chain inside the window is the same split sequence). Metrics and
+        watched-row events for the whole window come back in one transfer;
+        per-tick metrics are appended to ``metrics_history`` only when
+        ``record_metrics=True`` was passed at construction."""
+        rows = sorted(self._watches)
+        watch_arr = jnp.asarray(rows, dtype=jnp.int32) if rows else None
+        step = self._get_step(n_ticks, len(rows))
+        self.state, self._key, ms, watched = step(
+            self.state, self._key, watch_rows=watch_arr
+        )
+        if self.record_metrics:
+            host_ms = {name: np.asarray(v) for name, v in ms.items()}
+            for i in range(n_ticks):
+                self.metrics_history.append(
+                    {name: v[i] for name, v in host_ms.items()}
+                )
+        if rows:
+            keys = np.asarray(watched)  # [n_ticks, W, N]
+            for i in range(n_ticks):
+                for w_idx, row in enumerate(rows):
+                    w = self._watches[row]
+                    self._diff_row(w, keys[i, w_idx])
+                    w.prev_key = keys[i, w_idx]
+        return {name: np.asarray(v[-1]) for name, v in ms.items()}
 
     def run_until(
         self, predicate: Callable[["SimDriver"], bool], max_ticks: int = 10_000
@@ -145,16 +181,6 @@ class SimDriver:
         if row not in self.members:
             self.members[row] = Member(id=f"sim-{row}", address=row_address(row))
         return self.members[row]
-
-    def _extract_events(self) -> None:
-        if not self._watches:
-            return
-        rows = sorted(self._watches)
-        keys = np.asarray(self.state.view_key[np.array(rows)])
-        for i, row in enumerate(rows):
-            w = self._watches[row]
-            self._diff_row(w, keys[i])
-            w.prev_key = keys[i]
 
     def _diff_row(self, w: _Watch, key: np.ndarray) -> None:
         changed = key != w.prev_key
