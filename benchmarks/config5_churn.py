@@ -61,8 +61,15 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     churn_per_s = max(1, int(n * args.churn_pct_per_s / 100))
+    import collections
     import time
 
+    # Replacement joins draw from rows freed in EARLIER bursts (FIFO), never
+    # the rows just crashed — rejoining a just-crashed row would hand the new
+    # member the peers' still-ALIVE records for the old occupant and the
+    # crash would never manifest to failure detection. The initial pool is
+    # the n//100 rows left down at init.
+    free_pool = collections.deque(int(r) for r in np.nonzero(~np.asarray(loop.state.up))[0])
     t0 = time.perf_counter()
     fracs = []
     for sec in range(args.seconds):
@@ -73,9 +80,10 @@ def main() -> None:
         crash = crash[~np.isin(crash, params.seed_rows)]
         st = loop.state
         st = st.replace(up=st.up.at[np.asarray(crash)].set(False))
-        free = np.nonzero(~np.asarray(st.up))[0][: len(crash)]
-        for row in free:
-            st = S.join_row(st, int(row), list(params.seed_rows))
+        n_join = min(len(crash), len(free_pool))
+        for _ in range(n_join):
+            st = S.join_row(st, free_pool.popleft(), list(params.seed_rows))
+        free_pool.extend(int(r) for r in crash)
         loop.state = st
         m = loop.step(TICKS_PER_SECOND)
         frac = float(np.asarray(m["alive_view_fraction"]))

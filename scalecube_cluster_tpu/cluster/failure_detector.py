@@ -17,7 +17,7 @@ Behavioral parity with reference ``FailureDetectorImpl``
 * the ping list follows membership ADDED (insert at random position) /
   REMOVED events (``onMemberEvent`` :321-346).
 
-The vectorized analogue is ``ops/fd.py`` — one FD round per tick with the
+The vectorized analogue is ``ops/kernel.py``'s FD phase — one FD round per tick with the
 same verdict function expressed as Bernoulli draws on the link matrix.
 """
 

@@ -17,7 +17,7 @@ Behavioral parity with reference ``GossipProtocolImpl``
   :350-358); too many dedup gaps triggers the segmentation warning
   (``checkGossipSegmentation`` :217-236).
 
-Vectorized analogue: ``ops/gossip_ops.py`` — rumor state as (slots × N)
+Vectorized analogue: ``ops/kernel.py``'s gossip phase — rumor state as (slots × N)
 infection bitmaps, fanout-sample + scatter per tick, dedup as bitmap OR.
 """
 

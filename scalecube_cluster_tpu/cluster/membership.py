@@ -22,7 +22,7 @@ Behavioral parity with reference ``MembershipProtocolImpl``
   ALIVE-after-SUSPECT triggers a SYNC to the member instead of a direct
   override; membership rumors via ``onMembershipGossip`` (:452-459).
 
-Vectorized analogue: ``ops/membership_ops.py`` — the merge is an elementwise
+Vectorized analogue: ``ops/kernel.py``'s merge/suspicion phases — an elementwise
 lattice join over N×N (status, incarnation) tensors, suspicion timers a
 deadline matrix compared against the tick counter.
 """

@@ -39,14 +39,24 @@ def build_codec() -> bool:
 _BUILD_FAILED = False
 
 
+def _stale() -> bool:
+    """The .so must be rebuilt when missing or older than its source (a
+    stale binary from an earlier codec.c — or another Python/ABI — must not
+    be loaded as-is)."""
+    if not _SO.exists():
+        return True
+    src = _DIR / "codec.c"
+    return src.exists() and src.stat().st_mtime > _SO.stat().st_mtime
+
+
 def load_codec():
-    """Import the native codec module, building it if needed; None if
-    unavailable. A failed build is cached for the process lifetime so
-    callers don't repeatedly shell out to the compiler."""
+    """Import the native codec module, (re)building it if missing or stale;
+    None if unavailable. A failed build is cached for the process lifetime
+    so callers don't repeatedly shell out to the compiler."""
     global _BUILD_FAILED
     if _BUILD_FAILED:
         return None
-    if not _SO.exists():
+    if _stale():
         if not build_codec():
             _BUILD_FAILED = True
             return None
@@ -56,6 +66,7 @@ def load_codec():
     module = importlib.util.module_from_spec(spec)
     try:
         spec.loader.exec_module(module)
-    except ImportError:
+    except Exception as e:  # any load failure -> pure-Python wire
+        _log.info("native codec load failed (%s); using pure-Python fallback", e)
         return None
     return module

@@ -284,6 +284,10 @@ class SimDriver:
         self.state = _state.heal_partition(self.state, group_a, group_b)
 
     def link_loss(self, src: int, dst: int) -> float:
+        # scalar uniform-loss mode (init_state(dense_links=False)) has no
+        # per-link matrix to index — mirror kernel._loss_at
+        if self.state.loss.ndim == 0:
+            return float(self.state.loss)
         return float(self.state.loss[src, dst])
 
     # -- views --------------------------------------------------------------

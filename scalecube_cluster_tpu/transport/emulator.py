@@ -10,7 +10,7 @@ fail -> delay before send and filters inbound on the listen stream.
 
 The vectorized sim applies the same model on-device: loss/delay become
 Bernoulli/exponential draws against an N×N link matrix inside the tick kernel
-(``ops/fd.py``, ``ops/gossip_ops.py``); this module is the scalar-engine and
+(``ops/kernel.py`` — the FD and gossip phases); this module is the scalar-engine and
 real-transport version, and the oracle for those kernel draws.
 """
 
