@@ -10,11 +10,26 @@ the scalar oracle :func:`scalecube_cluster_tpu.models.record.overrides_codes`):
 
 To make the merge a **scatter-max reduction** (many senders may deliver
 records for the same receiver row in one tick; the combiner must be
-commutative + associative), each ``(status, incarnation)`` is packed into one
-monotone int32 key::
+commutative + associative), each ``(epoch, status, incarnation)`` is packed
+into one monotone int32 key::
 
-    key = incarnation * 4 + rank
+    key = epoch << 23 | incarnation << 2 | rank
     rank: ALIVE -> 0, LEAVING -> 1, SUSPECT -> 2, DEAD -> 3
+
+The **epoch** (8 bits, high) is the row's identity generation: a crashed
+row reused by a fresh joiner gets ``epoch+1`` (``state.join_row``). Because
+epoch occupies the top bits, every record of the new identity strictly
+dominates every record (even DEAD tombstones) of the old one — which is the
+sim's vectorized DEST_GONE: the reference answers a probe for a restarted
+member with AckType.DEST_GONE and the prober deletes the old identity
+(``FailureDetectorImpl.computeMemberStatus:382-404``,
+``onPing:227-259``); here the probe ACK (and any gossip/SYNC) carries the
+target's current self key, whose higher epoch overrides the stale record in
+one step, and the host driver maps the epoch flip to REMOVED(old identity) +
+ADDED(new identity) events — the reference's net outcome (restart = new
+member id, old one dead). Epoch wraps at 256 reuses of one row; the driver
+prefers forgotten rows precisely so a row is never re-occupied while live
+peers still hold near-wrap records.
 
 ``new overrides old  <=>  key(new) > key(old)`` — the reference truth table
 with three deliberate, documented deviations forced by totalizing the
@@ -45,7 +60,7 @@ absent record) is NOT part of the key: unknown entries get key ``-1`` and a
 separate accept gate blocks SUSPECT/DEAD candidates for unknown members
 (see ``kernel._merge``).
 
-Incarnations must stay below ``2**28`` to fit the packing; they only grow by
+Incarnations must stay below ``2**21`` to fit the packing; they only grow by
 refutations/metadata bumps, so this is never a practical limit.
 """
 
@@ -71,27 +86,39 @@ RANK_LEAVING = 1
 RANK_SUSPECT = 2
 RANK_DEAD = 3
 
+# Bit layout: rank [0:2), incarnation [2:23), epoch [23:31).
+INC_BITS = 21
+EPOCH_SHIFT = 2 + INC_BITS
+INC_MASK = (1 << INC_BITS) - 1
+EPOCH_MASK = 0xFF
+
 # rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1, DEAD->3
 _RANK = jnp.array([0, 2, 1, 3, 0], dtype=jnp.int32)
 # status lookup by rank: 0->ALIVE, 1->LEAVING, 2->SUSPECT, 3->DEAD
 _RANK_TO_STATUS = jnp.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=jnp.int8)
 
 
-def precedence_key(status: jnp.ndarray, incarnation: jnp.ndarray) -> jnp.ndarray:
-    """Pack (status, incarnation) into the monotone int32 precedence key.
+def precedence_key(
+    status: jnp.ndarray, incarnation: jnp.ndarray, epoch: jnp.ndarray | int = 0
+) -> jnp.ndarray:
+    """Pack (status, incarnation[, epoch]) into the monotone int32 key.
 
     UNKNOWN entries map to ``UNKNOWN_KEY`` (-1) so any known record beats
     them (the ALIVE/LEAVING-only gate is applied separately).
     """
     status = status.astype(jnp.int32)
-    key = incarnation.astype(jnp.int32) * 4 + _RANK[status]
+    key = (
+        (jnp.int32(epoch) << EPOCH_SHIFT)
+        | (incarnation.astype(jnp.int32) << 2)
+        | _RANK[status]
+    )
     return jnp.where(status == UNKNOWN, UNKNOWN_KEY, key)
 
 
 def decode_key(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Unpack a winning candidate key back to ``(status, incarnation)``."""
     status = _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
-    return status, (key >> 2).astype(jnp.int32)
+    return status, ((key >> 2) & INC_MASK).astype(jnp.int32)
 
 
 def key_status(key: jnp.ndarray) -> jnp.ndarray:
@@ -103,4 +130,9 @@ def key_status(key: jnp.ndarray) -> jnp.ndarray:
 
 def key_inc(key: jnp.ndarray) -> jnp.ndarray:
     """Incarnation of a packed table key; 0 where no record."""
-    return jnp.where(key < 0, 0, key >> 2).astype(jnp.int32)
+    return jnp.where(key < 0, 0, (key >> 2) & INC_MASK).astype(jnp.int32)
+
+
+def key_epoch(key: jnp.ndarray) -> jnp.ndarray:
+    """Identity epoch of a packed table key; 0 where no record."""
+    return jnp.where(key < 0, 0, (key >> EPOCH_SHIFT) & EPOCH_MASK).astype(jnp.int32)

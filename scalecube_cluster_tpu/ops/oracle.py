@@ -34,12 +34,6 @@ _RANK = {ALIVE: 0, LEAVING: 1, SUSPECT: 2, DEAD: 3}
 _RANK_TO_STATUS = {0: ALIVE, 1: LEAVING, 2: SUSPECT, 3: DEAD}
 
 
-def _key(status: int, inc: int) -> int:
-    if status == UNKNOWN:
-        return -1
-    return inc * 4 + _RANK[status]
-
-
 def _ceil_log2(n: int) -> int:
     return int(n).bit_length() if n > 0 else 0
 
@@ -77,6 +71,7 @@ class _O:
     def __init__(self, state: SimState):
         self.tick = int(state.tick)
         self.up = np.asarray(state.up).copy()
+        self.epoch = np.asarray(state.epoch).copy()  # tick-invariant (host-bumped)
         self.key = np.asarray(state.view_key).copy()
         self.changed = np.asarray(state.changed_at).copy()
         self.force_sync = np.asarray(state.force_sync).copy()
@@ -291,6 +286,7 @@ def assert_equivalent(state: SimState, o: _O) -> None:
     pairs = {
         "tick": (int(state.tick), o.tick),
         "up": (np.asarray(state.up), o.up),
+        "epoch": (np.asarray(state.epoch), o.epoch),
         "view_key": (np.asarray(state.view_key), o.key),
         "changed_at": (np.asarray(state.changed_at), o.changed),
         "force_sync": (np.asarray(state.force_sync), o.force_sync),

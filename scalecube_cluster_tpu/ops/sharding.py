@@ -43,6 +43,7 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
     return SimState(
         tick=rep,
         up=row,
+        epoch=row,
         view_key=row2d,
         changed_at=row2d,
         force_sync=row,

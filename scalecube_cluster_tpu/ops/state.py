@@ -24,10 +24,16 @@ import numpy as np
 from flax import struct
 
 from ..config import ClusterConfig
-from .lattice import RANK_LEAVING, UNKNOWN_KEY, key_inc, key_status
+from .lattice import (
+    EPOCH_SHIFT,
+    RANK_LEAVING,
+    UNKNOWN_KEY,
+    key_inc,
+    key_status,
+)
 
 NEVER = jnp.int32(-(1 << 30))  # "changed long ago" sentinel for changed_at
-# ALIVE@incarnation-0 packed key (inc * 4 + rank_alive)
+# ALIVE @ incarnation 0 @ epoch 0 packed key (epoch<<23 | inc<<2 | rank_alive)
 ALIVE0_KEY = jnp.int32(0)
 
 
@@ -100,8 +106,8 @@ class SimState(struct.PyTreeNode):
     """One cluster simulation: N nodes' replicated SWIM state + rumor pool.
 
     ``view_key[i, j]`` — node i's record for j as the packed precedence key
-    ``incarnation * 4 + rank`` (:mod:`.lattice`), or ``UNKNOWN_KEY`` (-1)
-    when i has no record. Storing the key directly (rather than separate
+    ``epoch << 23 | incarnation << 2 | rank`` (:mod:`.lattice`), or
+    ``UNKNOWN_KEY`` (-1) when i has no record. Storing the key directly (rather than separate
     status/incarnation planes) makes the merge a one-matrix scatter-max and
     is the memory-lean layout for large N: 8 bytes/cell total with
     ``changed_at``, so N=100k row-sharded fits a v5e-8 (~10 GB/chip).
@@ -138,6 +144,7 @@ class SimState(struct.PyTreeNode):
 
     tick: jax.Array  # i32 scalar
     up: jax.Array  # bool [N] — process running (host/churn controlled)
+    epoch: jax.Array  # i32 [N] — row identity generation (bumped on reuse)
     view_key: jax.Array  # i32 [N, N] — packed precedence key, -1 = unknown
     changed_at: jax.Array  # i32 [N, N]
     force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
@@ -196,6 +203,7 @@ def init_state(
     return SimState(
         tick=jnp.int32(0),
         up=up,
+        epoch=jnp.zeros((n,), jnp.int32),
         view_key=view_key,
         changed_at=jnp.full((n, n), NEVER),
         force_sync=jnp.zeros((n,), bool),
@@ -227,17 +235,34 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
     Seeds are recorded as ALIVE@0 placeholders (the reference treats seeds as
     bare addresses, ``MembershipProtocolImpl.start0:250-291``); the forced
     initial SYNC then pulls the real table, like the reference's startup SYNC.
+
+    A reused row (a previous occupant crashed/left) gets identity epoch
+    ``old+1`` in its self record's high key bits, so the new identity's
+    records dominate every stale record of the old occupant — the restart =
+    new-member-id rule (and the sim's DEST_GONE; see :mod:`.lattice`). The
+    epoch wraps at 256 reuses of a single row; callers (``SimDriver.join``)
+    prefer rows no live peer remembers, so near-wrap aliasing never has a
+    stale record to collide with.
     """
     seed_rows = jnp.asarray(seed_rows, jnp.int32)
+    was_used = state.view_key[row, row] >= 0  # row had a previous occupant
+    new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
+    self_key = (new_epoch << EPOCH_SHIFT).astype(jnp.int32)  # ALIVE@0 @ epoch
+    # Seed placeholders carry the seeds' CURRENT epochs — an epoch-0
+    # placeholder for a seed that has itself restarted would read as a
+    # phantom old identity (and emit a bogus REMOVED+ADDED pair at any
+    # watcher the placeholder reaches via the bootstrap SYNC).
+    seed_keys = (state.epoch[seed_rows] << EPOCH_SHIFT).astype(jnp.int32)
     row_key = (
         jnp.full((state.capacity,), UNKNOWN_KEY)
         .at[seed_rows]
-        .set(ALIVE0_KEY)
+        .set(seed_keys)
         .at[row]
-        .set(ALIVE0_KEY)
+        .set(self_key)
     )
     return state.replace(
         up=state.up.at[row].set(True),
+        epoch=state.epoch.at[row].set(new_epoch),
         view_key=state.view_key.at[row].set(row_key),
         changed_at=state.changed_at.at[row].set(NEVER).at[row, row].set(state.tick),
         force_sync=state.force_sync.at[row].set(True),

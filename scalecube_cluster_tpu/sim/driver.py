@@ -31,7 +31,16 @@ from ..models.member import Member, MemberStatus
 from ..utils.streams import EventStream
 from ..ops import kernel as _kernel
 from ..ops import state as _state
-from ..ops.lattice import ALIVE, DEAD, LEAVING, SUSPECT, UNKNOWN
+from ..ops.lattice import (
+    ALIVE,
+    DEAD,
+    EPOCH_MASK,
+    EPOCH_SHIFT,
+    INC_MASK,
+    LEAVING,
+    SUSPECT,
+    UNKNOWN,
+)
 from ..ops.state import SimParams, SimState
 
 
@@ -188,30 +197,53 @@ class SimDriver:
             j = int(j)
             old_k, new_k = int(w.prev_key[j]), int(key[j])
             old_s, new_s = _status_of_key(old_k), _status_of_key(new_k)
-            ev: Optional[MembershipEvent] = None
+            evs: List[MembershipEvent] = []
+            old_e = (old_k >> EPOCH_SHIFT) & EPOCH_MASK if old_k >= 0 else -1
+            new_e = (new_k >> EPOCH_SHIFT) & EPOCH_MASK if new_k >= 0 else -1
+            if old_k >= 0 and new_k >= 0 and old_e != new_e:
+                # Identity epoch flip: the row was re-occupied by a FRESH
+                # member (restart = new member id). The old identity is gone
+                # (the reference's DEST_GONE -> DEAD -> REMOVED,
+                # FailureDetectorImpl.computeMemberStatus:382-404) and the
+                # new one, if alive-ish, is a separate ADDED.
+                if old_s not in (UNKNOWN, DEAD):
+                    evs.append(
+                        MembershipEvent.removed(w.known.pop(j, self._member_handle(j)))
+                    )
+                else:
+                    w.known.pop(j, None)
+                if new_s in (ALIVE, SUSPECT, LEAVING):
+                    w.known[j] = self._member_handle(j)
+                    evs.append(MembershipEvent.added(w.known[j]))
             # old DEAD counts as "not a member": REMOVED already fired when
             # the record went DEAD; a later DEAD->ALIVE flip (a zombie/rejoin
             # refutation beating the tombstone) is a fresh ADDED.
-            if old_s in (UNKNOWN, DEAD) and new_s in (ALIVE, SUSPECT, LEAVING):
+            elif old_s in (UNKNOWN, DEAD) and new_s in (ALIVE, SUSPECT, LEAVING):
                 w.known[j] = self._member_handle(j)
-                ev = MembershipEvent.added(w.known[j])
+                evs.append(MembershipEvent.added(w.known[j]))
             elif new_s == LEAVING and old_s != LEAVING:
-                ev = MembershipEvent.leaving(w.known.get(j, self._member_handle(j)))
+                evs.append(
+                    MembershipEvent.leaving(w.known.get(j, self._member_handle(j)))
+                )
             elif new_s == DEAD and old_s != DEAD:
                 # reference removes member+record on death and publishes
                 # REMOVED (onDeadMemberDetected:740-767); the later
                 # DEAD->UNKNOWN table cleanup is internal, not an event
-                ev = MembershipEvent.removed(w.known.pop(j, self._member_handle(j)))
+                evs.append(
+                    MembershipEvent.removed(w.known.pop(j, self._member_handle(j)))
+                )
             elif (
                 new_s == ALIVE
                 and old_s in (ALIVE, SUSPECT)
-                and (new_k >> 2) > (old_k >> 2)
+                and ((new_k >> 2) & INC_MASK) > ((old_k >> 2) & INC_MASK)
             ):
                 # incarnation bump while alive = metadata/refutation update
-                ev = MembershipEvent.updated(
-                    w.known.get(j, self._member_handle(j)), None, None
+                evs.append(
+                    MembershipEvent.updated(
+                        w.known.get(j, self._member_handle(j)), None, None
+                    )
                 )
-            if ev is not None:
+            for ev in evs:
                 w.log.append(ev)
                 w.stream.emit(ev)
 
@@ -295,7 +327,7 @@ class SimDriver:
         """(status, incarnation) of node ``row``'s table — one device gather."""
         key = np.asarray(self.state.view_key[row])
         status = np.where(key < 0, np.int8(UNKNOWN), _RANK_TO_STATUS_NP[key & 3])
-        inc = np.where(key < 0, 0, key >> 2).astype(np.int32)
+        inc = np.where(key < 0, 0, (key >> 2) & INC_MASK).astype(np.int32)
         return status, inc
 
     def status_of(self, observer: int, subject: int) -> MemberStatus | None:

@@ -172,9 +172,9 @@ def test_checkpoint_restore_resumes_identically(tmp_path):
 def test_row_reuse_does_not_relabel_old_records():
     """An observer that still holds records about a row's previous occupant
     must emit events for the OLD identity even after the row is reused.
-    Capacity is full, so the crashed row MUST be reused; the newcomer's
-    ALIVE@0 is rejected by the tombstone until its seed-SYNC-triggered
-    refutation pushes the incarnation past it."""
+    Capacity is full, so the crashed row MUST be reused; the newcomer joins
+    at identity epoch+1, whose records dominate the old occupant's tombstone
+    (lattice epoch bits = the restart-is-a-new-member rule)."""
     d = make_driver(n=16)  # full capacity: no never-used rows
     events = d.events_of(1)  # observer watches from the start
     old_id = d.members[5].id
@@ -188,6 +188,49 @@ def test_row_reuse_does_not_relabel_old_records():
     added = [e.member.id for e in events if e.type == MembershipEventType.ADDED]
     assert removed == [old_id]
     assert new_id in added and new_id != old_id
+
+
+def test_restart_detected_as_removed_plus_added_without_suspicion():
+    """Crash + instant rejoin on the same row: peers never get the chance to
+    suspect the old identity to death, yet they must still see
+    REMOVED(old) + ADDED(new) — the reference's DEST_GONE path (a probe/ack
+    from the restarted process reveals a different member id,
+    FailureDetectorImpl.computeMemberStatus:382-404). In the sim the
+    restarted row's higher identity epoch rides every ACK/gossip/SYNC and
+    overrides the stale record in one step."""
+    d = make_driver(n=16)  # full capacity: the crashed row must be reused
+    events = d.events_of(1)
+    old_id = d.members[5].id
+    d.crash(5)
+    row = d.join(seed_rows=[0])  # immediate restart, no suspicion wait
+    assert row == 5
+    new_id = d.members[5].id
+    d.step(30)
+    removed = [e.member.id for e in events if e.type == MembershipEventType.REMOVED]
+    added = [e.member.id for e in events if e.type == MembershipEventType.ADDED]
+    assert removed == [old_id]
+    assert added == [new_id]
+    assert d.status_of(1, 5) == MemberStatus.ALIVE
+
+
+def test_seed_placeholder_carries_seed_epoch_no_phantom_restart():
+    """A joiner seeded with a row that has itself restarted (epoch > 0) must
+    record the seed placeholder at the seed's CURRENT epoch — an epoch-0
+    placeholder would later flip to the real epoch-1 record and read as a
+    phantom REMOVED+ADDED of a live member that never restarted."""
+    d = make_driver(n=16)
+    d.crash(5)
+    assert d.join(seed_rows=[0]) == 5  # row 5 restarts at epoch 1
+    seed_id = d.members[5].id
+    d.step(30)
+    d.crash(7)
+    row = d.join(seed_rows=[5])  # fresh joiner bootstraps off the epoch-1 seed
+    assert row == 7
+    events = d.events_of(7)
+    d.step(30)
+    removed = [e.member.id for e in events if e.type == MembershipEventType.REMOVED]
+    assert seed_id not in removed  # the seed never restarted from 7's viewpoint
+    assert d.status_of(7, 5) == MemberStatus.ALIVE
 
 
 def test_restore_into_fresh_driver_preserves_identities(tmp_path):

@@ -169,6 +169,30 @@ def test_partition_detect_heal_rejoin(step):
     assert (vs[np.ix_(half_b, half_a)] == ALIVE).all()
 
 
+def test_restart_same_row_new_epoch_overrides_stale_records(step):
+    """Kernel-level DEST_GONE: a crashed row reused by a fresh identity
+    (epoch+1) is re-learned by every peer as the NEW identity without
+    waiting for the old record's suspicion timeout — probe ACKs and the
+    joiner's own ALIVE gossip carry the higher-epoch key, which dominates
+    all stale records (reference: restart answered with AckType.DEST_GONE,
+    FailureDetectorImpl.java:382-404; rejoin = fresh member id)."""
+    from scalecube_cluster_tpu.ops.lattice import key_epoch
+
+    st = S.init_state(PARAMS, 12, warm=True)
+    key = jax.random.PRNGKey(11)
+    st = S.crash_row(st, 5)
+    st = S.join_row(st, 5, seed_rows=[0])  # instant restart on the same row
+    assert int(st.epoch[5]) == 1
+    st, key, _ = run(step, st, key, 20)
+    up = np.asarray(st.up)
+    vs = np.asarray(st.view_status)
+    ep = np.asarray(key_epoch(st.view_key))
+    assert up[5]
+    # every up peer replaced the stale epoch-0 record with the new identity
+    assert (ep[up, 5] == 1).all()
+    assert (vs[up, 5] == ALIVE).all()
+
+
 def test_zombie_refutes_dead_self_record(step):
     """A running node that merges a DEAD record about itself (lingering
     cross-partition death rumor arriving after a heal) must refute and
