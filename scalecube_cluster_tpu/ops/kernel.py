@@ -58,10 +58,14 @@ from .lattice import (
     RANK_SUSPECT,
 )
 from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
     FdRandoms,
     RoundRandoms,
     draw_fd_randoms,
     draw_round_randoms,
+    fetch_uniform,
     split_tick_key,
 )
 from .state import SimParams, SimState
@@ -188,6 +192,40 @@ def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -
     Bernoulli on outbound loss — NetworkEmulator.java:349-369)."""
     p = 1.0 - _loss_at(state, src, dst)
     return state.up[src] & state.up[dst] & (draw < p)
+
+
+def _fetch_gate(
+    state: SimState,
+    salt: int,
+    i: jax.Array,
+    j: jax.Array,
+    cand_key: jax.Array,
+    p_fetch: jax.Array,
+) -> jax.Array:
+    """Metadata-fetch gate for merge winners: an ALIVE-rank candidate about
+    subject ``j`` is applied at receiver ``i`` only if the fetch round trip
+    i→j→i succeeds (subject up + one Bernoulli on both link directions) —
+    the reference accepts ALIVE only after GET_METADATA_REQ/RESP completes
+    (``MembershipProtocolImpl.java:636-658``); on failure the record is
+    simply not applied and a later redelivery retries, like the reference's
+    dropped update. SUSPECT/LEAVING/DEAD candidates pass untouched (the
+    reference fetches only for ALIVE), as does the FD phase's direct ALIVE
+    verdict — there the ACK just arrived from the subject itself over the
+    very link a fetch would use.
+
+    ``p_fetch`` comes from the precomputed ``state.fetch_rt`` (whole matrix
+    at the gossip site, row-gathers at the SYNC sites). Never spell it as
+    ``loss[i, j] · loss[j, i]`` with broadcast index arrays in-tick: the two
+    [N, N] gathers measured a ~60x tick slowdown on TPU, and even the
+    view-based ``(1-loss)·(1-loss.T)`` costs ~2.5x from the materialized
+    per-tick transpose — which is why the matrix is derived state.
+
+    Broadcasting: ``i``/``j`` index arrays shaped like ``cand_key``.
+    """
+    needs = (cand_key & 3) == RANK_ALIVE  # UNKNOWN (-1) reads rank 3: exempt
+    u = fetch_uniform(state.tick, salt, i, j)
+    ok = state.up[j] & (u < p_fetch)
+    return ~needs | ok
 
 
 # ---------------------------------------------------------------------------
@@ -327,6 +365,9 @@ def _gossip_phase(
             (buf > own)
             & ((own >= 0) | ((buf & 3) <= RANK_LEAVING))
             & state.up[:, None]
+            & _fetch_gate(
+                state, SALT_GOSSIP, rows[:, None], rows[None, :], buf, state.fetch_rt
+            )
         )
         st = state.replace(
             view_key=jnp.where(accept, buf, own),
@@ -405,6 +446,14 @@ def _sync_phase(
         (buf_p > own_p)
         & ((own_p >= 0) | ((buf_p & 3) <= RANK_LEAVING))
         & state.up[peer][:, None]
+        & _fetch_gate(
+            state,
+            SALT_SYNC_REQ,
+            peer[:, None],
+            rows[None, :],
+            buf_p,
+            state.fetch_rt if state.fetch_rt.ndim == 0 else state.fetch_rt[peer],
+        )
     )
     st = state.replace(
         view_key=state.view_key.at[peer].max(jnp.where(acc, buf_p, own_p)),
@@ -423,6 +472,14 @@ def _sync_phase(
         (ack_cand > own_rows)
         & ((own_rows >= 0) | ((ack_cand & 3) <= RANK_LEAVING))
         & state.up[caller][:, None]
+        & _fetch_gate(
+            st,
+            SALT_SYNC_ACK,
+            caller[:, None],
+            rows[None, :],
+            ack_cand,
+            st.fetch_rt if st.fetch_rt.ndim == 0 else st.fetch_rt[caller],
+        )
     )
     st = st.replace(
         view_key=st.view_key.at[caller].max(jnp.where(accept, ack_cand, own_rows)),

@@ -27,7 +27,13 @@ from .lattice import (
     SUSPECT,
     UNKNOWN,
 )
-from .rand import draw_tick_randoms
+from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
+    draw_tick_randoms,
+    fetch_uniform,
+)
 from .state import SimParams, SimState
 
 _RANK = {ALIVE: 0, LEAVING: 1, SUSPECT: 2, DEAD: 3}
@@ -82,6 +88,7 @@ class _O:
         self.infected = np.asarray(state.infected).copy()
         self.infected_at = np.asarray(state.infected_at).copy()
         self.loss = np.asarray(state.loss).copy()
+        self.fetch_rt = np.asarray(state.fetch_rt).copy()
 
     def snap(self):
         import copy
@@ -103,14 +110,24 @@ def _cluster_size(o: _O, i: int) -> int:
     return int(((o.key[i] & 3) != RANK_DEAD).sum())
 
 
-def _accept_into(o: _O, i: int, j: int, cand_key: int) -> bool:
-    """The overrides gate + write, identical to kernel._merge for one cell."""
+def _accept_into(o: _O, i: int, j: int, cand_key: int, salt: int) -> bool:
+    """The overrides gate + metadata-fetch gate + write, identical to the
+    kernel's merge accept (incl. ``kernel._fetch_gate``) for one cell."""
     own = int(o.key[i, j])
     if cand_key <= own:
         return False
     known = own >= 0
     if not known and (cand_key & 3) > RANK_LEAVING:
         return False
+    if (cand_key & 3) == RANK_ALIVE:  # ALIVE needs the fetch round trip
+        u = np.float32(fetch_uniform(o.tick, salt, i, j, xp=np))
+        p = (
+            np.float32(o.fetch_rt)
+            if o.fetch_rt.ndim == 0
+            else o.fetch_rt[i, j]
+        )
+        if not (bool(o.up[j]) and u < p):
+            return False
     o.key[i, j] = cand_key
     o.changed[i, j] = o.tick
     return True
@@ -205,7 +222,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             continue
         for j in range(n):
             if recv_key[i, j] > np.iinfo(np.int64).min:
-                _accept_into(o, i, j, int(recv_key[i, j]))
+                _accept_into(o, i, j, int(recv_key[i, j]), SALT_GOSSIP)
         for ru in range(params.rumor_slots):
             if recv_inf[i, ru] and pre.r_active[ru] and not o.infected[i, ru]:
                 o.infected[i, ru] = True
@@ -251,13 +268,13 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 cand = int(pre.key[i, j])
                 recv_key[(p, j)] = max(recv_key.get((p, j), cand), cand)
     for (p, j), cand in recv_key.items():
-        _accept_into(o, p, j, cand)
+        _accept_into(o, p, j, cand, SALT_SYNC_REQ)
     # ack: peers' post-request tables back to callers (one snapshot for all)
     mid = o.snap()
     for i, p in callers:
         for j in range(n):
             if mid.key[p, j] >= 0:
-                _accept_into(o, i, j, int(mid.key[p, j]))
+                _accept_into(o, i, j, int(mid.key[p, j]), SALT_SYNC_ACK)
 
     # ---- refutation (SUSPECT/DEAD self-record, or overwritten leave intent;
     # a leaver re-announces LEAVING — see kernel._refute_phase) ----

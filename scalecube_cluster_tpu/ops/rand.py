@@ -58,6 +58,56 @@ class TickRandoms(NamedTuple):
     sync_edge: jax.Array
 
 
+# Phase salts for the stateless fetch hash (must differ per merge site so a
+# cell's draw is independent across the phases of one tick). The salt enters
+# the mixer additively before the row index, so fetch(s1, i, j) ==
+# fetch(s2, i + (s1 - s2), j): salts must differ by far more than any valid
+# row index or one phase's draws are a row-shifted copy of another's. These
+# are spread ~2^30 apart (golden-ratio multiples), so no i < 2^30 collides.
+SALT_GOSSIP = 0x9E3779B9
+SALT_SYNC_REQ = 0x3C6EF372
+SALT_SYNC_ACK = 0xDAA66D2B
+
+
+def fetch_uniform(tick, salt: int, i, j, xp=jnp):
+    """Uniform [0,1) draw for the metadata-fetch round trip of receiver ``i``
+    about subject ``j`` at ``tick`` (reference: ALIVE records are applied
+    only after a successful GET_METADATA_REQ/RESP exchange,
+    ``MembershipProtocolImpl.java:636-658``; SURVEY.md §2.2 MetadataStore row
+    prescribes "fetch success = link-matrix draw" for sim mode).
+
+    Stateless counter-based hash (Jenkins-style add/shift/xor rounds over
+    (tick, salt, i, j)) instead of a keyed [N, N] threefry draw: the
+    selection-sampler rework removed the tick's O(N²) RNG cost and this
+    keeps it that way. The wide-broadcast rounds use ONLY adds, shifts, and
+    xors — TPU has no native 32-bit vector multiply, and a multiplicative
+    mixer measured ~3x slower per tick; the one scalar multiply (tick
+    seeding) stays off the [N, N] path. Identical uint32 arithmetic under
+    ``xp=jnp`` (kernel) and ``xp=np`` (scalar oracle) keeps the lockstep
+    equivalence bit-exact.
+    """
+    import contextlib
+
+    import numpy as _np
+
+    u32 = xp.uint32
+    # uint32 wraparound is the point of the mixer; numpy warns on scalar
+    # overflow (jax doesn't), so silence it for the oracle path only.
+    guard = _np.errstate(over="ignore") if xp is _np else contextlib.nullcontext()
+    with guard:
+        h0 = xp.asarray(tick).astype(u32) * u32(0x9E3779B1) + u32(salt)
+        a = xp.asarray(i).astype(u32) + h0
+        a = a + (a << u32(10))
+        a = a ^ (a >> u32(6))
+        b = a + xp.asarray(j).astype(u32)
+        b = b + (b << u32(10))
+        b = b ^ (b >> u32(6))
+        b = b + (b << u32(3))
+        b = b ^ (b >> u32(11))
+        b = b + (b << u32(15))
+    return (b >> u32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
+
+
 def split_tick_key(key: jax.Array) -> tuple[jax.Array, jax.Array]:
     """(fd_key, round_key). FD draws live under their own subkey so the
     kernel can skip generating them entirely on non-FD ticks (lax.cond)

@@ -54,6 +54,7 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
         infected=row2d,
         infected_at=row2d,
         loss=row2d if dense_links else rep,
+        fetch_rt=row2d if dense_links else rep,
     )
 
 

@@ -25,11 +25,12 @@ from flax import struct
 
 from ..config import ClusterConfig
 from .lattice import (
-    EPOCH_SHIFT,
+    ALIVE,
     RANK_LEAVING,
     UNKNOWN_KEY,
     key_inc,
     key_status,
+    precedence_key,
 )
 
 NEVER = jnp.int32(-(1 << 30))  # "changed long ago" sentinel for changed_at
@@ -140,6 +141,13 @@ class SimState(struct.PyTreeNode):
     ``loss[i, j]`` — directed link drop probability (the NetworkEmulator's
     outbound loss, ``NetworkEmulator.java:349-369``, as a dense matrix;
     block = loss 1.0).
+
+    ``fetch_rt[i, j]`` — DERIVED: round-trip success probability
+    ``(1-loss[i,j])·(1-loss[j,i])``, the metadata-fetch / request-response
+    gate probability. Maintained by the host mutators whenever ``loss``
+    changes (losses change only between ticks) because computing it in-tick
+    needs ``loss.T`` — a materialized [N, N] transpose per tick that
+    measured a ~2.5x tick slowdown on TPU. Scalar in the lean-loss mode.
     """
 
     tick: jax.Array  # i32 scalar
@@ -155,6 +163,7 @@ class SimState(struct.PyTreeNode):
     infected: jax.Array  # bool [N, R]
     infected_at: jax.Array  # i32 [N, R]
     loss: jax.Array  # f32 [N, N]
+    fetch_rt: jax.Array  # f32 [N, N] — derived round-trip probability (see above)
 
     @property
     def capacity(self) -> int:
@@ -200,6 +209,11 @@ def init_state(
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
         view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY)
+    loss = (
+        jnp.full((n, n), uniform_loss, jnp.float32)
+        if dense_links
+        else jnp.float32(uniform_loss)
+    )
     return SimState(
         tick=jnp.int32(0),
         up=up,
@@ -213,12 +227,16 @@ def init_state(
         rumor_created=jnp.zeros((r,), jnp.int32),
         infected=jnp.zeros((n, r), bool),
         infected_at=jnp.zeros((n, r), jnp.int32),
-        loss=(
-            jnp.full((n, n), uniform_loss, jnp.float32)
-            if dense_links
-            else jnp.float32(uniform_loss)
-        ),
+        loss=loss,
+        fetch_rt=_roundtrip(loss),
     )
+
+
+def _roundtrip(loss: jax.Array) -> jax.Array:
+    """(1-loss)·(1-loss.T) — the derived fetch/request round-trip matrix."""
+    if loss.ndim == 0:
+        return ((1.0 - loss) * (1.0 - loss)).astype(jnp.float32)
+    return ((1.0 - loss) * (1.0 - loss.T)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -247,12 +265,16 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
     seed_rows = jnp.asarray(seed_rows, jnp.int32)
     was_used = state.view_key[row, row] >= 0  # row had a previous occupant
     new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
-    self_key = (new_epoch << EPOCH_SHIFT).astype(jnp.int32)  # ALIVE@0 @ epoch
+    self_key = precedence_key(jnp.int32(ALIVE), jnp.int32(0), new_epoch)
     # Seed placeholders carry the seeds' CURRENT epochs — an epoch-0
     # placeholder for a seed that has itself restarted would read as a
     # phantom old identity (and emit a bogus REMOVED+ADDED pair at any
     # watcher the placeholder reaches via the bootstrap SYNC).
-    seed_keys = (state.epoch[seed_rows] << EPOCH_SHIFT).astype(jnp.int32)
+    seed_keys = precedence_key(
+        jnp.full(seed_rows.shape, ALIVE, jnp.int32),
+        jnp.int32(0),
+        state.epoch[seed_rows],
+    )
     row_key = (
         jnp.full((state.capacity,), UNKNOWN_KEY)
         .at[seed_rows]
@@ -324,7 +346,16 @@ def set_link_loss(state: SimState, src, dst, loss: float) -> SimState:
         )
     src = jnp.atleast_1d(jnp.asarray(src))
     dst = jnp.atleast_1d(jnp.asarray(dst))
-    return state.replace(loss=state.loss.at[src[:, None], dst[None, :]].set(loss))
+    new_loss = state.loss.at[src[:, None], dst[None, :]].set(loss)
+    # fetch_rt partial update: only the [src, dst] and mirrored [dst, src]
+    # blocks change — O(|src|·|dst|), not a full N² recompute + transpose
+    # per host mutation. g[d, s] = loss[d, s] (the reverse legs, unchanged
+    # by this call unless inside the block, hence read from new_loss).
+    g = new_loss[dst[:, None], src[None, :]]
+    fwd = (1.0 - jnp.float32(loss)) * (1.0 - g)  # [D, S] value at (s, d) = fwd.T
+    new_rt = state.fetch_rt.at[src[:, None], dst[None, :]].set(fwd.T)
+    new_rt = new_rt.at[dst[:, None], src[None, :]].set(fwd)
+    return state.replace(loss=new_loss, fetch_rt=new_rt)
 
 
 def block_partition(state: SimState, group_a, group_b) -> SimState:

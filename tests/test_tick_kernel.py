@@ -214,6 +214,29 @@ def test_zombie_refutes_dead_self_record(step):
     assert (vs[up, 6] == ALIVE).all()  # everyone sees it alive again
 
 
+def test_metadata_fetch_gate_blocks_alive_until_link_heals(step):
+    """ALIVE acceptance is gated on the metadata fetch round trip to the
+    subject (MembershipProtocolImpl.java:636-658; SURVEY.md §2.2 "fetch
+    success = link-matrix draw"): an observer whose outbound link to a new
+    joiner is fully lossy keeps hearing the joiner's ALIVE record via gossip
+    from third parties but can never complete the fetch — the member must
+    stay unknown until the link heals."""
+    st = S.init_state(PARAMS, 12, warm=True)
+    key = jax.random.PRNGKey(13)
+    st = S.join_row(st, 12, seed_rows=[0])
+    st = S.set_link_loss(st, 2, 12, 1.0)  # observer 2 cannot reach the joiner
+    st, key, _ = run(step, st, key, 30)
+    vs = np.asarray(st.view_status)
+    up = np.asarray(st.up)
+    others = up.copy()
+    others[[2, 12]] = False
+    assert (vs[others, 12] == ALIVE).all()  # everyone else accepted the joiner
+    assert vs[2, 12] == UNKNOWN  # fetch never completes at observer 2
+    st = S.set_link_loss(st, 2, 12, 0.0)
+    st, key, _ = run(step, st, key, 30)
+    assert np.asarray(st.view_status)[2, 12] == ALIVE
+
+
 def test_metadata_update_propagates_as_incarnation(step):
     st = S.init_state(PARAMS, 12, warm=True)
     key = jax.random.PRNGKey(7)
