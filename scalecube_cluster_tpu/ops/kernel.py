@@ -187,6 +187,16 @@ def _loss_at(state: SimState, i, j) -> jnp.ndarray:
     return state.loss[i, j]
 
 
+def _rt_at(state: SimState, i, j) -> jnp.ndarray:
+    """Round-trip success probability i→j→i — one gather into the derived
+    ``fetch_rt`` matrix (the single source of the ``(1-loss)·(1-loss.T)``
+    formula, maintained by the host mutators). Used by every
+    request-response leg: ping, ping ACK, SYNC, metadata fetch."""
+    if state.fetch_rt.ndim == 0:
+        return jnp.broadcast_to(state.fetch_rt, jnp.shape(i))
+    return state.fetch_rt[i, j]
+
+
 def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -> jax.Array:
     """Delivery draw for a directed message src->dst (sender+receiver up,
     Bernoulli on outbound loss — NetworkEmulator.java:349-369)."""
@@ -242,21 +252,18 @@ def _fd_phase(
     tgt = sel_idx[:, 0]
     has_tgt = sel_valid[:, 0] & state.up
 
-    # Direct ping: PING out + ACK back must both survive (request-response).
-    p_direct = (1.0 - _loss_at(state, rows, tgt)) * (1.0 - _loss_at(state, tgt, rows))
+    # Direct ping: PING out + ACK back must both survive (request-response
+    # round trip = one fetch_rt lookup).
+    p_direct = _rt_at(state, rows, tgt)
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     # Indirect probe via k relays: PING_REQ -> transit PING -> transit ACK ->
-    # forwarded ACK (four hops, FailureDetectorImpl.java:173-315).
+    # forwarded ACK (four hops, FailureDetectorImpl.java:173-315) = the
+    # issuer↔relay round trip times the relay↔target round trip.
     relays = sel_idx[:, 1:]  # [N, k]
     relay_valid = sel_valid[:, 1:]
     tgt_b = tgt[:, None]
-    p_relay = (
-        (1.0 - _loss_at(state, rows[:, None], relays))
-        * (1.0 - _loss_at(state, relays, tgt_b))
-        * (1.0 - _loss_at(state, tgt_b, relays))
-        * (1.0 - _loss_at(state, relays, rows[:, None]))
-    )
+    p_relay = _rt_at(state, rows[:, None], relays) * _rt_at(state, relays, tgt_b)
     relay_ok = (
         relay_valid
         & state.up[relays]
@@ -348,17 +355,38 @@ def _gossip_phase(
         # recv buffer + merge pass.
         buf = state.view_key
         recv_inf = jnp.zeros_like(state.infected)
+        recv_src = jnp.full_like(state.infected_from, -1)
+        young_any = young.any(axis=1)  # [N] — membership payload exists
         sent = jnp.int32(0)
+        rumor_sent = jnp.int32(0)
         for s in range(params.fanout):
             p = peers[:, s]
+            # Known-infected filter (selectGossipsToSend:311-320 via
+            # GossipState's infected set): don't hand r back to the peer
+            # that delivered it to us, nor to its origin — the two members
+            # this sender KNOWS are infected. This is what keeps rumor
+            # message counts inside the ClusterMath per-node bound's
+            # constant instead of fanout-times it.
+            payload_r = (
+                rumor_young
+                & (state.infected_from != p[:, None])
+                & (state.rumor_origin[None, :] != p[:, None])
+            )
+            # A GOSSIP_REQ goes out only if THIS peer's payload is nonempty
+            # after filtering (the reference sends nothing when
+            # selectGossipsToSend comes back empty for that member).
+            has_payload = young_any | payload_r.any(axis=1)
             ok = (
                 peer_valid[:, s]
-                & sender_has
+                & has_payload
                 & _edge_ok(state, rows, p, r.gossip_edge[:, s])
             )
             sent = sent + ok.sum()
             buf = buf.at[p].max(jnp.where(ok[:, None], piggyback, NO_CANDIDATE))
-            recv_inf = recv_inf.at[p].max(rumor_young & ok[:, None])
+            send_r = payload_r & ok[:, None]
+            rumor_sent = rumor_sent + send_r.sum()
+            recv_inf = recv_inf.at[p].max(send_r)
+            recv_src = recv_src.at[p].max(jnp.where(send_r, rows[:, None], -1))
 
         own = state.view_key
         accept = (
@@ -378,12 +406,21 @@ def _gossip_phase(
         st = st.replace(
             infected=st.infected | newly_inf,
             infected_at=jnp.where(newly_inf, st.tick, st.infected_at),
+            # remember one delivering peer (max row id among this tick's
+            # senders — deterministic, oracle-mirrorable) as the compact
+            # known-infected set for the forwarding filter above
+            infected_from=jnp.where(newly_inf, recv_src, st.infected_from),
         )
-        return st, {"gossip_msgs": sent, "rumor_deliveries": newly_inf.sum()}
+        return st, {
+            "gossip_msgs": sent,
+            "rumor_sends": rumor_sent,
+            "rumor_deliveries": newly_inf.sum(),
+        }
 
     def _quiet(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
         return state, {
             "gossip_msgs": jnp.int32(0),
+            "rumor_sends": jnp.int32(0),
             "rumor_deliveries": jnp.int32(0),
         }
 
@@ -425,7 +462,7 @@ def _sync_phase(
     peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[caller][:, None])
     peer = peer_idx[:, 0]  # [K]
     # Round trip: SYNC out and SYNC_ACK back must both survive.
-    p_rt = (1.0 - _loss_at(state, caller, peer)) * (1.0 - _loss_at(state, peer, caller))
+    p_rt = _rt_at(state, caller, peer)
     ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
     # SYNC request: callers' full tables scattered into peers (several

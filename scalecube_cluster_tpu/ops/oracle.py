@@ -87,6 +87,7 @@ class _O:
         self.r_created = np.asarray(state.rumor_created).copy()
         self.infected = np.asarray(state.infected).copy()
         self.infected_at = np.asarray(state.infected_at).copy()
+        self.infected_from = np.asarray(state.infected_from).copy()
         self.loss = np.asarray(state.loss).copy()
         self.fetch_rt = np.asarray(state.fetch_rt).copy()
 
@@ -98,6 +99,11 @@ class _O:
 
 def _loss(o: "_O", i: int, j: int) -> np.float32:
     return np.float32(o.loss) if o.loss.ndim == 0 else o.loss[i, j]
+
+
+def _rt(o: "_O", i: int, j: int) -> np.float32:
+    """Round-trip probability i→j→i (mirror of kernel._rt_at)."""
+    return np.float32(o.fetch_rt) if o.fetch_rt.ndim == 0 else o.fetch_rt[i, j]
 
 
 def _live_mask(o: _O, i: int) -> np.ndarray:
@@ -153,9 +159,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             if not valid[0]:
                 continue
             tgt = int(sel[0])
-            p_direct = (np.float32(1.0) - _loss(pre, i, tgt)) * (
-                np.float32(1.0) - _loss(pre, tgt, i)
-            )
+            p_direct = _rt(pre, i, tgt)
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
             for s in range(k):
                 if ack:
@@ -163,12 +167,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 if not valid[1 + s]:
                     continue
                 rl = int(sel[1 + s])
-                p4 = (
-                    (np.float32(1.0) - _loss(pre, i, rl))
-                    * (np.float32(1.0) - _loss(pre, rl, tgt))
-                    * (np.float32(1.0) - _loss(pre, tgt, rl))
-                    * (np.float32(1.0) - _loss(pre, rl, i))
-                )
+                p4 = _rt(pre, i, rl) * _rt(pre, rl, tgt)
                 if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
                     ack = True
             own = int(pre.key[i, tgt])  # targets come from the live view: >= 0
@@ -194,6 +193,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
     pre = o.snap()
     recv_key = np.full((n, n), np.iinfo(np.int64).min, dtype=np.int64)
     recv_inf = np.zeros_like(pre.infected)
+    recv_src = np.full_like(pre.infected_from, -1)
     for i in range(n):
         if not pre.up[i]:
             continue
@@ -215,8 +215,13 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                     pre.infected[i, ru]
                     and pre.r_active[ru]
                     and t - pre.infected_at[i, ru] < spread
+                    # known-infected filter: skip the peer that delivered
+                    # this rumor to us, and its origin (kernel._deliver)
+                    and pre.infected_from[i, ru] != p
+                    and pre.r_origin[ru] != p
                 ):
                     recv_inf[p, ru] = True
+                    recv_src[p, ru] = max(recv_src[p, ru], i)
     for i in range(n):
         if not pre.up[i]:
             continue
@@ -227,6 +232,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             if recv_inf[i, ru] and pre.r_active[ru] and not o.infected[i, ru]:
                 o.infected[i, ru] = True
                 o.infected_at[i, ru] = t
+                o.infected_from[i, ru] = recv_src[i, ru]
 
     # ---- SYNC phase ----
     pre = o.snap()
@@ -255,7 +261,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         if not valid[0]:
             continue
         p = int(peers[0])
-        p_rt = (np.float32(1.0) - _loss(pre, i, p)) * (np.float32(1.0) - _loss(pre, p, i))
+        p_rt = _rt(pre, i, p)
         if pre.up[p] and r["sync_edge"][i] < p_rt:
             # bootstrap force_sync clears only on a successful round-trip
             o.force_sync[i] = False
@@ -311,6 +317,7 @@ def assert_equivalent(state: SimState, o: _O) -> None:
         "rumor_active": (np.asarray(state.rumor_active), o.r_active),
         "infected": (np.asarray(state.infected), o.infected),
         "infected_at": (np.asarray(state.infected_at), o.infected_at),
+        "infected_from": (np.asarray(state.infected_from), o.infected_from),
     }
     for name, (a, b) in pairs.items():
         if not np.array_equal(np.asarray(a), np.asarray(b)):

@@ -27,10 +27,12 @@ distinct peers per row — the dominant O(N²·log N) term of the tick.
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 
 class FdRandoms(NamedTuple):
@@ -61,12 +63,13 @@ class TickRandoms(NamedTuple):
 # Phase salts for the stateless fetch hash (must differ per merge site so a
 # cell's draw is independent across the phases of one tick). The salt enters
 # the mixer additively before the row index, so fetch(s1, i, j) ==
-# fetch(s2, i + (s1 - s2), j): salts must differ by far more than any valid
-# row index or one phase's draws are a row-shifted copy of another's. These
-# are spread ~2^30 apart (golden-ratio multiples), so no i < 2^30 collides.
-SALT_GOSSIP = 0x9E3779B9
-SALT_SYNC_REQ = 0x3C6EF372
-SALT_SYNC_ACK = 0xDAA66D2B
+# fetch(s2, i + (s1 - s2) mod 2^32, j): salts must differ (in either
+# direction mod 2^32) by at least the max row count or one phase's draws are
+# a row-shifted copy of another's. These sit exactly 2^30 / 2^31 apart, so
+# no pair of rows below 2^30 can collide across phases.
+SALT_GOSSIP = 0x40000000
+SALT_SYNC_REQ = 0x80000000
+SALT_SYNC_ACK = 0xC0000000
 
 
 def fetch_uniform(tick, salt: int, i, j, xp=jnp):
@@ -86,10 +89,6 @@ def fetch_uniform(tick, salt: int, i, j, xp=jnp):
     ``xp=jnp`` (kernel) and ``xp=np`` (scalar oracle) keeps the lockstep
     equivalence bit-exact.
     """
-    import contextlib
-
-    import numpy as _np
-
     u32 = xp.uint32
     # uint32 wraparound is the point of the mixer; numpy warns on scalar
     # overflow (jax doesn't), so silence it for the oracle path only.

@@ -53,6 +53,7 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
         rumor_created=rep,
         infected=row2d,
         infected_at=row2d,
+        infected_from=row2d,
         loss=row2d if dense_links else rep,
         fetch_rt=row2d if dense_links else rep,
     )

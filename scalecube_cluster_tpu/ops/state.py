@@ -136,7 +136,13 @@ class SimState(struct.PyTreeNode):
     Rumor pool: R slots of user gossip (``spreadGossip``), infection bitmap
     ``infected[i, r]`` + ``infected_at`` for the forwarding-age rule; dedup
     (the reference's ``SequenceIdCollector``) is the OR-semantics of the
-    bitmap itself.
+    bitmap itself. ``infected_from[i, r]`` is the peer that delivered r to i
+    (-1 at the origin / before infection): the compact analogue of the
+    reference's per-gossip known-infected set (``GossipState.java:18``,
+    receiver adds the sender, ``onGossipReq:201-215``) — a sender skips
+    forwarding r to its own infection source and to r's origin, which is
+    what keeps the per-node message count inside the ``ClusterMath`` bound's
+    constant (``ClusterMath.java:54-67``).
 
     ``loss[i, j]`` — directed link drop probability (the NetworkEmulator's
     outbound loss, ``NetworkEmulator.java:349-369``, as a dense matrix;
@@ -162,6 +168,7 @@ class SimState(struct.PyTreeNode):
     rumor_created: jax.Array  # i32 [R]
     infected: jax.Array  # bool [N, R]
     infected_at: jax.Array  # i32 [N, R]
+    infected_from: jax.Array  # i32 [N, R] — delivering peer, -1 origin/none
     loss: jax.Array  # f32 [N, N]
     fetch_rt: jax.Array  # f32 [N, N] — derived round-trip probability (see above)
 
@@ -227,6 +234,7 @@ def init_state(
         rumor_created=jnp.zeros((r,), jnp.int32),
         infected=jnp.zeros((n, r), bool),
         infected_at=jnp.zeros((n, r), jnp.int32),
+        infected_from=jnp.full((n, r), -1, jnp.int32),
         loss=loss,
         fetch_rt=_roundtrip(loss),
     )
@@ -290,6 +298,7 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
         force_sync=state.force_sync.at[row].set(True),
         leaving=state.leaving.at[row].set(False),
         infected=state.infected.at[row].set(False),
+        infected_from=state.infected_from.at[row].set(-1),
     )
 
 
@@ -334,6 +343,7 @@ def spread_rumor(state: SimState, slot: int, origin: int) -> SimState:
         rumor_created=state.rumor_created.at[slot].set(state.tick),
         infected=state.infected.at[:, slot].set(False).at[origin, slot].set(True),
         infected_at=state.infected_at.at[origin, slot].set(state.tick),
+        infected_from=state.infected_from.at[:, slot].set(-1),
     )
 
 

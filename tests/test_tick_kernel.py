@@ -248,6 +248,38 @@ def test_metadata_update_propagates_as_incarnation(step):
     assert (vi[up, 2] == 1).all()  # every peer observed the UPDATED bump
 
 
+def test_rumor_message_cost_within_cluster_math_bound():
+    """One rumor at N=256 must cost at most ClusterMath's cluster-wide
+    message bound (``maxMessagesPerGossipTotal``, ClusterMath.java:47-67):
+    the forwarding-age window bounds per-node sends at fanout·mult·log2 and
+    the known-infected filter (GossipState's infected set) cuts the wasted
+    constant. Full coverage must still be reached (GossipProtocolTest's own
+    assertion pair: everyone got it, message economics hold)."""
+    from scalecube_cluster_tpu.utils.cluster_math import (
+        gossip_periods_to_sweep,
+        max_messages_per_gossip_total,
+    )
+
+    n = 256
+    params = S.SimParams(
+        capacity=n, fanout=3, repeat_mult=3, fd_every=5, sync_every=200,
+        rumor_slots=2, seed_rows=(0,),
+    )
+    st = S.init_state(params, n, warm=True)
+    st = S.spread_rumor(st, 0, 0)
+    step = jax.jit(partial(K.tick, params=params))
+    key = jax.random.PRNGKey(3)
+    total_sends = 0
+    budget = gossip_periods_to_sweep(params.repeat_mult, n)
+    for _ in range(budget):
+        key, k = jax.random.split(key)
+        st, m = step(st, k)
+        total_sends += int(m["rumor_sends"])
+    assert float(m["rumor_coverage"][0]) == pytest.approx(1.0)
+    bound = max_messages_per_gossip_total(params.fanout, params.repeat_mult, n)
+    assert total_sends <= bound, (total_sends, bound)
+
+
 def test_checkpoint_roundtrip(step):
     st = S.init_state(PARAMS, 12, warm=True)
     key = jax.random.PRNGKey(8)
