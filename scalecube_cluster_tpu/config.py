@@ -140,6 +140,7 @@ class SimConfig:
     rumor_slots: int = 64  # concurrent user-rumor capacity per cluster
     record_queue: int = 32  # per-node piggyback queue for membership records
     dense_links: bool = True  # dense NxN loss/delay matrices (sim emulator)
+    delay_slots: int = 0  # pending-delivery ring depth (max link delay + 1 ticks)
     seed: int = 0
 
     def replace(self, **kw) -> "SimConfig":

@@ -197,6 +197,31 @@ def _rt_at(state: SimState, i, j) -> jnp.ndarray:
     return state.fetch_rt[i, j]
 
 
+def _delay_q_at(state: SimState, i, j) -> jnp.ndarray:
+    """Geometric delay parameter of the directed link i→j (0 = no delay)."""
+    if state.delay_q.ndim == 0:
+        return jnp.broadcast_to(state.delay_q, jnp.shape(i))
+    return state.delay_q[i, j]
+
+
+def _timely_rt(q1: jax.Array, q2: jax.Array, t: int) -> jax.Array:
+    """P(two independent geometric(q) legs sum to ≤ t ticks) — the chance a
+    request-response round trip beats its protocol timeout under the link
+    delay model. Closed-form polynomial in (q1, q2) via the convolution
+    recurrence h_s = q1·h_{s-1} + q2^s (pure f32 multiply/add, bit-exact
+    against the oracle on any backend; no transcendentals). With q = 0 (no
+    delay) this is EXACTLY 1.0, so multiplying it in never perturbs
+    zero-delay trajectories."""
+    h = jnp.ones_like(q1)  # h_0
+    acc = h
+    q2p = jnp.ones_like(q2)
+    for _ in range(t):
+        q2p = q2p * q2
+        h = q1 * h + q2p
+        acc = acc + h
+    return (1.0 - q1) * (1.0 - q2) * acc
+
+
 def _edge_ok(state: SimState, src: jax.Array, dst: jax.Array, draw: jax.Array) -> jax.Array:
     """Delivery draw for a directed message src->dst (sender+receiver up,
     Bernoulli on outbound loss — NetworkEmulator.java:349-369)."""
@@ -253,17 +278,37 @@ def _fd_phase(
     has_tgt = sel_valid[:, 0] & state.up
 
     # Direct ping: PING out + ACK back must both survive (request-response
-    # round trip = one fetch_rt lookup).
+    # round trip = one fetch_rt lookup), and under the delay model the round
+    # trip must also beat pingTimeout (FailureDetectorConfig.java:10 — the
+    # sub-interval timeout, SURVEY.md §7 hard part i).
     p_direct = _rt_at(state, rows, tgt)
+    if params.delay_slots:
+        p_direct = p_direct * _timely_rt(
+            _delay_q_at(state, rows, tgt),
+            _delay_q_at(state, tgt, rows),
+            params.fd_direct_timeout_ticks,
+        )
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     # Indirect probe via k relays: PING_REQ -> transit PING -> transit ACK ->
     # forwarded ACK (four hops, FailureDetectorImpl.java:173-315) = the
-    # issuer↔relay round trip times the relay↔target round trip.
+    # issuer↔relay round trip times the relay↔target round trip, each of
+    # which must fit its share of the remaining interval under delay.
     relays = sel_idx[:, 1:]  # [N, k]
     relay_valid = sel_valid[:, 1:]
     tgt_b = tgt[:, None]
     p_relay = _rt_at(state, rows[:, None], relays) * _rt_at(state, relays, tgt_b)
+    if params.delay_slots:
+        p_relay = p_relay * _timely_rt(
+            _delay_q_at(state, rows[:, None], relays),
+            _delay_q_at(state, relays, rows[:, None]),
+            params.fd_leg_timeout_ticks,
+        )
+        p_relay = p_relay * _timely_rt(
+            _delay_q_at(state, relays, tgt_b),
+            _delay_q_at(state, tgt_b, relays),
+            params.fd_leg_timeout_ticks,
+        )
     relay_ok = (
         relay_valid
         & state.up[relays]
@@ -343,7 +388,17 @@ def _gossip_phase(
     # cluster (converged steady state: nothing young, no live rumors) skips
     # peer selection + delivery + merge entirely — the dominant per-tick cost
     # drops out exactly when the real system would go quiet on the wire.
+    # Under the delay model, messages already in flight (the current tick's
+    # pending-ring slot) are work too, even if every sender is quiet.
     sender_has = young.any(axis=1) | rumor_young.any(axis=1)  # [N]
+    D = params.delay_slots
+    gossip_work = sender_has.any()
+    if D:
+        slot_now = state.tick % D
+        arriving_key = state.pending_key[slot_now]  # [N, N]
+        arriving_inf = state.pending_inf[slot_now]  # [N, R]
+        arriving_src = state.pending_src[slot_now]  # [N, R]
+        gossip_work = gossip_work | (arriving_key > NO_CANDIDATE).any() | arriving_inf.any()
 
     def _deliver(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
         peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
@@ -352,10 +407,19 @@ def _gossip_phase(
         # (buf = max(own, best delivered candidate) cellwise), then apply
         # the overrides gate on the winner: buf > own ⟺ the best candidate
         # overrides, in which case buf IS that candidate. Saves a separate
-        # recv buffer + merge pass.
-        buf = state.view_key
-        recv_inf = jnp.zeros_like(state.infected)
-        recv_src = jnp.full_like(state.infected_from, -1)
+        # recv buffer + merge pass. Messages whose delay draw lands them on
+        # this tick's ring slot were read above and join the same merge.
+        if D:
+            buf = jnp.maximum(state.view_key, arriving_key)
+            recv_inf = arriving_inf
+            recv_src = arriving_src
+            pend_key = state.pending_key
+            pend_inf = state.pending_inf
+            pend_src = state.pending_src
+        else:
+            buf = state.view_key
+            recv_inf = jnp.zeros_like(state.infected)
+            recv_src = jnp.full_like(state.infected_from, -1)
         young_any = young.any(axis=1)  # [N] — membership payload exists
         sent = jnp.int32(0)
         rumor_sent = jnp.int32(0)
@@ -382,11 +446,35 @@ def _gossip_phase(
                 & _edge_ok(state, rows, p, r.gossip_edge[:, s])
             )
             sent = sent + ok.sum()
-            buf = buf.at[p].max(jnp.where(ok[:, None], piggyback, NO_CANDIDATE))
             send_r = payload_r & ok[:, None]
             rumor_sent = rumor_sent + send_r.sum()
-            recv_inf = recv_inf.at[p].max(send_r)
-            recv_src = recv_src.at[p].max(jnp.where(send_r, rows[:, None], -1))
+            if D:
+                # Per-edge integer delay d: P(d ≥ k) = q^k (geometric floor
+                # of the emulator's exponential), capped at D-1 ring slots.
+                # Sequential f32 powers keep it transcendental-free.
+                qd = _delay_q_at(state, rows, p)
+                d = jnp.zeros((state.capacity,), jnp.int32)
+                qpow = qd
+                for _ in range(1, D):
+                    d = d + (r.gossip_delay[:, s] < qpow)
+                    qpow = qpow * qd
+                ok_now = ok & (d == 0)
+                ok_late = ok & (d > 0)
+                slot_d = (state.tick + d) % D  # d ∈ [1, D-1] ⇒ never slot_now
+                pend_key = pend_key.at[slot_d, p].max(
+                    jnp.where(ok_late[:, None], piggyback, NO_CANDIDATE)
+                )
+                late_r = send_r & ok_late[:, None]
+                pend_inf = pend_inf.at[slot_d, p].max(late_r)
+                pend_src = pend_src.at[slot_d, p].max(
+                    jnp.where(late_r, rows[:, None], -1)
+                )
+            else:
+                ok_now = ok
+            buf = buf.at[p].max(jnp.where(ok_now[:, None], piggyback, NO_CANDIDATE))
+            now_r = send_r & ok_now[:, None]
+            recv_inf = recv_inf.at[p].max(now_r)
+            recv_src = recv_src.at[p].max(jnp.where(now_r, rows[:, None], -1))
 
         own = state.view_key
         accept = (
@@ -411,6 +499,13 @@ def _gossip_phase(
             # known-infected set for the forwarding filter above
             infected_from=jnp.where(newly_inf, recv_src, st.infected_from),
         )
+        if D:
+            # current slot is consumed; d ≥ 1 scatters never target it
+            st = st.replace(
+                pending_key=pend_key.at[slot_now].set(NO_CANDIDATE),
+                pending_inf=pend_inf.at[slot_now].set(False),
+                pending_src=pend_src.at[slot_now].set(-1),
+            )
         return st, {
             "gossip_msgs": sent,
             "rumor_sends": rumor_sent,
@@ -424,7 +519,7 @@ def _gossip_phase(
             "rumor_deliveries": jnp.int32(0),
         }
 
-    return jax.lax.cond(sender_has.any(), _deliver, _quiet, state)
+    return jax.lax.cond(gossip_work, _deliver, _quiet, state)
 
 
 def _sync_phase(
@@ -461,8 +556,15 @@ def _sync_phase(
     cand = cand & (rows[None, :] != caller[:, None])
     peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[caller][:, None])
     peer = peer_idx[:, 0]  # [K]
-    # Round trip: SYNC out and SYNC_ACK back must both survive.
+    # Round trip: SYNC out and SYNC_ACK back must both survive (and beat
+    # syncTimeout under the delay model — MembershipConfig.java:15).
     p_rt = _rt_at(state, caller, peer)
+    if params.delay_slots:
+        p_rt = p_rt * _timely_rt(
+            _delay_q_at(state, caller, peer),
+            _delay_q_at(state, peer, caller),
+            params.sync_timeout_ticks,
+        )
     ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
     # SYNC request: callers' full tables scattered into peers (several
@@ -572,10 +674,27 @@ def _refute_phase(state: SimState) -> SimState:
 
 
 def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
+    """Reclaim rumor slots. The reference sweeps per NODE: each holds a
+    gossip for its own sweep window after ARRIVAL (getGossipsToRemove
+    :350-358). The global slot therefore stays live while (a) the creation
+    window runs, (b) any copy is still in flight (delay rings), or (c) any
+    up receiver is still inside its own forwarding window (a late receiver
+    must get to spread what it just learned — GossipDelayTest.java:33-70's
+    late node still disseminates). Lifetime stays bounded: once everyone
+    reachable is infected, the last infection + spread ends it."""
     n_up = state.up.sum().astype(jnp.int32)
     sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
-    keep = state.rumor_active & (state.tick - state.rumor_created <= sweep)
-    return state.replace(rumor_active=keep)
+    keep = state.tick - state.rumor_created <= sweep
+    spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
+    forwarding = (
+        state.infected
+        & state.up[:, None]
+        & (state.tick - state.infected_at < spread[:, None])
+    ).any(axis=0)
+    keep = keep | forwarding
+    if params.delay_slots:
+        keep = keep | state.pending_inf.any(axis=(0, 1))
+    return state.replace(rumor_active=state.rumor_active & keep)
 
 
 # ---------------------------------------------------------------------------

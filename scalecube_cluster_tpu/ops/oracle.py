@@ -90,6 +90,10 @@ class _O:
         self.infected_from = np.asarray(state.infected_from).copy()
         self.loss = np.asarray(state.loss).copy()
         self.fetch_rt = np.asarray(state.fetch_rt).copy()
+        self.delay_q = np.asarray(state.delay_q).copy()
+        self.pending_key = np.asarray(state.pending_key).copy()
+        self.pending_inf = np.asarray(state.pending_inf).copy()
+        self.pending_src = np.asarray(state.pending_src).copy()
 
     def snap(self):
         import copy
@@ -104,6 +108,24 @@ def _loss(o: "_O", i: int, j: int) -> np.float32:
 def _rt(o: "_O", i: int, j: int) -> np.float32:
     """Round-trip probability i→j→i (mirror of kernel._rt_at)."""
     return np.float32(o.fetch_rt) if o.fetch_rt.ndim == 0 else o.fetch_rt[i, j]
+
+
+def _delay_q(o: "_O", i: int, j: int) -> np.float32:
+    return np.float32(o.delay_q) if o.delay_q.ndim == 0 else o.delay_q[i, j]
+
+
+def _timely(q1: np.float32, q2: np.float32, t: int) -> np.float32:
+    """Scalar mirror of ``kernel._timely_rt`` — identical f32 op sequence."""
+    q1 = np.float32(q1)
+    q2 = np.float32(q2)
+    h = np.float32(1.0)
+    acc = np.float32(1.0)
+    q2p = np.float32(1.0)
+    for _ in range(t):
+        q2p = np.float32(q2p * q2)
+        h = np.float32(np.float32(q1 * h) + q2p)
+        acc = np.float32(acc + h)
+    return np.float32(np.float32((np.float32(1.0) - q1) * (np.float32(1.0) - q2)) * acc)
 
 
 def _live_mask(o: _O, i: int) -> np.ndarray:
@@ -160,6 +182,15 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 continue
             tgt = int(sel[0])
             p_direct = _rt(pre, i, tgt)
+            if params.delay_slots:
+                p_direct = np.float32(
+                    p_direct
+                    * _timely(
+                        _delay_q(pre, i, tgt),
+                        _delay_q(pre, tgt, i),
+                        params.fd_direct_timeout_ticks,
+                    )
+                )
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
             for s in range(k):
                 if ack:
@@ -168,6 +199,23 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                     continue
                 rl = int(sel[1 + s])
                 p4 = _rt(pre, i, rl) * _rt(pre, rl, tgt)
+                if params.delay_slots:
+                    p4 = np.float32(
+                        p4
+                        * _timely(
+                            _delay_q(pre, i, rl),
+                            _delay_q(pre, rl, i),
+                            params.fd_leg_timeout_ticks,
+                        )
+                    )
+                    p4 = np.float32(
+                        p4
+                        * _timely(
+                            _delay_q(pre, rl, tgt),
+                            _delay_q(pre, tgt, rl),
+                            params.fd_leg_timeout_ticks,
+                        )
+                    )
                 if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
                     ack = True
             own = int(pre.key[i, tgt])  # targets come from the live view: >= 0
@@ -191,9 +239,24 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
 
     # ---- gossip phase ----
     pre = o.snap()
+    D = params.delay_slots
     recv_key = np.full((n, n), np.iinfo(np.int64).min, dtype=np.int64)
     recv_inf = np.zeros_like(pre.infected)
     recv_src = np.full_like(pre.infected_from, -1)
+    if D:
+        # in-flight messages landing this tick join the same merge
+        slot_now = t % D
+        arr_key = pre.pending_key[slot_now]
+        arr_inf = pre.pending_inf[slot_now]
+        arr_src = pre.pending_src[slot_now]
+        for i in range(n):
+            for j in range(n):
+                if arr_key[i, j] > np.iinfo(np.int32).min:
+                    recv_key[i, j] = max(recv_key[i, j], int(arr_key[i, j]))
+            for ru in range(params.rumor_slots):
+                if arr_inf[i, ru]:
+                    recv_inf[i, ru] = True
+                    recv_src[i, ru] = max(recv_src[i, ru], int(arr_src[i, ru]))
     for i in range(n):
         if not pre.up[i]:
             continue
@@ -207,9 +270,26 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 continue
             if not r["gossip_edge"][i, s] < (np.float32(1.0) - _loss(pre, i, p)):
                 continue
-            for j in range(n):
-                if pre.key[i, j] >= 0 and t - pre.changed[i, j] < spread:
-                    recv_key[p, j] = max(recv_key[p, j], int(pre.key[i, j]))
+            # per-edge delay draw: d = #{k in 1..D-1 : u < q^k}
+            dd = 0
+            if D:
+                qd = _delay_q(pre, i, p)
+                qpow = qd
+                for _ in range(1, D):
+                    if r["gossip_delay"][i, s] < qpow:
+                        dd += 1
+                    qpow = np.float32(qpow * qd)
+            if dd == 0:
+                for j in range(n):
+                    if pre.key[i, j] >= 0 and t - pre.changed[i, j] < spread:
+                        recv_key[p, j] = max(recv_key[p, j], int(pre.key[i, j]))
+            else:
+                slot_d = (t + dd) % D
+                for j in range(n):
+                    if pre.key[i, j] >= 0 and t - pre.changed[i, j] < spread:
+                        o.pending_key[slot_d, p, j] = max(
+                            int(o.pending_key[slot_d, p, j]), int(pre.key[i, j])
+                        )
             for ru in range(params.rumor_slots):
                 if (
                     pre.infected[i, ru]
@@ -220,8 +300,15 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                     and pre.infected_from[i, ru] != p
                     and pre.r_origin[ru] != p
                 ):
-                    recv_inf[p, ru] = True
-                    recv_src[p, ru] = max(recv_src[p, ru], i)
+                    if dd == 0:
+                        recv_inf[p, ru] = True
+                        recv_src[p, ru] = max(recv_src[p, ru], i)
+                    else:
+                        slot_d = (t + dd) % D
+                        o.pending_inf[slot_d, p, ru] = True
+                        o.pending_src[slot_d, p, ru] = max(
+                            int(o.pending_src[slot_d, p, ru]), i
+                        )
     for i in range(n):
         if not pre.up[i]:
             continue
@@ -233,6 +320,11 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 o.infected[i, ru] = True
                 o.infected_at[i, ru] = t
                 o.infected_from[i, ru] = recv_src[i, ru]
+    if D:
+        # the consumed ring slot resets (kernel clears it after the merge)
+        o.pending_key[slot_now] = np.iinfo(np.int32).min
+        o.pending_inf[slot_now] = False
+        o.pending_src[slot_now] = -1
 
     # ---- SYNC phase ----
     pre = o.snap()
@@ -262,6 +354,15 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             continue
         p = int(peers[0])
         p_rt = _rt(pre, i, p)
+        if params.delay_slots:
+            p_rt = np.float32(
+                p_rt
+                * _timely(
+                    _delay_q(pre, i, p),
+                    _delay_q(pre, p, i),
+                    params.sync_timeout_ticks,
+                )
+            )
         if pre.up[p] and r["sync_edge"][i] < p_rt:
             # bootstrap force_sync clears only on a successful round-trip
             o.force_sync[i] = False
@@ -294,11 +395,24 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             o.key[i, i] = (((diag >> 2) + 1) << 2) | new_rank
             o.changed[i, i] = t
 
-    # ---- rumor sweep ----
+    # ---- rumor sweep (per-receiver hold semantics, kernel._rumor_sweep) ----
     n_up = int(o.up.sum())
     sweep = 2 * (params.repeat_mult * _ceil_log2(n_up) + 1)
     for ru in range(params.rumor_slots):
         if o.r_active[ru] and t - o.r_created[ru] > sweep:
+            # still in flight?
+            if params.delay_slots and bool(o.pending_inf[:, :, ru].any()):
+                continue
+            # some up receiver still inside its own forwarding window?
+            forwarding = any(
+                o.infected[i, ru]
+                and o.up[i]
+                and t - o.infected_at[i, ru]
+                < params.repeat_mult * _ceil_log2(_cluster_size(o, i))
+                for i in range(n)
+            )
+            if forwarding:
+                continue
             o.r_active[ru] = False
 
     return o
@@ -318,6 +432,9 @@ def assert_equivalent(state: SimState, o: _O) -> None:
         "infected": (np.asarray(state.infected), o.infected),
         "infected_at": (np.asarray(state.infected_at), o.infected_at),
         "infected_from": (np.asarray(state.infected_from), o.infected_from),
+        "pending_key": (np.asarray(state.pending_key), o.pending_key),
+        "pending_inf": (np.asarray(state.pending_inf), o.pending_inf),
+        "pending_src": (np.asarray(state.pending_src), o.pending_src),
     }
     for name, (a, b) in pairs.items():
         if not np.array_equal(np.asarray(a), np.asarray(b)):

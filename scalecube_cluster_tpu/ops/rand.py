@@ -44,6 +44,7 @@ class FdRandoms(NamedTuple):
 class RoundRandoms(NamedTuple):
     gossip_sel: jax.Array
     gossip_edge: jax.Array
+    gossip_delay: jax.Array
     sync_sel: jax.Array
     sync_edge: jax.Array
 
@@ -56,6 +57,7 @@ class TickRandoms(NamedTuple):
     fd_relay: jax.Array
     gossip_sel: jax.Array
     gossip_edge: jax.Array
+    gossip_delay: jax.Array
     sync_sel: jax.Array
     sync_edge: jax.Array
 
@@ -126,10 +128,11 @@ def draw_fd_randoms(key: jax.Array, n: int, ping_req_k: int) -> FdRandoms:
 
 
 def draw_round_randoms(key: jax.Array, n: int, fanout: int) -> RoundRandoms:
-    k4, k5, k6, k7 = jax.random.split(key, 4)
+    k4, k5, k6, k7, k8 = jax.random.split(key, 5)
     return RoundRandoms(
         gossip_sel=jax.random.uniform(k4, (n, fanout), dtype=jnp.float32),
         gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
+        gossip_delay=jax.random.uniform(k8, (n, fanout), dtype=jnp.float32),
         sync_sel=jax.random.uniform(k6, (n,), dtype=jnp.float32),
         sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
     )

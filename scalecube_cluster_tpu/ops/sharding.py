@@ -39,6 +39,7 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
     large-N mode), which must be replicated, not row-sharded."""
     row = NamedSharding(mesh, P(MEMBER_AXIS))
     row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
+    ring = NamedSharding(mesh, P(None, MEMBER_AXIS, None))  # [D, N, ...] rings
     rep = NamedSharding(mesh, P())
     return SimState(
         tick=rep,
@@ -56,6 +57,10 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
         infected_from=row2d,
         loss=row2d if dense_links else rep,
         fetch_rt=row2d if dense_links else rep,
+        delay_q=row2d if dense_links else rep,
+        pending_key=ring,
+        pending_inf=ring,
+        pending_src=ring,
     )
 
 

@@ -34,6 +34,7 @@ from .lattice import (
 )
 
 NEVER = jnp.int32(-(1 << 30))  # "changed long ago" sentinel for changed_at
+NO_CANDIDATE_I32 = jnp.int32(jnp.iinfo(jnp.int32).min)  # scatter-max identity
 # ALIVE @ incarnation 0 @ epoch 0 packed key (epoch<<23 | inc<<2 | rank_alive)
 ALIVE0_KEY = jnp.int32(0)
 
@@ -55,6 +56,21 @@ class SimParams:
     fd_every: int = 5  # ping_interval / tick_interval
     sync_every: int = 150  # sync_interval / tick_interval
     sync_stagger: int = 1
+    # Link-delay modeling (NetworkEmulator's exponential mean delay,
+    # NetworkEmulator.java:349-369). delay_slots is the pending-delivery
+    # ring depth D: gossip messages can land up to D-1 ticks late; 0
+    # disables delay entirely (no rings allocated, zero overhead).
+    delay_slots: int = 0
+    # Request-response timeout budgets in whole ticks, used by the delay
+    # model's timeliness factors (P(round trip <= budget)); with zero delay
+    # every factor is exactly 1.0 and trajectories are unchanged.
+    # Direct ping: ping_timeout (500ms/200ms = 2 whole ticks).
+    fd_direct_timeout_ticks: int = 2
+    # Indirect probe: the remaining interval is split across the two round
+    # trips (issuer<->relay, relay<->target), one tick each by default.
+    fd_leg_timeout_ticks: int = 1
+    # SYNC: syncTimeout (3s/200ms = 15 ticks).
+    sync_timeout_ticks: int = 15
     # Static cap on SYNC callers processed per tick (0 = auto:
     # capacity/sync_every + 32 headroom). Stagger spreads periodic syncs to
     # ~capacity/sync_every per tick; the headroom absorbs join bootstraps.
@@ -100,6 +116,18 @@ class SimParams:
             suspicion_mult=config.membership.suspicion_mult,
             rumor_slots=sim.rumor_slots,
             seed_rows=tuple(seed_rows),
+            delay_slots=getattr(sim, "delay_slots", 0),
+            fd_direct_timeout_ticks=max(
+                0, int(config.failure_detector.ping_timeout / dt)
+            ),
+            fd_leg_timeout_ticks=max(
+                0,
+                int(
+                    (config.failure_detector.ping_interval
+                     - config.failure_detector.ping_timeout) / dt / 2
+                ),
+            ),
+            sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
         )
 
 
@@ -154,6 +182,23 @@ class SimState(struct.PyTreeNode):
     changes (losses change only between ticks) because computing it in-tick
     needs ``loss.T`` — a materialized [N, N] transpose per tick that
     measured a ~2.5x tick slowdown on TPU. Scalar in the lean-loss mode.
+
+    Link delay (the emulator's exponentially-distributed mean delay,
+    ``NetworkEmulator.java:349-369``): ``delay_q[i, j]`` is the geometric
+    "one more tick" parameter ``q = exp(-tick_interval / mean_delay)``
+    (0 = no delay), computed by the HOST mutators — the only transcendental
+    in the delay model, so the kernel and the scalar oracle only ever do
+    pure f32 multiplies/compares and stay bit-exact across backends. A
+    gossip message drawn delay ``d`` (P(d≥k) = q^k, capped at
+    ``delay_slots-1``) lands in the pending rings ``pending_key`` /
+    ``pending_inf`` / ``pending_src`` and merges at its arrival tick
+    through the normal accept gates (infection stamps carry the ARRIVAL
+    tick, so a late receiver forwards the rumor on its own age window, like
+    the reference's per-receiver gossip periods). Request-response paths
+    (ping, indirect legs, SYNC) don't buffer; they multiply their success
+    probability by the closed-form chance the geometric round trip fits the
+    protocol timeout — with q=0 those factors are exactly 1.0, so zero-delay
+    states reproduce the undelayed trajectories bit-for-bit.
     """
 
     tick: jax.Array  # i32 scalar
@@ -171,6 +216,10 @@ class SimState(struct.PyTreeNode):
     infected_from: jax.Array  # i32 [N, R] — delivering peer, -1 origin/none
     loss: jax.Array  # f32 [N, N]
     fetch_rt: jax.Array  # f32 [N, N] — derived round-trip probability (see above)
+    delay_q: jax.Array  # f32 [N, N] or scalar — geometric delay parameter
+    pending_key: jax.Array  # i32 [D, N, N] — delayed candidate-key ring
+    pending_inf: jax.Array  # bool [D, N, R] — delayed rumor-infection ring
+    pending_src: jax.Array  # i32 [D, N, R] — delayed rumor source ring
 
     @property
     def capacity(self) -> int:
@@ -188,12 +237,21 @@ class SimState(struct.PyTreeNode):
         return key_inc(self.view_key)
 
 
+def delay_mean_to_q(mean_delay_ticks: float) -> float:
+    """Exponential mean delay (in ticks) → geometric parameter q (f32).
+    The single place the transcendental runs — on HOST, never in-tick."""
+    if mean_delay_ticks <= 0:
+        return 0.0
+    return float(np.float32(np.exp(np.float32(-1.0 / mean_delay_ticks))))
+
+
 def init_state(
     params: SimParams,
     n_initial: int,
     warm: bool = True,
     dense_links: bool = True,
     uniform_loss: float = 0.0,
+    uniform_delay: float = 0.0,
 ) -> SimState:
     """Fresh simulation with rows ``0..n_initial-1`` up.
 
@@ -202,10 +260,13 @@ def init_state(
     ``warm=False``: cold rows know only themselves; use :func:`join_row` /
     seed knowledge + SYNC to converge (join-path tests).
 
-    ``dense_links=False`` stores the link loss as one scalar
-    (``uniform_loss``) instead of the [N, N] matrix — required at very large
-    N (the dense float32 matrix alone is 40 GB at N=100k); per-link emulator
+    ``dense_links=False`` stores the link loss (and delay parameter) as one
+    scalar instead of the [N, N] matrices — required at very large N (each
+    dense float32 matrix alone is 40 GB at N=100k); per-link emulator
     controls then raise until densified.
+
+    ``uniform_delay`` is the mean link delay in TICKS (exponential mean, the
+    emulator's model); nonzero delay requires ``params.delay_slots > 0``.
     """
     n = params.capacity
     r = params.rumor_slots
@@ -221,6 +282,17 @@ def init_state(
         if dense_links
         else jnp.float32(uniform_loss)
     )
+    if uniform_delay > 0 and params.delay_slots <= 0:
+        raise ValueError("uniform_delay > 0 requires params.delay_slots > 0")
+    if params.delay_slots > 0 and not dense_links:
+        raise ValueError(
+            "delay_slots > 0 allocates [D, N, N] pending rings, which defeats "
+            "the lean dense_links=False mode — use the dense regime for the "
+            "delay emulator, or delay_slots=0 at large N"
+        )
+    q = delay_mean_to_q(uniform_delay)
+    delay_q = jnp.full((n, n), q, jnp.float32) if dense_links else jnp.float32(q)
+    d = max(0, params.delay_slots)
     return SimState(
         tick=jnp.int32(0),
         up=up,
@@ -237,6 +309,10 @@ def init_state(
         infected_from=jnp.full((n, r), -1, jnp.int32),
         loss=loss,
         fetch_rt=_roundtrip(loss),
+        delay_q=delay_q,
+        pending_key=jnp.full((d, n, n), NO_CANDIDATE_I32, jnp.int32),
+        pending_inf=jnp.zeros((d, n, r), bool),
+        pending_src=jnp.full((d, n, r), -1, jnp.int32),
     )
 
 
@@ -299,6 +375,12 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
         leaving=state.leaving.at[row].set(False),
         infected=state.infected.at[row].set(False),
         infected_from=state.infected_from.at[row].set(-1),
+        # messages still in flight TO this row were addressed to the dead
+        # previous occupant (the reference loses them with the connection);
+        # the fresh identity must not receive them
+        pending_key=state.pending_key.at[:, row].set(NO_CANDIDATE_I32),
+        pending_inf=state.pending_inf.at[:, row].set(False),
+        pending_src=state.pending_src.at[:, row].set(-1),
     )
 
 
@@ -366,6 +448,24 @@ def set_link_loss(state: SimState, src, dst, loss: float) -> SimState:
     new_rt = state.fetch_rt.at[src[:, None], dst[None, :]].set(fwd.T)
     new_rt = new_rt.at[dst[:, None], src[None, :]].set(fwd)
     return state.replace(loss=new_loss, fetch_rt=new_rt)
+
+
+def set_link_delay(state: SimState, src, dst, mean_delay_ticks: float) -> SimState:
+    """Set the outbound mean delay (in ticks) on directed link(s) src->dst
+    (the emulator's ``setOutboundSettings`` delay half). Host-side: converts
+    the mean to the geometric q here so the kernel stays transcendental-free."""
+    if state.delay_q.ndim == 0:
+        raise ValueError(
+            "per-link delay needs dense links; init_state(dense_links=True)"
+        )
+    if mean_delay_ticks > 0 and state.pending_key.shape[0] == 0:
+        raise ValueError("link delay requires params.delay_slots > 0")
+    src = jnp.atleast_1d(jnp.asarray(src))
+    dst = jnp.atleast_1d(jnp.asarray(dst))
+    q = delay_mean_to_q(mean_delay_ticks)
+    return state.replace(
+        delay_q=state.delay_q.at[src[:, None], dst[None, :]].set(q)
+    )
 
 
 def block_partition(state: SimState, group_a, group_b) -> SimState:

@@ -309,6 +309,11 @@ class SimDriver:
     def set_link_loss(self, src, dst, loss: float) -> None:
         self.state = _state.set_link_loss(self.state, src, dst, loss)
 
+    def set_link_delay(self, src, dst, mean_delay_ticks: float) -> None:
+        """Outbound mean delay in ticks (emulator delay half; needs
+        ``params.delay_slots > 0``)."""
+        self.state = _state.set_link_delay(self.state, src, dst, mean_delay_ticks)
+
     def block_partition(self, group_a, group_b) -> None:
         self.state = _state.block_partition(self.state, group_a, group_b)
 

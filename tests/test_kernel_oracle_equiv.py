@@ -53,6 +53,82 @@ def _mutations(tick: int, st: S.SimState) -> S.SimState:
     return st
 
 
+DELAY_PARAMS = S.SimParams(
+    capacity=10,
+    fanout=2,
+    repeat_mult=3,
+    ping_req_k=2,
+    fd_every=2,
+    sync_every=5,
+    suspicion_mult=2,
+    rumor_slots=3,
+    seed_rows=(0,),
+    delay_slots=4,
+    fd_direct_timeout_ticks=2,
+    fd_leg_timeout_ticks=1,
+    sync_timeout_ticks=8,
+)
+
+
+@pytest.mark.parametrize("seed", [1, 9])
+def test_lockstep_equivalence_with_delay(seed):
+    """Same scripted scenario with the link-delay model on: geometric delay
+    draws, pending-ring delivery, timeliness factors — all bit-exact
+    between kernel and oracle."""
+    step = jax.jit(partial(K.tick, params=DELAY_PARAMS))
+    st = S.init_state(DELAY_PARAMS, 8, warm=True, uniform_delay=1.5)
+    key = jax.random.PRNGKey(seed)
+    for t in range(30):
+        st = _mutations(t, st)
+        if t == 3:
+            st = S.set_link_delay(st, [0, 1], [2, 3], 4.0)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = O.oracle_tick(st, k, DELAY_PARAMS)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
+
+
+@pytest.mark.parametrize("seed", [2, 5])
+def test_lockstep_fuzz_larger_n(seed):
+    """Wider fuzz at N=24 with a random (exact-f32) loss matrix, delay,
+    churn, and rumors — the regime where scatter-max tie-breaking and
+    threshold edges would bite if kernel and oracle disagreed."""
+    import jax.numpy as jnp
+
+    params = S.SimParams(
+        capacity=24,
+        fanout=3,
+        repeat_mult=2,
+        ping_req_k=3,
+        fd_every=2,
+        sync_every=6,
+        suspicion_mult=2,
+        rumor_slots=4,
+        seed_rows=(0, 1),
+        delay_slots=3,
+    )
+    rng = np.random.default_rng(seed)
+    st = S.init_state(params, 20, warm=True, uniform_delay=0.8)
+    loss = rng.integers(0, 32, size=(24, 24)).astype(np.float32) / 64.0  # exact f32
+    loss_j = jnp.asarray(loss)
+    st = st.replace(loss=loss_j, fetch_rt=S._roundtrip(loss_j))
+    step = jax.jit(partial(K.tick, params=params))
+    key = jax.random.PRNGKey(100 + seed)
+    for t in range(20):
+        if t == 5:
+            st = S.crash_row(st, int(rng.integers(2, 20)))
+        if t == 8:
+            st = S.spread_rumor(st, 0, origin=int(rng.integers(0, 20)))
+        if t == 12:
+            st = S.join_row(st, 22, seed_rows=[0])
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = O.oracle_tick(st, k, params)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
+
+
 @pytest.mark.parametrize("seed", [0, 7])
 def test_lockstep_equivalence(seed):
     step = jax.jit(partial(K.tick, params=PARAMS))

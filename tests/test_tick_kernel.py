@@ -248,6 +248,64 @@ def test_metadata_update_propagates_as_incarnation(step):
     assert (vi[up, 2] == 1).all()  # every peer observed the UPDATED bump
 
 
+def test_delayed_rumor_exactly_once_delivery_beyond_sweep():
+    """Port of the reference GossipDelayTest (GossipDelayTest.java:33-70):
+    mean link delay far beyond the sweep window must still deliver the rumor
+    to every member EXACTLY once — late in-flight copies keep the slot live
+    (per-node sweep semantics) and the infection bitmap's OR makes double
+    delivery structurally impossible."""
+    from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_sweep
+
+    params = S.SimParams(
+        capacity=4, fanout=1, repeat_mult=2, fd_every=1000, sync_every=1000,
+        rumor_slots=2, seed_rows=(0,), delay_slots=24,
+    )
+    n_alive = 4
+    st = S.init_state(params, n_alive, warm=True, uniform_delay=60.0)
+    st = S.spread_rumor(st, 0, 0)
+    step = jax.jit(partial(K.tick, params=params))
+    key = jax.random.PRNGKey(21)
+    sweep = gossip_periods_to_sweep(params.repeat_mult, n_alive)
+    deliveries = 0
+    converged_at = None
+    for t in range(1, 140):
+        key, k = jax.random.split(key)
+        st, m = step(st, k)
+        deliveries += int(m["rumor_deliveries"])
+        if converged_at is None and float(m["rumor_coverage"][0]) >= 1.0:
+            converged_at = t
+    assert converged_at is not None  # everyone got it eventually
+    assert deliveries == n_alive - 1  # exactly once each, never redelivered
+    assert converged_at > sweep  # late delivery really outlived the window
+    assert not bool(st.rumor_active[0])  # slot drained + reclaimed after
+
+
+def test_heavy_delay_causes_ping_timeouts_without_loss():
+    """Sub-interval ping timeouts under pure delay (no loss): with mean link
+    delay ≫ pingTimeout most round trips miss the deadline and suspects
+    appear — the FD false-positive mechanism the delay model exists for
+    (SURVEY.md §7 hard part i). The same seed with zero delay never
+    suspects anyone."""
+    params = S.SimParams(
+        capacity=12, fanout=2, repeat_mult=2, fd_every=1, sync_every=1000,
+        rumor_slots=2, seed_rows=(0,), delay_slots=4,
+    )
+    step = jax.jit(partial(K.tick, params=params))
+
+    def suspects_after(uniform_delay, ticks=4):
+        st = S.init_state(params, 12, warm=True, uniform_delay=uniform_delay)
+        key = jax.random.PRNGKey(8)
+        worst = 0
+        for _ in range(ticks):
+            key, k = jax.random.split(key)
+            st, m = step(st, k)
+            worst = max(worst, int(m["false_suspect_pairs"]))
+        return worst
+
+    assert suspects_after(0.0) == 0
+    assert suspects_after(8.0) > 0
+
+
 def test_rumor_message_cost_within_cluster_math_bound():
     """One rumor at N=256 must cost at most ClusterMath's cluster-wide
     message bound (``maxMessagesPerGossipTotal``, ClusterMath.java:47-67):
