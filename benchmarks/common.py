@@ -9,7 +9,7 @@ from __future__ import annotations
 import json
 import sys
 import time
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 
@@ -25,7 +25,11 @@ def emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+@lru_cache(maxsize=16)
 def make_step(params: SimParams, donate: bool = True):
+    """One jitted step per (params, donate) — SimParams is a frozen
+    (hashable) dataclass, so trials of the same experiment matrix share the
+    compiled executable instead of re-jitting per TickLoop."""
     return jax.jit(partial(tick, params=params), donate_argnums=0 if donate else ())
 
 

@@ -1,4 +1,4 @@
-from . import codecs, local, native_codec, tcp  # register factories/codecs (ServiceLoader analogue)
+from . import codecs, local, native_codec, tcp, websocket  # register factories/codecs (ServiceLoader analogue)
 from .api import (
     Listeners,
     PeerUnavailableError,
@@ -16,6 +16,7 @@ from .emulator import (
 )
 from .local import MemoryTransport, MemoryTransportRegistry
 from .tcp import TcpTransport
+from .websocket import WebsocketTransport
 
 __all__ = [
     "Transport",
@@ -32,5 +33,6 @@ __all__ = [
     "MemoryTransport",
     "MemoryTransportRegistry",
     "TcpTransport",
+    "WebsocketTransport",
     "codecs",
 ]

@@ -1,0 +1,205 @@
+"""Shared scaffolding for stream-socket transports (TCP, WebSocket).
+
+Both real wire protocols share everything except framing and handshake: a
+listening asyncio server, a lazily-connected cached client connection per
+peer (the reference's connection cache, ``TransportImpl.java:54`` /
+``connect0:262-278``), codec-pluggable serialization at the channel
+boundary, and teardown that also reaps connections still mid-establishment
+when ``stop()`` runs. Subclasses supply the scheme, the client-side
+connection setup (handshake), the outbound frame encoding, and the inbound
+read loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from abc import abstractmethod
+from typing import Dict, Optional, Tuple
+
+from ..config import TransportConfig
+from ..models.message import Message
+from .api import Listeners, PeerUnavailableError, Transport, TransportError
+from .codecs import message_codec
+
+
+def parse_host_port(address: str, scheme: str) -> Tuple[str, int]:
+    addr = address[len(scheme):] if address.startswith(scheme) else address
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise TransportError(f"bad {scheme} address: {address!r}")
+    return host, int(port)
+
+
+class CachedConnection:
+    """One cached outbound connection with FIFO write ordering."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.lock = asyncio.Lock()
+
+    async def write_bytes(self, data: bytes) -> None:
+        async with self.lock:
+            self.writer.write(data)
+            await self.writer.drain()
+
+    def close(self) -> None:
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _close_when_done(fut: "asyncio.Future[CachedConnection]") -> None:
+    if not fut.cancelled() and fut.exception() is None:
+        fut.result().close()
+
+
+class StreamTransportBase(Transport):
+    """Server + cached-lazy-client plumbing shared by TCP and WebSocket."""
+
+    scheme: str = ""
+
+    def __init__(self, config: TransportConfig):
+        self._config = config
+        self._codec = message_codec(config.message_codec)
+        self._listeners = Listeners()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._address: Optional[str] = None
+        self._stopped = False
+        # peer address -> pending/established connection (TransportImpl.java:54)
+        self._connections: Dict[str, "asyncio.Future[CachedConnection]"] = {}
+        self._inbound_writers: set = set()
+
+    # -- subclass hooks ------------------------------------------------------
+    @abstractmethod
+    async def _setup_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Server-side channel setup (e.g. websocket upgrade); no-op for raw."""
+
+    @abstractmethod
+    async def _read_payload(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> Optional[bytes]:
+        """Read one whole encoded message; None when the peer closed cleanly."""
+
+    @abstractmethod
+    async def _setup_outbound(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        host: str,
+        port: int,
+    ) -> None:
+        """Client-side channel setup (e.g. websocket handshake)."""
+
+    @abstractmethod
+    def _frame(self, payload: bytes) -> bytes:
+        """Wrap one encoded message for the wire (length prefix / ws frame)."""
+
+    # -- Transport contract --------------------------------------------------
+    @property
+    def address(self) -> str:
+        if self._address is None:
+            raise TransportError("transport not started")
+        return self._address
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped
+
+    async def start(self) -> "StreamTransportBase":
+        host, port = self._config.host, self._config.port
+        self._server = await asyncio.start_server(self._accept, host=host, port=port)
+        bound = self._server.sockets[0].getsockname()
+        self._address = f"{self.scheme}{host}:{bound[1]}"
+        return self
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._inbound_writers.add(writer)
+        try:
+            await self._setup_inbound(reader, writer)
+            while not self._stopped:
+                payload = await self._read_payload(reader, writer)
+                if payload is None:
+                    break
+                self._listeners.emit(self._codec.decode(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, TransportError):
+            pass
+        finally:
+            self._inbound_writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for fut in self._connections.values():
+            if fut.done():
+                _close_when_done(fut)
+            else:
+                # a connect in flight when stop() runs must not leak its
+                # socket once it completes
+                fut.add_done_callback(_close_when_done)
+        self._connections.clear()
+        # Abort accepted connections so their handler coroutines finish —
+        # Server.wait_closed() (py3.12+) blocks until all handlers complete.
+        for writer in list(self._inbound_writers):
+            try:
+                writer.transport.abort()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _connect(self, address: str) -> CachedConnection:
+        """Lazy cached connect (reference connect0, TransportImpl.java:262-278)."""
+        fut = self._connections.get(address)
+        if fut is not None:
+            if not fut.done() or fut.exception() is None:
+                return await asyncio.shield(fut)
+            del self._connections[address]  # retry after failed connect
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._connections[address] = fut
+        try:
+            host, port = parse_host_port(address, self.scheme)
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), self._config.connect_timeout
+            )
+            await asyncio.wait_for(
+                self._setup_outbound(reader, writer, host, port),
+                self._config.connect_timeout,
+            )
+            conn = CachedConnection(writer)
+            fut.set_result(conn)
+            return conn
+        except Exception as exc:  # noqa: BLE001
+            err = PeerUnavailableError(f"connect to {address} failed: {exc}")
+            fut.set_exception(err)
+            # consume so the loop doesn't warn about unretrieved exceptions
+            fut.exception()
+            self._connections.pop(address, None)
+            raise err from exc
+
+    async def send(self, address: str, message: Message) -> None:
+        if self._stopped:
+            raise TransportError("transport is stopped")
+        conn = await self._connect(address)
+        payload = self._codec.encode(message)
+        if len(payload) > self._config.max_frame_length:
+            raise TransportError(f"frame too large: {len(payload)}")
+        try:
+            await conn.write_bytes(self._frame(payload))
+        except (ConnectionResetError, BrokenPipeError) as exc:
+            self._connections.pop(address, None)
+            raise PeerUnavailableError(f"send to {address} failed: {exc}") from exc
+
+    def listen(self) -> Listeners:
+        return self._listeners

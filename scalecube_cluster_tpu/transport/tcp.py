@@ -6,7 +6,8 @@ lazily-connected cached client connection per peer
 (``TransportImpl.connect0``, ``TransportImpl.java:262-278``), 4-byte
 big-endian length-prefixed framing (``TcpChannelInitializer.java:28-33``) with
 a max-frame guard, and codec-pluggable message serialization at the channel
-boundary (``TransportImpl.java:240-260``).
+boundary (``TransportImpl.java:240-260``). Server/client/cache scaffolding
+lives in :mod:`.stream_base`, shared with the WebSocket transport.
 
 This is the DCN-facing path for genuine multi-process clusters; addresses are
 ``tcp://host:port``.
@@ -16,160 +17,45 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 from ..config import TransportConfig
-from ..models.message import Message
-from .api import (
-    Listeners,
-    PeerUnavailableError,
-    Transport,
-    TransportError,
-    register_transport_factory,
-)
-from .codecs import message_codec
+from .api import TransportError, register_transport_factory
+from .stream_base import StreamTransportBase, parse_host_port
 
 _SCHEME = "tcp://"
 _LEN = struct.Struct(">I")
 
 
 def parse_tcp_address(address: str) -> Tuple[str, int]:
-    addr = address[len(_SCHEME):] if address.startswith(_SCHEME) else address
-    host, _, port = addr.rpartition(":")
-    if not host or not port.isdigit():
-        raise TransportError(f"bad tcp address: {address!r}")
-    return host, int(port)
+    return parse_host_port(address, _SCHEME)
 
 
-class _Connection:
-    """One cached outbound connection with FIFO write ordering."""
-
-    def __init__(self, writer: asyncio.StreamWriter):
-        self.writer = writer
-        self.lock = asyncio.Lock()
-
-    async def send_frame(self, frame: bytes) -> None:
-        async with self.lock:
-            self.writer.write(_LEN.pack(len(frame)) + frame)
-            await self.writer.drain()
-
-    def close(self) -> None:
-        try:
-            self.writer.close()
-        except Exception:  # noqa: BLE001
-            pass
-
-
-class TcpTransport(Transport):
+class TcpTransport(StreamTransportBase):
     """Length-prefixed TCP transport with cached lazy connections."""
 
+    scheme = _SCHEME
+
     def __init__(self, config: TransportConfig):
-        self._config = config
-        self._codec = message_codec(config.message_codec)
-        self._listeners = Listeners()
-        self._server: Optional[asyncio.base_events.Server] = None
-        self._address: Optional[str] = None
-        self._stopped = False
-        # peer address -> pending/established connection (TransportImpl.java:54)
-        self._connections: Dict[str, "asyncio.Future[_Connection]"] = {}
-        self._inbound_writers: set = set()
+        super().__init__(config)
 
-    @property
-    def address(self) -> str:
-        if self._address is None:
-            raise TransportError("transport not started")
-        return self._address
+    async def _setup_inbound(self, reader, writer) -> None:
+        pass  # raw stream: no handshake
 
-    @property
-    def is_stopped(self) -> bool:
-        return self._stopped
+    async def _setup_outbound(self, reader, writer, host, port) -> None:
+        pass
 
-    async def start(self) -> "TcpTransport":
-        host, port = self._config.host, self._config.port
-        self._server = await asyncio.start_server(self._accept, host=host, port=port)
-        bound = self._server.sockets[0].getsockname()
-        self._address = f"{_SCHEME}{host}:{bound[1]}"
-        return self
+    def _frame(self, payload: bytes) -> bytes:
+        return _LEN.pack(len(payload)) + payload
 
-    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        self._inbound_writers.add(writer)
-        try:
-            while not self._stopped:
-                header = await reader.readexactly(_LEN.size)
-                (length,) = _LEN.unpack(header)
-                if length > self._config.max_frame_length:
-                    raise TransportError(f"frame too large: {length}")
-                frame = await reader.readexactly(length)
-                self._listeners.emit(self._codec.decode(frame))
-        except (asyncio.IncompleteReadError, ConnectionResetError):
-            pass
-        finally:
-            self._inbound_writers.discard(writer)
-            try:
-                writer.close()
-            except Exception:  # noqa: BLE001
-                pass
-
-    async def stop(self) -> None:
-        if self._stopped:
-            return
-        self._stopped = True
-        for fut in self._connections.values():
-            if fut.done() and not fut.cancelled() and fut.exception() is None:
-                fut.result().close()
-        self._connections.clear()
-        # Abort accepted connections so their handler coroutines finish —
-        # Server.wait_closed() (py3.12+) blocks until all handlers complete.
-        for writer in list(self._inbound_writers):
-            try:
-                writer.transport.abort()
-            except Exception:  # noqa: BLE001
-                pass
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-
-    async def _connect(self, address: str) -> _Connection:
-        """Lazy cached connect (reference connect0, TransportImpl.java:262-278)."""
-        fut = self._connections.get(address)
-        if fut is not None:
-            if not fut.done() or fut.exception() is None:
-                return await asyncio.shield(fut)
-            del self._connections[address]  # retry after failed connect
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self._connections[address] = fut
-        try:
-            host, port = parse_tcp_address(address)
-            _, writer = await asyncio.wait_for(
-                asyncio.open_connection(host, port), self._config.connect_timeout
-            )
-            conn = _Connection(writer)
-            fut.set_result(conn)
-            return conn
-        except Exception as exc:  # noqa: BLE001
-            err = PeerUnavailableError(f"connect to {address} failed: {exc}")
-            fut.set_exception(err)
-            # consume so the loop doesn't warn about unretrieved exceptions
-            fut.exception()
-            self._connections.pop(address, None)
-            raise err from exc
-
-    async def send(self, address: str, message: Message) -> None:
-        if self._stopped:
-            raise TransportError("transport is stopped")
-        conn = await self._connect(address)
-        frame = self._codec.encode(message)
-        if len(frame) > self._config.max_frame_length:
-            raise TransportError(f"frame too large: {len(frame)}")
-        try:
-            await conn.send_frame(frame)
-        except (ConnectionResetError, BrokenPipeError) as exc:
-            self._connections.pop(address, None)
-            raise PeerUnavailableError(f"send to {address} failed: {exc}") from exc
-
-    def listen(self) -> Listeners:
-        return self._listeners
+    async def _read_payload(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> Optional[bytes]:
+        header = await reader.readexactly(_LEN.size)
+        (length,) = _LEN.unpack(header)
+        if length > self._config.max_frame_length:
+            raise TransportError(f"frame too large: {length}")
+        return await reader.readexactly(length)
 
 
 register_transport_factory("tcp", lambda cfg: TcpTransport(cfg))

@@ -205,3 +205,31 @@ def test_absent_seed_join_still_starts():
             await alice.shutdown()
 
     asyncio.run(run())
+
+
+def test_join_over_websocket_transport():
+    """The full protocol stack over the second real wire protocol (the
+    reference's WebSocket transport, WebsocketTransportFactory.java:8) —
+    proves the SPI's >1-wire-protocol claim end to end."""
+
+    async def run():
+        cfg = make_test_config().with_transport(
+            lambda t: t.replace(transport_factory="websocket", host="127.0.0.1")
+        )
+        alice = await new_cluster(cfg.replace(member_alias="Alice")).start()
+        bob = await new_cluster(
+            cfg.replace(member_alias="Bob").with_membership(
+                lambda m: m.replace(seed_members=[alice.address])
+            )
+        ).start()
+        try:
+            assert alice.address.startswith("ws://")
+            assert await await_until(
+                lambda: len(alice.members()) == 2 and len(bob.members()) == 2,
+                timeout=8.0,
+            )
+        finally:
+            await bob.shutdown()
+            await alice.shutdown()
+
+    asyncio.run(run())

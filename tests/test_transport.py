@@ -24,7 +24,7 @@ def fresh_registry():
     MemoryTransportRegistry.reset_default()
 
 
-FACTORIES = ["memory", "tcp"]
+FACTORIES = ["memory", "tcp", "websocket"]
 
 
 def cfg(factory):
@@ -95,7 +95,7 @@ def test_request_response_timeout(factory):
 
 @pytest.mark.parametrize(
     "factory,bogus",
-    [("memory", "mem://99999"), ("tcp", "tcp://127.0.0.1:1")],
+    [("memory", "mem://99999"), ("tcp", "tcp://127.0.0.1:1"), ("websocket", "ws://127.0.0.1:1")],
 )
 def test_unreachable_peer(factory, bogus):
     async def run():
@@ -158,5 +158,54 @@ def test_memory_fixed_port_rebind():
         t2 = await bind_transport(TransportConfig(port=4801, transport_factory="memory"))
         assert t2.address == "mem://4801"
         await t2.stop()
+
+    asyncio.run(run())
+
+
+def test_websocket_fragmentation_and_ping():
+    """RFC 6455 frame-level paths the SPI suite doesn't reach: a binary
+    message split into continuation frames must reassemble into one inbound
+    message, and a PING must be answered with a PONG echoing its payload."""
+
+    async def run():
+        from scalecube_cluster_tpu.transport.websocket import (
+            _OP_BINARY,
+            _OP_CONT,
+            _OP_PING,
+            _OP_PONG,
+            _client_handshake,
+            _encode_frame,
+            _read_frame,
+            parse_ws_address,
+        )
+        from scalecube_cluster_tpu.transport.codecs import message_codec
+
+        server = await bind_transport(cfg("websocket"))
+        inbox: list = []
+        server.listen().subscribe(inbox.append)
+        try:
+            host, port = parse_ws_address(server.address)
+            reader, writer = await asyncio.open_connection(host, port)
+            await _client_handshake(reader, writer, host, port)
+            payload = message_codec("jdk").encode(Message.with_data("frag", qualifier="q"))
+            # hand-fragment: BINARY(FIN=0) + CONT(FIN=1), both masked
+            first = _encode_frame(_OP_BINARY, payload[:3], mask=True)
+            first = bytes([first[0] & 0x7F]) + first[1:]  # clear FIN
+            writer.write(first)
+            writer.write(_encode_frame(_OP_CONT, payload[3:], mask=True))
+            writer.write(_encode_frame(_OP_PING, b"hello", mask=True))
+            await writer.drain()
+            opcode, fin, pong = await asyncio.wait_for(
+                _read_frame(reader, 1 << 20), 2.0
+            )
+            assert opcode == _OP_PONG and fin and pong == b"hello"
+            for _ in range(100):
+                if inbox:
+                    break
+                await asyncio.sleep(0.01)
+            assert inbox and inbox[0].data == "frag"
+            writer.close()
+        finally:
+            await server.stop()
 
     asyncio.run(run())
