@@ -69,22 +69,33 @@ def main() -> None:
     # member the peers' still-ALIVE records for the old occupant and the
     # crash would never manifest to failure detection. The initial pool is
     # the n//100 rows left down at init.
+    from functools import partial
+
+    # One traced+donated program per burst: crash K rows, join K replacements.
+    # The sequential host-side join_row path copy-on-writes the [N, N] planes
+    # ~6 times PER JOINER (a 163-joiner burst at N=16k measured ~25 s; the
+    # whole benchmark was dominated by it).
+    @partial(jax.jit, donate_argnums=0)
+    def churn_op(st, crash_rows, join_rows_):
+        st = st.replace(up=st.up.at[crash_rows].set(False))
+        return S.join_rows(st, join_rows_, list(params.seed_rows))
+
     free_pool = collections.deque(int(r) for r in np.nonzero(~np.asarray(loop.state.up))[0])
+    seed_set = np.asarray(params.seed_rows)
     t0 = time.perf_counter()
     fracs = []
     for sec in range(args.seconds):
-        # churn burst: crash churn_per_s random up rows, join replacements
+        # churn burst: crash K random non-seed up rows, join K replacements
+        # from the pool (pool size == burst size by construction, so the
+        # traced shapes stay static and churn_op never re-compiles)
         up = np.asarray(loop.state.up)
         up_rows = np.nonzero(up)[0]
-        crash = rng.choice(up_rows, size=min(churn_per_s, len(up_rows) - 8), replace=False)
-        crash = crash[~np.isin(crash, params.seed_rows)]
-        st = loop.state
-        st = st.replace(up=st.up.at[np.asarray(crash)].set(False))
-        n_join = min(len(crash), len(free_pool))
-        for _ in range(n_join):
-            st = S.join_row(st, free_pool.popleft(), list(params.seed_rows))
+        up_rows = up_rows[~np.isin(up_rows, seed_set)]
+        k = min(churn_per_s, len(free_pool), len(up_rows) - 8)
+        crash = rng.choice(up_rows, size=k, replace=False)
+        join = np.asarray([free_pool.popleft() for _ in range(k)], dtype=np.int32)
+        loop.state = churn_op(loop.state, np.asarray(crash, np.int32), join)
         free_pool.extend(int(r) for r in crash)
-        loop.state = st
         m = loop.step(TICKS_PER_SECOND)
         frac = float(np.asarray(m["alive_view_fraction"]))
         fracs.append(frac)

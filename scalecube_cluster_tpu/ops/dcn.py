@@ -1,0 +1,122 @@
+"""DCN / multi-host layer: the simulation spanning processes and slices.
+
+SURVEY.md §2.3's fourth parallelism component: within one slice the
+row-sharded tick rides ICI collectives (:mod:`.sharding`); ACROSS hosts or
+slices the same program runs under ``jax.distributed`` — each process
+contributes its local devices to one global mesh and XLA routes the
+inter-slice collectives over DCN. This is the analogue of the reference's
+WAN deployment profile (``ClusterConfig.defaultWanConfig``,
+``ClusterConfig.java:72-79``): same protocol, bigger/laggier fabric — the
+knobs that change are config (WAN profile), not code.
+
+Usage (one process per host/slice, e.g. under SLURM/GKE or manual spawn)::
+
+    from scalecube_cluster_tpu.ops import dcn
+    dcn.initialize(coordinator_address="host0:9777",
+                   num_processes=4, process_id=rank)   # or env-driven
+    mesh = dcn.global_mesh()                 # all processes' devices
+    params = SimParams(capacity=N, ...)
+    state = dcn.make_global_state(params, n_initial=N, mesh=mesh)
+    step = make_sharded_run(mesh, params, n_ticks=100)
+    state, key, metrics, _ = step(state, jax.random.PRNGKey(0))
+
+Every process executes the same program on the same inputs (SPMD); arrays
+are materialized per-process via ``jax.make_array_from_callback`` so no
+host ever needs another host's shard.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+# These imports are BACKEND-FREE by design: no sibling module materializes a
+# jnp value at import time (module constants are python ints / numpy — see
+# the notes in lattice.py/state.py). That invariant is what makes
+# ``from scalecube_cluster_tpu.ops import dcn`` safe as the first import of
+# a multi-process worker, BEFORE jax.distributed.initialize() runs; the
+# two-process smoke test (tests/test_dcn.py) would fail on any regression.
+from .sharding import MEMBER_AXIS, make_mesh, state_shardings
+from .state import SimParams, SimState, init_state
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Join the multi-process JAX runtime (``jax.distributed.initialize``).
+
+    Arguments fall back to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``) and, on managed TPU pods, to automatic cluster
+    detection (args all None). Call BEFORE any other jax API touches a
+    backend. No-op if the runtime is already initialized (re-entrant
+    drivers)."""
+    try:  # re-entrancy guard that must NOT itself touch a backend
+        from jax._src.distributed import global_state as _gs
+
+        if _gs.client is not None:
+            return  # already joined a multi-process world
+    except ImportError:
+        pass  # future jax moved it: let initialize() raise on double-init
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of this host in the global runtime."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh():
+    """One mesh (axis: ``sharding.MEMBER_AXIS``) over EVERY process's
+    devices (``jax.devices()`` is global after :func:`initialize`); the
+    member axis spans ICI within a slice and DCN between slices
+    automatically."""
+    return make_mesh(jax.devices())
+
+
+def make_global_state(
+    params: SimParams,
+    n_initial: int,
+    mesh,
+    **init_kwargs,
+) -> SimState:
+    """Build the initial SimState as GLOBAL arrays over a (possibly
+    multi-host) mesh.
+
+    ``jax.device_put`` of a host-local array only works single-host;
+    multi-host arrays must be assembled from per-process shards. Every
+    process deterministically computes the same host-side init (pure
+    function of params) and hands ``jax.make_array_from_callback`` just the
+    slices its own devices hold — no cross-host transfer, which is exactly
+    how a 100k-row state comes up on a multi-slice deployment without any
+    host materializing a full matrix copy per device.
+    """
+    import numpy as np
+
+    host_state = init_state(params, n_initial, **init_kwargs)
+    shardings = state_shardings(mesh, host_state.loss.ndim != 0)
+
+    def _globalize(leaf, sharding):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(_globalize, host_state, shardings)

@@ -67,6 +67,7 @@ refutations/metadata bumps, so this is never a practical limit.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as _np
 
 # Status codes (match models.member.MemberStatus + kernel-internal UNKNOWN).
 ALIVE = 0
@@ -75,8 +76,11 @@ LEAVING = 2
 DEAD = 3
 UNKNOWN = 4  # kernel-internal: "I have no record for this member"
 
-UNKNOWN_KEY = jnp.int32(-1)
-NO_CANDIDATE = jnp.iinfo(jnp.int32).min  # scatter-max identity
+# Host-side python ints (NOT jnp scalars: a module-level jnp constant would
+# initialize an XLA backend at import time, which breaks multi-process
+# workers that must call jax.distributed.initialize first — see ops.dcn).
+UNKNOWN_KEY = -1
+NO_CANDIDATE = jnp.iinfo(jnp.int32).min  # scatter-max identity (python int)
 
 # Ranks inside the packed key (key & 3). Note -1 (UNKNOWN_KEY) & 3 == 3, so
 # rank tests against ALIVE/LEAVING/SUSPECT are safe without a key >= 0 guard;
@@ -93,9 +97,11 @@ INC_MASK = (1 << INC_BITS) - 1
 EPOCH_MASK = 0xFF
 
 # rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1, DEAD->3
-_RANK = jnp.array([0, 2, 1, 3, 0], dtype=jnp.int32)
+# (numpy at module scope — converted to device constants inside the jitted
+# functions — so importing this module never touches an XLA backend)
+_RANK = _np.array([0, 2, 1, 3, 0], dtype=_np.int32)
 # status lookup by rank: 0->ALIVE, 1->LEAVING, 2->SUSPECT, 3->DEAD
-_RANK_TO_STATUS = jnp.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=jnp.int8)
+_RANK_TO_STATUS = _np.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=_np.int8)
 
 
 def precedence_key(
@@ -110,21 +116,21 @@ def precedence_key(
     key = (
         (jnp.int32(epoch) << EPOCH_SHIFT)
         | (incarnation.astype(jnp.int32) << 2)
-        | _RANK[status]
+        | jnp.asarray(_RANK)[status]
     )
     return jnp.where(status == UNKNOWN, UNKNOWN_KEY, key)
 
 
 def decode_key(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Unpack a winning candidate key back to ``(status, incarnation)``."""
-    status = _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
+    status = jnp.asarray(_RANK_TO_STATUS)[(key & 3).astype(jnp.int32)]
     return status, ((key >> 2) & INC_MASK).astype(jnp.int32)
 
 
 def key_status(key: jnp.ndarray) -> jnp.ndarray:
     """Status code of a packed table key; UNKNOWN where no record (key < 0)."""
     return jnp.where(
-        key < 0, jnp.int8(UNKNOWN), _RANK_TO_STATUS[(key & 3).astype(jnp.int32)]
+        key < 0, jnp.int8(UNKNOWN), jnp.asarray(_RANK_TO_STATUS)[(key & 3).astype(jnp.int32)]
     )
 
 

@@ -13,6 +13,7 @@ read loop.
 from __future__ import annotations
 
 import asyncio
+import logging
 from abc import abstractmethod
 from typing import Dict, Optional, Tuple
 
@@ -20,6 +21,8 @@ from ..config import TransportConfig
 from ..models.message import Message
 from .api import Listeners, PeerUnavailableError, Transport, TransportError
 from .codecs import message_codec
+
+logger = logging.getLogger(__name__)
 
 
 def parse_host_port(address: str, scheme: str) -> Tuple[str, int]:
@@ -31,11 +34,14 @@ def parse_host_port(address: str, scheme: str) -> Tuple[str, int]:
 
 
 class CachedConnection:
-    """One cached outbound connection with FIFO write ordering."""
+    """One cached outbound connection with FIFO write ordering and an
+    optional background reader task (protocols that must service inbound
+    control frames on the outbound channel, e.g. WebSocket PING)."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self.writer = writer
         self.lock = asyncio.Lock()
+        self.reader_task: Optional[asyncio.Task] = None
 
     async def write_bytes(self, data: bytes) -> None:
         async with self.lock:
@@ -43,6 +49,8 @@ class CachedConnection:
             await self.writer.drain()
 
     def close(self) -> None:
+        if self.reader_task is not None:
+            self.reader_task.cancel()
         try:
             self.writer.close()
         except Exception:  # noqa: BLE001
@@ -97,6 +105,16 @@ class StreamTransportBase(Transport):
     def _frame(self, payload: bytes) -> bytes:
         """Wrap one encoded message for the wire (length prefix / ws frame)."""
 
+    def _start_outbound_reader(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        conn: "CachedConnection",
+        address: str,
+    ) -> None:
+        """Hook: service the outbound channel's inbound half (control frames
+        / peer replies). Default: nothing to read on a raw stream."""
+
     # -- Transport contract --------------------------------------------------
     @property
     def address(self) -> str:
@@ -126,8 +144,13 @@ class StreamTransportBase(Transport):
                 if payload is None:
                     break
                 self._listeners.emit(self._codec.decode(payload))
-        except (asyncio.IncompleteReadError, ConnectionResetError, TransportError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # peer went away: normal churn
+        except TransportError as exc:
+            # wire-protocol violation (oversized frame, bad upgrade, broken
+            # fragmentation): tear the channel down, but leave a trace — a
+            # silent close makes version-skewed peers undebuggable
+            logger.warning("[%s] dropping inbound connection: %s", self._address, exc)
         finally:
             self._inbound_writers.discard(writer)
             try:
@@ -178,6 +201,7 @@ class StreamTransportBase(Transport):
                 self._config.connect_timeout,
             )
             conn = CachedConnection(writer)
+            self._start_outbound_reader(reader, writer, conn, address)
             fut.set_result(conn)
             return conn
         except Exception as exc:  # noqa: BLE001

@@ -207,5 +207,30 @@ class WebsocketTransport(StreamTransportBase):
             reader, writer, self._config.max_frame_length, server_side=True
         )
 
+    def _start_outbound_reader(self, reader, writer, conn, address) -> None:
+        """The outbound channel's inbound half must be serviced: RFC 6455
+        peers send PINGs (answered inside ``_read_message``) and may CLOSE;
+        unread frames would otherwise rot in the stream buffer until TCP
+        backpressure. Data frames a peer chooses to send back over this
+        channel feed the same listen() stream as server-side ones."""
+
+        async def _drain() -> None:
+            try:
+                while not self._stopped:
+                    payload = await _read_message(
+                        reader, writer, self._config.max_frame_length,
+                        server_side=False,
+                    )
+                    if payload is None:  # peer CLOSE
+                        break
+                    self._listeners.emit(self._codec.decode(payload))
+            except (asyncio.IncompleteReadError, ConnectionResetError, TransportError):
+                pass
+            finally:
+                self._connections.pop(address, None)
+                conn.close()
+
+        conn.reader_task = asyncio.get_running_loop().create_task(_drain())
+
 
 register_transport_factory("websocket", lambda cfg: WebsocketTransport(cfg))

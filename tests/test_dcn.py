@@ -1,0 +1,81 @@
+"""DCN / multi-host smoke tests (SURVEY.md §2.3 DCN row).
+
+Two real OS processes join one ``jax.distributed`` runtime over a
+localhost coordinator, build ONE global 2-device mesh (each process
+contributes its CPU device), materialize the sharded SimState via
+per-process shard callbacks, and run the full tick window SPMD — the
+minimal faithful analogue of a two-slice deployment where the member-axis
+collectives cross DCN.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from scalecube_cluster_tpu.ops import dcn
+from scalecube_cluster_tpu.ops.sharding import make_sharded_run
+from scalecube_cluster_tpu.ops.state import SimParams
+
+port, rank = sys.argv[1], int(sys.argv[2])
+dcn.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2, jax.device_count()  # one CPU device per process
+
+mesh = dcn.global_mesh()
+assert mesh.size == 2
+params = SimParams(capacity=16, fd_every=1, sync_every=8, seed_rows=(0,))
+state = dcn.make_global_state(params, 16, mesh)
+step = make_sharded_run(mesh, params, n_ticks=5)
+state, _key, ms, _w = step(state, jax.random.PRNGKey(0))
+frac = float(np.asarray(ms["alive_view_fraction"])[-1])
+assert frac > 0.99, frac
+print(f"DCN-OK rank={jax.process_index()} frac={frac:.3f}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_global_mesh_runs_sharded_tick():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one device per process, two processes
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(rank)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "DCN-OK" in out, f"rank {rank} output:\n{out}"

@@ -338,6 +338,22 @@ def test_rumor_message_cost_within_cluster_math_bound():
     assert total_sends <= bound, (total_sends, bound)
 
 
+def test_join_rows_matches_sequential_join_row():
+    """The vectorized churn-burst join must be exactly the fold of the
+    single-row join (same epochs, placeholders, ring clearing)."""
+    import dataclasses
+
+    st = S.init_state(PARAMS, 10, warm=True)
+    st = S.crash_row(S.crash_row(st, 3), 7)
+    batched = S.join_rows(st, [3, 7, 12], [0, 1])
+    seq = st
+    for r in (3, 7, 12):
+        seq = S.join_row(seq, r, [0, 1])
+    for f in dataclasses.fields(S.SimState):
+        a, b = getattr(batched, f.name), getattr(seq, f.name)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+
+
 def test_checkpoint_roundtrip(step):
     st = S.init_state(PARAMS, 12, warm=True)
     key = jax.random.PRNGKey(8)
