@@ -111,16 +111,43 @@ def main() -> None:
         f"{convergence_ticks} (warm round: {int(np.argmax(warm_cov >= 1.0)) + 1})"
     )
     log(f"{ticks_per_s:.1f} ticks/s at N={N} -> {speedup:.1f}x real time")
-    print(
-        json.dumps(
-            {
-                "metric": f"swim_sim_speedup_vs_realtime_n{N}",
-                "value": round(speedup, 2),
-                "unit": "x",
-                "vs_baseline": round(speedup, 2),
-            }
-        )
+    result = {
+        "metric": f"swim_sim_speedup_vs_realtime_n{N}",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 2),
+    }
+    # --scaling: also measure 8k/16k active ticks/s (extra multi-GiB states
+    # + 2 compiles, several minutes — kept OUT of the default headline run;
+    # recorded results live in BENCH_RESULTS_r02.json)
+    if "--scaling" in sys.argv and jax.default_backend() != "cpu":
+        curve = {N: round(ticks_per_s, 1)}
+        for n_big in (8192, 16384):
+            curve[n_big] = round(_measure_ticks_per_s(n_big), 1)
+            log(f"{curve[n_big]:.1f} ticks/s at N={n_big}")
+        result["scaling_active_ticks_per_s"] = curve
+    print(json.dumps(result))
+
+
+def _measure_ticks_per_s(n: int) -> float:
+    """Active-dissemination ticks/s at size ``n`` (one rumor round through
+    the sweep window, same protocol params as the headline)."""
+    params = SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
     )
+    budget = gossip_periods_to_sweep(params.repeat_mult, n)
+    state = init_state(params, n, warm=True)
+    step = jax.jit(partial(run_ticks, n_ticks=budget, params=params))
+    key = jax.random.PRNGKey(1)
+    state = S.spread_rumor(state, 0, origin=0)
+    state, key, _ms, _w = step(state, key)  # compile + warm
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    state = S.spread_rumor(state, 0, origin=97)
+    state, key, _ms, _w = step(state, key)
+    jax.block_until_ready(state)
+    return budget / (time.perf_counter() - t0)
 
 
 if __name__ == "__main__":

@@ -405,10 +405,15 @@ def join_rows(state: SimState, rows, seed_rows) -> SimState:
     self_keys = precedence_key(
         jnp.full((k,), ALIVE, jnp.int32), jnp.zeros((k,), jnp.int32), new_epoch
     )
+    # Seed placeholders use POST-burst epochs: if a seed row is itself being
+    # rejoined in this burst, the other joiners must record it at its NEW
+    # epoch (equivalent to folding join_row with the seed rows joined first)
+    # — a stale-epoch placeholder reads as a phantom old identity.
+    epoch_after = state.epoch.at[rows].set(new_epoch)
     seed_keys = precedence_key(
         jnp.full(seed_rows.shape, ALIVE, jnp.int32),
         jnp.zeros(seed_rows.shape, jnp.int32),
-        state.epoch[seed_rows],
+        epoch_after[seed_rows],
     )
     row_key = (
         jnp.full((k, state.capacity), UNKNOWN_KEY, jnp.int32)

@@ -214,6 +214,8 @@ class WebsocketTransport(StreamTransportBase):
         backpressure. Data frames a peer chooses to send back over this
         channel feed the same listen() stream as server-side ones."""
 
+        from .stream_base import logger
+
         async def _drain() -> None:
             try:
                 while not self._stopped:
@@ -224,10 +226,26 @@ class WebsocketTransport(StreamTransportBase):
                     if payload is None:  # peer CLOSE
                         break
                     self._listeners.emit(self._codec.decode(payload))
-            except (asyncio.IncompleteReadError, ConnectionResetError, TransportError):
+            except (asyncio.IncompleteReadError, ConnectionResetError):
                 pass
+            except TransportError as exc:
+                logger.warning(
+                    "[%s] dropping outbound connection to %s: %s",
+                    self._address, address, exc,
+                )
             finally:
-                self._connections.pop(address, None)
+                # evict ONLY if the cache still points at THIS connection — a
+                # stale drain racing a reconnect must not pop (and orphan)
+                # its successor
+                fut = self._connections.get(address)
+                if (
+                    fut is not None
+                    and fut.done()
+                    and not fut.cancelled()
+                    and fut.exception() is None
+                    and fut.result() is conn
+                ):
+                    self._connections.pop(address, None)
                 conn.close()
 
         conn.reader_task = asyncio.get_running_loop().create_task(_drain())
