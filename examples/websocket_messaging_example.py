@@ -1,0 +1,47 @@
+"""Messaging over WebSocket frames — the reference's
+WebsocketMessagingExample: the exact MessagingExample flow with the wire
+protocol swapped by config, demonstrating the transport SPI supports more
+than one real wire (HTTP-upgrade + RFC 6455 binary frames here, vs
+length-prefixed TCP in tcp_messaging_example.py)."""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.message import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local().with_transport(
+        lambda t: t.replace(transport_factory="websocket")
+    )
+    server = await new_cluster(cfg.replace(member_alias="server")).start()
+    print(f"server speaking RFC 6455 on {server.address}")
+
+    def on_message(msg: Message) -> None:
+        if msg.qualifier == "hello":
+            reply = Message.with_data("world", qualifier="hello/ack", cid=msg.correlation_id)
+            asyncio.ensure_future(server.send(msg.sender, reply))
+
+    server.listen_messages().subscribe(on_message)
+
+    client = await new_cluster(
+        cfg.replace(member_alias="client").with_membership(
+            lambda m: m.replace(seed_members=(server.address,))
+        )
+    ).start()
+    await asyncio.sleep(1.0)
+    resp = await client.request_response(
+        client.other_members()[0], Message.with_data("hello", qualifier="hello")
+    )
+    print(f"client got {resp.data!r} over WebSocket")
+    await client.shutdown()
+    await server.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -113,7 +113,9 @@ def make_global_state(
     import numpy as np
 
     host_state = init_state(params, n_initial, **init_kwargs)
-    shardings = state_shardings(mesh, host_state.loss.ndim != 0)
+    shardings = state_shardings(
+        mesh, host_state.loss.ndim != 0, host_state.pending_key.shape[0]
+    )
 
     def _globalize(leaf, sharding):
         arr = np.asarray(leaf)

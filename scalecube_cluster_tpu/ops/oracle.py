@@ -65,8 +65,9 @@ def _sample_distinct_row(mask: np.ndarray, u: np.ndarray):
                 x += 1
         taken.append(x)
         valid[s] = s < c
-        # first j with cs[j] >= x+1 (argmax of the bool row, like the kernel;
-        # all-False -> 0, garbage masked by valid)
+        # first j with cs[j] >= x+1 — same first-hit as the kernel's batched
+        # searchsorted for valid slots (invalid slots yield garbage on both
+        # sides, 0 here vs n-1 there, and are masked via `valid` everywhere)
         idx[s] = int(np.argmax(cs >= x + 1))
     return idx, valid
 

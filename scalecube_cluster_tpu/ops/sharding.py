@@ -32,15 +32,21 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (MEMBER_AXIS,))
 
 
-def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
+def state_shardings(
+    mesh: Mesh, dense_links: bool = True, delay_slots: int = 0
+) -> SimState:
     """A SimState-shaped pytree of NamedShardings: member-axis tensors split
     on rows, small per-rumor/scalar leaves replicated. ``dense_links=False``
     matches states built with a scalar uniform loss (the memory-lean
-    large-N mode), which must be replicated, not row-sharded."""
+    large-N mode), which must be replicated, not row-sharded.
+    ``delay_slots=0`` marks the (empty) pending rings replicated — XLA emits
+    zero-size outputs as replicated, and an explicit row spec on them makes
+    jitted host mutators' outputs clash with the tick's in_shardings."""
     row = NamedSharding(mesh, P(MEMBER_AXIS))
     row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
-    ring = NamedSharding(mesh, P(None, MEMBER_AXIS, None))  # [D, N, ...] rings
     rep = NamedSharding(mesh, P())
+    # [D, N, ...] rings: member axis is dim 1
+    ring = NamedSharding(mesh, P(None, MEMBER_AXIS, None)) if delay_slots else rep
     return SimState(
         tick=rep,
         up=row,
@@ -66,7 +72,10 @@ def state_shardings(mesh: Mesh, dense_links: bool = True) -> SimState:
 
 def shard_state(state: SimState, mesh: Mesh) -> SimState:
     """Place an existing (host/single-device) state onto the mesh."""
-    return jax.device_put(state, state_shardings(mesh, state.loss.ndim != 0))
+    return jax.device_put(
+        state,
+        state_shardings(mesh, state.loss.ndim != 0, state.pending_key.shape[0]),
+    )
 
 
 def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
@@ -79,7 +88,7 @@ def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
         raise ValueError(
             f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
         )
-    sh = state_shardings(mesh, dense_links)
+    sh = state_shardings(mesh, dense_links, params.delay_slots)
     rep = NamedSharding(mesh, P())
     return jax.jit(
         partial(tick, params=params),

@@ -119,7 +119,7 @@ class SimParams:
             suspicion_mult=config.membership.suspicion_mult,
             rumor_slots=sim.rumor_slots,
             seed_rows=tuple(seed_rows),
-            delay_slots=getattr(sim, "delay_slots", 0),
+            delay_slots=sim.delay_slots,
             fd_direct_timeout_ticks=max(
                 0, int(config.failure_detector.ping_timeout / dt)
             ),

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import hashlib
+import logging
 import os
 import struct
 from typing import Optional, Tuple
@@ -28,6 +29,8 @@ from typing import Optional, Tuple
 from ..config import TransportConfig
 from .api import TransportError, register_transport_factory
 from .stream_base import StreamTransportBase, parse_host_port
+
+logger = logging.getLogger(__name__)
 
 _SCHEME = "ws://"
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"  # RFC 6455 §1.3
@@ -213,8 +216,6 @@ class WebsocketTransport(StreamTransportBase):
         unread frames would otherwise rot in the stream buffer until TCP
         backpressure. Data frames a peer chooses to send back over this
         channel feed the same listen() stream as server-side ones."""
-
-        from .stream_base import logger
 
         async def _drain() -> None:
             try:
