@@ -64,6 +64,7 @@ def main() -> None:
         suspicion_mult=5,
         rumor_slots=8,
         seed_rows=(0,),
+        full_metrics=False,  # headline measures throughput; only coverage needed
     )
     budget = gossip_periods_to_sweep(params.repeat_mult, N)
     state = init_state(params, N, warm=True)
@@ -135,6 +136,7 @@ def _measure_ticks_per_s(n: int) -> float:
     params = SimParams(
         capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
         sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
     )
     budget = gossip_periods_to_sweep(params.repeat_mult, n)
     state = init_state(params, n, warm=True)

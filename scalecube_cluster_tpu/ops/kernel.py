@@ -729,12 +729,17 @@ def tick(
     state = _refute_phase(state)
     state = _rumor_sweep(state, params)
 
-    up2 = state.up[:, None] & state.up[None, :]
-    pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
-    off_diag = ~jnp.eye(state.capacity, dtype=bool)
-    rank = state.view_key & 3  # -1 (unknown) reads rank 3, never ALIVE/SUSPECT
-    alive_pairs = (up2 & off_diag & (rank == RANK_ALIVE)).sum()
-    false_suspects = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
+    if params.full_metrics:
+        up2 = state.up[:, None] & state.up[None, :]
+        pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
+        off_diag = ~jnp.eye(state.capacity, dtype=bool)
+        rank = state.view_key & 3  # -1 (unknown) reads rank 3, never ALIVE/SUSPECT
+        alive_pairs = (up2 & off_diag & (rank == RANK_ALIVE)).sum()
+        false_suspects = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
+        alive_frac = alive_pairs.astype(jnp.float32) / pairs
+    else:  # static lite mode: skip the [N, N] health passes
+        alive_frac = jnp.float32(0.0)
+        false_suspects = jnp.int32(0)
     coverage = (
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)
@@ -744,7 +749,7 @@ def tick(
         **g_m,
         **s_m,
         "n_up": state.up.sum(),
-        "alive_view_fraction": alive_pairs.astype(jnp.float32) / pairs,
+        "alive_view_fraction": alive_frac,
         "false_suspect_pairs": false_suspects,
         "rumor_coverage": coverage,  # [R]
     }

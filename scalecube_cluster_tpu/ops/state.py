@@ -82,6 +82,12 @@ class SimParams:
     sync_slots: int = 0
     suspicion_mult: int = 5
     rumor_slots: int = 64
+    # Static switch for the [N, N] health metrics (alive_view_fraction /
+    # false_suspect_pairs). They cost ~3-4 full-matrix passes per tick —
+    # ~20% of an active tick at large N — so throughput-focused runs that
+    # only need rumor coverage / counters can turn them off (the fields are
+    # then emitted as 0, keeping the metrics pytree shape stable for scan).
+    full_metrics: bool = True
     # Rows that act as configured seed members: always in the SYNC peer pool
     # even when absent from the local view (the reference's selectSyncAddress
     # draws from seedMembers ∪ members, MembershipProtocolImpl.java:461-472 —
