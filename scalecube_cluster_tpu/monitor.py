@@ -73,6 +73,9 @@ def sim_snapshot(driver, row: int) -> Dict[str, Any]:
         "tick": driver.tick,
         "cluster_size": int((status <= LEAVING).sum()),
         "incarnation": int(inc[row]),
+        # identity generation of this row (bumps on crash+reuse — the
+        # restart-is-a-new-member rule; see ops.lattice epoch bits)
+        "epoch": int(driver.state.epoch[row]),
         "alive_members": ids(status == ALIVE),
         "suspected_members": ids(status == SUSPECT),
         # DEAD tombstones ARE the removed set (reference removedMembersHistory)
