@@ -138,7 +138,10 @@ class SimConfig:
     capacity: int = 0  # max member rows; 0 -> derived from initial cluster size
     tick_interval: float = 0.2
     rumor_slots: int = 64  # concurrent user-rumor capacity per cluster
-    record_queue: int = 32  # per-node piggyback queue for membership records
+    # reserved: bounded per-node piggyback ring for the sparse record-queue
+    # tick (README §Roadmap); the dense kernel derives the piggyback set
+    # from changed_at ages instead
+    record_queue: int = 32
     dense_links: bool = True  # dense NxN loss/delay matrices (sim emulator)
     delay_slots: int = 0  # pending-delivery ring depth (max link delay + 1 ticks)
     seed: int = 0

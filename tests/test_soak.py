@@ -1,0 +1,61 @@
+"""Opt-in long-haul lockstep soak (set SOAK=1 to run; ~7 min on CPU).
+
+Extends the CI equivalence tests to 200 ticks x many seeds with random
+per-link loss, link delay, churn, graceful leave, and rumor churn — the
+regime where rare f32 threshold edges (delivery draws, timeliness
+polynomials, fetch-gate hashes) would surface as one-cell divergences.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.state as S
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("SOAK"), reason="long soak; set SOAK=1 to run"
+)
+
+PARAMS = S.SimParams(
+    capacity=16, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+    sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0,),
+    delay_slots=4,
+)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_lockstep_soak(seed):
+    import jax.numpy as jnp
+
+    step = jax.jit(partial(K.tick, params=PARAMS))
+    rng = np.random.default_rng(seed)
+    st = S.init_state(PARAMS, 14, warm=True, uniform_delay=1.2)
+    loss = rng.integers(0, 24, size=(16, 16)).astype(np.float32) / 64.0  # exact f32
+    st = st.replace(loss=jnp.asarray(loss), fetch_rt=S._roundtrip(jnp.asarray(loss)))
+    key = jax.random.PRNGKey(1000 + seed)
+    for t in range(200):
+        if t == 20:
+            st = S.crash_row(st, int(rng.integers(2, 14)))
+        if t == 25:
+            st = S.spread_rumor(st, t % 4, origin=int(rng.integers(0, 14)))
+        if t == 60:
+            st = S.join_row(st, 15, seed_rows=[0])
+        if t == 90:
+            st = S.begin_leave(st, 9)
+        if t == 95:
+            st = S.crash_row(st, 9)
+        if t == 120:
+            st = S.spread_rumor(st, 1, origin=2)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = O.oracle_tick(st, k, PARAMS)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
