@@ -1,0 +1,147 @@
+"""Driver config #3b: kernel FD false-positive rate vs the SCALAR engine.
+
+BASELINE.md target: "FD false-positive curves matching a 256-node
+Netty-loopback-equivalent baseline". This runs the SAME experiment on both
+engines at identical parameters and compares the raw per-round probe-failure
+rates:
+
+* scalar side — real `FailureDetector` instances over emulator-wrapped
+  loopback transports with uniform outbound loss (the reference
+  FailureDetectorTest component pattern, FailureDetectorTest.java:415-427),
+  counting SUSPECT verdicts per probe round;
+* kernel side — the vectorized tick at the same N/loss/k, counting
+  `fd_failed_probes` (direct + all relays missed, the same event).
+
+Both should sit on the analytic curve (1-(1-l)^2)·(1-(1-l)^4)^k; the pass
+gate is that the two measured rates agree within combined 3-sigma binomial
+noise. Suspicion is effectively disabled on the kernel side (no refutation
+exists in the scalar FD-only harness either), so the two populations stay
+identical for the whole run.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import asyncio
+
+import numpy as np
+
+from scalecube_cluster_tpu.config import FailureDetectorConfig, TransportConfig
+from scalecube_cluster_tpu.cluster.failure_detector import FailureDetector
+from scalecube_cluster_tpu.models.events import MembershipEvent
+from scalecube_cluster_tpu.models.member import Member, MemberStatus
+from scalecube_cluster_tpu.ops.state import SimParams
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+    bind_transport,
+)
+from scalecube_cluster_tpu.utils.streams import EventStream
+
+from common import TickLoop, emit, log
+
+N = 32
+LOSS = 0.15
+K = 3
+ROUNDS = 200
+PING_INTERVAL = 0.15
+PING_TIMEOUT = 0.05
+
+
+async def scalar_side() -> tuple[int, int]:
+    MemoryTransportRegistry.reset_default()
+    cfg = FailureDetectorConfig(
+        ping_interval=PING_INTERVAL, ping_timeout=PING_TIMEOUT, ping_req_members=K
+    )
+    transports, members = [], []
+    for i in range(N):
+        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+        t.network_emulator.set_default_outbound_settings(loss_percent=100 * LOSS)
+        transports.append(t)
+        members.append(Member(id=f"m{i}", address=t.address))
+    fds, logs = [], []
+    for i in range(N):
+        events = EventStream()
+        fd = FailureDetector(members[i], transports[i], events, cfg)
+        verdicts: list = []
+        fd.listen().subscribe(lambda e, v=verdicts: v.append(e))
+        for j in range(N):
+            if j != i:
+                events.emit(MembershipEvent.added(members[j]))
+        fds.append(fd)
+        logs.append(verdicts)
+    for fd in fds:
+        fd.start()
+    # run until every node has ~ROUNDS verdicts
+    deadline = asyncio.get_running_loop().time() + ROUNDS * PING_INTERVAL + 10
+    while asyncio.get_running_loop().time() < deadline:
+        if min(len({e.period for e in v}) for v in logs) >= ROUNDS:
+            break
+        await asyncio.sleep(0.2)
+    for fd in fds:
+        fd.stop()
+    for t in transports:
+        await t.stop()
+    # A ROUND fails only when every verdict of its period is SUSPECT: an
+    # indirect probe publishes one verdict per relay path (as the reference
+    # does), so a round with any surviving path is not a false positive.
+    probes = failed = 0
+    for verdicts in logs:
+        by_period: dict = {}
+        for e in verdicts:
+            by_period.setdefault(e.period, []).append(e.status)
+        for _period, statuses in sorted(by_period.items())[:ROUNDS]:
+            probes += 1
+            failed += all(s == MemberStatus.SUSPECT for s in statuses)
+    return failed, probes
+
+
+def kernel_side() -> tuple[int, int]:
+    params = SimParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=K, fd_every=1,
+        sync_every=10_000, suspicion_mult=10_000, rumor_slots=2, seed_rows=(0,),
+    )
+    loop = TickLoop(params, N, seed=3, dense_links=False, uniform_loss=LOSS)
+    probes = failed = 0
+    for _ in range(ROUNDS):
+        m = loop.step()
+        probes += int(np.asarray(m["fd_probes"]))
+        failed += int(np.asarray(m["fd_failed_probes"]))
+    return failed, probes
+
+
+def main() -> None:
+    p2 = (1 - LOSS) ** 2
+    p4 = (1 - LOSS) ** 4
+    analytic = (1 - p2) * (1 - p4) ** K
+
+    s_fail, s_probes = asyncio.run(scalar_side())
+    s_rate = s_fail / max(s_probes, 1)
+    log(f"scalar engine: {s_fail}/{s_probes} failed probes -> {s_rate:.5f}")
+
+    k_fail, k_probes = kernel_side()
+    k_rate = k_fail / max(k_probes, 1)
+    log(f"kernel:        {k_fail}/{k_probes} failed probes -> {k_rate:.5f}")
+    log(f"analytic:      {analytic:.5f}")
+
+    sigma = (
+        analytic * (1 - analytic) / max(s_probes, 1)
+        + analytic * (1 - analytic) / max(k_probes, 1)
+    ) ** 0.5
+    ok = abs(s_rate - k_rate) < 3 * sigma
+    emit({
+        "config": "3b", "metric": "fd_fp_rate_scalar_vs_kernel", "n": N,
+        "loss_pct": 100 * LOSS, "scalar_rate": round(s_rate, 6),
+        "kernel_rate": round(k_rate, 6), "analytic": round(analytic, 6),
+        "scalar_probes": s_probes, "kernel_probes": k_probes,
+        "within_3_sigma": bool(ok),
+    })
+
+
+if __name__ == "__main__":
+    main()

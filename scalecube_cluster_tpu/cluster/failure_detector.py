@@ -252,7 +252,7 @@ class FailureDetector:
     # -- verdicts ----------------------------------------------------------
     def _publish(self, period: int, member: Member, status: MemberStatus) -> None:
         _log.debug("[%s][%s] member %s detected as %s", self._local, period, member, status.name)
-        self._events.emit(FailureDetectorEvent(member, status))
+        self._events.emit(FailureDetectorEvent(member, status, period=period))
 
     @staticmethod
     def _compute_status(ack: Message) -> MemberStatus:

@@ -86,10 +86,15 @@ class MembershipEvent:
 
 @dataclass(frozen=True)
 class FailureDetectorEvent:
-    """Per-probe verdict emitted by the failure detector toward membership."""
+    """Per-probe verdict emitted by the failure detector toward membership.
+
+    ``period`` is the FD round that produced the verdict (an indirect probe
+    publishes one verdict per relay path, all for the same period — group by
+    it to reason about whole rounds)."""
 
     member: Member
     status: MemberStatus
+    period: Optional[int] = None
 
     def __str__(self) -> str:
         return f"FailureDetectorEvent({self.member}, {self.status.name})"

@@ -337,6 +337,11 @@ def _fd_phase(
     )
     metrics = {
         "fd_probes": has_tgt.sum(),
+        # raw per-round failures (direct + ALL relay paths missed) — compare
+        # against scalar rounds whose EVERY relay verdict was SUSPECT (an
+        # indirect round emits one verdict per relay; see
+        # benchmarks/config3b_scalar_vs_kernel_fd.py's per-period grouping)
+        "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (accept & ~ack).sum(),
     }
     return st, metrics
@@ -718,7 +723,11 @@ def tick(
         return _fd_phase(st, fd_r, params)
 
     def _fd_off(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
-        return st, {"fd_probes": jnp.int32(0), "fd_new_suspects": jnp.int32(0)}
+        return st, {
+            "fd_probes": jnp.int32(0),
+            "fd_failed_probes": jnp.int32(0),
+            "fd_new_suspects": jnp.int32(0),
+        }
 
     state, fd_m = jax.lax.cond(
         (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state

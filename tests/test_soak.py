@@ -29,13 +29,16 @@ PARAMS = S.SimParams(
     sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0,),
     delay_slots=4,
 )
+# one shared executable across all 12 seeds (re-jitting per test would
+# recompile the identical kernel 12 times)
+_STEP = jax.jit(partial(K.tick, params=PARAMS))
 
 
 @pytest.mark.parametrize("seed", range(12))
 def test_lockstep_soak(seed):
     import jax.numpy as jnp
 
-    step = jax.jit(partial(K.tick, params=PARAMS))
+    step = _STEP
     rng = np.random.default_rng(seed)
     st = S.init_state(PARAMS, 14, warm=True, uniform_delay=1.2)
     loss = rng.integers(0, 24, size=(16, 16)).astype(np.float32) / 64.0  # exact f32
