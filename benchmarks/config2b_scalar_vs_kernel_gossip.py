@@ -1,0 +1,136 @@
+"""Driver config #2b: rumor dissemination rounds, scalar engine vs kernel.
+
+The reference's headline experiment (GossipProtocolTest.java:47-63: spread a
+rumor, assert full delivery, log convergence) run on BOTH engines at the
+same {N, loss, fanout, repeat_mult}:
+
+* scalar — real GossipProtocol instances over emulator loopback transports;
+  convergence time measured in gossip periods (wall time / interval);
+* kernel — the vectorized tick at identical parameters; convergence tick
+  from the rumor-coverage metric.
+
+Pass gate: both engines' mean rounds sit inside the analytic spread window
+and within a couple of rounds of each other — the dissemination dynamics of
+the simulation match the real protocol implementation, not just the math.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import asyncio
+import time
+
+import numpy as np
+
+from scalecube_cluster_tpu.config import GossipConfig, TransportConfig
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.models.events import MembershipEvent
+from scalecube_cluster_tpu.models.member import Member
+from scalecube_cluster_tpu.models.message import Message
+from scalecube_cluster_tpu.ops.state import SimParams
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.transport import (
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+    bind_transport,
+)
+from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_spread
+from scalecube_cluster_tpu.utils.streams import EventStream
+
+from common import TickLoop, emit, log
+
+N = 24
+INTERVAL = 0.05
+TRIALS = 5
+CONFIG = GossipConfig(gossip_interval=INTERVAL, gossip_fanout=3, gossip_repeat_mult=3)
+
+
+async def scalar_trial(loss_pct: float) -> float:
+    MemoryTransportRegistry.reset_default()
+    transports, members, protocols, received = [], [], [], []
+    for i in range(N):
+        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+        t.network_emulator.set_default_outbound_settings(loss_pct, 0.002)
+        transports.append(t)
+        members.append(Member(id=f"g{i}", address=t.address))
+    for i in range(N):
+        events = EventStream()
+        gp = GossipProtocol(members[i], transports[i], events, CONFIG)
+        inbox: list = []
+        gp.listen().subscribe(lambda m, inbox=inbox: inbox.append(m.data))
+        for j in range(N):
+            if j != i:
+                events.emit(MembershipEvent.added(members[j]))
+        protocols.append(gp)
+        received.append(inbox)
+    try:
+        for gp in protocols:
+            gp.start()
+        t0 = time.perf_counter()
+        protocols[0].spread(Message.with_data("r", qualifier="bench/rumor"))
+        deadline = t0 + 30.0
+        while time.perf_counter() < deadline:
+            if all(len(inbox) >= 1 for inbox in received[1:]):
+                break
+            await asyncio.sleep(0.005)
+        elapsed = time.perf_counter() - t0
+        assert all(len(inbox) == 1 for inbox in received[1:]), "delivery failed"
+        return elapsed / INTERVAL  # rounds
+    finally:
+        for gp in protocols:
+            gp.stop()
+        for t in transports:
+            await t.stop()
+
+
+def kernel_trials(loss: float) -> list:
+    params = SimParams(
+        capacity=N, fanout=3, repeat_mult=3, fd_every=5, sync_every=10_000,
+        suspicion_mult=10_000, rumor_slots=2, seed_rows=(0,),
+    )
+    rounds = []
+    for seed in range(TRIALS):
+        loop = TickLoop(params, N, seed=seed, dense_links=False, uniform_loss=loss)
+        loop.state = S.spread_rumor(loop.state, 0, origin=seed % N)
+        for t in range(200):
+            m = loop.step()
+            if float(np.asarray(m["rumor_coverage"])[0]) >= 1.0:
+                rounds.append(t + 1)
+                break
+    return rounds
+
+
+def main() -> None:
+    for loss_pct in (0.0, 25.0):
+        scalar_rounds = [
+            asyncio.run(scalar_trial(loss_pct)) for _ in range(TRIALS)
+        ]
+        k_rounds = kernel_trials(loss_pct / 100.0)
+        bound = gossip_periods_to_spread(3, N)
+        s_mean = float(np.mean(scalar_rounds))
+        k_mean = float(np.mean(k_rounds))
+        log(
+            f"loss={loss_pct}%: scalar rounds {[round(r, 1) for r in scalar_rounds]}"
+            f" (mean {s_mean:.1f}), kernel rounds {k_rounds} (mean {k_mean:.1f}),"
+            f" analytic window {bound}"
+        )
+        ok = (
+            s_mean <= bound
+            and k_mean <= bound
+            and abs(s_mean - k_mean) <= max(2.0, 0.5 * max(s_mean, k_mean))
+        )
+        emit({
+            "config": "2b", "metric": "gossip_rounds_scalar_vs_kernel", "n": N,
+            "loss_pct": loss_pct, "scalar_mean_rounds": round(s_mean, 2),
+            "kernel_mean_rounds": round(k_mean, 2),
+            "analytic_spread_rounds": bound, "ok": bool(ok),
+        })
+
+
+if __name__ == "__main__":
+    main()
