@@ -33,6 +33,28 @@ def make_step(params: SimParams, donate: bool = True):
     return jax.jit(partial(tick, params=params), donate_argnums=0 if donate else ())
 
 
+async def make_emulated_mesh(n: int, loss_percent: float = 0.0, mean_delay: float = 0.0):
+    """n emulator-wrapped loopback transports + Member handles — the shared
+    scaffolding of the scalar-engine component benchmarks (the reference
+    FailureDetectorTest/GossipProtocolTest network pattern)."""
+    from scalecube_cluster_tpu.config import TransportConfig
+    from scalecube_cluster_tpu.models.member import Member
+    from scalecube_cluster_tpu.transport import (
+        MemoryTransportRegistry,
+        NetworkEmulatorTransport,
+        bind_transport,
+    )
+
+    MemoryTransportRegistry.reset_default()
+    transports, members = [], []
+    for i in range(n):
+        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
+        t.network_emulator.set_default_outbound_settings(loss_percent, mean_delay)
+        transports.append(t)
+        members.append(Member(id=f"m{i}", address=t.address))
+    return transports, members
+
+
 class TickLoop:
     """Minimal stepping harness (the SimDriver without host-side extras —
     benchmark loops must not force per-tick device syncs)."""

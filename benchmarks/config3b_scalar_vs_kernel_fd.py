@@ -31,19 +31,14 @@ import asyncio
 
 import numpy as np
 
-from scalecube_cluster_tpu.config import FailureDetectorConfig, TransportConfig
+from scalecube_cluster_tpu.config import FailureDetectorConfig
 from scalecube_cluster_tpu.cluster.failure_detector import FailureDetector
 from scalecube_cluster_tpu.models.events import MembershipEvent
-from scalecube_cluster_tpu.models.member import Member, MemberStatus
+from scalecube_cluster_tpu.models.member import MemberStatus
 from scalecube_cluster_tpu.ops.state import SimParams
-from scalecube_cluster_tpu.transport import (
-    MemoryTransportRegistry,
-    NetworkEmulatorTransport,
-    bind_transport,
-)
 from scalecube_cluster_tpu.utils.streams import EventStream
 
-from common import TickLoop, emit, log
+from common import TickLoop, emit, log, make_emulated_mesh
 
 N = 32
 LOSS = 0.15
@@ -54,16 +49,10 @@ PING_TIMEOUT = 0.05
 
 
 async def scalar_side() -> tuple[int, int]:
-    MemoryTransportRegistry.reset_default()
     cfg = FailureDetectorConfig(
         ping_interval=PING_INTERVAL, ping_timeout=PING_TIMEOUT, ping_req_members=K
     )
-    transports, members = [], []
-    for i in range(N):
-        t = NetworkEmulatorTransport(await bind_transport(TransportConfig()))
-        t.network_emulator.set_default_outbound_settings(loss_percent=100 * LOSS)
-        transports.append(t)
-        members.append(Member(id=f"m{i}", address=t.address))
+    transports, members = await make_emulated_mesh(N, loss_percent=100 * LOSS)
     fds, logs = [], []
     for i in range(N):
         events = EventStream()
