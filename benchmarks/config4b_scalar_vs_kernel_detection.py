@@ -1,0 +1,146 @@
+"""Driver config #4b: crash-detection latency, scalar engine vs kernel.
+
+Completes the cross-engine validation triad (2b: gossip dissemination,
+3b: FD false positives): an 8-node cluster loses one member without
+goodbye; measure how long an observer takes to REMOVE it. Both engines run
+the same protocol constants, so both should land just past the same
+suspicion math (detect + suspicion timeout + dissemination):
+
+* scalar — full Cluster facade over emulator loopback; the "crash" is a
+  total block of the victim's links (reference partition-until-removed
+  family, MembershipProtocolTest); latency measured in wall seconds;
+* kernel — same constants in tick units; latency = ticks × tick_interval.
+
+Pass gate: both latencies exceed the analytic suspicion timeout and agree
+within 60% + 1 s of each other.
+"""
+
+from __future__ import annotations
+
+import pathlib as _p
+import sys as _s
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import asyncio
+import time
+
+import numpy as np
+
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig, TransportConfig
+from scalecube_cluster_tpu.ops.state import SimParams
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.transport import (
+    MemoryTransport,
+    MemoryTransportRegistry,
+    NetworkEmulatorTransport,
+)
+from scalecube_cluster_tpu.utils.cluster_math import suspicion_timeout
+
+from common import TickLoop, emit, log
+
+N = 8
+TICK = 0.05          # gossip interval (one kernel tick)
+PING_INTERVAL = 0.2  # = 4 ticks
+SUSPICION_MULT = 3
+
+
+def _config(seeds=()):
+    return (
+        ClusterConfig.default_local()
+        .with_membership(
+            lambda m: m.replace(
+                seed_members=list(seeds), sync_interval=0.4, sync_timeout=0.4,
+                suspicion_mult=SUSPICION_MULT,
+            )
+        )
+        .with_failure_detector(
+            lambda f: f.replace(
+                ping_interval=PING_INTERVAL, ping_timeout=0.1, ping_req_members=2
+            )
+        )
+        .with_gossip(lambda g: g.replace(gossip_interval=TICK, gossip_repeat_mult=3))
+    )
+
+
+async def scalar_side() -> float | None:
+    MemoryTransportRegistry.reset_default()
+    nodes, emulators = [], []
+    seed_addr = []
+    for i in range(N):
+        emu = NetworkEmulatorTransport(MemoryTransport(TransportConfig()))
+        node = await new_cluster(_config(seed_addr)).transport_factory(lambda e=emu: e).start()
+        nodes.append(node)
+        emulators.append(emu.network_emulator)
+        if not seed_addr:
+            seed_addr = [node.address]
+    try:
+        deadline = time.perf_counter() + 20
+        while time.perf_counter() < deadline:
+            if all(len(n.members()) == N for n in nodes):
+                break
+            await asyncio.sleep(0.05)
+        if not all(len(n.members()) == N for n in nodes):
+            return None  # cluster never converged: reported, not raised
+        victim, observer = nodes[N - 1], nodes[0]
+        em = emulators[N - 1]
+        t0 = time.perf_counter()
+        em.block_all_outbound()
+        em.block_all_inbound()
+        deadline = t0 + 60
+        while time.perf_counter() < deadline:
+            if all(m.id != victim.member().id for m in observer.members()):
+                break
+            await asyncio.sleep(0.05)
+        detected = time.perf_counter() - t0
+        if any(m.id == victim.member().id for m in observer.members()):
+            return None  # never removed within budget
+        return detected
+    finally:
+        for n in nodes:
+            await n.shutdown()
+
+
+def kernel_side() -> float | None:
+    params = SimParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=2,
+        fd_every=round(PING_INTERVAL / TICK), sync_every=round(0.4 / TICK),
+        suspicion_mult=SUSPICION_MULT, rumor_slots=2, seed_rows=(0,),
+    )
+    loop = TickLoop(params, N, seed=1, dense_links=True)
+    loop.state = S.crash_row(loop.state, N - 1)
+    for t in range(2000):
+        loop.step()
+        # observer row 0 no longer lists the victim as a live member
+        k = int(np.asarray(loop.state.view_key[0, N - 1]))
+        if k >= 0 and (k & 3) == 3:  # DEAD = removed at the API level
+            return (t + 1) * TICK
+    return None  # never detected within budget: reported, not raised
+
+
+def main() -> None:
+    analytic = suspicion_timeout(SUSPICION_MULT, N, PING_INTERVAL)
+    s = asyncio.run(scalar_side())
+    k = kernel_side()
+    log(f"scalar removal latency: {s}s, kernel: {k}s, "
+        f"suspicion math: {analytic:.2f}s")
+    ok = (
+        s is not None
+        and k is not None
+        and s >= analytic  # removal must wait out the suspicion window
+        and k >= analytic
+        and abs(s - k) <= 0.6 * max(s, k) + 1.0
+    )
+    emit({
+        "config": "4b", "metric": "crash_removal_latency_scalar_vs_kernel",
+        "n": N,
+        "scalar_seconds": round(s, 2) if s is not None else None,
+        "kernel_seconds": round(k, 2) if k is not None else None,
+        "suspicion_math_seconds": round(analytic, 2), "ok": bool(ok),
+    })
+
+
+if __name__ == "__main__":
+    main()
