@@ -6,11 +6,13 @@ One call advances simulated time by one gossip period and runs, in order:
    target uniformly from its live view (the reference's shuffled round-robin,
    ``FailureDetectorImpl.selectPingMember:352-361``; random-without-
    replacement has the same per-round marginal), direct ping succeeds with
-   probability ``(1-loss_ij)(1-loss_ji)`` iff the target is up; on failure,
-   ``k`` relays run the indirect probe (``doPingReq:173-210``); all-fail ⇒
-   SUSPECT verdict, any-ack ⇒ ALIVE verdict carrying the target's current
-   self-incarnation (the effect of the reference's ALIVE-again SYNC,
-   ``MembershipProtocolImpl.onFailureDetectorEvent:427-442``). The
+   probability ``(1-loss_ij)(1-loss_ji)`` (times the chance the round trip
+   beats pingTimeout under the delay model) iff the target is up; on
+   failure, ``k`` relays run the indirect probe (``doPingReq:173-210``);
+   all-fail ⇒ SUSPECT verdict, any-ack ⇒ ALIVE verdict carrying the
+   target's current self-key — including its identity EPOCH, so a probe of
+   a restarted row overrides the stale identity in one step (the DEST_GONE
+   verdict, ``computeMemberStatus:382-404``; see :mod:`.lattice`). The
    sub-interval ping timeout + remainder-of-interval indirect window
    collapse into phases of a single tick (SURVEY.md §7 hard part i).
 2. **Suspicion sweep** — SUSPECT entries older than
@@ -21,10 +23,17 @@ One call advances simulated time by one gossip period and runs, in order:
    peers (``selectGossipMembers:322-343``) and sends one message carrying
    (a) every membership record changed within the last
    ``repeat_mult*ceil_log2(n_i)`` ticks (``selectGossipsToSend:311-320``)
-   and (b) every young user rumor it's infected with. Delivery is one
-   Bernoulli draw per edge. Receivers fold records in via the scatter-max
-   precedence-key join (:mod:`.lattice`) and OR in rumor infections (bitmap
-   OR = the SequenceIdCollector dedup — double delivery is impossible).
+   and (b) every young user rumor it's infected with, MINUS rumors the peer
+   is known to have (its delivery source / origin — the reference's
+   per-gossip infected set, ``GossipState.java:18``), which keeps message
+   cost inside the ClusterMath bound. Delivery is one Bernoulli draw per
+   edge plus a geometric delay draw: messages land 0..D-1 ticks later
+   through scatter-max pending rings. Receivers fold records in via the
+   scatter-max precedence-key join (:mod:`.lattice`) — ALIVE winners gated
+   on a metadata-fetch round trip to the subject
+   (``MembershipProtocolImpl.java:636-658``) — and OR in rumor infections
+   (bitmap OR = the SequenceIdCollector dedup — double delivery is
+   impossible).
 4. **SYNC phase** — nodes whose stagger slot matches (or with
    ``force_sync``, the join bootstrap) pick one random live peer and run the
    full-table exchange: request merge into the peer, then the peer's merged
@@ -34,8 +43,10 @@ One call advances simulated time by one gossip period and runs, in order:
    bumps its incarnation and re-announces ALIVE
    (``onSelfMemberDetected:686-708``), which re-enters the gossip stream via
    ``changed_at``.
-6. **Rumor sweep** — slots older than ``2*(spread+1)`` periods deactivate
-   (``getGossipsToRemove:350-358``).
+6. **Rumor sweep** — a slot is reclaimed once its creation window
+   (``2*(spread+1)`` periods, ``getGossipsToRemove:350-358``) has passed,
+   no copy is still in flight, and no receiver is inside its own forwarding
+   window (the reference's per-node hold after arrival).
 
 Everything is static-shaped and branch-free (masks, no Python control flow
 on traced values); the per-tick cost is O(N²·fanout) elementwise work — no
