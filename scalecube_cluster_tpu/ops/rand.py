@@ -109,6 +109,66 @@ def fetch_uniform(tick, salt: int, i, j, xp=jnp):
     return (b >> u32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
 
 
+class SparseFdRandoms(NamedTuple):
+    """Sparse-mode FD draws: rejection-sampling tries instead of rank draws."""
+
+    fd_try: jax.Array  # [N, (1+k)*T] uniforms -> column tries
+    fd_direct: jax.Array  # [N]
+    fd_relay: jax.Array  # [N, k]
+
+
+class SparseRoundRandoms(NamedTuple):
+    gossip_try: jax.Array  # [N, f*T]
+    gossip_edge: jax.Array  # [N, f]
+    gossip_delay: jax.Array  # [N, f]
+    sync_try: jax.Array  # [N, T]
+    sync_edge: jax.Array  # [N]
+
+
+class SparseRandoms(NamedTuple):
+    """Union view for the sparse scalar oracle."""
+
+    fd_try: jax.Array
+    fd_direct: jax.Array
+    fd_relay: jax.Array
+    gossip_try: jax.Array
+    gossip_edge: jax.Array
+    gossip_delay: jax.Array
+    sync_try: jax.Array
+    sync_edge: jax.Array
+
+
+def draw_sparse_fd(key: jax.Array, n: int, ping_req_k: int, tries: int) -> SparseFdRandoms:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return SparseFdRandoms(
+        fd_try=jax.random.uniform(k1, (n, (1 + ping_req_k) * tries), dtype=jnp.float32),
+        fd_direct=jax.random.uniform(k2, (n,), dtype=jnp.float32),
+        fd_relay=jax.random.uniform(k3, (n, ping_req_k), dtype=jnp.float32),
+    )
+
+
+def draw_sparse_round(key: jax.Array, n: int, fanout: int, tries: int) -> SparseRoundRandoms:
+    k4, k5, k6, k7, k8 = jax.random.split(key, 5)
+    return SparseRoundRandoms(
+        gossip_try=jax.random.uniform(k4, (n, fanout * tries), dtype=jnp.float32),
+        gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
+        gossip_delay=jax.random.uniform(k8, (n, fanout), dtype=jnp.float32),
+        sync_try=jax.random.uniform(k6, (n, tries), dtype=jnp.float32),
+        sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
+    )
+
+
+def draw_sparse_randoms(
+    key: jax.Array, n: int, fanout: int, ping_req_k: int, tries: int
+) -> SparseRandoms:
+    """All of a sparse tick's draws (oracle-side convenience; matches the
+    kernel's two-subkey layout exactly)."""
+    fd_key, round_key = split_tick_key(key)
+    fd = draw_sparse_fd(fd_key, n, ping_req_k, tries)
+    rd = draw_sparse_round(round_key, n, fanout, tries)
+    return SparseRandoms(*fd, *rd)
+
+
 def split_tick_key(key: jax.Array) -> tuple[jax.Array, jax.Array]:
     """(fd_key, round_key). FD draws live under their own subkey so the
     kernel can skip generating them entirely on non-FD ticks (lax.cond)

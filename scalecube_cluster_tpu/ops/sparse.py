@@ -1,0 +1,1270 @@
+"""The sparse ("record-queue") SWIM tick: large-N mode without O(N²) per-tick work.
+
+The dense kernel (:mod:`.kernel`) does O(N²) elementwise work per active tick
+(young-window scan + whole-row piggyback + dense suspicion sweep), which is
+measured ~N²-shaped and sub-realtime past ~16k members on one chip. This
+module is the scaling mode SURVEY.md §7 hard part (v) prescribes — per-tick
+work O(N·f·log N)-ish, not O(N²) — built the way the reference itself
+disseminates membership: **membership changes are gossips**. Every accepted
+non-gossip update is re-gossiped by the reference
+(``MembershipProtocolImpl.spreadMembershipGossipUnlessGossiped:836-843``);
+here those records live in a bounded pool of M membership-rumor slots
+(subject row + packed precedence key + origin) with per-node infection ages,
+spread by the exact infection-style protocol user rumors already use
+(``GossipProtocolImpl`` semantics). Dissemination cost scales with *change
+rate*, not with N²:
+
+* the only [N, N] plane is ``view_key`` itself (4 B/cell — ``changed_at`` is
+  gone: gossip ages live on the rumors, suspicion stamps on the episodes);
+* per-tick gossip work is O(N·M) on a one-byte infection-age plane (u8),
+  plus O(N·f·T) for peer sampling; M is sized by live-change volume
+  (events/tick × spread window), far below N for every real workload;
+* peer selection is bounded **rejection sampling** (T uniform tries against
+  the live view) instead of the dense kernel's exact rank-insertion over an
+  [N, N] cumsum;
+* suspicion timers are per-subject **episode stamps** (``sus_key`` /
+  ``sus_since``, [N]) checked by a dense expiry sweep only every
+  ``sweep_every`` ticks — O(N²/B) amortized;
+* SYNC stays the dense kernel's compacted-caller O(K·N) design (anti-entropy
+  is *supposed* to move whole tables);
+* the delay model composes leanly: pending infection rings are [D, N, M]
+  (never [D, N, N]), and FD/SYNC round trips use the same closed-form
+  timeliness factors as the dense kernel (VERDICT r2 item #4).
+
+Deliberate deviations from the reference (each mirrored bit-exactly by the
+scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
+
+1. **Suspicion timer per episode, not per cell.** The reference schedules a
+   timer per (observer, subject) at its own accept time
+   (``scheduleSuspicionTimeoutTask:805-823``). Here the FIRST registration of
+   a suspicion episode (subject + key, at any observer) stamps
+   ``sus_since[subject]``; every observer expires against that stamp, checked
+   every ``sweep_every`` ticks. Late-learning observers therefore expire up
+   to one dissemination delay (≪ timeout: spread ≈ 3·log2 N ticks vs timeout
+   = 5·log2 N·fd_every) earlier than their private timer would — the
+   refutation window the timeout exists to provide is preserved.
+2. **Origin-only known-infected filter.** The dense kernel tracks one
+   delivering peer per infection (``infected_from``); per-source tracking for
+   membership rumors would cost a 4 B/cell [N, M] plane and ~3 extra passes
+   per tick — the exact cost this mode exists to avoid. Senders skip only the
+   rumor's origin. (User rumors keep the full filter — their pool is tiny.)
+3. **Bounded announcements.** New-rumor allocation is capped per tick
+   (``announce_slots``) and per SYNC participant (``sync_announce`` — the
+   reference re-gossips every sync-accepted record); the suspicion sweep
+   announces one expiry per observer per sweep (every observer's own timer
+   fires anyway — the rumor merely accelerates). Overflow is counted
+   (``announce_dropped`` metric) and heals via SYNC, exactly like the
+   reference's dropped gossip under backpressure.
+4. **Bounded rejection sampling** can miss a pick with probability
+   (1 - live_fraction)^T per draw (T = ``sample_tries``); a miss skips that
+   probe/peer for one round — statistically negligible at the live fractions
+   SWIM operates at, and the scalar oracle consumes identical draws.
+5. **Early rumor free**: a membership rumor whose up-members are all infected
+   (and nothing in flight) frees its slot before the reference's age-based
+   sweep (``getGossipsToRemove:350-358``) would — fewer redundant sends, no
+   semantic difference (every reachable node already merged it). Age-based
+   sweep still bounds the lifetime of never-fully-covered rumors.
+
+Memory at flagship scale (v5e, 16 GB/chip): N=98,304 sharded over 8 chips =
+4.8 GB/chip for ``view_key`` + 0.4 GB for a 32k-slot ``minf_age`` plane; the
+single-chip ceiling is N≈57k (13 GB view_key) — N=65,536 needs 17.2 GB for
+the view matrix alone and cannot fit one 16 GB chip at 4 B/cell.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .kernel import ceil_log2
+from .lattice import (
+    ALIVE,
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEAVING,
+    RANK_SUSPECT,
+    UNKNOWN_KEY,
+    precedence_key,
+)
+from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
+    SparseRandoms,
+    draw_sparse_fd,
+    draw_sparse_round,
+    fetch_uniform,
+    split_tick_key,
+)
+from .state import ALIVE0_KEY, NEVER, NO_CANDIDATE_I32, delay_mean_to_q
+
+NO_CANDIDATE = NO_CANDIDATE_I32
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseParams:
+    """Static parameters of the sparse tick (hashable; close over in jit).
+
+    Shared protocol knobs mirror :class:`.state.SimParams` (same reference
+    anchors); the sparse-only knobs size the bounded structures:
+    ``mr_slots`` (M, membership-rumor pool), ``announce_slots`` (E, new
+    rumors per tick), ``sample_tries`` (T, rejection draws per pick),
+    ``sweep_every`` (B, suspicion expiry period), ``sync_announce`` (P,
+    re-gossip cap per SYNC participant).
+    """
+
+    capacity: int
+    fanout: int = 3
+    repeat_mult: int = 3
+    ping_req_k: int = 3
+    fd_every: int = 5
+    sync_every: int = 150
+    sync_stagger: int = 1
+    suspicion_mult: int = 5
+    sweep_every: int = 8
+    sample_tries: int = 8
+    rumor_slots: int = 16
+    mr_slots: int = 1024
+    announce_slots: int = 256
+    sync_slots: int = 0
+    sync_announce: int = 2
+    delay_slots: int = 0
+    fd_direct_timeout_ticks: int = 2
+    fd_leg_timeout_ticks: int = 1
+    sync_timeout_ticks: int = 15
+    seed_rows: tuple = ()
+    early_free: bool = True
+    full_metrics: bool = False
+
+
+class SparseState(struct.PyTreeNode):
+    """Lean large-N simulation state.
+
+    ``view_key[i, j]`` — as the dense :class:`.state.SimState`: node i's
+    record for j as the packed monotone precedence key (:mod:`.lattice`),
+    -1 unknown. The ONLY N×N plane.
+
+    ``n_live[i]`` — incrementally maintained count of non-DEAD known columns
+    in row i (incl. self): drives every ``ceilLog2(cluster size)`` knob
+    (``ClusterMath.java:111-135``) without an O(N²) recount.
+
+    ``sus_key[subject]`` / ``sus_since[subject]`` — current suspicion
+    episode: the highest SUSPECT-rank key ever accepted about ``subject`` and
+    the tick its value last rose (deviation 1 above).
+
+    Membership-rumor pool (M slots): ``mr_subject/mr_key/mr_origin/
+    mr_created/mr_active`` + the u8 infection-age plane ``minf_age[i, m]``
+    (0 = not infected; else ticks-since-infection + 1, saturating at 255 —
+    every forwarding window is ≤ ``repeat_mult·ceilLog2(N) < 255``). Infection
+    marking doubles as the reference's ``SequenceIdCollector`` dedup: a rumor
+    is applied to the table exactly once per receiver, at first infection.
+
+    User-rumor pool: identical fields/semantics to the dense state
+    (``rumor_*``, ``infected*`` — the full known-infected filter retained).
+
+    Links: ``loss`` / ``fetch_rt`` / ``delay_q`` scalar (uniform, the lean
+    default) or dense [N, N] (emulator mode at moderate N).
+    """
+
+    tick: jax.Array
+    up: jax.Array  # bool [N]
+    epoch: jax.Array  # i32 [N]
+    view_key: jax.Array  # i32 [N, N]
+    n_live: jax.Array  # i32 [N]
+    sus_key: jax.Array  # i32 [N]
+    sus_since: jax.Array  # i32 [N]
+    force_sync: jax.Array  # bool [N]
+    leaving: jax.Array  # bool [N]
+    mr_active: jax.Array  # bool [M]
+    mr_subject: jax.Array  # i32 [M]
+    mr_key: jax.Array  # i32 [M]
+    mr_created: jax.Array  # i32 [M]
+    mr_origin: jax.Array  # i32 [M]
+    minf_age: jax.Array  # u8 [N, M]
+    rumor_active: jax.Array  # bool [R]
+    rumor_origin: jax.Array  # i32 [R]
+    rumor_created: jax.Array  # i32 [R]
+    infected: jax.Array  # bool [N, R]
+    infected_at: jax.Array  # i32 [N, R]
+    infected_from: jax.Array  # i32 [N, R]
+    loss: jax.Array
+    fetch_rt: jax.Array
+    delay_q: jax.Array
+    pending_minf: jax.Array  # bool [D, N, M]
+    pending_inf: jax.Array  # bool [D, N, R]
+    pending_src: jax.Array  # i32 [D, N, R]
+
+    @property
+    def capacity(self) -> int:
+        return self.up.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# construction + host mutators
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip(loss: jax.Array) -> jax.Array:
+    if loss.ndim == 0:
+        return ((1.0 - loss) * (1.0 - loss)).astype(jnp.float32)
+    return ((1.0 - loss) * (1.0 - loss.T)).astype(jnp.float32)
+
+
+def init_sparse_state(
+    params: SparseParams,
+    n_initial: int,
+    warm: bool = True,
+    dense_links: bool = False,
+    uniform_loss: float = 0.0,
+    uniform_delay: float = 0.0,
+) -> SparseState:
+    """Fresh sparse-mode simulation; rows ``0..n_initial-1`` up.
+
+    ``dense_links`` defaults to False (scalar uniform loss) — this mode
+    exists for N where an [N, N] float link matrix is unaffordable; pass
+    True for emulator-controlled runs at moderate N."""
+    n, m, r = params.capacity, params.mr_slots, params.rumor_slots
+    up = jnp.arange(n) < n_initial
+    if warm:
+        known = up[:, None] & up[None, :]
+        view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
+        n_live = jnp.where(up, n_initial, 0).astype(jnp.int32)
+    else:
+        diag = jnp.eye(n, dtype=bool) & up[:, None]
+        view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
+        n_live = up.astype(jnp.int32)
+    if uniform_delay > 0 and params.delay_slots <= 0:
+        raise ValueError("uniform_delay > 0 requires params.delay_slots > 0")
+    loss = (
+        jnp.full((n, n), uniform_loss, jnp.float32)
+        if dense_links
+        else jnp.float32(uniform_loss)
+    )
+    q = delay_mean_to_q(uniform_delay)
+    delay_q = jnp.full((n, n), q, jnp.float32) if dense_links else jnp.float32(q)
+    d = max(0, params.delay_slots)
+    return SparseState(
+        tick=jnp.int32(0),
+        up=up,
+        epoch=jnp.zeros((n,), jnp.int32),
+        view_key=view_key,
+        n_live=n_live,
+        sus_key=jnp.full((n,), NO_CANDIDATE, jnp.int32),
+        sus_since=jnp.full((n,), NEVER, jnp.int32),
+        force_sync=jnp.zeros((n,), bool),
+        leaving=jnp.zeros((n,), bool),
+        mr_active=jnp.zeros((m,), bool),
+        mr_subject=jnp.full((m,), -1, jnp.int32),
+        mr_key=jnp.zeros((m,), jnp.int32),
+        mr_created=jnp.zeros((m,), jnp.int32),
+        mr_origin=jnp.zeros((m,), jnp.int32),
+        minf_age=jnp.zeros((n, m), jnp.uint8),
+        rumor_active=jnp.zeros((r,), bool),
+        rumor_origin=jnp.zeros((r,), jnp.int32),
+        rumor_created=jnp.zeros((r,), jnp.int32),
+        infected=jnp.zeros((n, r), bool),
+        infected_at=jnp.zeros((n, r), jnp.int32),
+        infected_from=jnp.full((n, r), -1, jnp.int32),
+        loss=loss,
+        fetch_rt=_roundtrip(loss),
+        delay_q=delay_q,
+        pending_minf=jnp.zeros((d, n, m), bool),
+        pending_inf=jnp.zeros((d, n, r), bool),
+        pending_src=jnp.full((d, n, r), -1, jnp.int32),
+    )
+
+
+def announce(state: SparseState, subject, key, origin) -> SparseState:
+    """Host-side membership-rumor allocation (join/leave/metadata paths —
+    the in-tick analogue is the allocation phase). First free slot; silently
+    skipped when the pool is full (SYNC still converges, deviation 3)."""
+    subject = jnp.asarray(subject, jnp.int32)
+    free = ~state.mr_active
+    slot = jnp.argmax(free)
+    ok = free[slot]
+    return state.replace(
+        mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
+        mr_subject=jnp.where(
+            ok, state.mr_subject.at[slot].set(subject), state.mr_subject
+        ),
+        mr_key=jnp.where(ok, state.mr_key.at[slot].set(jnp.asarray(key)), state.mr_key),
+        mr_created=jnp.where(
+            ok, state.mr_created.at[slot].set(state.tick), state.mr_created
+        ),
+        mr_origin=jnp.where(
+            ok, state.mr_origin.at[slot].set(jnp.asarray(origin)), state.mr_origin
+        ),
+        minf_age=jnp.where(
+            ok,
+            state.minf_age.at[jnp.asarray(origin), slot].set(jnp.uint8(1)),
+            state.minf_age,
+        ),
+    )
+
+
+def join_row(state: SparseState, row: int, seed_rows) -> SparseState:
+    """Activate ``row`` as a fresh member knowing itself + seed placeholders;
+    identical identity-epoch semantics to the dense ``state.join_row``
+    (restart = new member id via the epoch bits — :mod:`.lattice`). Also
+    self-announces the new identity as a membership rumor (the reference
+    seed's sync-accept re-gossip spreads a joiner; the self-announce plus the
+    SYNC participants' ``sync_announce`` cover both paths)."""
+    seed_rows = jnp.asarray(seed_rows, jnp.int32)
+    was_used = state.view_key[row, row] >= 0
+    new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
+    self_key = precedence_key(jnp.int32(ALIVE), jnp.int32(0), new_epoch)
+    seed_keys = precedence_key(
+        jnp.full(seed_rows.shape, ALIVE, jnp.int32),
+        jnp.int32(0),
+        state.epoch[seed_rows],
+    )
+    row_key = (
+        jnp.full((state.capacity,), UNKNOWN_KEY, jnp.int32)
+        .at[seed_rows]
+        .set(seed_keys)
+        .at[row]
+        .set(self_key)
+    )
+    n_live_row = ((row_key & 3) != RANK_DEAD).sum().astype(jnp.int32)
+    state = state.replace(
+        up=state.up.at[row].set(True),
+        epoch=state.epoch.at[row].set(new_epoch),
+        view_key=state.view_key.at[row].set(row_key),
+        n_live=state.n_live.at[row].set(n_live_row),
+        force_sync=state.force_sync.at[row].set(True),
+        leaving=state.leaving.at[row].set(False),
+        minf_age=state.minf_age.at[row].set(0),
+        infected=state.infected.at[row].set(False),
+        infected_from=state.infected_from.at[row].set(-1),
+        pending_minf=state.pending_minf.at[:, row].set(False)
+        if state.pending_minf.shape[0]
+        else state.pending_minf,
+        pending_inf=state.pending_inf.at[:, row].set(False)
+        if state.pending_inf.shape[0]
+        else state.pending_inf,
+        pending_src=state.pending_src.at[:, row].set(-1)
+        if state.pending_src.shape[0]
+        else state.pending_src,
+    )
+    return announce(state, row, self_key, row)
+
+
+def join_rows(state: SparseState, rows, seed_rows) -> SparseState:
+    """Vectorized churn-burst join (distinct ``rows``); jit with
+    ``donate_argnums=0``. Mirrors the dense ``state.join_rows`` (post-burst
+    seed epochs) and allocates one self-announce rumor per joiner (pool
+    permitting)."""
+    rows = jnp.asarray(rows, jnp.int32)
+    seed_rows = jnp.asarray(seed_rows, jnp.int32)
+    k = rows.shape[0]
+    was_used = state.view_key[rows, rows] >= 0
+    new_epoch = jnp.where(was_used, (state.epoch[rows] + 1) & 0xFF, state.epoch[rows])
+    self_keys = precedence_key(
+        jnp.full((k,), ALIVE, jnp.int32), jnp.zeros((k,), jnp.int32), new_epoch
+    )
+    epoch_after = state.epoch.at[rows].set(new_epoch)
+    seed_keys = precedence_key(
+        jnp.full(seed_rows.shape, ALIVE, jnp.int32),
+        jnp.zeros(seed_rows.shape, jnp.int32),
+        epoch_after[seed_rows],
+    )
+    row_key = (
+        jnp.full((k, state.capacity), UNKNOWN_KEY, jnp.int32)
+        .at[:, seed_rows]
+        .set(seed_keys[None, :])
+        .at[jnp.arange(k), rows]
+        .set(self_keys)
+    )
+    n_live_rows = ((row_key & 3) != RANK_DEAD).sum(axis=1).astype(jnp.int32)
+    state = state.replace(
+        up=state.up.at[rows].set(True),
+        epoch=epoch_after,
+        view_key=state.view_key.at[rows].set(row_key),
+        n_live=state.n_live.at[rows].set(n_live_rows),
+        force_sync=state.force_sync.at[rows].set(True),
+        leaving=state.leaving.at[rows].set(False),
+        minf_age=state.minf_age.at[rows].set(0),
+        infected=state.infected.at[rows].set(False),
+        infected_from=state.infected_from.at[rows].set(-1),
+        pending_minf=state.pending_minf.at[:, rows].set(False)
+        if state.pending_minf.shape[0]
+        else state.pending_minf,
+        pending_inf=state.pending_inf.at[:, rows].set(False)
+        if state.pending_inf.shape[0]
+        else state.pending_inf,
+        pending_src=state.pending_src.at[:, rows].set(-1)
+        if state.pending_src.shape[0]
+        else state.pending_src,
+    )
+    # batch self-announces: first k free slots (ascending), skip on overflow
+    free_idx = jnp.nonzero(~state.mr_active, size=k, fill_value=state.mr_active.shape[0])[0]
+    ok = free_idx < state.mr_active.shape[0]
+    slot = jnp.minimum(free_idx, state.mr_active.shape[0] - 1)
+    return state.replace(
+        mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
+        mr_subject=state.mr_subject.at[slot].set(
+            jnp.where(ok, rows, state.mr_subject[slot])
+        ),
+        mr_key=state.mr_key.at[slot].set(jnp.where(ok, self_keys, state.mr_key[slot])),
+        mr_created=state.mr_created.at[slot].set(
+            jnp.where(ok, state.tick, state.mr_created[slot])
+        ),
+        mr_origin=state.mr_origin.at[slot].set(
+            jnp.where(ok, rows, state.mr_origin[slot])
+        ),
+        minf_age=state.minf_age.at[rows, slot].set(
+            jnp.where(ok, jnp.uint8(1), state.minf_age[rows, slot])
+        ),
+    )
+
+
+def crash_row(state: SparseState, row: int) -> SparseState:
+    return state.replace(up=state.up.at[row].set(False))
+
+
+def begin_leave(state: SparseState, row: int) -> SparseState:
+    """Graceful leave: LEAVING self-record + announcement rumor (the
+    reference's leaveCluster LEAVING gossip,
+    ``MembershipProtocolImpl.java:233-242``)."""
+    own = state.view_key[row, row]
+    leaving_key = ((own >> 2) << 2) | RANK_LEAVING
+    state = state.replace(
+        view_key=state.view_key.at[row, row].set(leaving_key),
+        leaving=state.leaving.at[row].set(True),
+    )
+    return announce(state, row, leaving_key, row)
+
+
+def update_metadata(state: SparseState, row: int) -> SparseState:
+    """Metadata update = own-incarnation bump re-announced ALIVE
+    (``ClusterImpl.updateMetadata``, ``ClusterImpl.java:497-501``)."""
+    new_key = state.view_key[row, row] + 4
+    state = state.replace(view_key=state.view_key.at[row, row].set(new_key))
+    return announce(state, row, new_key, row)
+
+
+def spread_rumor(state: SparseState, slot: int, origin: int) -> SparseState:
+    """Start a user rumor (Cluster.spreadGossip) — dense-state semantics."""
+    return state.replace(
+        rumor_active=state.rumor_active.at[slot].set(True),
+        rumor_origin=state.rumor_origin.at[slot].set(origin),
+        rumor_created=state.rumor_created.at[slot].set(state.tick),
+        infected=state.infected.at[:, slot].set(False).at[origin, slot].set(True),
+        infected_at=state.infected_at.at[origin, slot].set(state.tick),
+        infected_from=state.infected_from.at[:, slot].set(-1),
+    )
+
+
+def set_link_loss(state: SparseState, src, dst, loss: float) -> SparseState:
+    if state.loss.ndim == 0:
+        raise ValueError(
+            "per-link loss needs dense links; init_sparse_state(dense_links=True)"
+        )
+    src = jnp.atleast_1d(jnp.asarray(src))
+    dst = jnp.atleast_1d(jnp.asarray(dst))
+    new_loss = state.loss.at[src[:, None], dst[None, :]].set(loss)
+    g = new_loss[dst[:, None], src[None, :]]
+    fwd = (1.0 - jnp.float32(loss)) * (1.0 - g)
+    new_rt = state.fetch_rt.at[src[:, None], dst[None, :]].set(fwd.T)
+    new_rt = new_rt.at[dst[:, None], src[None, :]].set(fwd)
+    return state.replace(loss=new_loss, fetch_rt=new_rt)
+
+
+def set_link_delay(state: SparseState, src, dst, mean_delay_ticks: float) -> SparseState:
+    if state.delay_q.ndim == 0:
+        raise ValueError(
+            "per-link delay needs dense links; init_sparse_state(dense_links=True)"
+        )
+    if mean_delay_ticks > 0 and state.pending_minf.shape[0] == 0:
+        raise ValueError("link delay requires params.delay_slots > 0")
+    src = jnp.atleast_1d(jnp.asarray(src))
+    dst = jnp.atleast_1d(jnp.asarray(dst))
+    q = delay_mean_to_q(mean_delay_ticks)
+    return state.replace(delay_q=state.delay_q.at[src[:, None], dst[None, :]].set(q))
+
+
+def block_partition(state: SparseState, group_a, group_b) -> SparseState:
+    s = set_link_loss(state, group_a, group_b, 1.0)
+    return set_link_loss(s, group_b, group_a, 1.0)
+
+
+def heal_partition(state: SparseState, group_a, group_b) -> SparseState:
+    s = set_link_loss(state, group_a, group_b, 0.0)
+    return set_link_loss(s, group_b, group_a, 0.0)
+
+
+def snapshot(state: SparseState) -> dict:
+    return {
+        f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(SparseState)
+    }
+
+
+def restore(arrays: dict) -> SparseState:
+    return SparseState(**{k: jnp.asarray(v) for k, v in arrays.items()})
+
+
+# ---------------------------------------------------------------------------
+# in-tick helpers
+# ---------------------------------------------------------------------------
+
+
+def _loss_at(state: SparseState, i, j):
+    if state.loss.ndim == 0:
+        return jnp.broadcast_to(state.loss, jnp.shape(i))
+    return state.loss[i, j]
+
+
+def _rt_at(state: SparseState, i, j):
+    if state.fetch_rt.ndim == 0:
+        return jnp.broadcast_to(state.fetch_rt, jnp.shape(i))
+    return state.fetch_rt[i, j]
+
+
+def _delay_q_at(state: SparseState, i, j):
+    if state.delay_q.ndim == 0:
+        return jnp.broadcast_to(state.delay_q, jnp.shape(i))
+    return state.delay_q[i, j]
+
+
+def _timely_rt(q1, q2, t: int):
+    """P(two geometric legs sum ≤ t) — identical to ``kernel._timely_rt``."""
+    h = jnp.ones_like(q1)
+    acc = h
+    q2p = jnp.ones_like(q2)
+    for _ in range(t):
+        q2p = q2p * q2
+        h = q1 * h + q2p
+        acc = acc + h
+    return (1.0 - q1) * (1.0 - q2) * acc
+
+
+def _fetch_gate(state: SparseState, salt: int, i, j, cand_key, p_fetch):
+    """ALIVE-rank candidates gated on the metadata-fetch round trip
+    (``MembershipProtocolImpl.java:636-658``) — same stateless hash draw as
+    the dense kernel so loss semantics match across modes."""
+    needs = (cand_key & 3) == RANK_ALIVE
+    u = fetch_uniform(state.tick, salt, i, j)
+    ok = state.up[j] & (u < p_fetch)
+    return ~needs | ok
+
+
+def _sample_rejection(
+    state: SparseState, rows, u, n_picks: int, tries: int, extra_mask=None
+):
+    """Per-row ``n_picks`` distinct draws from the live view by bounded
+    rejection: each pick takes the first of ``tries`` uniform column draws
+    that is not self, not DEAD/unknown in the row's view (rank != 3 — the
+    -1 unknown key also reads rank 3), optionally allowed by ``extra_mask``
+    [N]-indexed (the SYNC seed pool), and distinct from earlier picks.
+
+    Returns (idx [N, n_picks] clamped, valid [N, n_picks]). Deviation 4:
+    a pick can come up empty with prob (1-live_frac)^tries.
+    """
+    n = state.capacity
+    picks = []
+    for p in range(n_picks):
+        sel = jnp.full(rows.shape, -1, jnp.int32)
+        for t in range(tries):
+            c = jnp.minimum(
+                (u[:, p * tries + t] * np.float32(n)).astype(jnp.int32), n - 1
+            )
+            ok = c != rows
+            live = (state.view_key[rows, c] & 3) != RANK_DEAD
+            if extra_mask is not None:
+                live = live | extra_mask[c]
+            ok = ok & live
+            for q in picks:
+                ok = ok & (c != q)  # q == -1 never collides
+            sel = jnp.where((sel < 0) & ok, c, sel)
+        picks.append(sel)
+    idx = jnp.stack(picks, 1)
+    return jnp.maximum(idx, 0), idx >= 0
+
+
+def _first_occurrence(subjects: jax.Array, valid: jax.Array) -> jax.Array:
+    """Mask keeping one entry per distinct subject among ``valid`` entries
+    (needed so per-row liveness deltas don't double-count duplicate rumor
+    subjects). Stable: the earliest index among equals wins."""
+    m = subjects.shape[0]
+    key = jnp.where(valid, subjects, jnp.int32(-2))
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    first_sorted = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_key[1:] != sorted_key[:-1]]
+    )
+    first = jnp.zeros((m,), bool).at[order].set(first_sorted)
+    return first & valid
+
+
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
+
+
+def _fd_phase(state: SparseState, r, params: SparseParams):
+    """Vectorized FD round (``FailureDetectorImpl`` semantics, as the dense
+    kernel's ``_fd_phase``) with rejection-sampled target/relay selection.
+    Returns (state, proposals, metrics)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    sel, valid = _sample_rejection(
+        state, rows, r.fd_try, 1 + params.ping_req_k, params.sample_tries
+    )
+    tgt = sel[:, 0]
+    has_tgt = valid[:, 0] & state.up
+
+    p_direct = _rt_at(state, rows, tgt)
+    if params.delay_slots:
+        p_direct = p_direct * _timely_rt(
+            _delay_q_at(state, rows, tgt),
+            _delay_q_at(state, tgt, rows),
+            params.fd_direct_timeout_ticks,
+        )
+    direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
+
+    relays = sel[:, 1:]
+    relay_valid = valid[:, 1:]
+    tgt_b = tgt[:, None]
+    p_relay = _rt_at(state, rows[:, None], relays) * _rt_at(state, relays, tgt_b)
+    if params.delay_slots:
+        p_relay = p_relay * _timely_rt(
+            _delay_q_at(state, rows[:, None], relays),
+            _delay_q_at(state, relays, rows[:, None]),
+            params.fd_leg_timeout_ticks,
+        )
+        p_relay = p_relay * _timely_rt(
+            _delay_q_at(state, relays, tgt_b),
+            _delay_q_at(state, tgt_b, relays),
+            params.fd_leg_timeout_ticks,
+        )
+    relay_ok = relay_valid & state.up[relays] & state.up[tgt_b] & (r.fd_relay < p_relay)
+    ack = direct_ok | relay_ok.any(axis=1)
+
+    own_key = state.view_key[rows, tgt]
+    alive_key = (state.view_key[tgt, tgt] >> 2) << 2
+    suspect_key = ((own_key >> 2) << 2) | RANK_SUSPECT
+    cand = jnp.where(ack, alive_key, suspect_key)
+    accept = has_tgt & (cand > own_key)
+
+    st = state.replace(
+        view_key=state.view_key.at[rows, tgt].set(jnp.where(accept, cand, own_key))
+    )
+    # suspicion-episode registration (deviation 1)
+    sus_cand = (
+        jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        .at[tgt]
+        .max(jnp.where(accept & ~ack, cand, NO_CANDIDATE))
+    )
+    new_sus = jnp.maximum(st.sus_key, sus_cand)
+    st = st.replace(
+        sus_key=new_sus,
+        sus_since=jnp.where(new_sus > st.sus_key, st.tick, st.sus_since),
+    )
+    # FD verdicts flip between non-DEAD ranks only (targets come from the
+    # live view; ALIVE/SUSPECT are both live) — n_live is unchanged.
+    proposals = (tgt, cand, rows, accept)
+    metrics = {
+        "fd_probes": has_tgt.sum(),
+        "fd_failed_probes": (has_tgt & ~ack).sum(),
+        "fd_new_suspects": (accept & ~ack).sum(),
+    }
+    return st, proposals, metrics
+
+
+def _suspicion_sweep(state: SparseState, params: SparseParams):
+    """Dense expiry pass, every ``sweep_every`` ticks: SUSPECT cells whose
+    subject's episode stamp is older than the observer's suspicion timeout
+    become DEAD at the same incarnation (rank +1). O(N²/B) amortized.
+    Returns (state, proposals)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    no_props = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        rows,
+        jnp.zeros((n,), bool),
+    )
+
+    def _sweep(st: SparseState):
+        timeout = params.suspicion_mult * ceil_log2(st.n_live) * params.fd_every
+        suspect = (st.view_key & 3) == RANK_SUSPECT
+        expired = (
+            suspect
+            & st.up[:, None]
+            & ((st.tick - st.sus_since)[None, :] >= timeout[:, None])
+            & (st.view_key <= st.sus_key[None, :])
+        )
+        new_key = jnp.where(expired, st.view_key + 1, st.view_key)
+        n_live = st.n_live - expired.sum(axis=1).astype(jnp.int32)
+        # announce ONE expiry per observer (lowest column; deviation 3) —
+        # every other observer's own timer fires within a sweep period anyway
+        any_exp = expired.any(axis=1)
+        col = jnp.argmax(expired, axis=1).astype(jnp.int32)
+        key = new_key[rows, col]
+        return (
+            st.replace(view_key=new_key, n_live=n_live),
+            (col, key, rows, any_exp),
+        )
+
+    def _skip(st: SparseState):
+        return st, no_props
+
+    # cheap gate: no registered episode young enough to matter -> skip scan
+    has_suspects = (state.sus_since > NEVER).any()
+    on_tick = (state.tick % params.sweep_every) == 0
+    return jax.lax.cond(on_tick & has_suspects, _sweep, _skip, state)
+
+
+def _gossip_phase(state: SparseState, r, params: SparseParams):
+    """Infection-style dissemination of user rumors ([N, R], full fidelity)
+    and membership rumors ([N, M], origin-filter — deviation 2). One message
+    per (sender, peer) edge carries both payloads, as the reference's single
+    GOSSIP_REQ does. Quiescent clusters (no active rumor, nothing pending)
+    skip the whole phase."""
+    n = state.capacity
+    m = params.mr_slots
+    rows = jnp.arange(n)
+    D = params.delay_slots
+
+    work = state.rumor_active.any() | state.mr_active.any()
+    if D:
+        slot_now = state.tick % D
+        work = (
+            work
+            | state.pending_inf[slot_now].any()
+            | state.pending_minf[slot_now].any()
+        )
+
+    def _deliver(state: SparseState):
+        # age the infection planes (only while rumors exist — all-zero when
+        # quiet, so skipping on quiet ticks changes nothing)
+        age = state.minf_age
+        age = jnp.where(age > 0, jnp.minimum(age, jnp.uint8(254)) + jnp.uint8(1), age)
+        state = state.replace(minf_age=age)
+
+        spread = params.repeat_mult * ceil_log2(state.n_live)  # [N]
+        young_u = (
+            state.infected
+            & state.rumor_active[None, :]
+            & (state.tick - state.infected_at < spread[:, None])
+        )
+        # age = tick - infection_tick + 1 after this tick's increment, so
+        # age <= spread  <=>  tick - infection_tick < spread — exactly the
+        # dense kernel's (and the reference's) forwarding window
+        young_m = (
+            (age > 0)
+            & state.mr_active[None, :]
+            & (age.astype(jnp.int32) <= spread[:, None])
+        )
+        peers, peer_valid = _sample_rejection(
+            state, rows, r.gossip_try, params.fanout, params.sample_tries
+        )
+
+        if D:
+            recv_u = state.pending_inf[slot_now]
+            recv_src = state.pending_src[slot_now]
+            recv_m = state.pending_minf[slot_now]
+            pend_u = state.pending_inf
+            pend_src = state.pending_src
+            pend_m = state.pending_minf
+        else:
+            recv_u = jnp.zeros_like(state.infected)
+            recv_src = jnp.full_like(state.infected_from, -1)
+            recv_m = jnp.zeros((n, m), bool)
+
+        sent = jnp.int32(0)
+        rumor_sent = jnp.int32(0)
+        for s in range(params.fanout):
+            p = peers[:, s]
+            send_u = (
+                young_u
+                & (state.infected_from != p[:, None])
+                & (state.rumor_origin[None, :] != p[:, None])
+            )
+            send_m = young_m & (state.mr_origin[None, :] != p[:, None])
+            has_payload = send_u.any(axis=1) | send_m.any(axis=1)
+            ok = (
+                peer_valid[:, s]
+                & has_payload
+                & state.up
+                & state.up[p]
+                & (r.gossip_edge[:, s] < (1.0 - _loss_at(state, rows, p)))
+            )
+            sent = sent + ok.sum()
+            rumor_sent = rumor_sent + (send_u & ok[:, None]).sum()
+            if D:
+                qd = _delay_q_at(state, rows, p)
+                d = jnp.zeros((n,), jnp.int32)
+                qpow = qd
+                for _ in range(1, D):
+                    d = d + (r.gossip_delay[:, s] < qpow)
+                    qpow = qpow * qd
+                ok_now = ok & (d == 0)
+                ok_late = ok & (d > 0)
+                slot_d = (state.tick + d) % D
+                late_u = send_u & ok_late[:, None]
+                pend_u = pend_u.at[slot_d, p].max(late_u)
+                pend_src = pend_src.at[slot_d, p].max(
+                    jnp.where(late_u, rows[:, None], -1)
+                )
+                pend_m = pend_m.at[slot_d, p].max(send_m & ok_late[:, None])
+            else:
+                ok_now = ok
+            now_u = send_u & ok_now[:, None]
+            recv_u = recv_u.at[p].max(now_u)
+            recv_src = recv_src.at[p].max(jnp.where(now_u, rows[:, None], -1))
+            recv_m = recv_m.at[p].max(send_m & ok_now[:, None])
+
+        # user-rumor infection (bitmap OR = SequenceIdCollector dedup)
+        newly_u = recv_u & ~state.infected & state.up[:, None] & state.rumor_active[None, :]
+        state = state.replace(
+            infected=state.infected | newly_u,
+            infected_at=jnp.where(newly_u, state.tick, state.infected_at),
+            infected_from=jnp.where(newly_u, recv_src, state.infected_from),
+        )
+
+        # membership-rumor infection + one-shot record application
+        newly_m = (
+            recv_m & (state.minf_age == 0) & state.up[:, None] & state.mr_active[None, :]
+        )
+        state = state.replace(
+            minf_age=jnp.where(newly_m, jnp.uint8(1), state.minf_age)
+        )
+        subj = jnp.maximum(state.mr_subject, 0)  # [M]; inactive masked below
+        own = jnp.take(state.view_key, subj, axis=1)  # [N, M]
+        cand = jnp.where(newly_m, state.mr_key[None, :], NO_CANDIDATE)
+        p_fetch = (
+            state.fetch_rt
+            if state.fetch_rt.ndim == 0
+            else jnp.take(state.fetch_rt, subj, axis=1)
+        )
+        accept = (
+            (cand > own)
+            & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+            & _fetch_gate(state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch)
+        )
+        vals = jnp.where(accept, cand, NO_CANDIDATE)
+        new_view = state.view_key.at[:, subj].max(vals)
+        # liveness deltas: count each distinct subject once (duplicate-slot
+        # rumors about one subject would double-count otherwise)
+        first = _first_occurrence(state.mr_subject, state.mr_active)
+        new_own = jnp.take(new_view, subj, axis=1)
+        delta = (
+            ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
+            - ((own & 3) != RANK_DEAD).astype(jnp.int32)
+        ) * first[None, :].astype(jnp.int32)
+        n_live = state.n_live + delta.sum(axis=1)
+        # episode registration for accepted SUSPECT records
+        sus_col = jnp.where(accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE).max(
+            axis=0
+        )  # [M]
+        sus_cand = (
+            jnp.full((n,), NO_CANDIDATE, jnp.int32).at[subj].max(sus_col)
+        )
+        new_sus = jnp.maximum(state.sus_key, sus_cand)
+        state = state.replace(
+            view_key=new_view,
+            n_live=n_live,
+            sus_key=new_sus,
+            sus_since=jnp.where(new_sus > state.sus_key, state.tick, state.sus_since),
+        )
+        if D:
+            state = state.replace(
+                pending_inf=pend_u.at[slot_now].set(False),
+                pending_src=pend_src.at[slot_now].set(-1),
+                pending_minf=pend_m.at[slot_now].set(False),
+            )
+        return state, {
+            "gossip_msgs": sent,
+            "rumor_sends": rumor_sent,
+            "rumor_deliveries": newly_u.sum(),
+            "mr_deliveries": newly_m.sum(),
+        }
+
+    def _quiet(state: SparseState):
+        return state, {
+            "gossip_msgs": jnp.int32(0),
+            "rumor_sends": jnp.int32(0),
+            "rumor_deliveries": jnp.int32(0),
+            "mr_deliveries": jnp.int32(0),
+        }
+
+    return jax.lax.cond(work, _deliver, _quiet, state)
+
+
+def _sync_phase(state: SparseState, r, params: SparseParams):
+    """Anti-entropy full-table exchange — the dense kernel's compacted-K
+    design (O(K·N)), minus ``changed_at``, plus liveness-delta upkeep,
+    episode registration, and capped re-gossip proposals (deviation 3;
+    the reference re-gossips EVERY sync-accepted record,
+    ``spreadMembershipGossipUnlessGossiped:836-843``)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    P = params.sync_announce
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
+    due = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
+    due = (due | state.force_sync) & state.up
+    (caller,) = jnp.nonzero(due, size=K, fill_value=n)
+    valid_c = caller < n
+    caller = jnp.minimum(caller, n - 1)
+
+    if params.seed_rows:
+        seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
+    else:
+        seed_mask = None
+    peer_idx, peer_valid = _sample_rejection(
+        state, caller, r.sync_try[caller], 1, params.sample_tries, extra_mask=seed_mask
+    )
+    peer = peer_idx[:, 0]
+    p_rt = _rt_at(state, caller, peer)
+    if params.delay_slots:
+        p_rt = p_rt * _timely_rt(
+            _delay_q_at(state, caller, peer),
+            _delay_q_at(state, peer, caller),
+            params.sync_timeout_ticks,
+        )
+    ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
+
+    caller_tables = state.view_key[caller]  # [K, N]
+    buf = state.view_key.at[peer].max(jnp.where(ok[:, None], caller_tables, NO_CANDIDATE))
+    own_p = state.view_key[peer]
+    buf_p = buf[peer]
+    acc = (
+        (buf_p > own_p)
+        & ((own_p >= 0) | ((buf_p & 3) <= RANK_LEAVING))
+        & state.up[peer][:, None]
+        & _fetch_gate(
+            state,
+            SALT_SYNC_REQ,
+            peer[:, None],
+            rows[None, :],
+            buf_p,
+            state.fetch_rt if state.fetch_rt.ndim == 0 else state.fetch_rt[peer],
+        )
+    )
+    new_p = jnp.where(acc, buf_p, own_p)
+    # duplicate peer slots recompute the IDENTICAL merged row; liveness
+    # deltas must count each distinct peer once
+    first_p = _first_occurrence(jnp.where(ok, peer, -1), ok)
+    delta_p = (
+        ((new_p & 3) != RANK_DEAD).astype(jnp.int32)
+        - ((own_p & 3) != RANK_DEAD).astype(jnp.int32)
+    ).sum(axis=1) * first_p.astype(jnp.int32)
+    st = state.replace(
+        view_key=state.view_key.at[peer].max(new_p),
+        n_live=state.n_live.at[peer].add(delta_p),
+    )
+    sus_req = jnp.where(acc & ((buf_p & 3) == RANK_SUSPECT), buf_p, NO_CANDIDATE).max(
+        axis=0
+    )  # [N]
+
+    # SYNC_ACK: peer's post-merge table back to the caller
+    ack_cand = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)
+    own_rows = st.view_key[caller]
+    accept = (
+        (ack_cand > own_rows)
+        & ((own_rows >= 0) | ((ack_cand & 3) <= RANK_LEAVING))
+        & state.up[caller][:, None]
+        & _fetch_gate(
+            st,
+            SALT_SYNC_ACK,
+            caller[:, None],
+            rows[None, :],
+            ack_cand,
+            st.fetch_rt if st.fetch_rt.ndim == 0 else st.fetch_rt[caller],
+        )
+    )
+    new_c = jnp.where(accept, ack_cand, own_rows)
+    delta_c = (
+        ((new_c & 3) != RANK_DEAD).astype(jnp.int32)
+        - ((own_rows & 3) != RANK_DEAD).astype(jnp.int32)
+    ).sum(axis=1) * valid_c.astype(jnp.int32)
+    st = st.replace(
+        view_key=st.view_key.at[caller].max(new_c),
+        n_live=st.n_live.at[caller].add(delta_c),
+    )
+    sus_ack = jnp.where(
+        accept & ((ack_cand & 3) == RANK_SUSPECT), ack_cand, NO_CANDIDATE
+    ).max(axis=0)
+    sus_cand = jnp.maximum(sus_req, sus_ack)
+    new_sus = jnp.maximum(st.sus_key, sus_cand)
+    st = st.replace(
+        sus_key=new_sus,
+        sus_since=jnp.where(new_sus > st.sus_key, st.tick, st.sus_since),
+    )
+
+    ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
+    st = st.replace(force_sync=st.force_sync & ~ok_full)
+
+    # capped re-gossip: top-P accepted keys per participant row (largest key
+    # first — freshest identities/incarnations are the newsworthy ones)
+    def _top_props(acc_mask, cand_vals, owner_rows, owner_valid):
+        subs, keys, origs, vals = [], [], [], []
+        remaining = jnp.where(acc_mask, cand_vals, NO_CANDIDATE)
+        for _ in range(P):
+            col = jnp.argmax(remaining, axis=1).astype(jnp.int32)
+            val = remaining[jnp.arange(remaining.shape[0]), col]
+            good = (val > NO_CANDIDATE) & owner_valid
+            subs.append(col)
+            keys.append(val)
+            origs.append(owner_rows)
+            vals.append(good)
+            remaining = remaining.at[jnp.arange(remaining.shape[0]), col].set(
+                NO_CANDIDATE
+            )
+        return (
+            jnp.concatenate(subs),
+            jnp.concatenate(keys),
+            jnp.concatenate(origs),
+            jnp.concatenate(vals),
+        )
+
+    props_p = _top_props(acc & first_p[:, None], buf_p, peer, ok & first_p)
+    props_c = _top_props(accept, ack_cand, caller, ok)
+    proposals = tuple(
+        jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
+    )
+    return st, proposals, {"sync_roundtrips": ok.sum()}
+
+
+def _refute_phase(state: SparseState):
+    """Self-record refutation (SUSPECT/DEAD diagonal, or overwritten leave
+    intent) — row-local; the refuted record is proposed as a rumor (the
+    reference gossips the bumped ALIVE, ``onSelfMemberDetected:686-708``)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    diag = state.view_key[rows, rows]
+    rank = diag & 3
+    need = state.up & (
+        (rank == RANK_SUSPECT)
+        | (rank == RANK_DEAD)
+        | (state.leaving & (rank != RANK_LEAVING))
+    )
+    announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
+    new_diag = jnp.where(need, (((diag >> 2) + 1) << 2) | announce_rank, diag)
+
+    def _apply(st: SparseState):
+        # a DEAD diagonal was counted out of the row's own live view
+        regain = (need & (rank == RANK_DEAD)).astype(jnp.int32)
+        return st.replace(
+            view_key=st.view_key.at[rows, rows].set(new_diag),
+            n_live=st.n_live + regain,
+        )
+
+    st = jax.lax.cond(need.any(), _apply, lambda s: s, state)
+    return st, (rows, new_diag, rows, need)
+
+
+def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
+    """Slot reclamation. User rumors: dense-kernel semantics. Membership
+    rumors: same age/forwarder/pending rules on the u8 plane, plus the
+    early full-coverage free (deviation 5)."""
+    n_up = state.up.sum().astype(jnp.int32)
+    sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
+    spread = params.repeat_mult * ceil_log2(state.n_live)  # [N]
+
+    keep_u = state.tick - state.rumor_created <= sweep
+    forwarding_u = (
+        state.infected
+        & state.up[:, None]
+        & (state.tick - state.infected_at < spread[:, None])
+    ).any(axis=0)
+    keep_u = keep_u | forwarding_u
+    if params.delay_slots:
+        keep_u = keep_u | state.pending_inf.any(axis=(0, 1))
+
+    age = state.minf_age.astype(jnp.int32)
+    forwarding_m = ((age > 0) & (age <= spread[:, None]) & state.up[:, None]).any(
+        axis=0
+    )
+    keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
+    pending_m = (
+        state.pending_minf.any(axis=(0, 1))
+        if params.delay_slots
+        else jnp.zeros_like(keep_m)
+    )
+    keep_m = keep_m | pending_m
+    if params.early_free:
+        covered = ((state.minf_age > 0) | ~state.up[:, None]).all(axis=0)
+        keep_m = keep_m & ~(covered & ~pending_m)
+    keep_m = keep_m & state.mr_active
+    freed = state.mr_active & ~keep_m
+    state = state.replace(
+        rumor_active=state.rumor_active & keep_u,
+        mr_active=keep_m,
+        mr_subject=jnp.where(freed, -1, state.mr_subject),
+        minf_age=jnp.where(freed[None, :], jnp.uint8(0), state.minf_age),
+    )
+    if params.delay_slots:
+        state = state.replace(
+            pending_minf=state.pending_minf & keep_m[None, None, :]
+        )
+    return state
+
+
+def _alloc_phase(state: SparseState, proposals, params: SparseParams):
+    """Turn this tick's accepted-change proposals into new membership rumors.
+
+    Proposals (subject, key, origin, valid) from FD verdicts, suspicion
+    expiries, SYNC re-gossip, and refutations are compacted to E =
+    ``announce_slots`` entries, deduplicated (stable sort by packed
+    (subject, key); first proposer wins) against both the batch and the
+    active pool, and assigned ascending free slots. Dropped proposals are
+    counted (``announce_dropped``) — they reach stragglers via SYNC."""
+    E = params.announce_slots
+    M = params.mr_slots
+    subject = jnp.concatenate([p[0] for p in proposals])
+    key = jnp.concatenate([p[1] for p in proposals])
+    origin = jnp.concatenate([p[2] for p in proposals])
+    valid = jnp.concatenate([p[3] for p in proposals])
+    L = subject.shape[0]
+
+    def _alloc(state: SparseState):
+        (idx,) = jnp.nonzero(valid, size=E, fill_value=L)
+        got = idx < L
+        idx = jnp.minimum(idx, L - 1)
+        s = jnp.where(got, subject[idx], -9)  # sentinel: matches nothing real
+        k, o = key[idx], origin[idx]
+        # batch dedup (earliest compacted index wins) + pool dedup — E is
+        # small (announce_slots), so O(E²)+O(E·M) broadcast compares beat a
+        # 64-bit pack-and-sort (and the runtime is 32-bit anyway)
+        same = (s[:, None] == s[None, :]) & (k[:, None] == k[None, :])
+        dup = (same & jnp.tri(E, E, -1, dtype=bool)).any(axis=1)
+        in_pool = (
+            (s[:, None] == state.mr_subject[None, :])
+            & (k[:, None] == state.mr_key[None, :])
+            & state.mr_active[None, :]
+        ).any(axis=1)
+        new = got & ~dup & ~in_pool
+        rank = jnp.cumsum(new.astype(jnp.int32)) - 1
+        (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
+        slot_r = free[jnp.clip(rank, 0, E - 1)]
+        ok = new & (slot_r < M)
+        slot = jnp.minimum(slot_r, M - 1)
+        st = state.replace(
+            mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
+            mr_subject=state.mr_subject.at[slot].set(
+                jnp.where(ok, s, state.mr_subject[slot])
+            ),
+            mr_key=state.mr_key.at[slot].set(jnp.where(ok, k, state.mr_key[slot])),
+            mr_created=state.mr_created.at[slot].set(
+                jnp.where(ok, state.tick, state.mr_created[slot])
+            ),
+            mr_origin=state.mr_origin.at[slot].set(
+                jnp.where(ok, o, state.mr_origin[slot])
+            ),
+            minf_age=state.minf_age.at[jnp.where(ok, o, 0), slot].max(
+                jnp.where(ok, jnp.uint8(1), jnp.uint8(0))
+            ),
+        )
+        # dropped = compaction overflow (valid proposals beyond E) + unique
+        # new proposals that found no free slot; batch/pool duplicates are
+        # not drops (the rumor already exists and keeps spreading)
+        overflow = valid.sum() - got.sum()
+        no_slot = new.sum() - ok.sum()
+        return st, {"announce_dropped": overflow + no_slot, "announced": ok.sum()}
+
+    def _skip(state: SparseState):
+        return state, {"announce_dropped": jnp.int32(0), "announced": jnp.int32(0)}
+
+    return jax.lax.cond(valid.any(), _alloc, _skip, state)
+
+
+# ---------------------------------------------------------------------------
+# tick
+# ---------------------------------------------------------------------------
+
+
+def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
+    """One gossip period for all N members, sparse mode. Pure; jit/shard me."""
+    state = state.replace(tick=state.tick + 1)
+    fd_key, round_key = split_tick_key(key)
+    r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
+
+    n = state.capacity
+    rows = jnp.arange(n)
+    no_props = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        rows,
+        jnp.zeros((n,), bool),
+    )
+
+    def _fd_on(st: SparseState):
+        fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
+        return _fd_phase(st, fd_r, params)
+
+    def _fd_off(st: SparseState):
+        return st, no_props, {
+            "fd_probes": jnp.int32(0),
+            "fd_failed_probes": jnp.int32(0),
+            "fd_new_suspects": jnp.int32(0),
+        }
+
+    state, props_fd, fd_m = jax.lax.cond(
+        (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state
+    )
+    state, props_exp = _suspicion_sweep(state, params)
+    state, g_m = _gossip_phase(state, r, params)
+    state, props_sync, s_m = _sync_phase(state, r, params)
+    state, props_ref = _refute_phase(state)
+    state = _rumor_sweeps(state, params)
+    state, a_m = _alloc_phase(
+        state, (props_fd, props_exp, props_sync, props_ref), params
+    )
+
+    coverage = (
+        (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
+        / jnp.maximum(state.up.sum(), 1)
+    )
+    metrics = {
+        **fd_m,
+        **g_m,
+        **s_m,
+        **a_m,
+        "n_up": state.up.sum(),
+        "mr_active_count": state.mr_active.sum(),
+        "rumor_coverage": coverage,
+    }
+    if params.full_metrics:
+        up2 = state.up[:, None] & state.up[None, :]
+        pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)
+        off_diag = ~jnp.eye(n, dtype=bool)
+        rank = state.view_key & 3
+        metrics["alive_view_fraction"] = (
+            (up2 & off_diag & (rank == RANK_ALIVE)).sum().astype(jnp.float32) / pairs
+        )
+        metrics["false_suspect_pairs"] = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
+    else:
+        metrics["alive_view_fraction"] = jnp.float32(0.0)
+        metrics["false_suspect_pairs"] = jnp.int32(0)
+    return state, metrics
+
+
+def run_sparse_ticks(
+    state: SparseState,
+    key: jax.Array,
+    n_ticks: int,
+    params: SparseParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Batched scan window — same contract as ``kernel.run_ticks`` (same
+    per-tick key chain as host-side splitting; watched rows' view keys
+    stacked per tick)."""
+
+    def body(carry, _):
+        st, k = carry
+        k, tick_key = jax.random.split(k)
+        st, m = sparse_tick(st, tick_key, params)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, k), m
+
+    (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched
