@@ -400,25 +400,19 @@ def join_rows(state: SparseState, rows, seed_rows) -> SparseState:
         if state.pending_src.shape[0]
         else state.pending_src,
     )
-    # batch self-announces: first k free slots (ascending), skip on overflow
-    free_idx = jnp.nonzero(~state.mr_active, size=k, fill_value=state.mr_active.shape[0])[0]
-    ok = free_idx < state.mr_active.shape[0]
-    slot = jnp.minimum(free_idx, state.mr_active.shape[0] - 1)
+    # batch self-announces: first k free slots (ascending); overflow entries
+    # are routed out of bounds and dropped (pool-full joiners still bootstrap
+    # via force_sync + the SYNC participants' re-gossip)
+    M = state.mr_active.shape[0]
+    free_idx = jnp.nonzero(~state.mr_active, size=k, fill_value=M)[0]
+    slot = jnp.where(free_idx < M, free_idx, M)
     return state.replace(
-        mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
-        mr_subject=state.mr_subject.at[slot].set(
-            jnp.where(ok, rows, state.mr_subject[slot])
-        ),
-        mr_key=state.mr_key.at[slot].set(jnp.where(ok, self_keys, state.mr_key[slot])),
-        mr_created=state.mr_created.at[slot].set(
-            jnp.where(ok, state.tick, state.mr_created[slot])
-        ),
-        mr_origin=state.mr_origin.at[slot].set(
-            jnp.where(ok, rows, state.mr_origin[slot])
-        ),
-        minf_age=state.minf_age.at[rows, slot].set(
-            jnp.where(ok, jnp.uint8(1), state.minf_age[rows, slot])
-        ),
+        mr_active=state.mr_active.at[slot].set(True, mode="drop"),
+        mr_subject=state.mr_subject.at[slot].set(rows, mode="drop"),
+        mr_key=state.mr_key.at[slot].set(self_keys, mode="drop"),
+        mr_created=state.mr_created.at[slot].set(state.tick, mode="drop"),
+        mr_origin=state.mr_origin.at[slot].set(rows, mode="drop"),
+        minf_age=state.minf_age.at[rows, slot].set(jnp.uint8(1), mode="drop"),
     )
 
 
@@ -1145,22 +1139,17 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
         (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
         slot_r = free[jnp.clip(rank, 0, E - 1)]
         ok = new & (slot_r < M)
-        slot = jnp.minimum(slot_r, M - 1)
+        # entries that allocate nothing are routed OUT OF BOUNDS and dropped:
+        # a clamped in-bounds index would duplicate a real allocation's slot,
+        # and scatter-set with conflicting duplicate values is order-undefined
+        slot = jnp.where(ok, jnp.minimum(slot_r, M - 1), M)
         st = state.replace(
-            mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
-            mr_subject=state.mr_subject.at[slot].set(
-                jnp.where(ok, s, state.mr_subject[slot])
-            ),
-            mr_key=state.mr_key.at[slot].set(jnp.where(ok, k, state.mr_key[slot])),
-            mr_created=state.mr_created.at[slot].set(
-                jnp.where(ok, state.tick, state.mr_created[slot])
-            ),
-            mr_origin=state.mr_origin.at[slot].set(
-                jnp.where(ok, o, state.mr_origin[slot])
-            ),
-            minf_age=state.minf_age.at[jnp.where(ok, o, 0), slot].max(
-                jnp.where(ok, jnp.uint8(1), jnp.uint8(0))
-            ),
+            mr_active=state.mr_active.at[slot].set(True, mode="drop"),
+            mr_subject=state.mr_subject.at[slot].set(s, mode="drop"),
+            mr_key=state.mr_key.at[slot].set(k, mode="drop"),
+            mr_created=state.mr_created.at[slot].set(state.tick, mode="drop"),
+            mr_origin=state.mr_origin.at[slot].set(o, mode="drop"),
+            minf_age=state.minf_age.at[o, slot].set(jnp.uint8(1), mode="drop"),
         )
         # dropped = compaction overflow (valid proposals beyond E) + unique
         # new proposals that found no free slot; batch/pool duplicates are

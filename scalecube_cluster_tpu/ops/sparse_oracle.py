@@ -1,0 +1,636 @@
+"""Scalar (per-node-loop NumPy) oracle of the SPARSE tick semantics.
+
+Mirror of :mod:`.sparse` the way :mod:`.oracle` mirrors :mod:`.kernel`
+(SURVEY.md §4's lockstep-equivalence strategy): per-node Python loops
+consuming byte-identical draws from :func:`.rand.draw_sparse_randoms`; the
+equivalence suite steps both and compares the full state every tick. All
+float comparisons replay the kernel's float32 op order; all tie-breaking
+(first rejection try, earliest duplicate proposal, ascending free slots,
+first-max argmax) is mirrored exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .lattice import RANK_ALIVE, RANK_DEAD, RANK_LEAVING, RANK_SUSPECT
+from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
+    draw_sparse_randoms,
+    fetch_uniform,
+)
+from .sparse import SparseParams, SparseState
+
+NO_CAND = np.iinfo(np.int32).min
+NEVER = -(1 << 30)
+
+
+def _ceil_log2(n: int) -> int:
+    return int(n).bit_length() if n > 0 else 0
+
+
+class _SO:
+    """Mutable numpy mirror of SparseState."""
+
+    def __init__(self, state: SparseState):
+        self.tick = int(state.tick)
+        for name in (
+            "up", "epoch", "view_key", "n_live", "sus_key", "sus_since",
+            "force_sync", "leaving", "mr_active", "mr_subject", "mr_key",
+            "mr_created", "mr_origin", "minf_age", "rumor_active",
+            "rumor_origin", "rumor_created", "infected", "infected_at",
+            "infected_from", "loss", "fetch_rt", "delay_q", "pending_minf",
+            "pending_inf", "pending_src",
+        ):
+            setattr(self, name, np.asarray(getattr(state, name)).copy())
+
+    def snap(self):
+        import copy
+
+        return copy.deepcopy(self)
+
+
+def _loss(o, i, j):
+    return np.float32(o.loss) if o.loss.ndim == 0 else o.loss[i, j]
+
+
+def _rt(o, i, j):
+    return np.float32(o.fetch_rt) if o.fetch_rt.ndim == 0 else o.fetch_rt[i, j]
+
+
+def _dq(o, i, j):
+    return np.float32(o.delay_q) if o.delay_q.ndim == 0 else o.delay_q[i, j]
+
+
+def _timely(q1, q2, t: int) -> np.float32:
+    q1, q2 = np.float32(q1), np.float32(q2)
+    h = np.float32(1.0)
+    acc = np.float32(1.0)
+    q2p = np.float32(1.0)
+    for _ in range(t):
+        q2p = np.float32(q2p * q2)
+        h = np.float32(np.float32(q1 * h) + q2p)
+        acc = np.float32(acc + h)
+    return np.float32(np.float32((np.float32(1.0) - q1) * (np.float32(1.0) - q2)) * acc)
+
+
+def _pick_rejection(o, row: int, u: np.ndarray, n_picks: int, tries: int,
+                    seed_mask=None):
+    """Mirror of ``sparse._sample_rejection`` for one row: first valid try
+    wins; picks held as raw -1-able values for distinctness checks."""
+    n = o.up.shape[0]
+    sels: list[int] = []
+    for p in range(n_picks):
+        sel = -1
+        for t in range(tries):
+            c = min(int(np.float32(np.float32(u[p * tries + t]) * np.float32(n))), n - 1)
+            ok = c != row
+            live = (int(o.view_key[row, c]) & 3) != RANK_DEAD
+            if seed_mask is not None:
+                live = live or bool(seed_mask[c])
+            ok = ok and live and all(c != q for q in sels)
+            if sel < 0 and ok:
+                sel = c
+        sels.append(sel)
+    idx = np.asarray([max(s, 0) for s in sels], np.int32)
+    valid = np.asarray([s >= 0 for s in sels], bool)
+    return idx, valid
+
+
+def _first_occurrence(subjects: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """Mirror of ``sparse._first_occurrence``: earliest index per distinct
+    subject among valid entries."""
+    first = np.zeros(subjects.shape[0], bool)
+    seen: set = set()
+    for i in range(subjects.shape[0]):
+        if not valid[i]:
+            continue
+        s = int(subjects[i])
+        if s not in seen:
+            seen.add(s)
+            first[i] = True
+    return first
+
+
+def _fetch_ok(o, salt: int, i: int, j: int) -> bool:
+    u = np.float32(fetch_uniform(o.tick, salt, i, j, xp=np))
+    p = _rt(o, i, j)
+    return bool(o.up[j]) and bool(u < p)
+
+
+def _accept_gates(o, i: int, j: int, cand: int, salt: int) -> bool:
+    own = int(o.view_key[i, j])
+    if cand <= own:
+        return False
+    if own < 0 and (cand & 3) > RANK_LEAVING:
+        return False
+    if (cand & 3) == RANK_ALIVE and not _fetch_ok(o, salt, i, j):
+        return False
+    return True
+
+
+def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
+    n = params.capacity
+    f, k_req, T = params.fanout, params.ping_req_k, params.sample_tries
+    M, R = params.mr_slots, params.rumor_slots
+    D = params.delay_slots
+    o = _SO(state)
+    o.tick += 1
+    t = o.tick
+    r = draw_sparse_randoms(key, n, f, k_req, T)
+    r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    proposals: list[tuple[list, list, list, list]] = []
+
+    # ---- FD phase ----
+    fd_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    if (t % params.fd_every) == 0:
+        pre = o.snap()
+        sus_cand = np.full(n, NO_CAND, np.int64)
+        for i in range(n):
+            sel, valid = _pick_rejection(pre, i, r["fd_try"][i], 1 + k_req, T)
+            if not (valid[0] and pre.up[i]):
+                continue
+            tgt = int(sel[0])
+            p_direct = _rt(pre, i, tgt)
+            if D:
+                p_direct = np.float32(
+                    p_direct
+                    * _timely(_dq(pre, i, tgt), _dq(pre, tgt, i),
+                              params.fd_direct_timeout_ticks)
+                )
+            ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
+            for s in range(k_req):
+                if ack:
+                    break
+                if not valid[1 + s]:
+                    continue
+                rl = int(sel[1 + s])
+                p4 = np.float32(_rt(pre, i, rl) * _rt(pre, rl, tgt))
+                if D:
+                    p4 = np.float32(
+                        p4 * _timely(_dq(pre, i, rl), _dq(pre, rl, i),
+                                     params.fd_leg_timeout_ticks)
+                    )
+                    p4 = np.float32(
+                        p4 * _timely(_dq(pre, rl, tgt), _dq(pre, tgt, rl),
+                                     params.fd_leg_timeout_ticks)
+                    )
+                if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
+                    ack = True
+            own = int(pre.view_key[i, tgt])
+            if ack:
+                cand = (int(pre.view_key[tgt, tgt]) >> 2) << 2
+            else:
+                cand = ((own >> 2) << 2) | RANK_SUSPECT
+            if cand > own:
+                o.view_key[i, tgt] = cand
+                fd_props[0][i] = tgt
+                fd_props[1][i] = cand
+                fd_props[3][i] = True
+                if not ack:
+                    sus_cand[tgt] = max(sus_cand[tgt], cand)
+        for j in range(n):
+            if sus_cand[j] > int(o.sus_key[j]):
+                o.sus_key[j] = sus_cand[j]
+                o.sus_since[j] = t
+    proposals.append(fd_props)
+
+    # ---- suspicion expiry sweep (per-episode stamps, every sweep_every) ----
+    exp_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    if (t % params.sweep_every) == 0 and bool((o.sus_since > NEVER).any()):
+        timeout = {
+            i: params.suspicion_mult * _ceil_log2(int(o.n_live[i])) * params.fd_every
+            for i in range(n)
+        }
+        for i in range(n):
+            if not o.up[i]:
+                continue
+            for j in range(n):
+                kij = int(o.view_key[i, j])
+                if (
+                    (kij & 3) == RANK_SUSPECT
+                    and t - int(o.sus_since[j]) >= timeout[i]
+                    and kij <= int(o.sus_key[j])
+                ):
+                    o.view_key[i, j] = kij + 1
+                    o.n_live[i] -= 1
+                    if not exp_props[3][i]:
+                        exp_props[0][i] = j
+                        exp_props[1][i] = kij + 1
+                        exp_props[3][i] = True
+    proposals.append(exp_props)
+
+    # ---- gossip phase ----
+    slot_now = t % D if D else 0
+    work = bool(o.rumor_active.any()) or bool(o.mr_active.any())
+    if D:
+        work = work or bool(o.pending_inf[slot_now].any()) or bool(
+            o.pending_minf[slot_now].any()
+        )
+    if work:
+        age = o.minf_age
+        o.minf_age = np.where(
+            age > 0, np.minimum(age, np.uint8(254)) + np.uint8(1), age
+        ).astype(np.uint8)
+        pre = o.snap()
+        spread = {
+            i: params.repeat_mult * _ceil_log2(int(pre.n_live[i])) for i in range(n)
+        }
+        recv_u = (
+            pre.pending_inf[slot_now].copy() if D else np.zeros((n, R), bool)
+        )
+        recv_src = (
+            pre.pending_src[slot_now].copy() if D else np.full((n, R), -1, np.int32)
+        )
+        recv_m = (
+            pre.pending_minf[slot_now].copy() if D else np.zeros((n, M), bool)
+        )
+        for i in range(n):
+            peers, valid = _pick_rejection(pre, i, r["gossip_try"][i], f, T)
+            young_u = [
+                pre.infected[i, ru]
+                and pre.rumor_active[ru]
+                and t - int(pre.infected_at[i, ru]) < spread[i]
+                for ru in range(R)
+            ]
+            young_m = [
+                pre.mr_active[m]
+                and int(pre.minf_age[i, m]) > 0
+                and int(pre.minf_age[i, m]) <= spread[i]
+                for m in range(M)
+            ]
+            for s in range(f):
+                if not valid[s]:
+                    continue
+                p = int(peers[s])
+                send_u = [
+                    young_u[ru]
+                    and int(pre.infected_from[i, ru]) != p
+                    and int(pre.rumor_origin[ru]) != p
+                    for ru in range(R)
+                ]
+                send_m = [
+                    young_m[m] and int(pre.mr_origin[m]) != p for m in range(M)
+                ]
+                if not (any(send_u) or any(send_m)):
+                    continue
+                if not (pre.up[i] and pre.up[p]):
+                    continue
+                if not bool(
+                    r["gossip_edge"][i, s] < (np.float32(1.0) - _loss(pre, i, p))
+                ):
+                    continue
+                dd = 0
+                if D:
+                    qd = _dq(pre, i, p)
+                    qpow = qd
+                    for _ in range(1, D):
+                        if r["gossip_delay"][i, s] < qpow:
+                            dd += 1
+                        qpow = np.float32(qpow * qd)
+                if dd == 0:
+                    for ru in range(R):
+                        if send_u[ru]:
+                            recv_u[p, ru] = True
+                            recv_src[p, ru] = max(int(recv_src[p, ru]), i)
+                    for m in range(M):
+                        if send_m[m]:
+                            recv_m[p, m] = True
+                else:
+                    sd = (t + dd) % D
+                    for ru in range(R):
+                        if send_u[ru]:
+                            o.pending_inf[sd, p, ru] = True
+                            o.pending_src[sd, p, ru] = max(
+                                int(o.pending_src[sd, p, ru]), i
+                            )
+                    for m in range(M):
+                        if send_m[m]:
+                            o.pending_minf[sd, p, m] = True
+
+        # user-rumor infection
+        for i in range(n):
+            if not pre.up[i]:
+                continue
+            for ru in range(R):
+                if recv_u[i, ru] and pre.rumor_active[ru] and not pre.infected[i, ru]:
+                    o.infected[i, ru] = True
+                    o.infected_at[i, ru] = t
+                    o.infected_from[i, ru] = recv_src[i, ru]
+        # membership-rumor infection + one-shot record application.
+        # Mirrors the kernel's vectorized order: gates read the PRE-apply
+        # table (own), scatter-max resolves duplicate subjects, liveness
+        # deltas count each distinct subject once (first active slot).
+        newly = np.zeros((n, M), bool)
+        for i in range(n):
+            if not pre.up[i]:
+                continue
+            for m in range(M):
+                if recv_m[i, m] and pre.mr_active[m] and int(pre.minf_age[i, m]) == 0:
+                    newly[i, m] = True
+                    o.minf_age[i, m] = 1
+        first = _first_occurrence(pre.mr_subject, pre.mr_active)
+        for i in range(n):
+            best: dict[int, int] = {}
+            for m in range(M):
+                if not newly[i, m]:
+                    continue
+                subj = int(pre.mr_subject[m])
+                cand = int(pre.mr_key[m])
+                own = int(pre.view_key[i, subj])
+                if cand <= own:
+                    continue
+                if own < 0 and (cand & 3) > RANK_LEAVING:
+                    continue
+                if (cand & 3) == RANK_ALIVE and not _fetch_ok(
+                    pre, SALT_GOSSIP, i, subj
+                ):
+                    continue
+                best[subj] = max(best.get(subj, NO_CAND), cand)
+                if (cand & 3) == RANK_SUSPECT and cand > int(o.sus_key[subj]):
+                    o.sus_key[subj] = cand
+                    o.sus_since[subj] = t
+            for subj, cand in best.items():
+                if cand > int(o.view_key[i, subj]):
+                    o.view_key[i, subj] = cand
+            # liveness delta over distinct active subjects
+            delta = 0
+            for m in range(M):
+                if not first[m]:
+                    continue
+                subj = int(pre.mr_subject[m])
+                before = (int(pre.view_key[i, subj]) & 3) != RANK_DEAD
+                after = (int(o.view_key[i, subj]) & 3) != RANK_DEAD
+                delta += int(after) - int(before)
+            o.n_live[i] += delta
+        if D:
+            o.pending_inf[slot_now] = False
+            o.pending_src[slot_now] = -1
+            o.pending_minf[slot_now] = False
+
+    # ---- SYNC phase ----
+    pre = o.snap()
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
+    P = params.sync_announce
+    due_rows = [
+        i
+        for i in range(n)
+        if pre.up[i]
+        and (
+            ((t + i * params.sync_stagger) % params.sync_every) == 0
+            or bool(pre.force_sync[i])
+        )
+    ][:K]
+    seed_mask = None
+    if params.seed_rows:
+        seed_mask = np.zeros(n, bool)
+        seed_mask[list(params.seed_rows)] = True
+    pairs = []  # (slot_index_in_K, caller, peer) for ok round trips
+    for slot_i, i in enumerate(due_rows):
+        peers, valid = _pick_rejection(
+            pre, i, r["sync_try"][i], 1, T, seed_mask=seed_mask
+        )
+        if not valid[0]:
+            continue
+        p = int(peers[0])
+        p_rt = _rt(pre, i, p)
+        if D:
+            p_rt = np.float32(
+                p_rt * _timely(_dq(pre, i, p), _dq(pre, p, i),
+                               params.sync_timeout_ticks)
+            )
+        if pre.up[p] and bool(r["sync_edge"][i] < p_rt):
+            o.force_sync[i] = False
+            pairs.append((slot_i, i, p))
+
+    # REQ: per-peer scatter-max of caller tables, then gates on the winner
+    sus_cand = np.full(n, NO_CAND, np.int64)
+    by_peer: dict[int, list[int]] = {}
+    for slot_i, i, p in pairs:
+        by_peer.setdefault(p, []).append(i)
+    first_peer = set()
+    seen_p: set = set()
+    for slot_i, i, p in pairs:
+        if p not in seen_p:
+            seen_p.add(p)
+            first_peer.add(slot_i)
+    peer_new_rows: dict[int, np.ndarray] = {}
+    for p, callers in by_peer.items():
+        buf = pre.view_key[p].copy()
+        for i in callers:
+            buf = np.maximum(buf, pre.view_key[i])
+        new_row = pre.view_key[p].copy()
+        for j in range(n):
+            cand = int(buf[j])
+            own = int(pre.view_key[p, j])
+            if cand <= own:
+                continue
+            if own < 0 and (cand & 3) > RANK_LEAVING:
+                continue
+            if (cand & 3) == RANK_ALIVE and not _fetch_ok(pre, SALT_SYNC_REQ, p, j):
+                continue
+            new_row[j] = cand
+            if (cand & 3) == RANK_SUSPECT:
+                sus_cand[j] = max(sus_cand[j], cand)
+        delta = int(
+            ((new_row & 3) != RANK_DEAD).sum() - ((pre.view_key[p] & 3) != RANK_DEAD).sum()
+        )
+        o.view_key[p] = np.maximum(o.view_key[p], new_row)
+        o.n_live[p] += delta
+        peer_new_rows[p] = new_row
+
+    # ACK: peer's post-REQ row back to each caller
+    mid = o.snap()
+    caller_acc: dict[int, np.ndarray] = {}
+    for slot_i, i, p in pairs:
+        ack = mid.view_key[p]
+        own_row = mid.view_key[i].copy()
+        acc = np.zeros(n, bool)
+        new_row = own_row.copy()
+        for j in range(n):
+            cand = int(ack[j])
+            own = int(own_row[j])
+            if cand <= own:
+                continue
+            if own < 0 and (cand & 3) > RANK_LEAVING:
+                continue
+            if (cand & 3) == RANK_ALIVE and not _fetch_ok(mid, SALT_SYNC_ACK, i, j):
+                continue
+            new_row[j] = cand
+            acc[j] = True
+            if (cand & 3) == RANK_SUSPECT:
+                sus_cand[j] = max(sus_cand[j], cand)
+        delta = int(
+            ((new_row & 3) != RANK_DEAD).sum() - ((own_row & 3) != RANK_DEAD).sum()
+        )
+        o.view_key[i] = np.maximum(o.view_key[i], new_row)
+        o.n_live[i] += delta
+        caller_acc[i] = np.where(acc, ack, NO_CAND)
+    for j in range(n):
+        if sus_cand[j] > int(o.sus_key[j]):
+            o.sus_key[j] = sus_cand[j]
+            o.sus_since[j] = t
+
+    # SYNC re-gossip proposals: top-P accepted keys per participant, mirrored
+    # in the kernel's iteration-major concat order over K static slots
+    def _top_props(rows_by_slot, acc_by_slot, owner_valid_by_slot):
+        subs = [[0] * K for _ in range(P)]
+        keys = [[0] * K for _ in range(P)]
+        origs = [[0] * K for _ in range(P)]
+        vals = [[False] * K for _ in range(P)]
+        for slot_i in range(K):
+            owner = rows_by_slot.get(slot_i)
+            if owner is None:
+                continue
+            rem = acc_by_slot.get(slot_i)
+            for p_i in range(P):
+                origs[p_i][slot_i] = owner
+                if rem is None:
+                    continue
+                col = int(np.argmax(rem))
+                val = int(rem[col])
+                good = val > NO_CAND and owner_valid_by_slot.get(slot_i, False)
+                subs[p_i][slot_i] = col
+                keys[p_i][slot_i] = val
+                vals[p_i][slot_i] = good
+                rem = rem.copy()
+                rem[col] = NO_CAND
+                acc_by_slot[slot_i] = rem
+        flat = lambda a: [x for chunk in a for x in chunk]
+        return (flat(subs), flat(keys), flat(origs), flat(vals))
+
+    # peers: accepted = cells where the merged row changed, first-peer only
+    rows_p, acc_p, valid_p = {}, {}, {}
+    for slot_i, i, p in pairs:
+        rows_p[slot_i] = p
+        if slot_i in first_peer:
+            new_row = peer_new_rows[p]
+            changed = new_row != pre.view_key[p]
+            acc_p[slot_i] = np.where(changed, new_row, NO_CAND).astype(np.int64)
+            valid_p[slot_i] = True
+    # kernel origin field is `peer` for every slot (invalid slots carry the
+    # clamped caller row, but valid=False so values don't matter except
+    # origin placement — mirror only valid slots, rest are zeros/False)
+    props_p = _top_props(rows_p, acc_p, valid_p)
+    rows_c, acc_c, valid_c2 = {}, {}, {}
+    for slot_i, i, p in pairs:
+        rows_c[slot_i] = i
+        acc_c[slot_i] = caller_acc[i].astype(np.int64)
+        valid_c2[slot_i] = True
+    props_c = _top_props(rows_c, acc_c, valid_c2)
+    proposals.append(tuple(a + b for a, b in zip(props_p, props_c)))
+
+    # ---- refutation ----
+    ref_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    for i in range(n):
+        diag = int(o.view_key[i, i])
+        rank = diag & 3
+        need = bool(o.up[i]) and (
+            rank == RANK_SUSPECT
+            or rank == RANK_DEAD
+            or (bool(o.leaving[i]) and rank != RANK_LEAVING)
+        )
+        new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
+        new_diag = (((diag >> 2) + 1) << 2) | new_rank if need else diag
+        ref_props[0][i] = i
+        ref_props[1][i] = new_diag
+        ref_props[3][i] = need
+        if need:
+            if rank == RANK_DEAD:
+                o.n_live[i] += 1
+            o.view_key[i, i] = new_diag
+    proposals.append(ref_props)
+
+    # ---- rumor sweeps ----
+    n_up = int(o.up.sum())
+    sweep = 2 * (params.repeat_mult * _ceil_log2(n_up) + 1)
+    spread = {i: params.repeat_mult * _ceil_log2(int(o.n_live[i])) for i in range(n)}
+    for ru in range(R):
+        if not o.rumor_active[ru] or t - int(o.rumor_created[ru]) <= sweep:
+            continue
+        if D and bool(o.pending_inf[:, :, ru].any()):
+            continue
+        if any(
+            o.infected[i, ru] and o.up[i] and t - int(o.infected_at[i, ru]) < spread[i]
+            for i in range(n)
+        ):
+            continue
+        o.rumor_active[ru] = False
+    for m in range(M):
+        if not o.mr_active[m]:
+            continue
+        pending = D and bool(o.pending_minf[:, :, m].any())
+        forwarding = any(
+            o.up[i] and 0 < int(o.minf_age[i, m]) <= spread[i] for i in range(n)
+        )
+        keep = (t - int(o.mr_created[m]) <= sweep) or forwarding or pending
+        if params.early_free:
+            covered = all(
+                (not o.up[i]) or int(o.minf_age[i, m]) > 0 for i in range(n)
+            )
+            if covered and not pending:
+                keep = False
+        if not keep:
+            o.mr_active[m] = False
+            o.mr_subject[m] = -1
+            o.minf_age[:, m] = 0
+            if D:
+                o.pending_minf[:, :, m] = False
+
+    # ---- announcement allocation ----
+    E = params.announce_slots
+    subject = [x for p in proposals for x in p[0]]
+    key_l = [x for p in proposals for x in p[1]]
+    origin = [x for p in proposals for x in p[2]]
+    valid = [x for p in proposals for x in p[3]]
+    if any(valid):
+        compact = [i for i, v in enumerate(valid) if v][:E]
+        pool = {
+            (int(o.mr_subject[m]), int(o.mr_key[m]))
+            for m in range(M)
+            if o.mr_active[m]
+        }
+        seen: set = set()
+        free = [m for m in range(M) if not o.mr_active[m]][:E]
+        fi = 0
+        for ci in compact:
+            s, kk, oo = int(subject[ci]), int(key_l[ci]), int(origin[ci])
+            if (s, kk) in seen or (s, kk) in pool:
+                continue
+            seen.add((s, kk))
+            if fi >= len(free):
+                continue
+            slot = free[fi]
+            fi += 1
+            o.mr_active[slot] = True
+            o.mr_subject[slot] = s
+            o.mr_key[slot] = kk
+            o.mr_created[slot] = t
+            o.mr_origin[slot] = oo
+            o.minf_age[oo, slot] = max(int(o.minf_age[oo, slot]), 1)
+    return o
+
+
+def assert_sparse_equivalent(state: SparseState, o: _SO) -> None:
+    pairs = {"tick": (int(state.tick), o.tick)}
+    for name in (
+        "up", "epoch", "view_key", "n_live", "sus_key", "sus_since",
+        "force_sync", "leaving", "mr_active", "mr_subject", "mr_key",
+        "mr_created", "mr_origin", "minf_age", "rumor_active", "rumor_origin",
+        "rumor_created", "infected", "infected_at", "infected_from",
+        "pending_minf", "pending_inf", "pending_src",
+    ):
+        pairs[name] = (np.asarray(getattr(state, name)), getattr(o, name))
+    for name, (a, b) in pairs.items():
+        a, b = np.asarray(a), np.asarray(b)
+        if not np.array_equal(a, b):
+            diff = np.argwhere(a != b)
+            raise AssertionError(
+                f"sparse kernel/oracle divergence in {name} at "
+                f"{diff[:10].tolist()} (kernel="
+                f"{a[tuple(diff[0])] if diff.size else a}, "
+                f"oracle={b[tuple(diff[0])] if diff.size else b})"
+            )
