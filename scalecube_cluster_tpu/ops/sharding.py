@@ -97,6 +97,83 @@ def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
     )
 
 
+def sparse_state_shardings(mesh: Mesh, dense_links: bool = False, delay_slots: int = 0):
+    """SparseState-shaped pytree of NamedShardings: every [N, ...] tensor
+    row-sharded on the member axis; the [M]/[R] rumor-pool vectors and
+    scalars replicated; [D, N, ...] pending rings sharded on dim 1. The
+    membership-rumor pool being replicated is what makes dissemination
+    cross-shard-cheap: senders scatter infection bits into receiver rows
+    (one collective), while pool metadata needs no communication at all."""
+    from .sparse import SparseState
+
+    row = NamedSharding(mesh, P(MEMBER_AXIS))
+    row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    ring = NamedSharding(mesh, P(None, MEMBER_AXIS, None)) if delay_slots else rep
+    return SparseState(
+        tick=rep,
+        up=row,
+        epoch=row,
+        view_key=row2d,
+        n_live=row,
+        sus_key=row,
+        sus_since=row,
+        force_sync=row,
+        leaving=row,
+        mr_active=rep,
+        mr_subject=rep,
+        mr_key=rep,
+        mr_created=rep,
+        mr_origin=rep,
+        minf_age=row2d,
+        rumor_active=rep,
+        rumor_origin=rep,
+        rumor_created=rep,
+        infected=row2d,
+        infected_at=row2d,
+        infected_from=row2d,
+        loss=row2d if dense_links else rep,
+        fetch_rt=row2d if dense_links else rep,
+        delay_q=row2d if dense_links else rep,
+        pending_minf=ring,
+        pending_inf=ring,
+        pending_src=ring,
+    )
+
+
+def shard_sparse_state(state, mesh: Mesh):
+    return jax.device_put(
+        state,
+        sparse_state_shardings(mesh, state.loss.ndim != 0, state.pending_minf.shape[0]),
+    )
+
+
+def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
+    from .sparse import sparse_tick
+
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    sh = sparse_state_shardings(mesh, dense_links, params.delay_slots)
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        partial(sparse_tick, params=params),
+        in_shardings=(sh, rep),
+        out_shardings=(sh, None),
+    )
+
+
+def make_sharded_sparse_run(mesh: Mesh, params, n_ticks: int):
+    from .sparse import run_sparse_ticks
+
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    return jax.jit(partial(run_sparse_ticks, n_ticks=n_ticks, params=params))
+
+
 def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: bool = True):
     """jit the batched ``run_ticks`` window over ``mesh``.
 

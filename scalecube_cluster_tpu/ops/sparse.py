@@ -64,6 +64,16 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    sweep (``getGossipsToRemove:350-358``) would — fewer redundant sends, no
    semantic difference (every reachable node already merged it). Age-based
    sweep still bounds the lifetime of never-fully-covered rumors.
+6. **Receiver-pulled delivery with slot-collision drop.** Deliveries resolve
+   through per-fanout-slot inverse sender indexes (one [N] point scatter +
+   row gathers — ~2x the throughput of scattering payload planes by
+   receiver): when several senders pick the same receiver in the same slot,
+   only the highest-row sender's message lands that tick (the rest retry
+   while their forwarding windows last — a second-order extra-loss term,
+   ~fanout/N per edge). The known-infected/origin filters apply
+   receiver-side, which cannot change state evolution (a filtered receiver
+   is by definition already infected); message counters tally payload-
+   bearing sends before that filter.
 
 Memory at flagship scale (v5e, 16 GB/chip): N=98,304 sharded over 8 chips =
 4.8 GB/chip for ``view_key`` + 0.4 GB for a 32k-slot ``minf_age`` plane; the
@@ -278,32 +288,78 @@ def init_sparse_state(
     )
 
 
+def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
+    """Allocate/supersede membership rumors for E compacted proposals.
+
+    POOL INVARIANT: active slots carry UNIQUE subjects. A proposal matching
+    an active subject with a strictly HIGHER key supersedes that slot in
+    place (the old rumor's infection column and pending deliveries are
+    cleared — the superseded record loses every merge anyway, so spreading
+    the stronger fact instead is strictly faster); lower/equal keys are
+    already covered and are skipped. Fresh subjects take ascending free
+    slots. Batch duplicates: max key wins, ties to the earliest entry.
+    Returns (state, allocated_count, dropped_count).
+    """
+    E = subj_p.shape[0]
+    M = state.mr_active.shape[0]
+    s = jnp.where(got, subj_p, -9)  # sentinel: matches nothing real
+    same_s = s[:, None] == s[None, :]
+    tie_earlier = jnp.tri(E, E, -1, dtype=bool)  # [e, e']: e' < e
+    lose = (
+        same_s
+        & (
+            (key_p[None, :] > key_p[:, None])
+            | ((key_p[None, :] == key_p[:, None]) & tie_earlier)
+        )
+    ).any(axis=1)
+    win = got & ~lose
+    match = (s[:, None] == state.mr_subject[None, :]) & state.mr_active[None, :]
+    has_match = match.any(axis=1)
+    mslot = jnp.argmax(match, axis=1).astype(jnp.int32)
+    replace = win & has_match & (key_p > state.mr_key[mslot])
+    fresh = win & ~has_match
+    rank = jnp.cumsum(fresh.astype(jnp.int32)) - 1
+    (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
+    slot_fresh = free[jnp.clip(rank, 0, E - 1)]
+    ok_fresh = fresh & (slot_fresh < M)
+    do = replace | ok_fresh
+    slot = jnp.where(replace, mslot, jnp.minimum(slot_fresh, M - 1))
+    slot = jnp.where(do, slot, M)  # non-allocating entries dropped OOB
+    clear_slot = jnp.where(replace, slot, M)
+    age = state.minf_age.at[:, clear_slot].set(
+        jnp.uint8(0), mode="drop", unique_indices=True
+    )
+    age = age.at[orig_p, slot].set(jnp.uint8(1), mode="drop")
+    st = state.replace(
+        mr_active=state.mr_active.at[slot].set(True, mode="drop"),
+        mr_subject=state.mr_subject.at[slot].set(s, mode="drop"),
+        mr_key=state.mr_key.at[slot].set(key_p, mode="drop"),
+        mr_created=state.mr_created.at[slot].set(state.tick, mode="drop"),
+        mr_origin=state.mr_origin.at[slot].set(orig_p, mode="drop"),
+        minf_age=age,
+    )
+    if state.pending_minf.shape[0]:
+        st = st.replace(
+            pending_minf=state.pending_minf.at[:, :, clear_slot].set(
+                False, mode="drop", unique_indices=True
+            )
+        )
+    return st, do.sum(), (fresh & ~ok_fresh).sum()
+
+
 def announce(state: SparseState, subject, key, origin) -> SparseState:
     """Host-side membership-rumor allocation (join/leave/metadata paths —
-    the in-tick analogue is the allocation phase). First free slot; silently
-    skipped when the pool is full (SYNC still converges, deviation 3)."""
-    subject = jnp.asarray(subject, jnp.int32)
-    free = ~state.mr_active
-    slot = jnp.argmax(free)
-    ok = free[slot]
-    return state.replace(
-        mr_active=state.mr_active.at[slot].set(ok | state.mr_active[slot]),
-        mr_subject=jnp.where(
-            ok, state.mr_subject.at[slot].set(subject), state.mr_subject
-        ),
-        mr_key=jnp.where(ok, state.mr_key.at[slot].set(jnp.asarray(key)), state.mr_key),
-        mr_created=jnp.where(
-            ok, state.mr_created.at[slot].set(state.tick), state.mr_created
-        ),
-        mr_origin=jnp.where(
-            ok, state.mr_origin.at[slot].set(jnp.asarray(origin)), state.mr_origin
-        ),
-        minf_age=jnp.where(
-            ok,
-            state.minf_age.at[jnp.asarray(origin), slot].set(jnp.uint8(1)),
-            state.minf_age,
-        ),
+    the in-tick analogue is the allocation phase). Supersedes an existing
+    rumor about the same subject when strictly newer; silently skipped when
+    the pool is full (SYNC still converges, deviation 3)."""
+    st, _a, _d = _allocate(
+        state,
+        jnp.asarray([subject], jnp.int32),
+        jnp.asarray([key], jnp.int32),
+        jnp.asarray([origin], jnp.int32),
+        jnp.ones((1,), bool),
     )
+    return st
 
 
 def join_row(state: SparseState, row: int, seed_rows) -> SparseState:
@@ -400,20 +456,11 @@ def join_rows(state: SparseState, rows, seed_rows) -> SparseState:
         if state.pending_src.shape[0]
         else state.pending_src,
     )
-    # batch self-announces: first k free slots (ascending); overflow entries
-    # are routed out of bounds and dropped (pool-full joiners still bootstrap
-    # via force_sync + the SYNC participants' re-gossip)
-    M = state.mr_active.shape[0]
-    free_idx = jnp.nonzero(~state.mr_active, size=k, fill_value=M)[0]
-    slot = jnp.where(free_idx < M, free_idx, M)
-    return state.replace(
-        mr_active=state.mr_active.at[slot].set(True, mode="drop"),
-        mr_subject=state.mr_subject.at[slot].set(rows, mode="drop"),
-        mr_key=state.mr_key.at[slot].set(self_keys, mode="drop"),
-        mr_created=state.mr_created.at[slot].set(state.tick, mode="drop"),
-        mr_origin=state.mr_origin.at[slot].set(rows, mode="drop"),
-        minf_age=state.minf_age.at[rows, slot].set(jnp.uint8(1), mode="drop"),
-    )
+    # batch self-announces (supersede-capable: a joiner's fresh epoch beats a
+    # lingering death rumor about the same row); pool-full joiners still
+    # bootstrap via force_sync + the SYNC participants' re-gossip
+    state, _a, _d = _allocate(state, rows, self_keys, rows, jnp.ones((k,), bool))
+    return state
 
 
 def crash_row(state: SparseState, row: int) -> SparseState:
@@ -560,39 +607,26 @@ def _sample_rejection(
     a pick can come up empty with prob (1-live_frac)^tries.
     """
     n = state.capacity
+    # ALL try-columns materialized and validated in ONE [R, P·T] gather (the
+    # sampled state is the pre-phase table, constant across tries — per-try
+    # point-gathers measured ~10x slower as separate kernels)
+    cols = jnp.minimum((u * np.float32(n)).astype(jnp.int32), n - 1)  # [R, P*T]
+    live = (state.view_key[rows[:, None], cols] & 3) != RANK_DEAD
+    if extra_mask is not None:
+        live = live | extra_mask[cols]
+    ok_base = (cols != rows[:, None]) & live
     picks = []
     for p in range(n_picks):
         sel = jnp.full(rows.shape, -1, jnp.int32)
         for t in range(tries):
-            c = jnp.minimum(
-                (u[:, p * tries + t] * np.float32(n)).astype(jnp.int32), n - 1
-            )
-            ok = c != rows
-            live = (state.view_key[rows, c] & 3) != RANK_DEAD
-            if extra_mask is not None:
-                live = live | extra_mask[c]
-            ok = ok & live
+            c = cols[:, p * tries + t]
+            ok = ok_base[:, p * tries + t]
             for q in picks:
                 ok = ok & (c != q)  # q == -1 never collides
             sel = jnp.where((sel < 0) & ok, c, sel)
         picks.append(sel)
     idx = jnp.stack(picks, 1)
     return jnp.maximum(idx, 0), idx >= 0
-
-
-def _first_occurrence(subjects: jax.Array, valid: jax.Array) -> jax.Array:
-    """Mask keeping one entry per distinct subject among ``valid`` entries
-    (needed so per-row liveness deltas don't double-count duplicate rumor
-    subjects). Stable: the earliest index among equals wins."""
-    m = subjects.shape[0]
-    key = jnp.where(valid, subjects, jnp.int32(-2))
-    order = jnp.argsort(key, stable=True)
-    sorted_key = key[order]
-    first_sorted = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_key[1:] != sorted_key[:-1]]
-    )
-    first = jnp.zeros((m,), bool).at[order].set(first_sorted)
-    return first & valid
 
 
 # ---------------------------------------------------------------------------
@@ -771,26 +805,33 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             recv_src = jnp.full_like(state.infected_from, -1)
             recv_m = jnp.zeros((n, m), bool)
 
+        # Delivery is RECEIVER-pulled through per-slot inverse sender
+        # indexes: one [N] point scatter builds inv_s (the sender that
+        # reached each receiver in fanout slot s), then row GATHERS pull the
+        # payloads — measured ~2x the throughput of scattering [N, ·] payload
+        # planes by receiver. Two deliberate consequences (deviation 6):
+        # (a) when several senders pick the same receiver in the SAME slot,
+        # only the highest-row sender's message lands (the others retry
+        # while their forwarding window lasts — statistically a second-order
+        # extra-loss term, ~fanout/N per edge); (b) the known-infected /
+        # origin filters apply receiver-side (a filtered receiver is already
+        # infected, so state evolution is unchanged; message counters tally
+        # payload-bearing sends before that filter).
+        young_m_i32 = young_m  # [N, M] sender payload (receiver-independent)
+        sender_has = young_u.any(axis=1) | young_m.any(axis=1)
         sent = jnp.int32(0)
         rumor_sent = jnp.int32(0)
+        no_sender = jnp.full((n,), -1, jnp.int32)
         for s in range(params.fanout):
             p = peers[:, s]
-            send_u = (
-                young_u
-                & (state.infected_from != p[:, None])
-                & (state.rumor_origin[None, :] != p[:, None])
-            )
-            send_m = young_m & (state.mr_origin[None, :] != p[:, None])
-            has_payload = send_u.any(axis=1) | send_m.any(axis=1)
             ok = (
                 peer_valid[:, s]
-                & has_payload
+                & sender_has
                 & state.up
                 & state.up[p]
                 & (r.gossip_edge[:, s] < (1.0 - _loss_at(state, rows, p)))
             )
             sent = sent + ok.sum()
-            rumor_sent = rumor_sent + (send_u & ok[:, None]).sum()
             if D:
                 qd = _delay_q_at(state, rows, p)
                 d = jnp.zeros((n,), jnp.int32)
@@ -800,19 +841,42 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                     qpow = qpow * qd
                 ok_now = ok & (d == 0)
                 ok_late = ok & (d > 0)
-                slot_d = (state.tick + d) % D
-                late_u = send_u & ok_late[:, None]
-                pend_u = pend_u.at[slot_d, p].max(late_u)
-                pend_src = pend_src.at[slot_d, p].max(
-                    jnp.where(late_u, rows[:, None], -1)
-                )
-                pend_m = pend_m.at[slot_d, p].max(send_m & ok_late[:, None])
             else:
                 ok_now = ok
-            now_u = send_u & ok_now[:, None]
-            recv_u = recv_u.at[p].max(now_u)
-            recv_src = recv_src.at[p].max(jnp.where(now_u, rows[:, None], -1))
-            recv_m = recv_m.at[p].max(send_m & ok_now[:, None])
+            inv_s = no_sender.at[p].max(jnp.where(ok_now, rows, -1))
+            j = jnp.maximum(inv_s, 0)
+            has = (inv_s >= 0)[:, None]
+            deliver_u = (
+                young_u[j]
+                & has
+                & (state.infected_from[j] != rows[:, None])
+                & (state.rumor_origin[None, :] != rows[:, None])
+            )
+            recv_u = recv_u | deliver_u
+            recv_src = jnp.maximum(recv_src, jnp.where(deliver_u, j[:, None], -1))
+            deliver_m = (
+                young_m_i32[j] & has & (state.mr_origin[None, :] != rows[:, None])
+            )
+            recv_m = recv_m | deliver_m
+            rumor_sent = rumor_sent + deliver_u.sum()
+            if D:
+                inv_l = no_sender.at[p].max(jnp.where(ok_late, rows, -1))
+                jl = jnp.maximum(inv_l, 0)
+                hasl = (inv_l >= 0)[:, None]
+                slot_d = (state.tick + d[jl]) % D
+                late_u = (
+                    young_u[jl]
+                    & hasl
+                    & (state.infected_from[jl] != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                pend_u = pend_u.at[slot_d, rows].max(late_u)
+                pend_src = pend_src.at[slot_d, rows].max(
+                    jnp.where(late_u, jl[:, None], -1)
+                )
+                pend_m = pend_m.at[slot_d, rows].max(
+                    young_m_i32[jl] & hasl & (state.mr_origin[None, :] != rows[:, None])
+                )
 
         # user-rumor infection (bitmap OR = SequenceIdCollector dedup)
         newly_u = recv_u & ~state.infected & state.up[:, None] & state.rumor_active[None, :]
@@ -829,7 +893,11 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         state = state.replace(
             minf_age=jnp.where(newly_m, jnp.uint8(1), state.minf_age)
         )
-        subj = jnp.maximum(state.mr_subject, 0)  # [M]; inactive masked below
+        # Record application. Pool subjects are UNIQUE among active slots
+        # (allocation supersedes-in-place, see _alloc_phase), so the winner
+        # at a cell IS the slot's own accepted candidate — no group-max, no
+        # second gather, and the column scatter carries unique indices.
+        subj = jnp.maximum(state.mr_subject, 0)  # clamped for the gather
         own = jnp.take(state.view_key, subj, axis=1)  # [N, M]
         cand = jnp.where(newly_m, state.mr_key[None, :], NO_CANDIDATE)
         p_fetch = (
@@ -843,22 +911,24 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             & _fetch_gate(state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch)
         )
         vals = jnp.where(accept, cand, NO_CANDIDATE)
-        new_view = state.view_key.at[:, subj].max(vals)
-        # liveness deltas: count each distinct subject once (duplicate-slot
-        # rumors about one subject would double-count otherwise)
-        first = _first_occurrence(state.mr_subject, state.mr_active)
-        new_own = jnp.take(new_view, subj, axis=1)
+        subj_scatter = jnp.where(state.mr_active, state.mr_subject, n)  # OOB -> drop
+        new_view = state.view_key.at[:, subj_scatter].max(
+            vals, mode="drop", unique_indices=True
+        )
+        new_own = jnp.where(accept, cand, own)
         delta = (
             ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
             - ((own & 3) != RANK_DEAD).astype(jnp.int32)
-        ) * first[None, :].astype(jnp.int32)
+        )
         n_live = state.n_live + delta.sum(axis=1)
         # episode registration for accepted SUSPECT records
         sus_col = jnp.where(accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE).max(
             axis=0
         )  # [M]
         sus_cand = (
-            jnp.full((n,), NO_CANDIDATE, jnp.int32).at[subj].max(sus_col)
+            jnp.full((n,), NO_CANDIDATE, jnp.int32)
+            .at[subj_scatter]
+            .max(sus_col, mode="drop", unique_indices=True)
         )
         new_sus = jnp.maximum(state.sus_key, sus_cand)
         state = state.replace(
@@ -925,9 +995,17 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
     caller_tables = state.view_key[caller]  # [K, N]
-    buf = state.view_key.at[peer].max(jnp.where(ok[:, None], caller_tables, NO_CANDIDATE))
+    # Merge slots sharing a peer COMPACTLY ([K, K] + [K, N] scratch) instead
+    # of staging through an [N, N] scatter copy — the staging copy alone was
+    # ~2.4 ms/tick at N=16k. dup_to_first[k] = first slot with slot k's peer;
+    # invalid slots get unique sentinels so they form singleton groups.
+    cand_k = jnp.where(ok[:, None], caller_tables, NO_CANDIDATE)  # [K, N]
+    peer_eff = jnp.where(ok, peer, -1 - jnp.arange(K, dtype=jnp.int32))
+    dup_to_first = jnp.argmax(peer_eff[:, None] == peer_eff[None, :], axis=1)
+    merged = jnp.full((K, n), NO_CANDIDATE, jnp.int32).at[dup_to_first].max(cand_k)
     own_p = state.view_key[peer]
-    buf_p = buf[peer]
+    buf_p = jnp.maximum(own_p, merged[dup_to_first])  # [K, N]
+    first_p = ok & (dup_to_first == jnp.arange(K))
     acc = (
         (buf_p > own_p)
         & ((own_p >= 0) | ((buf_p & 3) <= RANK_LEAVING))
@@ -943,8 +1021,7 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     )
     new_p = jnp.where(acc, buf_p, own_p)
     # duplicate peer slots recompute the IDENTICAL merged row; liveness
-    # deltas must count each distinct peer once
-    first_p = _first_occurrence(jnp.where(ok, peer, -1), ok)
+    # deltas count each distinct peer once (first_p)
     delta_p = (
         ((new_p & 3) != RANK_DEAD).astype(jnp.int32)
         - ((own_p & 3) != RANK_DEAD).astype(jnp.int32)
@@ -1111,7 +1188,6 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
     active pool, and assigned ascending free slots. Dropped proposals are
     counted (``announce_dropped``) — they reach stragglers via SYNC."""
     E = params.announce_slots
-    M = params.mr_slots
     subject = jnp.concatenate([p[0] for p in proposals])
     key = jnp.concatenate([p[1] for p in proposals])
     origin = jnp.concatenate([p[2] for p in proposals])
@@ -1122,41 +1198,14 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
         (idx,) = jnp.nonzero(valid, size=E, fill_value=L)
         got = idx < L
         idx = jnp.minimum(idx, L - 1)
-        s = jnp.where(got, subject[idx], -9)  # sentinel: matches nothing real
-        k, o = key[idx], origin[idx]
-        # batch dedup (earliest compacted index wins) + pool dedup — E is
-        # small (announce_slots), so O(E²)+O(E·M) broadcast compares beat a
-        # 64-bit pack-and-sort (and the runtime is 32-bit anyway)
-        same = (s[:, None] == s[None, :]) & (k[:, None] == k[None, :])
-        dup = (same & jnp.tri(E, E, -1, dtype=bool)).any(axis=1)
-        in_pool = (
-            (s[:, None] == state.mr_subject[None, :])
-            & (k[:, None] == state.mr_key[None, :])
-            & state.mr_active[None, :]
-        ).any(axis=1)
-        new = got & ~dup & ~in_pool
-        rank = jnp.cumsum(new.astype(jnp.int32)) - 1
-        (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
-        slot_r = free[jnp.clip(rank, 0, E - 1)]
-        ok = new & (slot_r < M)
-        # entries that allocate nothing are routed OUT OF BOUNDS and dropped:
-        # a clamped in-bounds index would duplicate a real allocation's slot,
-        # and scatter-set with conflicting duplicate values is order-undefined
-        slot = jnp.where(ok, jnp.minimum(slot_r, M - 1), M)
-        st = state.replace(
-            mr_active=state.mr_active.at[slot].set(True, mode="drop"),
-            mr_subject=state.mr_subject.at[slot].set(s, mode="drop"),
-            mr_key=state.mr_key.at[slot].set(k, mode="drop"),
-            mr_created=state.mr_created.at[slot].set(state.tick, mode="drop"),
-            mr_origin=state.mr_origin.at[slot].set(o, mode="drop"),
-            minf_age=state.minf_age.at[o, slot].set(jnp.uint8(1), mode="drop"),
+        st, allocated, no_slot = _allocate(
+            state, subject[idx], key[idx], origin[idx], got
         )
-        # dropped = compaction overflow (valid proposals beyond E) + unique
-        # new proposals that found no free slot; batch/pool duplicates are
-        # not drops (the rumor already exists and keeps spreading)
+        # dropped = compaction overflow (valid proposals beyond E) + fresh
+        # winners that found no free slot; batch duplicates and superseded/
+        # already-covered proposals are not drops
         overflow = valid.sum() - got.sum()
-        no_slot = new.sum() - ok.sum()
-        return st, {"announce_dropped": overflow + no_slot, "announced": ok.sum()}
+        return st, {"announce_dropped": overflow + no_slot, "announced": allocated}
 
     def _skip(state: SparseState):
         return state, {"announce_dropped": jnp.int32(0), "announced": jnp.int32(0)}

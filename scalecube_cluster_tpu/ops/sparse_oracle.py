@@ -99,21 +99,6 @@ def _pick_rejection(o, row: int, u: np.ndarray, n_picks: int, tries: int,
     return idx, valid
 
 
-def _first_occurrence(subjects: np.ndarray, valid: np.ndarray) -> np.ndarray:
-    """Mirror of ``sparse._first_occurrence``: earliest index per distinct
-    subject among valid entries."""
-    first = np.zeros(subjects.shape[0], bool)
-    seen: set = set()
-    for i in range(subjects.shape[0]):
-        if not valid[i]:
-            continue
-        s = int(subjects[i])
-        if s not in seen:
-            seen.add(s)
-            first[i] = True
-    return first
-
-
 def _fetch_ok(o, salt: int, i: int, j: int) -> bool:
     u = np.float32(fetch_uniform(o.tick, salt, i, j, xp=np))
     p = _rt(o, i, j)
@@ -248,68 +233,87 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         recv_m = (
             pre.pending_minf[slot_now].copy() if D else np.zeros((n, M), bool)
         )
+        # per-sender payloads + peer picks (receiver-independent)
+        young_u = np.zeros((n, R), bool)
+        young_m = np.zeros((n, M), bool)
+        peers_all = np.zeros((n, f), np.int32)
+        valid_all = np.zeros((n, f), bool)
         for i in range(n):
-            peers, valid = _pick_rejection(pre, i, r["gossip_try"][i], f, T)
-            young_u = [
-                pre.infected[i, ru]
-                and pre.rumor_active[ru]
-                and t - int(pre.infected_at[i, ru]) < spread[i]
-                for ru in range(R)
-            ]
-            young_m = [
-                pre.mr_active[m]
-                and int(pre.minf_age[i, m]) > 0
-                and int(pre.minf_age[i, m]) <= spread[i]
-                for m in range(M)
-            ]
-            for s in range(f):
-                if not valid[s]:
+            peers_all[i], valid_all[i] = _pick_rejection(
+                pre, i, r["gossip_try"][i], f, T
+            )
+            for ru in range(R):
+                young_u[i, ru] = (
+                    pre.infected[i, ru]
+                    and pre.rumor_active[ru]
+                    and t - int(pre.infected_at[i, ru]) < spread[i]
+                )
+            for m in range(M):
+                young_m[i, m] = (
+                    pre.mr_active[m]
+                    and 0 < int(pre.minf_age[i, m]) <= spread[i]
+                )
+        sender_has = young_u.any(axis=1) | young_m.any(axis=1)
+        # receiver-pulled delivery via per-slot inverse sender indexes
+        # (sparse.py deviation 6: highest-row sender wins slot collisions;
+        # known-infected/origin filters applied receiver-side)
+        for s in range(f):
+            inv_now = np.full(n, -1, np.int32)
+            inv_late = np.full(n, -1, np.int32)
+            d_of = np.zeros(n, np.int32)
+            for j in range(n):  # senders
+                if not (valid_all[j, s] and sender_has[j] and pre.up[j]):
                     continue
-                p = int(peers[s])
-                send_u = [
-                    young_u[ru]
-                    and int(pre.infected_from[i, ru]) != p
-                    and int(pre.rumor_origin[ru]) != p
-                    for ru in range(R)
-                ]
-                send_m = [
-                    young_m[m] and int(pre.mr_origin[m]) != p for m in range(M)
-                ]
-                if not (any(send_u) or any(send_m)):
-                    continue
-                if not (pre.up[i] and pre.up[p]):
+                p = int(peers_all[j, s])
+                if not pre.up[p]:
                     continue
                 if not bool(
-                    r["gossip_edge"][i, s] < (np.float32(1.0) - _loss(pre, i, p))
+                    r["gossip_edge"][j, s] < (np.float32(1.0) - _loss(pre, j, p))
                 ):
                     continue
                 dd = 0
                 if D:
-                    qd = _dq(pre, i, p)
+                    qd = _dq(pre, j, p)
                     qpow = qd
                     for _ in range(1, D):
-                        if r["gossip_delay"][i, s] < qpow:
+                        if r["gossip_delay"][j, s] < qpow:
                             dd += 1
                         qpow = np.float32(qpow * qd)
+                d_of[j] = dd
                 if dd == 0:
-                    for ru in range(R):
-                        if send_u[ru]:
-                            recv_u[p, ru] = True
-                            recv_src[p, ru] = max(int(recv_src[p, ru]), i)
-                    for m in range(M):
-                        if send_m[m]:
-                            recv_m[p, m] = True
+                    inv_now[p] = max(inv_now[p], j)
                 else:
-                    sd = (t + dd) % D
+                    inv_late[p] = max(inv_late[p], j)
+            for i in range(n):  # receivers
+                j = int(inv_now[i])
+                if j >= 0:
                     for ru in range(R):
-                        if send_u[ru]:
-                            o.pending_inf[sd, p, ru] = True
-                            o.pending_src[sd, p, ru] = max(
-                                int(o.pending_src[sd, p, ru]), i
+                        if (
+                            young_u[j, ru]
+                            and int(pre.infected_from[j, ru]) != i
+                            and int(pre.rumor_origin[ru]) != i
+                        ):
+                            recv_u[i, ru] = True
+                            recv_src[i, ru] = max(int(recv_src[i, ru]), j)
+                    for m in range(M):
+                        if young_m[j, m] and int(pre.mr_origin[m]) != i:
+                            recv_m[i, m] = True
+                jl = int(inv_late[i])
+                if jl >= 0:
+                    sd = (t + int(d_of[jl])) % D
+                    for ru in range(R):
+                        if (
+                            young_u[jl, ru]
+                            and int(pre.infected_from[jl, ru]) != i
+                            and int(pre.rumor_origin[ru]) != i
+                        ):
+                            o.pending_inf[sd, i, ru] = True
+                            o.pending_src[sd, i, ru] = max(
+                                int(o.pending_src[sd, i, ru]), jl
                             )
                     for m in range(M):
-                        if send_m[m]:
-                            o.pending_minf[sd, p, m] = True
+                        if young_m[jl, m] and int(pre.mr_origin[m]) != i:
+                            o.pending_minf[sd, i, m] = True
 
         # user-rumor infection
         for i in range(n):
@@ -332,9 +336,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 if recv_m[i, m] and pre.mr_active[m] and int(pre.minf_age[i, m]) == 0:
                     newly[i, m] = True
                     o.minf_age[i, m] = 1
-        first = _first_occurrence(pre.mr_subject, pre.mr_active)
+        # pool subjects are unique among active slots (allocation supersedes
+        # in place), so each accepted candidate applies directly
         for i in range(n):
-            best: dict[int, int] = {}
+            delta = 0
             for m in range(M):
                 if not newly[i, m]:
                     continue
@@ -349,22 +354,11 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     pre, SALT_GOSSIP, i, subj
                 ):
                     continue
-                best[subj] = max(best.get(subj, NO_CAND), cand)
+                o.view_key[i, subj] = cand
+                delta += int((cand & 3) != RANK_DEAD) - int((own & 3) != RANK_DEAD)
                 if (cand & 3) == RANK_SUSPECT and cand > int(o.sus_key[subj]):
                     o.sus_key[subj] = cand
                     o.sus_since[subj] = t
-            for subj, cand in best.items():
-                if cand > int(o.view_key[i, subj]):
-                    o.view_key[i, subj] = cand
-            # liveness delta over distinct active subjects
-            delta = 0
-            for m in range(M):
-                if not first[m]:
-                    continue
-                subj = int(pre.mr_subject[m])
-                before = (int(pre.view_key[i, subj]) & 3) != RANK_DEAD
-                after = (int(o.view_key[i, subj]) & 3) != RANK_DEAD
-                delta += int(after) - int(before)
             o.n_live[i] += delta
         if D:
             o.pending_inf[slot_now] = False
@@ -588,29 +582,44 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     valid = [x for p in proposals for x in p[3]]
     if any(valid):
         compact = [i for i, v in enumerate(valid) if v][:E]
-        pool = {
-            (int(o.mr_subject[m]), int(o.mr_key[m]))
-            for m in range(M)
-            if o.mr_active[m]
+        entries = [
+            (int(subject[ci]), int(key_l[ci]), int(origin[ci])) for ci in compact
+        ]
+        # batch dedup by subject: max key wins, tie -> earliest entry
+        wins = []
+        for e, (s, kk, oo) in enumerate(entries):
+            lose = any(
+                s2 == s and (k2 > kk or (k2 == kk and e2 < e))
+                for e2, (s2, k2, _o2) in enumerate(entries)
+                if e2 != e
+            )
+            if not lose:
+                wins.append((s, kk, oo))
+        pool_by_subject = {
+            int(o.mr_subject[m]): m for m in range(M) if o.mr_active[m]
         }
-        seen: set = set()
         free = [m for m in range(M) if not o.mr_active[m]][:E]
         fi = 0
-        for ci in compact:
-            s, kk, oo = int(subject[ci]), int(key_l[ci]), int(origin[ci])
-            if (s, kk) in seen or (s, kk) in pool:
-                continue
-            seen.add((s, kk))
-            if fi >= len(free):
-                continue
-            slot = free[fi]
-            fi += 1
+        for s, kk, oo in wins:
+            if s in pool_by_subject:
+                slot = pool_by_subject[s]
+                if kk <= int(o.mr_key[slot]):
+                    continue  # already covered by an equal/stronger rumor
+                # supersede in place: old infection column + pending cleared
+                o.minf_age[:, slot] = 0
+                if D:
+                    o.pending_minf[:, :, slot] = False
+            else:
+                if fi >= len(free):
+                    continue
+                slot = free[fi]
+                fi += 1
             o.mr_active[slot] = True
             o.mr_subject[slot] = s
             o.mr_key[slot] = kk
             o.mr_created[slot] = t
             o.mr_origin[slot] = oo
-            o.minf_age[oo, slot] = max(int(o.minf_age[oo, slot]), 1)
+            o.minf_age[oo, slot] = 1
     return o
 
 

@@ -1,0 +1,223 @@
+"""Behavior scenarios for the sparse (record-queue) tick.
+
+Protocol-level assertions mirroring the dense kernel's suite and the
+reference's test families: steady-state quiescence, crash detection through
+SUSPECT → suspicion expiry → DEAD dissemination, rumor convergence within
+the ClusterMath window, partition + seed-SYNC re-bridging, restart epochs,
+link-delay late delivery in the LEAN layout, and bit-exact equivalence of
+the row-sharded program on the virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import scalecube_cluster_tpu.ops.sparse as SP
+from scalecube_cluster_tpu.ops.lattice import RANK_ALIVE, RANK_DEAD, RANK_SUSPECT
+from scalecube_cluster_tpu.utils.cluster_math import (
+    ceil_log2,
+    gossip_periods_to_sweep,
+)
+
+
+def _run(params, st, n_ticks, seed=0, collect=()):
+    step = jax.jit(partial(SP.run_sparse_ticks, n_ticks=n_ticks, params=params))
+    st, _key, ms, _w = step(st, jax.random.PRNGKey(seed))
+    return st, {k: np.asarray(v) for k, v in ms.items() if not collect or k in collect}
+
+
+def test_warm_cluster_stays_quiet():
+    """No loss, no churn: nothing to gossip, no suspects, zero messages —
+    the quiescence short-circuit regime."""
+    params = SP.SparseParams(capacity=64, seed_rows=(0,), full_metrics=True)
+    st = SP.init_sparse_state(params, 64, warm=True)
+    st, ms = _run(params, st, 40)
+    assert ms["gossip_msgs"].sum() == 0
+    assert ms["fd_failed_probes"].sum() == 0
+    assert float(ms["alive_view_fraction"][-1]) == 1.0
+    assert int(st.mr_active.sum()) == 0
+
+
+def test_crash_detection_and_dissemination():
+    """A crash is suspected by FD, expires to DEAD, and the DEAD rumor
+    reaches every up member — within suspicion timeout + dissemination
+    slack."""
+    n = 128
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=4, sync_every=40,
+        suspicion_mult=2, mr_slots=64, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True)
+    st = SP.crash_row(st, 17)
+    timeout = params.suspicion_mult * ceil_log2(n) * params.fd_every
+    budget = 3 * timeout + 3 * params.repeat_mult * ceil_log2(n) + 40
+    st, ms = _run(params, st, budget)
+    vk = np.asarray(st.view_key)
+    up = np.asarray(st.up)
+    assert ((vk[up, 17] & 3) == RANK_DEAD).all(), "crash not detected everywhere"
+    assert ms["announce_dropped"].sum() == 0
+
+
+def test_rumor_convergence_within_math_window():
+    """User-rumor dissemination at N=256 matches the reference's analytic
+    budget (GossipProtocolTest's assertion discipline)."""
+    n = 256
+    params = SP.SparseParams(capacity=n, rumor_slots=4, seed_rows=(0,))
+    st = SP.init_sparse_state(params, n, warm=True)
+    st = SP.spread_rumor(st, 0, origin=13)
+    budget = gossip_periods_to_sweep(params.repeat_mult, n)
+    st, ms = _run(params, st, budget)
+    cov = ms["rumor_coverage"][:, 0]
+    hit = np.nonzero(cov >= 1.0)[0]
+    assert hit.size, f"no full coverage within {budget} ticks (max {cov.max()})"
+    assert int(hit[0]) + 1 <= budget
+
+
+def test_partition_detect_and_seed_rebridge():
+    """Symmetric partition: each side declares the other DEAD; after heal,
+    the seed-SYNC pool re-bridges and refutations resurrect both sides
+    (the reference's SYNC anti-entropy purpose, README.md:17-19)."""
+    n = 64
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=2, sync_every=16,
+        suspicion_mult=2, mr_slots=128, announce_slots=64, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True, dense_links=True)
+    a, b = list(range(32)), list(range(32, 64))
+    st = SP.block_partition(st, a, b)
+    timeout = params.suspicion_mult * ceil_log2(n) * params.fd_every
+    st, _ = _run(params, st, 3 * timeout + 60, seed=1)
+    vk = np.asarray(st.view_key)
+    cross = (vk[np.ix_(a, b)] & 3) == RANK_DEAD
+    assert cross.mean() > 0.95, f"partition not detected ({cross.mean():.2f})"
+    st = SP.heal_partition(st, a, b)
+    st, _ = _run(params, st, 10 * params.sync_every, seed=2)
+    vk = np.asarray(st.view_key)
+    alive_ab = (vk[np.ix_(a, b)] & 3) == RANK_ALIVE
+    alive_ba = (vk[np.ix_(b, a)] & 3) == RANK_ALIVE
+    assert alive_ab.mean() > 0.95 and alive_ba.mean() > 0.95, (
+        f"heal not re-bridged ({alive_ab.mean():.2f}/{alive_ba.mean():.2f})"
+    )
+
+
+def test_restart_epoch_overrides_stale_identity():
+    """Crash + rejoin of the same row: the new identity's epoch dominates
+    every stale record (the sim's DEST_GONE, lattice.py)."""
+    n = 48
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=2, sync_every=12,
+        suspicion_mult=2, mr_slots=64, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True)
+    st = SP.crash_row(st, 5)
+    st, _ = _run(params, st, 30, seed=3)
+    st = SP.join_row(st, 5, seed_rows=[0])
+    st, _ = _run(params, st, 120, seed=4)
+    vk = np.asarray(st.view_key)
+    up = np.asarray(st.up)
+    epoch = (vk[up, 5] >> 23) & 0xFF
+    rank = vk[up, 5] & 3
+    assert (epoch == 1).all(), "stale identity survived the restart"
+    assert (rank == RANK_ALIVE).all()
+
+
+def test_graceful_leave_spreads_leaving():
+    n = 48
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=2, sync_every=20, mr_slots=64,
+        seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True)
+    st = SP.begin_leave(st, 7)
+    st, _ = _run(params, st, 3 * params.repeat_mult * ceil_log2(n) + 10, seed=5)
+    vk = np.asarray(st.view_key)
+    others = np.ones(n, bool)
+    others[7] = False
+    assert ((vk[others, 7] & 3) == 1).mean() > 0.95  # RANK_LEAVING
+
+
+def test_delay_late_delivery_lean():
+    """Link delay in the lean ([D, N, M] rings) mode: with a large uniform
+    delay, rumors still reach everyone — later than the no-delay run
+    (GossipDelayTest's late node still gets all rumors)."""
+    n = 64
+    base = dict(capacity=n, rumor_slots=2, seed_rows=(0,))
+    p0 = SP.SparseParams(**base)
+    pd = SP.SparseParams(**base, delay_slots=6)
+    budget = gossip_periods_to_sweep(3, n) + 20
+
+    def converge_tick(params, delay):
+        st = SP.init_sparse_state(params, n, warm=True, uniform_delay=delay)
+        st = SP.spread_rumor(st, 0, origin=3)
+        st, ms = _run(params, st, budget, seed=6)
+        cov = ms["rumor_coverage"][:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        assert hit.size, "no convergence"
+        return int(hit[0])
+
+    t_fast = converge_tick(p0, 0.0)
+    t_slow = converge_tick(pd, 2.0)
+    assert t_slow > t_fast, (t_fast, t_slow)
+
+
+def test_sharded_sparse_equivalence():
+    """The row-sharded sparse program on the 8-device virtual mesh must be
+    bit-identical to the single-device run — churn + rumor + delay paths."""
+    from scalecube_cluster_tpu.ops.sharding import (
+        make_mesh,
+        make_sharded_sparse_tick,
+        shard_sparse_state,
+    )
+
+    n = 32
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=2, sync_every=8, mr_slots=32,
+        announce_slots=16, rumor_slots=2, seed_rows=(0,), delay_slots=3,
+    )
+    st = SP.init_sparse_state(params, 30, warm=True, uniform_delay=0.7)
+    st = SP.crash_row(st, 9)
+    st = SP.spread_rumor(st, 0, origin=4)
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    st_sh = shard_sparse_state(st, mesh)
+    step_sh = make_sharded_sparse_tick(mesh, params)
+    step_1 = jax.jit(partial(SP.sparse_tick, params=params))
+    key = jax.random.PRNGKey(7)
+    for t in range(20):
+        key, k = jax.random.split(key)
+        st, _ = step_1(st, k)
+        st_sh, _ = step_sh(st_sh, k)
+        if t == 10:
+            st = SP.join_row(st, 31, seed_rows=[0])
+            st_sh = shard_sparse_state(SP.join_row(st_sh, 31, seed_rows=[0]), mesh)
+    for f in (
+        "view_key", "n_live", "sus_key", "sus_since", "minf_age", "mr_active",
+        "mr_subject", "mr_key", "infected", "pending_minf",
+    ):
+        a = np.asarray(getattr(st, f))
+        b = np.asarray(getattr(st_sh, f))
+        assert np.array_equal(a, b), f"sharded divergence in {f}"
+
+
+def test_pool_exhaustion_heals_via_sync():
+    """With a deliberately tiny rumor pool, mass change still converges —
+    dropped announcements are counted and SYNC anti-entropy covers the gap
+    (sparse.py deviation 3)."""
+    n = 64
+    params = SP.SparseParams(
+        capacity=n, fd_every=2, sweep_every=2, sync_every=8,
+        suspicion_mult=2, mr_slots=4, announce_slots=4, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True)
+    for row in (11, 12, 13, 14, 15, 16):
+        st = SP.crash_row(st, row)
+    timeout = params.suspicion_mult * ceil_log2(n) * params.fd_every
+    st, ms = _run(params, st, 3 * timeout + 20 * params.sync_every, seed=8)
+    vk = np.asarray(st.view_key)
+    up = np.asarray(st.up)
+    dead = (vk[np.ix_(up, [11, 12, 13, 14, 15, 16])] & 3) == RANK_DEAD
+    assert dead.mean() > 0.99, f"convergence failed under pool pressure ({dead.mean():.3f})"
