@@ -94,18 +94,24 @@ def fetch_uniform(tick, salt: int, i, j, xp=jnp):
     u32 = xp.uint32
     # uint32 wraparound is the point of the mixer; numpy warns on scalar
     # overflow (jax doesn't), so silence it for the oracle path only.
+    # The i-side is mixed FULLY before j enters: ``i`` broadcasts narrow
+    # ([N, 1]-ish) while ``j`` broadcasts wide, so front-loading rounds onto
+    # the i-side halves the wide-tensor op count (the gate is evaluated on
+    # [N, N] / [N, M] planes every tick).
     guard = _np.errstate(over="ignore") if xp is _np else contextlib.nullcontext()
     with guard:
         h0 = xp.asarray(tick).astype(u32) * u32(0x9E3779B1) + u32(salt)
         a = xp.asarray(i).astype(u32) + h0
         a = a + (a << u32(10))
         a = a ^ (a >> u32(6))
+        a = a + (a << u32(3))
+        a = a ^ (a >> u32(11))
+        a = a + (a << u32(15))
         b = a + xp.asarray(j).astype(u32)
         b = b + (b << u32(10))
         b = b ^ (b >> u32(6))
         b = b + (b << u32(3))
         b = b ^ (b >> u32(11))
-        b = b + (b << u32(15))
     return (b >> u32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
 
 
@@ -122,6 +128,7 @@ class SparseRoundRandoms(NamedTuple):
     gossip_edge: jax.Array  # [N, f]
     gossip_delay: jax.Array  # [N, f]
     sync_try: jax.Array  # [N, T]
+    sync_fb: jax.Array  # [N] — seed-fallback pick when rejection misses
     sync_edge: jax.Array  # [N]
 
 
@@ -135,6 +142,7 @@ class SparseRandoms(NamedTuple):
     gossip_edge: jax.Array
     gossip_delay: jax.Array
     sync_try: jax.Array
+    sync_fb: jax.Array
     sync_edge: jax.Array
 
 
@@ -148,12 +156,13 @@ def draw_sparse_fd(key: jax.Array, n: int, ping_req_k: int, tries: int) -> Spars
 
 
 def draw_sparse_round(key: jax.Array, n: int, fanout: int, tries: int) -> SparseRoundRandoms:
-    k4, k5, k6, k7, k8 = jax.random.split(key, 5)
+    k4, k5, k6, k7, k8, k9 = jax.random.split(key, 6)
     return SparseRoundRandoms(
         gossip_try=jax.random.uniform(k4, (n, fanout * tries), dtype=jnp.float32),
         gossip_edge=jax.random.uniform(k5, (n, fanout), dtype=jnp.float32),
         gossip_delay=jax.random.uniform(k8, (n, fanout), dtype=jnp.float32),
         sync_try=jax.random.uniform(k6, (n, tries), dtype=jnp.float32),
+        sync_fb=jax.random.uniform(k9, (n,), dtype=jnp.float32),
         sync_edge=jax.random.uniform(k7, (n,), dtype=jnp.float32),
     )
 

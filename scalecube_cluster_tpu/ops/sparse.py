@@ -142,6 +142,15 @@ class SparseParams:
     announce_slots: int = 256
     sync_slots: int = 0
     sync_announce: int = 2
+    # Per-round cap on FD verdicts / refutations WRITTEN per tick (0 = auto:
+    # max(64, capacity // 16)). Point scatters into the [N, N] table
+    # serialize per index on TPU (~1 µs each), so the usually-near-empty
+    # accept sets are compacted to this many slots; throttled rows simply
+    # retry next round (their trigger condition persists). Mass events
+    # (partition waves) stretch by a few FD intervals — negligible against
+    # the suspicion timeout, and mirrored exactly by the oracle.
+    fd_accept_slots: int = 0
+    refute_slots: int = 0
     delay_slots: int = 0
     fd_direct_timeout_ticks: int = 2
     fd_leg_timeout_ticks: int = 1
@@ -629,6 +638,29 @@ def _sample_rejection(
     return jnp.maximum(idx, 0), idx >= 0
 
 
+def _pack_bits(x: jax.Array) -> jax.Array:
+    """bool [R, L] -> u32 [R, ceil(L/32)] bitmap words (delivery payloads
+    travel packed: 32x less gathered/OR'd data than bool planes)."""
+    nrows, L = x.shape
+    W = (L + 31) // 32
+    pad = W * 32 - L
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    xr = x.reshape(nrows, W, 32).astype(jnp.uint32)
+    return (xr << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32
+    )
+
+
+def _unpack_bits(p: jax.Array, L: int) -> jax.Array:
+    """u32 [R, W] -> bool [R, L]."""
+    nrows, W = p.shape
+    b = (
+        (p[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
+    ).astype(bool)
+    return b.reshape(nrows, W * 32)[:, :L]
+
+
 # ---------------------------------------------------------------------------
 # phases
 # ---------------------------------------------------------------------------
@@ -678,15 +710,25 @@ def _fd_phase(state: SparseState, r, params: SparseParams):
     suspect_key = ((own_key >> 2) << 2) | RANK_SUSPECT
     cand = jnp.where(ack, alive_key, suspect_key)
     accept = has_tgt & (cand > own_key)
+    # verdict throttle: first V accepting rows write this round, the rest
+    # retry next FD round (see SparseParams.fd_accept_slots)
+    V = min(n, params.fd_accept_slots or max(64, n // 16))
+    eff = accept & (jnp.cumsum(accept.astype(jnp.int32)) - 1 < V)
 
-    st = state.replace(
-        view_key=state.view_key.at[rows, tgt].set(jnp.where(accept, cand, own_key))
-    )
+    def _write(st: SparseState) -> SparseState:
+        (vi,) = jnp.nonzero(eff, size=V, fill_value=n)
+        vi_c = jnp.minimum(vi, n - 1)
+        wrow = jnp.where(vi < n, vi_c, n)  # OOB -> drop
+        return st.replace(
+            view_key=st.view_key.at[wrow, tgt[vi_c]].set(cand[vi_c], mode="drop")
+        )
+
+    st = jax.lax.cond(eff.any(), _write, lambda s: s, state)
     # suspicion-episode registration (deviation 1)
     sus_cand = (
         jnp.full((n,), NO_CANDIDATE, jnp.int32)
         .at[tgt]
-        .max(jnp.where(accept & ~ack, cand, NO_CANDIDATE))
+        .max(jnp.where(eff & ~ack, cand, NO_CANDIDATE))
     )
     new_sus = jnp.maximum(st.sus_key, sus_cand)
     st = st.replace(
@@ -695,11 +737,11 @@ def _fd_phase(state: SparseState, r, params: SparseParams):
     )
     # FD verdicts flip between non-DEAD ranks only (targets come from the
     # live view; ALIVE/SUSPECT are both live) — n_live is unchanged.
-    proposals = (tgt, cand, rows, accept)
+    proposals = (tgt, cand, rows, eff)
     metrics = {
         "fd_probes": has_tgt.sum(),
         "fd_failed_probes": (has_tgt & ~ack).sum(),
-        "fd_new_suspects": (accept & ~ack).sum(),
+        "fd_new_suspects": (eff & ~ack).sum(),
     }
     return st, proposals, metrics
 
@@ -729,10 +771,14 @@ def _suspicion_sweep(state: SparseState, params: SparseParams):
         )
         new_key = jnp.where(expired, st.view_key + 1, st.view_key)
         n_live = st.n_live - expired.sum(axis=1).astype(jnp.int32)
-        # announce ONE expiry per observer (lowest column; deviation 3) —
-        # every other observer's own timer fires within a sweep period anyway
-        any_exp = expired.any(axis=1)
-        col = jnp.argmax(expired, axis=1).astype(jnp.int32)
+        # announce each expiring SUBJECT once: the first (lowest) expiring
+        # row is the elected announcer (deviation 3) — without the election,
+        # every observer proposes the same DEAD fact and floods the
+        # allocation compaction window on mass-expiry sweeps
+        first_row = jnp.argmax(expired, axis=0)  # [N] per subject
+        mine = expired & (first_row[None, :] == rows[:, None])
+        any_exp = mine.any(axis=1)
+        col = jnp.argmax(mine, axis=1).astype(jnp.int32)
         key = new_key[rows, col]
         return (
             st.replace(view_key=new_key, n_live=n_live),
@@ -793,17 +839,26 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             state, rows, r.gossip_try, params.fanout, params.sample_tries
         )
 
+        # ONE combined per-sender payload row [packed-M | packed-R | from]:
+        # row-gathers cost per ROW on TPU (~independent of row width), so the
+        # three per-slot payload lookups collapse into a single gather
+        ym_p = _pack_bits(young_m)  # [N, Wm] u32
+        yu_p = _pack_bits(young_u)  # [N, Wu] u32
+        Wm, Wu = ym_p.shape[1], yu_p.shape[1]
+        payload = jnp.concatenate(
+            [ym_p, yu_p, state.infected_from.astype(jnp.uint32)], axis=1
+        )
         if D:
             recv_u = state.pending_inf[slot_now]
             recv_src = state.pending_src[slot_now]
-            recv_m = state.pending_minf[slot_now]
+            recv_m_p = _pack_bits(state.pending_minf[slot_now])
             pend_u = state.pending_inf
             pend_src = state.pending_src
             pend_m = state.pending_minf
         else:
             recv_u = jnp.zeros_like(state.infected)
             recv_src = jnp.full_like(state.infected_from, -1)
-            recv_m = jnp.zeros((n, m), bool)
+            recv_m_p = jnp.zeros_like(ym_p)
 
         # Delivery is RECEIVER-pulled through per-slot inverse sender
         # indexes: one [N] point scatter builds inv_s (the sender that
@@ -817,7 +872,6 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         # origin filters apply receiver-side (a filtered receiver is already
         # infected, so state evolution is unchanged; message counters tally
         # payload-bearing sends before that filter).
-        young_m_i32 = young_m  # [N, M] sender payload (receiver-independent)
         sender_has = young_u.any(axis=1) | young_m.any(axis=1)
         sent = jnp.int32(0)
         rumor_sent = jnp.int32(0)
@@ -846,28 +900,35 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             inv_s = no_sender.at[p].max(jnp.where(ok_now, rows, -1))
             j = jnp.maximum(inv_s, 0)
             has = (inv_s >= 0)[:, None]
+            pl = payload[j]  # the slot's single row-gather
+            young_u_j = _unpack_bits(pl[:, Wm : Wm + Wu], state.infected.shape[1])
+            jfrom = pl[:, Wm + Wu :].astype(jnp.int32)
             deliver_u = (
-                young_u[j]
+                young_u_j
                 & has
-                & (state.infected_from[j] != rows[:, None])
+                & (jfrom != rows[:, None])
                 & (state.rumor_origin[None, :] != rows[:, None])
             )
             recv_u = recv_u | deliver_u
             recv_src = jnp.maximum(recv_src, jnp.where(deliver_u, j[:, None], -1))
-            deliver_m = (
-                young_m_i32[j] & has & (state.mr_origin[None, :] != rows[:, None])
-            )
-            recv_m = recv_m | deliver_m
+            # membership payload stays packed; the origin filter is
+            # receiver-only, so it applies once after the slot OR below
+            recv_m_p = recv_m_p | jnp.where(has, pl[:, :Wm], jnp.uint32(0))
             rumor_sent = rumor_sent + deliver_u.sum()
             if D:
                 inv_l = no_sender.at[p].max(jnp.where(ok_late, rows, -1))
                 jl = jnp.maximum(inv_l, 0)
                 hasl = (inv_l >= 0)[:, None]
+                pll = payload[jl]
+                young_u_l = _unpack_bits(
+                    pll[:, Wm : Wm + Wu], state.infected.shape[1]
+                )
+                lfrom = pll[:, Wm + Wu :].astype(jnp.int32)
                 slot_d = (state.tick + d[jl]) % D
                 late_u = (
-                    young_u[jl]
+                    young_u_l
                     & hasl
-                    & (state.infected_from[jl] != rows[:, None])
+                    & (lfrom != rows[:, None])
                     & (state.rumor_origin[None, :] != rows[:, None])
                 )
                 pend_u = pend_u.at[slot_d, rows].max(late_u)
@@ -875,7 +936,9 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                     jnp.where(late_u, jl[:, None], -1)
                 )
                 pend_m = pend_m.at[slot_d, rows].max(
-                    young_m_i32[jl] & hasl & (state.mr_origin[None, :] != rows[:, None])
+                    _unpack_bits(pll[:, :Wm], m)
+                    & hasl
+                    & (state.mr_origin[None, :] != rows[:, None])
                 )
 
         # user-rumor infection (bitmap OR = SequenceIdCollector dedup)
@@ -887,6 +950,9 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         )
 
         # membership-rumor infection + one-shot record application
+        recv_m = _unpack_bits(recv_m_p, m) & (
+            state.mr_origin[None, :] != rows[:, None]
+        )
         newly_m = (
             recv_m & (state.minf_age == 0) & state.up[:, None] & state.mr_active[None, :]
         )
@@ -985,6 +1051,22 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
         state, caller, r.sync_try[caller], 1, params.sample_tries, extra_mask=seed_mask
     )
     peer = peer_idx[:, 0]
+    valid_pick = peer_valid[:, 0]
+    if params.seed_rows:
+        # Seed fallback: a caller whose live view is too sparse for rejection
+        # sampling (a fresh joiner knows only the seeds — ~S/N hit rate)
+        # draws a configured seed directly. This is the reference's own
+        # bootstrap bias: selectSyncAddress draws from seedMembers ∪ members
+        # (MembershipProtocolImpl.java:461-472), and a joiner's member list
+        # IS the seed list. Without it, bootstrap SYNC stalls ~N/(S·T) ticks.
+        seeds_arr = jnp.asarray(params.seed_rows, jnp.int32)
+        S = len(params.seed_rows)
+        fb = seeds_arr[
+            jnp.minimum((r.sync_fb[caller] * np.float32(S)).astype(jnp.int32), S - 1)
+        ]
+        use_fb = ~valid_pick & (fb != caller)
+        peer = jnp.where(use_fb, fb, peer)
+        valid_pick = valid_pick | use_fb
     p_rt = _rt_at(state, caller, peer)
     if params.delay_slots:
         p_rt = p_rt * _timely_rt(
@@ -992,7 +1074,7 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
             _delay_q_at(state, peer, caller),
             params.sync_timeout_ticks,
         )
-    ok = valid_c & peer_valid[:, 0] & state.up[peer] & (r.sync_edge[caller] < p_rt)
+    ok = valid_c & valid_pick & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
     caller_tables = state.view_key[caller]  # [K, N]
     # Merge slots sharing a peer COMPACTLY ([K, K] + [K, N] scratch) instead
@@ -1103,7 +1185,7 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     return st, proposals, {"sync_roundtrips": ok.sum()}
 
 
-def _refute_phase(state: SparseState):
+def _refute_phase(state: SparseState, params: SparseParams):
     """Self-record refutation (SUSPECT/DEAD diagonal, or overwritten leave
     intent) — row-local; the refuted record is proposed as a rumor (the
     reference gossips the bumped ALIVE, ``onSelfMemberDetected:686-708``)."""
@@ -1116,19 +1198,26 @@ def _refute_phase(state: SparseState):
         | (rank == RANK_DEAD)
         | (state.leaving & (rank != RANK_LEAVING))
     )
+    # same compaction/throttle as the FD write: refutes are near-zero per
+    # tick; throttled rows still need refuting next tick and retry
+    V = min(n, params.refute_slots or max(64, n // 16))
+    eff = need & (jnp.cumsum(need.astype(jnp.int32)) - 1 < V)
     announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
-    new_diag = jnp.where(need, (((diag >> 2) + 1) << 2) | announce_rank, diag)
+    new_diag = jnp.where(eff, (((diag >> 2) + 1) << 2) | announce_rank, diag)
 
     def _apply(st: SparseState):
+        (vi,) = jnp.nonzero(eff, size=V, fill_value=n)
+        vi_c = jnp.minimum(vi, n - 1)
+        wrow = jnp.where(vi < n, vi_c, n)  # OOB -> drop
         # a DEAD diagonal was counted out of the row's own live view
-        regain = (need & (rank == RANK_DEAD)).astype(jnp.int32)
+        regain = (eff & (rank == RANK_DEAD)).astype(jnp.int32)
         return st.replace(
-            view_key=st.view_key.at[rows, rows].set(new_diag),
+            view_key=st.view_key.at[wrow, wrow].set(new_diag[vi_c], mode="drop"),
             n_live=st.n_live + regain,
         )
 
-    st = jax.lax.cond(need.any(), _apply, lambda s: s, state)
-    return st, (rows, new_diag, rows, need)
+    st = jax.lax.cond(eff.any(), _apply, lambda s: s, state)
+    return st, (rows, new_diag, rows, eff)
 
 
 def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
@@ -1250,7 +1339,7 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
     state, props_exp = _suspicion_sweep(state, params)
     state, g_m = _gossip_phase(state, r, params)
     state, props_sync, s_m = _sync_phase(state, r, params)
-    state, props_ref = _refute_phase(state)
+    state, props_ref = _refute_phase(state, params)
     state = _rumor_sweeps(state, params)
     state, a_m = _alloc_phase(
         state, (props_fd, props_exp, props_sync, props_ref), params

@@ -134,6 +134,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     if (t % params.fd_every) == 0:
         pre = o.snap()
         sus_cand = np.full(n, NO_CAND, np.int64)
+        V_fd = min(n, params.fd_accept_slots or max(64, n // 16))
+        accepted_so_far = 0
         for i in range(n):
             sel, valid = _pick_rejection(pre, i, r["fd_try"][i], 1 + k_req, T)
             if not (valid[0] and pre.up[i]):
@@ -171,6 +173,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             else:
                 cand = ((own >> 2) << 2) | RANK_SUSPECT
             if cand > own:
+                # verdict throttle: first V accepting rows write this round
+                accepted_so_far += 1
+                if accepted_so_far > V_fd:
+                    continue
                 o.view_key[i, tgt] = cand
                 fd_props[0][i] = tgt
                 fd_props[1][i] = cand
@@ -190,6 +196,7 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             i: params.suspicion_mult * _ceil_log2(int(o.n_live[i])) * params.fd_every
             for i in range(n)
         }
+        expired = np.zeros((n, n), bool)
         for i in range(n):
             if not o.up[i]:
                 continue
@@ -200,12 +207,20 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     and t - int(o.sus_since[j]) >= timeout[i]
                     and kij <= int(o.sus_key[j])
                 ):
-                    o.view_key[i, j] = kij + 1
-                    o.n_live[i] -= 1
-                    if not exp_props[3][i]:
-                        exp_props[0][i] = j
-                        exp_props[1][i] = kij + 1
-                        exp_props[3][i] = True
+                    expired[i, j] = True
+        # per-subject announcer election: first expiring row; each elected
+        # row proposes its first such column (sparse._suspicion_sweep)
+        first_row = expired.argmax(axis=0)
+        for i in range(n):
+            for j in range(n):
+                if not expired[i, j]:
+                    continue
+                o.view_key[i, j] += 1
+                o.n_live[i] -= 1
+                if not exp_props[3][i] and first_row[j] == i:
+                    exp_props[0][i] = j
+                    exp_props[1][i] = int(o.view_key[i, j])
+                    exp_props[3][i] = True
     proposals.append(exp_props)
 
     # ---- gossip phase ----
@@ -387,9 +402,20 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         peers, valid = _pick_rejection(
             pre, i, r["sync_try"][i], 1, T, seed_mask=seed_mask
         )
-        if not valid[0]:
-            continue
         p = int(peers[0])
+        ok_pick = bool(valid[0])
+        if not ok_pick and params.seed_rows:
+            # seed fallback (sparse._sync_phase): a too-sparse live view
+            # draws a configured seed directly
+            S = len(params.seed_rows)
+            fb = params.seed_rows[
+                min(int(np.float32(np.float32(r["sync_fb"][i]) * np.float32(S))), S - 1)
+            ]
+            if fb != i:
+                p = int(fb)
+                ok_pick = True
+        if not ok_pick:
+            continue
         p_rt = _rt(pre, i, p)
         if D:
             p_rt = np.float32(
@@ -517,8 +543,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     props_c = _top_props(rows_c, acc_c, valid_c2)
     proposals.append(tuple(a + b for a, b in zip(props_p, props_c)))
 
-    # ---- refutation ----
+    # ---- refutation (throttled like the FD write) ----
     ref_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    V_ref = min(n, params.refute_slots or max(64, n // 16))
+    needed_so_far = 0
     for i in range(n):
         diag = int(o.view_key[i, i])
         rank = diag & 3
@@ -527,6 +555,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             or rank == RANK_DEAD
             or (bool(o.leaving[i]) and rank != RANK_LEAVING)
         )
+        if need:
+            needed_so_far += 1
+            if needed_so_far > V_ref:
+                need = False
         new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
         new_diag = (((diag >> 2) + 1) << 2) | new_rank if need else diag
         ref_props[0][i] = i

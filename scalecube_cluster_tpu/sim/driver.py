@@ -73,25 +73,43 @@ class SimDriver:
 
     def __init__(
         self,
-        params: SimParams,
+        params,
         n_initial: int,
         warm: bool = True,
         seed: int = 0,
         mesh=None,
         record_metrics: bool = False,
+        dense_links: bool | None = None,
     ):
+        """``params`` selects the engine: a :class:`SimParams` drives the
+        dense kernel, a :class:`.sparse.SparseParams` the sparse
+        (record-queue) one — same driver surface either way.
+        ``dense_links`` overrides the per-link matrix default (dense mode:
+        True; sparse mode: False — the lean scalar-loss layout)."""
+        from ..ops import sparse as _sparse
+
         self.params = params
+        self.sparse = isinstance(params, _sparse.SparseParams)
+        self._ops = _sparse if self.sparse else _state
         self.mesh = mesh
         self.record_metrics = record_metrics
-        if mesh is not None:
-            from ..ops.sharding import shard_state
-
-            init = _state.init_state(params, n_initial, warm=warm)
-            self._dense_links = init.loss.ndim != 0
-            self.state: SimState = shard_state(init, mesh)
+        if dense_links is None:
+            dense_links = not self.sparse
+        if self.sparse:
+            init = _sparse.init_sparse_state(
+                params, n_initial, warm=warm, dense_links=dense_links
+            )
         else:
-            self._dense_links = True
-            self.state = _state.init_state(params, n_initial, warm=warm)
+            init = _state.init_state(params, n_initial, warm=warm, dense_links=dense_links)
+        self._dense_links = init.loss.ndim != 0
+        if mesh is not None:
+            from ..ops.sharding import shard_sparse_state, shard_state
+
+            self.state = (
+                shard_sparse_state(init, mesh) if self.sparse else shard_state(init, mesh)
+            )
+        else:
+            self.state = init
         self._step_cache: Dict[tuple, Callable] = {}
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed ^ 0x5EED)  # host-side (transport) draws
@@ -121,15 +139,26 @@ class SimDriver:
         from a single transfer."""
         cache_key = (n_ticks, n_watch)
         if cache_key not in self._step_cache:
-            fn = partial(_kernel.run_ticks, n_ticks=n_ticks, params=self.params)
-            if self.mesh is not None:
-                from ..ops.sharding import make_sharded_run
+            if self.sparse:
+                from ..ops import sparse as _sparse
 
-                self._step_cache[cache_key] = make_sharded_run(
-                    self.mesh, self.params, n_ticks, self._dense_links
+                run = _sparse.run_sparse_ticks
+            else:
+                run = _kernel.run_ticks
+            if self.mesh is not None:
+                from ..ops.sharding import make_sharded_run, make_sharded_sparse_run
+
+                self._step_cache[cache_key] = (
+                    make_sharded_sparse_run(self.mesh, self.params, n_ticks)
+                    if self.sparse
+                    else make_sharded_run(
+                        self.mesh, self.params, n_ticks, self._dense_links
+                    )
                 )
             else:
-                self._step_cache[cache_key] = jax.jit(fn)
+                self._step_cache[cache_key] = jax.jit(
+                    partial(run, n_ticks=n_ticks, params=self.params)
+                )
         return self._step_cache[cache_key]
 
     def step(self, n_ticks: int = 1) -> dict:
@@ -264,7 +293,7 @@ class SimDriver:
         )
         forgotten = free[~remembered[free]]
         row = int(forgotten[0]) if len(forgotten) else int(free[0])
-        self.state = _state.join_row(self.state, row, list(seed_rows))
+        self.state = self._ops.join_row(self.state, row, list(seed_rows))
         # a restart reuses the row but is a NEW member identity (reference:
         # rejoin after restart gets a fresh member id)
         self.members[row] = Member(
@@ -274,16 +303,16 @@ class SimDriver:
         return row
 
     def crash(self, row: int) -> None:
-        self.state = _state.crash_row(self.state, row)
+        self.state = self._ops.crash_row(self.state, row)
 
     def leave(self, row: int, crash_after_ticks: int = 0) -> None:
-        self.state = _state.begin_leave(self.state, row)
+        self.state = self._ops.begin_leave(self.state, row)
         if crash_after_ticks:
             self.step(crash_after_ticks)
             self.crash(row)
 
     def update_metadata(self, row: int) -> None:
-        self.state = _state.update_metadata(self.state, row)
+        self.state = self._ops.update_metadata(self.state, row)
 
     # -- rumors (spreadGossip) ----------------------------------------------
     def spread_rumor(self, origin: int, payload: object) -> int:
@@ -293,7 +322,7 @@ class SimDriver:
         if len(free) == 0:
             raise RuntimeError("no free rumor slots")
         slot = int(free[0])
-        self.state = _state.spread_rumor(self.state, slot, origin)
+        self.state = self._ops.spread_rumor(self.state, slot, origin)
         self._rumor_payloads[slot] = payload
         return slot
 
@@ -307,18 +336,18 @@ class SimDriver:
 
     # -- links (NetworkEmulator surface) ------------------------------------
     def set_link_loss(self, src, dst, loss: float) -> None:
-        self.state = _state.set_link_loss(self.state, src, dst, loss)
+        self.state = self._ops.set_link_loss(self.state, src, dst, loss)
 
     def set_link_delay(self, src, dst, mean_delay_ticks: float) -> None:
         """Outbound mean delay in ticks (emulator delay half; needs
         ``params.delay_slots > 0``)."""
-        self.state = _state.set_link_delay(self.state, src, dst, mean_delay_ticks)
+        self.state = self._ops.set_link_delay(self.state, src, dst, mean_delay_ticks)
 
     def block_partition(self, group_a, group_b) -> None:
-        self.state = _state.block_partition(self.state, group_a, group_b)
+        self.state = self._ops.block_partition(self.state, group_a, group_b)
 
     def heal_partition(self, group_a, group_b) -> None:
-        self.state = _state.heal_partition(self.state, group_a, group_b)
+        self.state = self._ops.heal_partition(self.state, group_a, group_b)
 
     def link_loss(self, src: int, dst: int) -> float:
         # scalar uniform-loss mode (init_state(dense_links=False)) has no
@@ -358,7 +387,7 @@ class SimDriver:
         }
         np.savez_compressed(
             path,
-            **_state.snapshot(self.state),
+            **self._ops.snapshot(self.state),
             _key=np.asarray(self._key),
             _host=np.frombuffer(pickle.dumps(host), dtype=np.uint8),
         )
@@ -375,11 +404,15 @@ class SimDriver:
         self._rng = np.random.default_rng()
         self._rng.bit_generator.state = host["rng"]
         del self.metrics_history[host["metrics_len"] :]  # drop abandoned timeline
-        state = _state.restore(data)
+        state = self._ops.restore(data)
         if self.mesh is not None:
-            from ..ops.sharding import shard_state
+            from ..ops.sharding import shard_sparse_state, shard_state
 
-            state = shard_state(state, self.mesh)
+            state = (
+                shard_sparse_state(state, self.mesh)
+                if self.sparse
+                else shard_state(state, self.mesh)
+            )
         self.state = state
         # re-baseline watches so restore doesn't emit phantom events
         for w in self._watches.values():

@@ -1,0 +1,71 @@
+"""SimDriver over the sparse engine: the same host-driver surface (events,
+churn, rumors, links, checkpoint/resume) drives either kernel — passing a
+SparseParams selects the record-queue tick."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from scalecube_cluster_tpu.models.events import MembershipEventType
+from scalecube_cluster_tpu.ops.sparse import SparseParams
+from scalecube_cluster_tpu.sim import SimDriver
+
+PARAMS = SparseParams(
+    capacity=48, fd_every=2, sync_every=12, suspicion_mult=2, sweep_every=2,
+    mr_slots=64, announce_slots=32, rumor_slots=4, seed_rows=(0,),
+)
+
+
+def test_sparse_driver_crash_events_and_rumor():
+    d = SimDriver(PARAMS, 40, seed=3)
+    stream = d.watch(1)
+    seen = []
+    stream.subscribe(seen.append)
+    slot = d.spread_rumor(origin=5, payload={"hello": "world"})
+    d.crash(7)
+    d.step(160)
+    assert d.rumor_coverage(slot) == 1.0
+    assert d.rumor_payload(slot) == {"hello": "world"}
+    removed = [e for e in seen if e.type is MembershipEventType.REMOVED]
+    assert any(e.member.address == "sim://7" for e in removed)
+    assert not d.is_up(7)
+
+
+def test_sparse_driver_join_leave_metadata_checkpoint(tmp_path):
+    d = SimDriver(PARAMS, 40, seed=4)
+    d.watch(2)
+    row = d.join()
+    d.step(40)
+    status, _inc = d.view_of(2)
+    assert status[row] == 0  # ALIVE at an established observer
+    d.update_metadata(5)
+    d.leave(6, crash_after_ticks=6)
+    d.step(40)
+    added = [
+        e for e in d.events_of(2) if e.type is MembershipEventType.ADDED
+    ]
+    assert any(e.member.address == f"sim://{row}" for e in added)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    before = np.asarray(d.state.view_key).copy()
+    d.step(10)
+    d.restore(path)
+    assert np.array_equal(np.asarray(d.state.view_key), before)
+    d.step(10)  # resumes cleanly
+
+
+def test_sparse_driver_partition_with_dense_links():
+    params = SparseParams(
+        capacity=32, fd_every=2, sync_every=8, suspicion_mult=2, sweep_every=2,
+        mr_slots=64, announce_slots=32, seed_rows=(0,),
+    )
+    d = SimDriver(params, 32, seed=5, dense_links=True)
+    a, b = list(range(16)), list(range(16, 32))
+    d.block_partition(a, b)
+    d.step(120)
+    assert d.status_of(3, 20) is not None
+    assert d.status_of(3, 20).name == "DEAD"
+    d.heal_partition(a, b)
+    d.step(200)
+    assert d.status_of(3, 20).name == "ALIVE"
+    assert d.status_of(20, 3).name == "ALIVE"
