@@ -1341,8 +1341,11 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
     state, props_sync, s_m = _sync_phase(state, r, params)
     state, props_ref = _refute_phase(state, params)
     state = _rumor_sweeps(state, params)
+    # allocation compaction takes the first E valid proposals in this order:
+    # refutations rank BEFORE the sync re-gossip flood (sync proposals are
+    # mostly pool duplicates; a crowded-out refutation is a lingering zombie)
     state, a_m = _alloc_phase(
-        state, (props_fd, props_exp, props_sync, props_ref), params
+        state, (props_fd, props_exp, props_ref, props_sync), params
     )
 
     coverage = (

@@ -541,7 +541,7 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         acc_c[slot_i] = caller_acc[i].astype(np.int64)
         valid_c2[slot_i] = True
     props_c = _top_props(rows_c, acc_c, valid_c2)
-    proposals.append(tuple(a + b for a, b in zip(props_p, props_c)))
+    sync_props = tuple(a + b for a, b in zip(props_p, props_c))
 
     # ---- refutation (throttled like the FD write) ----
     ref_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
@@ -569,6 +569,7 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 o.n_live[i] += 1
             o.view_key[i, i] = new_diag
     proposals.append(ref_props)
+    proposals.append(sync_props)
 
     # ---- rumor sweeps ----
     n_up = int(o.up.sum())
