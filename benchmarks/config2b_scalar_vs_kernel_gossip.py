@@ -38,7 +38,10 @@ from scalecube_cluster_tpu.utils.streams import EventStream
 
 from common import TickLoop, emit, log, make_emulated_mesh
 
-N = 24
+# N=50 is the reference experiment matrix's largest point
+# (GossipProtocolTest.java:47-63: N in {2..50}, loss in {0,10,25,50}%);
+# the loss points below are the matrix's N=50 rows plus the 25% stressor.
+N = 50
 INTERVAL = 0.05
 TRIALS = 5
 CONFIG = GossipConfig(gossip_interval=INTERVAL, gossip_fanout=3, gossip_repeat_mult=3)
@@ -101,7 +104,7 @@ def kernel_trials(loss: float) -> list:
 
 
 def main() -> None:
-    for loss_pct in (0.0, 25.0):
+    for loss_pct in (0.0, 10.0, 25.0):
         scalar_rounds = [
             asyncio.run(scalar_trial(loss_pct)) for _ in range(TRIALS)
         ]

@@ -7,10 +7,22 @@ expectation. Per probe round the analytic per-probe suspect probability is
 
 with l = 5%, k = 3 (the reference's PingReqMembers). Measures observed
 fd_new_suspects / fd_probes over many rounds and compares.
+
+``--delay-mean D`` additionally turns the link-delay model ON (exponential
+mean D ticks, the NetworkEmulator's distribution) in the SPARSE engine's
+fully-lean layout — scalar loss AND scalar delay parameter, no [N, N]
+matrices, no [D, N, N] rings (round-2 verdict item #4: the delay model must
+compose with the large-N mode). Every request-response leg then multiplies
+in the closed-form probability that its geometric round trip beats the
+protocol timeout; the analytic expectation gains the same factors:
+
+    p_direct = (1-l)^2 · T(q, q, ping_timeout)
+    p_relay  = (1-l)^4 · T(q, q, leg)^2,   q = exp(-1/D)
 """
 
 from __future__ import annotations
 
+import argparse
 import pathlib as _p
 import sys as _s
 
@@ -30,7 +42,79 @@ K = 3
 FD_ROUNDS = 200
 
 
+def _timely(q: float, t: int) -> float:
+    """Host mirror of the kernel's closed-form P(two geometric(q) legs ≤ t)."""
+    q = float(q)
+    h, acc, qp = 1.0, 1.0, 1.0
+    for _ in range(t):
+        qp *= q
+        h = q * h + qp
+        acc += h
+    return (1.0 - q) * (1.0 - q) * acc
+
+
+def delay_main(delay_mean: float) -> None:
+    """FD false positives with the delay model ON, sparse lean layout."""
+    from functools import partial
+
+    import jax
+
+    import scalecube_cluster_tpu.ops.sparse as SP
+    from scalecube_cluster_tpu.ops.state import delay_mean_to_q
+
+    params = SP.SparseParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=K, fd_every=1,
+        sync_every=300, suspicion_mult=5, rumor_slots=2, mr_slots=512,
+        announce_slots=256, seed_rows=(0,), delay_slots=6,
+        fd_direct_timeout_ticks=2, fd_leg_timeout_ticks=1,
+    )
+    q = delay_mean_to_q(delay_mean)
+    t_direct = _timely(q, params.fd_direct_timeout_ticks)
+    t_leg = _timely(q, params.fd_leg_timeout_ticks)
+    p_direct = (1 - LOSS) ** 2 * t_direct
+    p_relay = (1 - LOSS) ** 4 * t_leg * t_leg
+    analytic = (1 - p_direct) * (1 - p_relay) ** K
+
+    state = SP.init_sparse_state(
+        params, N, warm=True, dense_links=False,
+        uniform_loss=LOSS, uniform_delay=delay_mean,
+    )
+    window = 50
+    run = jax.jit(partial(SP.run_sparse_ticks, n_ticks=window, params=params))
+    key = jax.random.PRNGKey(0)
+    probes = failed = suspects = 0
+    for w in range(FD_ROUNDS // window):
+        state, key, ms, _ = run(state, key)
+        probes += int(np.asarray(ms["fd_probes"]).sum())
+        failed += int(np.asarray(ms["fd_failed_probes"]).sum())
+        suspects += int(np.asarray(ms["fd_new_suspects"]).sum())
+        log(f"window {w+1}: cumulative raw-failure rate "
+            f"{failed/max(probes,1):.5f} (analytic {analytic:.5f})")
+    # the raw per-round failure rate is the analytic comparator: at these
+    # delay-driven failure levels, most failed probes hit already-SUSPECT
+    # targets, so the NEW-suspect rate saturates far below it
+    observed = failed / max(probes, 1)
+    sigma = (analytic * (1 - analytic) / max(probes, 1)) ** 0.5
+    ok = abs(observed - analytic) < 3 * sigma
+    emit({
+        "config": 3, "metric": "fd_failure_rate_with_delay",
+        "engine": "sparse_lean", "n": N, "loss_pct": 100 * LOSS,
+        "delay_mean_ticks": delay_mean, "observed": round(observed, 6),
+        "analytic": round(analytic, 6),
+        "new_suspect_rate": round(suspects / max(probes, 1), 6),
+        "probes": probes, "within_tolerance": bool(ok),
+    })
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--delay-mean", type=float, default=0.0,
+                    help="mean link delay in ticks; >0 runs the sparse-lean delay variant")
+    args = ap.parse_args()
+    if args.delay_mean > 0:
+        delay_main(args.delay_mean)
+        return
+
     p_direct = (1 - LOSS) ** 2
     p_relay = (1 - LOSS) ** 4
     analytic = (1 - p_direct) * (1 - p_relay) ** K

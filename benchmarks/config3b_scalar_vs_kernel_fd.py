@@ -40,12 +40,17 @@ from scalecube_cluster_tpu.utils.streams import EventStream
 
 from common import TickLoop, emit, log, make_emulated_mesh
 
-N = 32
+# round-2 verdict: the BASELINE "curves match at 256" leg was under-powered
+# (N=32, 6.4k probes/side). Now N=128 x 400 rounds = 51,200 scalar probes,
+# and the comparison is made PER-DECILE of the round timeline (curves, not
+# just means) — each of the 10 bins must agree within combined 3-sigma.
+N = 128
 LOSS = 0.15
 K = 3
-ROUNDS = 200
+ROUNDS = 400
 PING_INTERVAL = 0.15
 PING_TIMEOUT = 0.05
+BINS = 10
 
 
 async def scalar_side() -> tuple[int, int]:
@@ -79,14 +84,16 @@ async def scalar_side() -> tuple[int, int]:
     # A ROUND fails only when every verdict of its period is SUSPECT: an
     # indirect probe publishes one verdict per relay path (as the reference
     # does), so a round with any surviving path is not a false positive.
-    probes = failed = 0
+    # Collected per round index so the comparison can be made per-decile.
+    probes = np.zeros(ROUNDS, np.int64)
+    failed = np.zeros(ROUNDS, np.int64)
     for verdicts in logs:
         by_period: dict = {}
         for e in verdicts:
             by_period.setdefault(e.period, []).append(e.status)
-        for _period, statuses in sorted(by_period.items())[:ROUNDS]:
-            probes += 1
-            failed += all(s == MemberStatus.SUSPECT for s in statuses)
+        for idx, (_period, statuses) in enumerate(sorted(by_period.items())[:ROUNDS]):
+            probes[idx] += 1
+            failed[idx] += all(s == MemberStatus.SUSPECT for s in statuses)
     return failed, probes
 
 
@@ -96,11 +103,12 @@ def kernel_side() -> tuple[int, int]:
         sync_every=10_000, suspicion_mult=10_000, rumor_slots=2, seed_rows=(0,),
     )
     loop = TickLoop(params, N, seed=3, dense_links=False, uniform_loss=LOSS)
-    probes = failed = 0
-    for _ in range(ROUNDS):
+    probes = np.zeros(ROUNDS, np.int64)
+    failed = np.zeros(ROUNDS, np.int64)
+    for t in range(ROUNDS):
         m = loop.step()
-        probes += int(np.asarray(m["fd_probes"]))
-        failed += int(np.asarray(m["fd_failed_probes"]))
+        probes[t] = int(np.asarray(m["fd_probes"]))
+        failed[t] = int(np.asarray(m["fd_failed_probes"]))
     return failed, probes
 
 
@@ -109,25 +117,48 @@ def main() -> None:
     p4 = (1 - LOSS) ** 4
     analytic = (1 - p2) * (1 - p4) ** K
 
-    s_fail, s_probes = asyncio.run(scalar_side())
-    s_rate = s_fail / max(s_probes, 1)
-    log(f"scalar engine: {s_fail}/{s_probes} failed probes -> {s_rate:.5f}")
+    s_failed, s_probes = asyncio.run(scalar_side())
+    s_rate = s_failed.sum() / max(s_probes.sum(), 1)
+    log(f"scalar engine: {s_failed.sum()}/{s_probes.sum()} failed probes -> {s_rate:.5f}")
 
-    k_fail, k_probes = kernel_side()
-    k_rate = k_fail / max(k_probes, 1)
-    log(f"kernel:        {k_fail}/{k_probes} failed probes -> {k_rate:.5f}")
+    k_failed, k_probes = kernel_side()
+    k_rate = k_failed.sum() / max(k_probes.sum(), 1)
+    log(f"kernel:        {k_failed.sum()}/{k_probes.sum()} failed probes -> {k_rate:.5f}")
     log(f"analytic:      {analytic:.5f}")
 
+    # per-decile curve comparison: the round timeline split into BINS equal
+    # chunks; every bin pair must agree within its combined 3-sigma band
+    edges = np.linspace(0, ROUNDS, BINS + 1, dtype=int)
+    bins = []
+    curves_ok = True
+    for b in range(BINS):
+        lo, hi = edges[b], edges[b + 1]
+        sp, sf = int(s_probes[lo:hi].sum()), int(s_failed[lo:hi].sum())
+        kp, kf = int(k_probes[lo:hi].sum()), int(k_failed[lo:hi].sum())
+        sr, kr = sf / max(sp, 1), kf / max(kp, 1)
+        sig = (
+            analytic * (1 - analytic) / max(sp, 1)
+            + analytic * (1 - analytic) / max(kp, 1)
+        ) ** 0.5
+        bin_ok = abs(sr - kr) < 3 * sig
+        curves_ok = curves_ok and bin_ok
+        bins.append({
+            "rounds": [int(lo), int(hi)], "scalar_rate": round(sr, 5),
+            "kernel_rate": round(kr, 5), "ok": bool(bin_ok),
+        })
+        log(f"bin {b}: scalar {sr:.5f} kernel {kr:.5f} (3s={3*sig:.5f})"
+            + ("" if bin_ok else "  MISMATCH"))
     sigma = (
-        analytic * (1 - analytic) / max(s_probes, 1)
-        + analytic * (1 - analytic) / max(k_probes, 1)
+        analytic * (1 - analytic) / max(s_probes.sum(), 1)
+        + analytic * (1 - analytic) / max(k_probes.sum(), 1)
     ) ** 0.5
-    ok = abs(s_rate - k_rate) < 3 * sigma
+    ok = abs(s_rate - k_rate) < 3 * sigma and curves_ok
     emit({
         "config": "3b", "metric": "fd_fp_rate_scalar_vs_kernel", "n": N,
-        "loss_pct": 100 * LOSS, "scalar_rate": round(s_rate, 6),
-        "kernel_rate": round(k_rate, 6), "analytic": round(analytic, 6),
-        "scalar_probes": s_probes, "kernel_probes": k_probes,
+        "loss_pct": 100 * LOSS, "scalar_rate": round(float(s_rate), 6),
+        "kernel_rate": round(float(k_rate), 6), "analytic": round(analytic, 6),
+        "scalar_probes": int(s_probes.sum()), "kernel_probes": int(k_probes.sum()),
+        "per_decile": bins, "curves_match": bool(curves_ok),
         "within_3_sigma": bool(ok),
     })
 

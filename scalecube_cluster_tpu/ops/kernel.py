@@ -79,7 +79,7 @@ from .rand import (
     fetch_uniform,
     split_tick_key,
 )
-from .state import SimParams, SimState
+from .state import NEVER as NEVER_I32, SimParams, SimState
 
 
 def ceil_log2(n: jnp.ndarray) -> jnp.ndarray:
@@ -502,6 +502,11 @@ def _gossip_phase(
                 state, SALT_GOSSIP, rows[:, None], rows[None, :], buf, state.fetch_rt
             )
         )
+        if params.namespace_gate:
+            # hierarchical-namespace relatedness gate (areNamespacesRelated,
+            # MembershipProtocolImpl.java:511-536): a record about an
+            # unrelated subject is never applied
+            accept = accept & state.ns_rel[state.ns_id[:, None], state.ns_id[None, :]]
         st = state.replace(
             view_key=jnp.where(accept, buf, own),
             changed_at=jnp.where(accept, state.tick, state.changed_at),
@@ -611,6 +616,8 @@ def _sync_phase(
             state.fetch_rt if state.fetch_rt.ndim == 0 else state.fetch_rt[peer],
         )
     )
+    if params.namespace_gate:
+        acc = acc & state.ns_rel[state.ns_id[peer][:, None], state.ns_id[None, :]]
     st = state.replace(
         view_key=state.view_key.at[peer].max(jnp.where(acc, buf_p, own_p)),
         changed_at=state.changed_at.at[peer].max(
@@ -637,6 +644,8 @@ def _sync_phase(
             st.fetch_rt if st.fetch_rt.ndim == 0 else st.fetch_rt[caller],
         )
     )
+    if params.namespace_gate:
+        accept = accept & state.ns_rel[state.ns_id[caller][:, None], state.ns_id[None, :]]
     st = st.replace(
         view_key=st.view_key.at[caller].max(jnp.where(accept, ack_cand, own_rows)),
         changed_at=st.changed_at.at[caller].max(
@@ -764,6 +773,25 @@ def tick(
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)
     )
+    # Gossip segmentation (the reference warns when a receiver's
+    # SequenceIdCollector fragments past a threshold,
+    # GossipProtocolImpl.java:217-236, GossipConfig.java:12): per node, the
+    # number of ACTIVE rumors it is missing that are OLDER than its newest
+    # infection — holes in its receive stream. Reported as the worst node's
+    # count; the driver warns past the configured threshold.
+    newest = jnp.where(
+        state.infected, state.rumor_created[None, :], NEVER_I32
+    ).max(axis=1)
+    seg = (
+        (
+            state.rumor_active[None, :]
+            & ~state.infected
+            & (state.rumor_created[None, :] < newest[:, None])
+            & state.up[:, None]
+        )
+        .sum(axis=1)
+        .max()
+    )
     metrics = {
         **fd_m,
         **g_m,
@@ -772,6 +800,7 @@ def tick(
         "alive_view_fraction": alive_frac,
         "false_suspect_pairs": false_suspects,
         "rumor_coverage": coverage,  # [R]
+        "gossip_segmentation": seg,
     }
     return state, metrics
 

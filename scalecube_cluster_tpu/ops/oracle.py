@@ -89,6 +89,8 @@ class _O:
         self.infected = np.asarray(state.infected).copy()
         self.infected_at = np.asarray(state.infected_at).copy()
         self.infected_from = np.asarray(state.infected_from).copy()
+        self.ns_id = np.asarray(state.ns_id).copy()
+        self.ns_rel = np.asarray(state.ns_rel).copy()
         self.loss = np.asarray(state.loss).copy()
         self.fetch_rt = np.asarray(state.fetch_rt).copy()
         self.delay_q = np.asarray(state.delay_q).copy()
@@ -139,11 +141,14 @@ def _cluster_size(o: _O, i: int) -> int:
     return int(((o.key[i] & 3) != RANK_DEAD).sum())
 
 
-def _accept_into(o: _O, i: int, j: int, cand_key: int, salt: int) -> bool:
+def _accept_into(o: _O, i: int, j: int, cand_key: int, salt: int,
+                 namespace_gate: bool = False) -> bool:
     """The overrides gate + metadata-fetch gate + write, identical to the
     kernel's merge accept (incl. ``kernel._fetch_gate``) for one cell."""
     own = int(o.key[i, j])
     if cand_key <= own:
+        return False
+    if namespace_gate and not bool(o.ns_rel[o.ns_id[i], o.ns_id[j]]):
         return False
     known = own >= 0
     if not known and (cand_key & 3) > RANK_LEAVING:
@@ -315,7 +320,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             continue
         for j in range(n):
             if recv_key[i, j] > np.iinfo(np.int64).min:
-                _accept_into(o, i, j, int(recv_key[i, j]), SALT_GOSSIP)
+                _accept_into(o, i, j, int(recv_key[i, j]), SALT_GOSSIP,
+                             params.namespace_gate)
         for ru in range(params.rumor_slots):
             if recv_inf[i, ru] and pre.r_active[ru] and not o.infected[i, ru]:
                 o.infected[i, ru] = True
@@ -376,13 +382,14 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 cand = int(pre.key[i, j])
                 recv_key[(p, j)] = max(recv_key.get((p, j), cand), cand)
     for (p, j), cand in recv_key.items():
-        _accept_into(o, p, j, cand, SALT_SYNC_REQ)
+        _accept_into(o, p, j, cand, SALT_SYNC_REQ, params.namespace_gate)
     # ack: peers' post-request tables back to callers (one snapshot for all)
     mid = o.snap()
     for i, p in callers:
         for j in range(n):
             if mid.key[p, j] >= 0:
-                _accept_into(o, i, j, int(mid.key[p, j]), SALT_SYNC_ACK)
+                _accept_into(o, i, j, int(mid.key[p, j]), SALT_SYNC_ACK,
+                             params.namespace_gate)
 
     # ---- refutation (SUSPECT/DEAD self-record, or overwritten leave intent;
     # a leaver re-announces LEAVING — see kernel._refute_phase) ----

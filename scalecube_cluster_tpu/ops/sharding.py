@@ -55,6 +55,8 @@ def state_shardings(
         changed_at=row2d,
         force_sync=row,
         leaving=row,
+        ns_id=row,
+        ns_rel=rep,
         rumor_active=rep,
         rumor_origin=rep,
         rumor_created=rep,
@@ -120,6 +122,8 @@ def sparse_state_shardings(mesh: Mesh, dense_links: bool = False, delay_slots: i
         sus_since=row,
         force_sync=row,
         leaving=row,
+        ns_id=row,
+        ns_rel=rep,
         mr_active=rep,
         mr_subject=rep,
         mr_key=rep,

@@ -158,6 +158,11 @@ class SparseParams:
     seed_rows: tuple = ()
     early_free: bool = True
     full_metrics: bool = False
+    # hierarchical-namespace relatedness gate on every merge accept
+    # (areNamespacesRelated, MembershipProtocolImpl.java:511-536); zero-cost
+    # when False. Unrelated records never enter a view, so peer selection
+    # (drawn from the view) needs no extra gating.
+    namespace_gate: bool = False
 
 
 class SparseState(struct.PyTreeNode):
@@ -198,6 +203,8 @@ class SparseState(struct.PyTreeNode):
     sus_since: jax.Array  # i32 [N]
     force_sync: jax.Array  # bool [N]
     leaving: jax.Array  # bool [N]
+    ns_id: jax.Array  # i32 [N] — namespace group per row
+    ns_rel: jax.Array  # bool [G, G] — host-built relatedness table
     mr_active: jax.Array  # bool [M]
     mr_subject: jax.Array  # i32 [M]
     mr_key: jax.Array  # i32 [M]
@@ -240,6 +247,7 @@ def init_sparse_state(
     dense_links: bool = False,
     uniform_loss: float = 0.0,
     uniform_delay: float = 0.0,
+    namespaces=None,
 ) -> SparseState:
     """Fresh sparse-mode simulation; rows ``0..n_initial-1`` up.
 
@@ -248,10 +256,23 @@ def init_sparse_state(
     True for emulator-controlled runs at moderate N."""
     n, m, r = params.capacity, params.mr_slots, params.rumor_slots
     up = jnp.arange(n) < n_initial
+    if namespaces is not None:
+        from .state import build_namespace_tables
+
+        ids_np, rel_np = build_namespace_tables(list(namespaces))
+        ns_id = jnp.asarray(ids_np)
+        ns_rel = jnp.asarray(rel_np)
+        related = ns_rel[ns_id[:, None], ns_id[None, :]] | jnp.eye(n, dtype=bool)
+    else:
+        ns_id = jnp.zeros((n,), jnp.int32)
+        ns_rel = jnp.ones((1, 1), bool)
+        related = None
     if warm:
         known = up[:, None] & up[None, :]
+        if related is not None:
+            known = known & related
         view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
-        n_live = jnp.where(up, n_initial, 0).astype(jnp.int32)
+        n_live = known.sum(axis=1).astype(jnp.int32)
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
         view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
@@ -276,6 +297,8 @@ def init_sparse_state(
         sus_since=jnp.full((n,), NEVER, jnp.int32),
         force_sync=jnp.zeros((n,), bool),
         leaving=jnp.zeros((n,), bool),
+        ns_id=ns_id,
+        ns_rel=ns_rel,
         mr_active=jnp.zeros((m,), bool),
         mr_subject=jnp.full((m,), -1, jnp.int32),
         mr_key=jnp.zeros((m,), jnp.int32),
@@ -976,6 +999,10 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
             & _fetch_gate(state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch)
         )
+        if params.namespace_gate:
+            accept = accept & state.ns_rel[
+                state.ns_id[:, None], state.ns_id[subj][None, :]
+            ]
         vals = jnp.where(accept, cand, NO_CANDIDATE)
         subj_scatter = jnp.where(state.mr_active, state.mr_subject, n)  # OOB -> drop
         new_view = state.view_key.at[:, subj_scatter].max(
@@ -1101,6 +1128,8 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
             state.fetch_rt if state.fetch_rt.ndim == 0 else state.fetch_rt[peer],
         )
     )
+    if params.namespace_gate:
+        acc = acc & state.ns_rel[state.ns_id[peer][:, None], state.ns_id[None, :]]
     new_p = jnp.where(acc, buf_p, own_p)
     # duplicate peer slots recompute the IDENTICAL merged row; liveness
     # deltas count each distinct peer once (first_p)
@@ -1132,6 +1161,10 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
             st.fetch_rt if st.fetch_rt.ndim == 0 else st.fetch_rt[caller],
         )
     )
+    if params.namespace_gate:
+        accept = accept & state.ns_rel[
+            state.ns_id[caller][:, None], state.ns_id[None, :]
+        ]
     new_c = jnp.where(accept, ack_cand, own_rows)
     delta_c = (
         ((new_c & 3) != RANK_DEAD).astype(jnp.int32)
@@ -1352,6 +1385,26 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)
     )
+    # segmentation over BOTH pools (user rumors + membership rumors): holes
+    # in a node's receive stream — see kernel.tick's metric of the same name
+    newest_u = jnp.where(
+        state.infected, state.rumor_created[None, :], NEVER
+    ).max(axis=1)
+    seg_u = (
+        state.rumor_active[None, :]
+        & ~state.infected
+        & (state.rumor_created[None, :] < newest_u[:, None])
+        & state.up[:, None]
+    ).sum(axis=1)
+    newest_m = jnp.where(
+        state.minf_age > 0, state.mr_created[None, :], NEVER
+    ).max(axis=1)
+    seg_m = (
+        state.mr_active[None, :]
+        & (state.minf_age == 0)
+        & (state.mr_created[None, :] < newest_m[:, None])
+        & state.up[:, None]
+    ).sum(axis=1)
     metrics = {
         **fd_m,
         **g_m,
@@ -1360,6 +1413,7 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
         "n_up": state.up.sum(),
         "mr_active_count": state.mr_active.sum(),
         "rumor_coverage": coverage,
+        "gossip_segmentation": (seg_u + seg_m).max(),
     }
     if params.full_metrics:
         up2 = state.up[:, None] & state.up[None, :]

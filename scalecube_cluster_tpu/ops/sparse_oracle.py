@@ -38,7 +38,7 @@ class _SO:
         self.tick = int(state.tick)
         for name in (
             "up", "epoch", "view_key", "n_live", "sus_key", "sus_since",
-            "force_sync", "leaving", "mr_active", "mr_subject", "mr_key",
+            "force_sync", "leaving", "ns_id", "ns_rel", "mr_active", "mr_subject", "mr_key",
             "mr_created", "mr_origin", "minf_age", "rumor_active",
             "rumor_origin", "rumor_created", "infected", "infected_at",
             "infected_from", "loss", "fetch_rt", "delay_q", "pending_minf",
@@ -369,6 +369,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     pre, SALT_GOSSIP, i, subj
                 ):
                     continue
+                if params.namespace_gate and not bool(
+                    pre.ns_rel[pre.ns_id[i], pre.ns_id[subj]]
+                ):
+                    continue
                 o.view_key[i, subj] = cand
                 delta += int((cand & 3) != RANK_DEAD) - int((own & 3) != RANK_DEAD)
                 if (cand & 3) == RANK_SUSPECT and cand > int(o.sus_key[subj]):
@@ -452,6 +456,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 continue
             if (cand & 3) == RANK_ALIVE and not _fetch_ok(pre, SALT_SYNC_REQ, p, j):
                 continue
+            if params.namespace_gate and not bool(
+                pre.ns_rel[pre.ns_id[p], pre.ns_id[j]]
+            ):
+                continue
             new_row[j] = cand
             if (cand & 3) == RANK_SUSPECT:
                 sus_cand[j] = max(sus_cand[j], cand)
@@ -478,6 +486,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             if own < 0 and (cand & 3) > RANK_LEAVING:
                 continue
             if (cand & 3) == RANK_ALIVE and not _fetch_ok(mid, SALT_SYNC_ACK, i, j):
+                continue
+            if params.namespace_gate and not bool(
+                mid.ns_rel[mid.ns_id[i], mid.ns_id[j]]
+            ):
                 continue
             new_row[j] = cand
             acc[j] = True

@@ -88,6 +88,14 @@ class SimParams:
     # only need rumor coverage / counters can turn them off (the fields are
     # then emitted as 0, keeping the metrics pytree shape stable for scan).
     full_metrics: bool = True
+    # Apply the hierarchical-namespace relatedness gate
+    # (areNamespacesRelated, MembershipProtocolImpl.java:511-536) to every
+    # merge accept: records about subjects whose namespace group is
+    # unrelated to the receiver's are never applied, so unrelated members
+    # never enter a view (and therefore never get probed or gossiped to —
+    # the reference's member lists have the same property). Zero-cost when
+    # False (no gate ops are traced).
+    namespace_gate: bool = False
     # Rows that act as configured seed members: always in the SYNC peer pool
     # even when absent from the local view (the reference's selectSyncAddress
     # draws from seedMembers ∪ members, MembershipProtocolImpl.java:461-472 —
@@ -217,6 +225,8 @@ class SimState(struct.PyTreeNode):
     changed_at: jax.Array  # i32 [N, N]
     force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
     leaving: jax.Array  # bool [N] — graceful-leave intent (survives record overwrites)
+    ns_id: jax.Array  # i32 [N] — namespace group of each row (0 = default)
+    ns_rel: jax.Array  # bool [G, G] — precomputed relatedness (host-built)
     rumor_active: jax.Array  # bool [R]
     rumor_origin: jax.Array  # i32 [R]
     rumor_created: jax.Array  # i32 [R]
@@ -254,6 +264,23 @@ def delay_mean_to_q(mean_delay_ticks: float) -> float:
     return float(np.float32(np.exp(np.float32(-1.0 / mean_delay_ticks))))
 
 
+def build_namespace_tables(namespaces):
+    """Per-row namespace strings -> (ns_id [N] i32, ns_rel [G, G] bool) via
+    the reference's prefix-hierarchy relatedness
+    (``areNamespacesRelated``, ``MembershipProtocolImpl.java:511-536``)."""
+    from ..utils.namespaces import are_namespaces_related
+
+    uniq = sorted(set(namespaces))
+    gid = {ns: g for g, ns in enumerate(uniq)}
+    ids = np.asarray([gid[ns] for ns in namespaces], np.int32)
+    g = len(uniq)
+    rel = np.zeros((g, g), bool)
+    for a in uniq:
+        for b in uniq:
+            rel[gid[a], gid[b]] = are_namespaces_related(a, b)
+    return ids, rel
+
+
 def init_state(
     params: SimParams,
     n_initial: int,
@@ -261,6 +288,7 @@ def init_state(
     dense_links: bool = True,
     uniform_loss: float = 0.0,
     uniform_delay: float = 0.0,
+    namespaces=None,
 ) -> SimState:
     """Fresh simulation with rows ``0..n_initial-1`` up.
 
@@ -280,8 +308,19 @@ def init_state(
     n = params.capacity
     r = params.rumor_slots
     up = jnp.arange(n) < n_initial
+    if namespaces is not None:
+        ids_np, rel_np = build_namespace_tables(list(namespaces))
+        ns_id = jnp.asarray(ids_np)
+        ns_rel = jnp.asarray(rel_np)
+        related = ns_rel[ns_id[:, None], ns_id[None, :]]
+    else:
+        ns_id = jnp.zeros((n,), jnp.int32)
+        ns_rel = jnp.ones((1, 1), bool)
+        related = None
     if warm:
         known = up[:, None] & up[None, :]
+        if related is not None:
+            known = known & (related | jnp.eye(n, dtype=bool))
         view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
@@ -310,6 +349,8 @@ def init_state(
         changed_at=jnp.full((n, n), NEVER, jnp.int32),
         force_sync=jnp.zeros((n,), bool),
         leaving=jnp.zeros((n,), bool),
+        ns_id=ns_id,
+        ns_rel=ns_rel,
         rumor_active=jnp.zeros((r,), bool),
         rumor_origin=jnp.zeros((r,), jnp.int32),
         rumor_created=jnp.zeros((r,), jnp.int32),

@@ -118,6 +118,11 @@ class SimDriver:
             r: Member(id=f"sim-{r}", address=row_address(r)) for r in range(n_initial)
         }
         self.metrics_history: List[dict] = []
+        # gossip-stream fragmentation warning (the reference's
+        # checkGossipSegmentation, GossipProtocolImpl.java:217-236; default
+        # threshold 1000, GossipConfig.java:12)
+        self.segmentation_threshold = 1000
+        self.segmentation_warnings = 0
         self._watches: Dict[int, _Watch] = {}
         self._rumor_payloads: Dict[int, object] = {}
         self._next_member_ordinal = n_initial
@@ -189,6 +194,16 @@ class SimDriver:
                     w = self._watches[row]
                     self._diff_row(w, keys[i, w_idx])
                     w.prev_key = keys[i, w_idx]
+        if "gossip_segmentation" in ms:
+            worst = int(np.asarray(ms["gossip_segmentation"]).max())
+            if worst > self.segmentation_threshold:
+                self.segmentation_warnings += 1
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "gossip stream fragmented: %d missing-older rumors at the "
+                    "worst node (threshold %d)", worst, self.segmentation_threshold
+                )
         return {name: np.asarray(v[-1]) for name, v in ms.items()}
 
     def run_until(

@@ -221,3 +221,44 @@ def test_pool_exhaustion_heals_via_sync():
     up = np.asarray(st.up)
     dead = (vk[np.ix_(up, [11, 12, 13, 14, 15, 16])] & 3) == RANK_DEAD
     assert dead.mean() > 0.99, f"convergence failed under pool pressure ({dead.mean():.3f})"
+
+
+def test_segmentation_metric():
+    """A node missing an ACTIVE rumor older than its newest infection counts
+    as a receive-stream gap (the reference's SequenceIdCollector
+    fragmentation warning, GossipProtocolImpl.java:217-236)."""
+    import jax.numpy as jnp
+
+    params = SP.SparseParams(capacity=8, rumor_slots=4, mr_slots=8, seed_rows=(0,))
+    st = SP.init_sparse_state(params, 8, warm=True)
+    st = SP.spread_rumor(st, 0, origin=0)  # created tick 0
+    st = st.replace(tick=jnp.int32(10))
+    st = SP.spread_rumor(st, 1, origin=1)  # created tick 10
+    # node 2: infected only with the NEWER rumor -> 1 gap
+    st = st.replace(
+        infected=st.infected.at[2, 1].set(True),
+        infected_at=st.infected_at.at[2, 1].set(10),
+    )
+    step = jax.jit(partial(SP.sparse_tick, params=params))
+    _st, ms = step(st, jax.random.PRNGKey(0))
+    assert int(ms["gossip_segmentation"]) >= 1
+
+
+def test_segmentation_metric_dense():
+    import jax.numpy as jnp
+
+    import scalecube_cluster_tpu.ops.kernel as K
+    import scalecube_cluster_tpu.ops.state as S
+
+    params = S.SimParams(capacity=8, rumor_slots=4, seed_rows=(0,))
+    st = S.init_state(params, 8, warm=True)
+    st = S.spread_rumor(st, 0, origin=0)
+    st = st.replace(tick=jnp.int32(10))
+    st = S.spread_rumor(st, 1, origin=1)
+    st = st.replace(
+        infected=st.infected.at[2, 1].set(True),
+        infected_at=st.infected_at.at[2, 1].set(10),
+    )
+    step = jax.jit(partial(K.tick, params=params))
+    _st, ms = step(st, jax.random.PRNGKey(0))
+    assert int(ms["gossip_segmentation"]) >= 1
