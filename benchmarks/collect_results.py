@@ -1,0 +1,90 @@
+"""Run the full benchmark matrix sequentially and assemble BENCH_RESULTS_r{N}.json.
+
+Each config script prints one JSON line per experiment on stdout; this
+runner executes them as subprocesses (serially — the tunneled TPU is
+single-tenant and host contention skews wall-clock numbers), collects every
+JSON line, and writes the round artifact. Usage:
+
+    python benchmarks/collect_results.py --round 3 [--quick]
+
+``--quick`` skips the slowest entries (config3b's 128-node scalar side and
+the 32k+ churn points) for a smoke pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+HERE = pathlib.Path(__file__).parent
+ROOT = HERE.parent
+
+
+def run(cmd: list, timeout: int = 1800) -> list:
+    print(f"$ {' '.join(cmd)}", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        cmd, cwd=ROOT, capture_output=True, text=True, timeout=timeout
+    )
+    print(proc.stderr[-2000:], file=sys.stderr, flush=True)
+    out = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    print(f"  -> {len(out)} result(s) in {time.perf_counter()-t0:.0f}s",
+          file=sys.stderr, flush=True)
+    if proc.returncode != 0 and not out:
+        out.append({"cmd": " ".join(cmd), "error": proc.returncode,
+                    "stderr_tail": proc.stderr[-500:]})
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    py = sys.executable
+    results: list = []
+
+    results += run([py, "benchmarks/config1_join.py"])
+    results += run([py, "benchmarks/config2_gossip.py"])
+    results += run([py, "benchmarks/config3_fd_loss.py"])
+    results += run([py, "benchmarks/config3_fd_loss.py", "--delay-mean", "1.5"])
+    results += run([py, "benchmarks/config4_partition.py"])
+    results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "16384"])
+    if not args.quick:
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "32768"])
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152"],
+                       timeout=3000)
+    results += run([py, "benchmarks/config2b_scalar_vs_kernel_gossip.py"])
+    if not args.quick:
+        results += run([py, "benchmarks/config3b_scalar_vs_kernel_fd.py"],
+                       timeout=3000)
+    results += run([py, "benchmarks/config4b_scalar_vs_kernel_detection.py"])
+    results += run([py, "benchmarks/compile_proof_100k.py"])
+    results += run([py, "bench.py", "--scaling"], timeout=3000)
+
+    artifact = {
+        "round": args.round,
+        "hardware": "TPU v5e (1 chip, 16 GB) via axon tunnel; "
+                    "compile proofs on 8 virtual CPU devices",
+        "configs": results,
+    }
+    out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"wrote": str(out), "n_results": len(results)}))
+
+
+if __name__ == "__main__":
+    main()
