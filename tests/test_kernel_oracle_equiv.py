@@ -144,3 +144,32 @@ def test_lockstep_equivalence(seed):
     # sanity: the scenario actually exercised state (cluster noticed crashes)
     vs = np.asarray(st.view_status)
     assert (vs[0, 4] != 0) or (vs[0, 5] != 0)
+
+
+def test_lockstep_medium_haul():
+    """Always-on 100-tick seed (the full soak is opt-in via SOAK=1; this
+    catches regressions that only bite past the ~30-tick CI scenarios —
+    round-2 verdict weak #5)."""
+    params = S.SimParams(
+        capacity=12, fanout=2, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=3, seed_rows=(0,),
+        delay_slots=3,
+    )
+    step = jax.jit(partial(K.tick, params=params))
+    rng = np.random.default_rng(77)
+    st = S.init_state(params, 10, warm=True, uniform_delay=0.9)
+    key = jax.random.PRNGKey(777)
+    for t in range(100):
+        if t == 10:
+            st = S.crash_row(st, 4)
+        if t == 14:
+            st = S.spread_rumor(st, 0, origin=2)
+        if t == 40:
+            st = S.join_row(st, 11, seed_rows=[0])
+        if t == 70:
+            st = S.spread_rumor(st, 1, origin=7)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = O.oracle_tick(st, k, params)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next

@@ -174,3 +174,29 @@ def test_sparse_n_live_invariant():
     recount = ((vk & 3) != 3).sum(axis=1)
     up = np.asarray(st.up)
     assert (recount[up] == np.asarray(st.n_live)[up]).all()
+
+
+def test_sparse_lockstep_medium_haul():
+    """Always-on 80-tick sparse seed (full soak opt-in; see the dense
+    suite's medium-haul note)."""
+    params = SP.SparseParams(
+        capacity=12, fanout=2, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, sweep_every=2, sample_tries=4,
+        rumor_slots=3, mr_slots=16, announce_slots=8, seed_rows=(0,),
+        delay_slots=3,
+    )
+    st = SP.init_sparse_state(params, 10, warm=True, dense_links=True,
+                              uniform_delay=0.9)
+
+    def mutate(t, st):
+        if t == 10:
+            st = SP.crash_row(st, 4)
+        if t == 14:
+            st = SP.spread_rumor(st, 0, origin=2)
+        if t == 40:
+            st = SP.join_row(st, 11, seed_rows=[0])
+        if t == 70:
+            st = SP.spread_rumor(st, 1, origin=7)
+        return st
+
+    _run_lockstep(params, st, 777, 80, mutate=mutate)
