@@ -69,3 +69,32 @@ def test_sparse_driver_partition_with_dense_links():
     d.step(200)
     assert d.status_of(3, 20).name == "ALIVE"
     assert d.status_of(20, 3).name == "ALIVE"
+
+
+def test_sparse_sim_transport_bridge():
+    """The Transport SPI bridge (sim://row messaging) runs unmodified over
+    the sparse engine — same facade-shape guarantee as the dense driver."""
+    import asyncio
+
+    from scalecube_cluster_tpu.sim import SimCluster
+
+    async def scenario():
+        d = SimDriver(PARAMS, 16, seed=9, dense_links=True)
+        cluster = SimCluster(d)
+        a, b = cluster.node(1), cluster.node(2)
+        ta = a.transport()
+        tb = b.transport()
+        inbox = []
+        tb.listen().subscribe(inbox.append)
+        from scalecube_cluster_tpu.models.message import Message
+
+        await ta.send(tb.address, Message.with_data("hi", qualifier="t/x"))
+        await asyncio.sleep(0.05)
+        assert inbox and inbox[0].data == "hi"
+        # blocked link surfaces as drop/timeout like the emulator decorator
+        d.set_link_loss([1], [2], 1.0)
+        await ta.send(tb.address, Message.with_data("lost", qualifier="t/x"))
+        await asyncio.sleep(0.05)
+        assert len(inbox) == 1
+
+    asyncio.run(scenario())
