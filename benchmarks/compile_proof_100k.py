@@ -107,6 +107,7 @@ def prove_sparse(mesh) -> dict:
     shapes = dict(
         tick=(), up=(N,), epoch=(N,), view_key=(N, N), n_live=(N,),
         sus_key=(N,), sus_since=(N,), force_sync=(N,), leaving=(N,),
+        ns_id=(N,), ns_rel=(1, 1),
         mr_active=(M,), mr_subject=(M,), mr_key=(M,), mr_created=(M,),
         mr_origin=(M,), minf_age=(N, M), rumor_active=(R,), rumor_origin=(R,),
         rumor_created=(R,), infected=(N, R), infected_at=(N, R),
@@ -175,7 +176,8 @@ def prove_dense(mesh) -> dict:
     R = params.rumor_slots
     shapes = dict(
         tick=(), up=(N,), epoch=(N,), view_key=(N, N), changed_at=(N, N),
-        force_sync=(N,), leaving=(N,), rumor_active=(R,), rumor_origin=(R,),
+        force_sync=(N,), leaving=(N,), ns_id=(N,), ns_rel=(1, 1),
+        rumor_active=(R,), rumor_origin=(R,),
         rumor_created=(R,), infected=(N, R), infected_at=(N, R),
         infected_from=(N, R), loss=(), fetch_rt=(), delay_q=(),
         pending_key=(0, N, N), pending_inf=(0, N, R), pending_src=(0, N, R),

@@ -57,7 +57,7 @@ def sparse_main(args) -> None:
     params = SPS.SparseParams(
         capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
         sync_every=150, suspicion_mult=5, rumor_slots=2, mr_slots=m,
-        announce_slots=512, seed_rows=(0, 1, 2, 3),
+        announce_slots=1024, seed_rows=(0, 1, 2, 3),
     )
     churn_per_s = max(1, int(n * args.churn_pct_per_s / 100))
 
@@ -89,12 +89,20 @@ def sparse_main(args) -> None:
         st = st.replace(up=st.up.at[crash].set(False))
         st = SPS.join_rows(st, join, seeds)
         st, key, ms, _w = SPS.run_sparse_ticks(st, key, TICKS_PER_SECOND, params)
-        up2 = st.up[:, None] & st.up[None, :]
-        pairs = jnp.maximum(up2.sum() - st.up.sum(), 1)
-        off = ~jnp.eye(n, dtype=bool)
-        alive = (up2 & off & ((st.view_key & 3) == RANK_ALIVE)).sum()
+        # health WITHOUT materializing [N, N] bool planes (an eye() alone is
+        # 2.4 GB at 49k and OOMs the single chip): row-reduce the fused
+        # predicate, subtract the diagonal's self-ALIVE contribution
+        n_up = st.up.sum()
+        alive_rows = jnp.where(
+            st.up[:, None] & st.up[None, :] & ((st.view_key & 3) == RANK_ALIVE),
+            1,
+            0,
+        ).sum()
+        diag = jnp.diagonal(st.view_key)
+        self_alive = (st.up & ((diag & 3) == RANK_ALIVE)).sum()
+        pairs = jnp.maximum(n_up * (n_up - 1), 1)
         out = (
-            alive.astype(jnp.float32) / pairs,
+            (alive_rows - self_alive).astype(jnp.float32) / pairs,
             ms["announce_dropped"].sum(),
             ms["mr_active_count"].max(),
         )
