@@ -676,12 +676,10 @@ def _pack_bits(x: jax.Array) -> jax.Array:
 
 
 def _unpack_bits(p: jax.Array, L: int) -> jax.Array:
-    """u32 [R, W] -> bool [R, L]."""
-    nrows, W = p.shape
-    b = (
-        (p[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]) & 1
-    ).astype(bool)
-    return b.reshape(nrows, W * 32)[:, :L]
+    """u32 [..., W] -> bool [..., L]."""
+    *lead, W = p.shape
+    b = ((p[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1).astype(bool)
+    return b.reshape(*lead, W * 32)[..., :L]
 
 
 # ---------------------------------------------------------------------------
@@ -838,26 +836,41 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         )
 
     def _deliver(state: SparseState):
-        # age the infection planes (only while rumors exist — all-zero when
-        # quiet, so skipping on quiet ticks changes nothing)
-        age = state.minf_age
-        age = jnp.where(age > 0, jnp.minimum(age, jnp.uint8(254)) + jnp.uint8(1), age)
-        state = state.replace(minf_age=age)
-
+        mr_any = state.mr_active.any()
+        if D:
+            mr_any = mr_any | state.pending_minf[slot_now].any()
         spread = params.repeat_mult * ceil_log2(state.n_live)  # [N]
         young_u = (
             state.infected
             & state.rumor_active[None, :]
             & (state.tick - state.infected_at < spread[:, None])
         )
-        # age = tick - infection_tick + 1 after this tick's increment, so
-        # age <= spread  <=>  tick - infection_tick < spread — exactly the
-        # dense kernel's (and the reference's) forwarding window
-        young_m = (
-            (age > 0)
-            & state.mr_active[None, :]
-            & (age.astype(jnp.int32) <= spread[:, None])
-        )
+
+        # ALL [N, M] work is gated on the pool being non-empty: a pure
+        # user-rumor dissemination (or any membership-quiet stretch) skips
+        # the age pass, young window, packing, apply, and sweep entirely.
+        def _mr_pre(st: SparseState):
+            age = st.minf_age
+            age = jnp.where(
+                age > 0, jnp.minimum(age, jnp.uint8(254)) + jnp.uint8(1), age
+            )
+            # age = tick - infection_tick + 1 after this tick's increment, so
+            # age <= spread  <=>  tick - infection_tick < spread — exactly
+            # the dense kernel's (and the reference's) forwarding window
+            young_m = (
+                (age > 0)
+                & st.mr_active[None, :]
+                & (age.astype(jnp.int32) <= spread[:, None])
+            )
+            return age, _pack_bits(young_m)
+
+        def _mr_pre_skip(st: SparseState):
+            return st.minf_age, jnp.zeros(
+                (n, (m + 31) // 32), jnp.uint32
+            )
+
+        age, ym_p = jax.lax.cond(mr_any, _mr_pre, _mr_pre_skip, state)
+        state = state.replace(minf_age=age)
         peers, peer_valid = _sample_rejection(
             state, rows, r.gossip_try, params.fanout, params.sample_tries
         )
@@ -865,7 +878,6 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         # ONE combined per-sender payload row [packed-M | packed-R | from]:
         # row-gathers cost per ROW on TPU (~independent of row width), so the
         # three per-slot payload lookups collapse into a single gather
-        ym_p = _pack_bits(young_m)  # [N, Wm] u32
         yu_p = _pack_bits(young_u)  # [N, Wu] u32
         Wm, Wu = ym_p.shape[1], yu_p.shape[1]
         payload = jnp.concatenate(
@@ -895,59 +907,75 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         # origin filters apply receiver-side (a filtered receiver is already
         # infected, so state evolution is unchanged; message counters tally
         # payload-bearing sends before that filter).
-        sender_has = young_u.any(axis=1) | young_m.any(axis=1)
-        sent = jnp.int32(0)
-        rumor_sent = jnp.int32(0)
-        no_sender = jnp.full((n,), -1, jnp.int32)
-        for s in range(params.fanout):
-            p = peers[:, s]
-            ok = (
-                peer_valid[:, s]
-                & sender_has
-                & state.up
-                & state.up[p]
-                & (r.gossip_edge[:, s] < (1.0 - _loss_at(state, rows, p)))
-            )
-            sent = sent + ok.sum()
-            if D:
-                qd = _delay_q_at(state, rows, p)
-                d = jnp.zeros((n,), jnp.int32)
-                qpow = qd
-                for _ in range(1, D):
-                    d = d + (r.gossip_delay[:, s] < qpow)
-                    qpow = qpow * qd
-                ok_now = ok & (d == 0)
-                ok_late = ok & (d > 0)
-            else:
-                ok_now = ok
-            inv_s = no_sender.at[p].max(jnp.where(ok_now, rows, -1))
-            j = jnp.maximum(inv_s, 0)
-            has = (inv_s >= 0)[:, None]
-            pl = payload[j]  # the slot's single row-gather
-            young_u_j = _unpack_bits(pl[:, Wm : Wm + Wu], state.infected.shape[1])
-            jfrom = pl[:, Wm + Wu :].astype(jnp.int32)
-            deliver_u = (
-                young_u_j
-                & has
-                & (jfrom != rows[:, None])
-                & (state.rumor_origin[None, :] != rows[:, None])
-            )
-            recv_u = recv_u | deliver_u
-            recv_src = jnp.maximum(recv_src, jnp.where(deliver_u, j[:, None], -1))
-            # membership payload stays packed; the origin filter is
-            # receiver-only, so it applies once after the slot OR below
-            recv_m_p = recv_m_p | jnp.where(has, pl[:, :Wm], jnp.uint32(0))
-            rumor_sent = rumor_sent + deliver_u.sum()
-            if D:
-                inv_l = no_sender.at[p].max(jnp.where(ok_late, rows, -1))
+        sender_has = young_u.any(axis=1) | (ym_p != 0).any(axis=1)
+        # ALL fanout slots batched into [F, N] tensors — TPU executes
+        # kernels serially, so three sequential per-slot accumulate chains
+        # cost three sets of launch overheads; one stacked chain + a final
+        # OR/max-reduce costs one.
+        F = params.fanout
+        R = state.infected.shape[1]
+        p_all = peers.T  # [F, N]
+        rows_b = jnp.broadcast_to(rows, (F, n))
+        ok_all = (
+            peer_valid.T
+            & sender_has[None, :]
+            & state.up[None, :]
+            & state.up[p_all]
+            & (r.gossip_edge.T < (1.0 - _loss_at(state, rows_b, p_all)))
+        )
+        sent = ok_all.sum()
+        if D:
+            qd = _delay_q_at(state, rows_b, p_all)
+            d_all = jnp.zeros((F, n), jnp.int32)
+            qpow = qd
+            for _ in range(1, D):
+                d_all = d_all + (r.gossip_delay.T < qpow)
+                qpow = qpow * qd
+            ok_now_all = ok_all & (d_all == 0)
+        else:
+            ok_now_all = ok_all
+        inv = (
+            jnp.full((F, n), -1, jnp.int32)
+            .at[jnp.arange(F)[:, None], p_all]
+            .max(jnp.where(ok_now_all, rows[None, :], -1))
+        )
+        j_all = jnp.maximum(inv, 0)  # [F, N]
+        has_all = (inv >= 0)[:, :, None]
+        pl_all = payload[j_all]  # [F, N, Wm+Wu+R] — ONE gather
+        yu_all = _unpack_bits(pl_all[:, :, Wm : Wm + Wu], R)
+        from_all = pl_all[:, :, Wm + Wu :].astype(jnp.int32)
+        deliver_u_all = (
+            yu_all
+            & has_all
+            & (from_all != rows[None, :, None])
+            & (state.rumor_origin[None, None, :] != rows[None, :, None])
+        )
+        recv_u = recv_u | deliver_u_all.any(axis=0)
+        recv_src = jnp.maximum(
+            recv_src,
+            jnp.where(deliver_u_all, j_all[:, :, None], -1).max(axis=0),
+        )
+        import functools as _ft
+
+        recv_m_p = _ft.reduce(
+            jnp.bitwise_or,
+            [jnp.where(has_all[s], pl_all[s, :, :Wm], jnp.uint32(0)) for s in range(F)],
+            recv_m_p,
+        )
+        rumor_sent = deliver_u_all.sum()
+        if D:
+            # late deliveries stay per-slot (delay runs are small-N
+            # fidelity configurations; the rings force per-slot scatters)
+            no_sender = jnp.full((n,), -1, jnp.int32)
+            for s in range(F):
+                ok_late = ok_all[s] & (d_all[s] > 0)
+                inv_l = no_sender.at[p_all[s]].max(jnp.where(ok_late, rows, -1))
                 jl = jnp.maximum(inv_l, 0)
                 hasl = (inv_l >= 0)[:, None]
                 pll = payload[jl]
-                young_u_l = _unpack_bits(
-                    pll[:, Wm : Wm + Wu], state.infected.shape[1]
-                )
+                young_u_l = _unpack_bits(pll[:, Wm : Wm + Wu], R)
                 lfrom = pll[:, Wm + Wu :].astype(jnp.int32)
-                slot_d = (state.tick + d[jl]) % D
+                slot_d = (state.tick + d_all[s][jl]) % D
                 late_u = (
                     young_u_l
                     & hasl
@@ -972,63 +1000,77 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             infected_from=jnp.where(newly_u, recv_src, state.infected_from),
         )
 
-        # membership-rumor infection + one-shot record application
-        recv_m = _unpack_bits(recv_m_p, m) & (
-            state.mr_origin[None, :] != rows[:, None]
-        )
-        newly_m = (
-            recv_m & (state.minf_age == 0) & state.up[:, None] & state.mr_active[None, :]
-        )
-        state = state.replace(
-            minf_age=jnp.where(newly_m, jnp.uint8(1), state.minf_age)
-        )
-        # Record application. Pool subjects are UNIQUE among active slots
-        # (allocation supersedes-in-place, see _alloc_phase), so the winner
-        # at a cell IS the slot's own accepted candidate — no group-max, no
-        # second gather, and the column scatter carries unique indices.
-        subj = jnp.maximum(state.mr_subject, 0)  # clamped for the gather
-        own = jnp.take(state.view_key, subj, axis=1)  # [N, M]
-        cand = jnp.where(newly_m, state.mr_key[None, :], NO_CANDIDATE)
-        p_fetch = (
-            state.fetch_rt
-            if state.fetch_rt.ndim == 0
-            else jnp.take(state.fetch_rt, subj, axis=1)
-        )
-        accept = (
-            (cand > own)
-            & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
-            & _fetch_gate(state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch)
-        )
-        if params.namespace_gate:
-            accept = accept & state.ns_rel[
-                state.ns_id[:, None], state.ns_id[subj][None, :]
-            ]
-        vals = jnp.where(accept, cand, NO_CANDIDATE)
-        subj_scatter = jnp.where(state.mr_active, state.mr_subject, n)  # OOB -> drop
-        new_view = state.view_key.at[:, subj_scatter].max(
-            vals, mode="drop", unique_indices=True
-        )
-        new_own = jnp.where(accept, cand, own)
-        delta = (
-            ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
-            - ((own & 3) != RANK_DEAD).astype(jnp.int32)
-        )
-        n_live = state.n_live + delta.sum(axis=1)
-        # episode registration for accepted SUSPECT records
-        sus_col = jnp.where(accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE).max(
-            axis=0
-        )  # [M]
-        sus_cand = (
-            jnp.full((n,), NO_CANDIDATE, jnp.int32)
-            .at[subj_scatter]
-            .max(sus_col, mode="drop", unique_indices=True)
-        )
-        new_sus = jnp.maximum(state.sus_key, sus_cand)
-        state = state.replace(
-            view_key=new_view,
-            n_live=n_live,
-            sus_key=new_sus,
-            sus_since=jnp.where(new_sus > state.sus_key, state.tick, state.sus_since),
+        # membership-rumor infection + one-shot record application — all
+        # [N, M] work gated on the pool being non-empty (mr_any)
+        def _mr_apply(state: SparseState):
+            recv_m = _unpack_bits(recv_m_p, m) & (
+                state.mr_origin[None, :] != rows[:, None]
+            )
+            newly_m = (
+                recv_m
+                & (state.minf_age == 0)
+                & state.up[:, None]
+                & state.mr_active[None, :]
+            )
+            state = state.replace(
+                minf_age=jnp.where(newly_m, jnp.uint8(1), state.minf_age)
+            )
+            # Pool subjects are UNIQUE among active slots (allocation
+            # supersedes-in-place, see _alloc_phase), so the winner at a
+            # cell IS the slot's own accepted candidate — no group-max, no
+            # second gather, and the column scatter carries unique indices.
+            subj = jnp.maximum(state.mr_subject, 0)  # clamped for the gather
+            own = jnp.take(state.view_key, subj, axis=1)  # [N, M]
+            cand = jnp.where(newly_m, state.mr_key[None, :], NO_CANDIDATE)
+            p_fetch = (
+                state.fetch_rt
+                if state.fetch_rt.ndim == 0
+                else jnp.take(state.fetch_rt, subj, axis=1)
+            )
+            accept = (
+                (cand > own)
+                & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+                & _fetch_gate(
+                    state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch
+                )
+            )
+            if params.namespace_gate:
+                accept = accept & state.ns_rel[
+                    state.ns_id[:, None], state.ns_id[subj][None, :]
+                ]
+            vals = jnp.where(accept, cand, NO_CANDIDATE)
+            subj_scatter = jnp.where(state.mr_active, state.mr_subject, n)
+            new_view = state.view_key.at[:, subj_scatter].max(
+                vals, mode="drop", unique_indices=True
+            )
+            new_own = jnp.where(accept, cand, own)
+            delta = (
+                ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
+                - ((own & 3) != RANK_DEAD).astype(jnp.int32)
+            )
+            n_live = state.n_live + delta.sum(axis=1)
+            # episode registration for accepted SUSPECT records
+            sus_col = jnp.where(
+                accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE
+            ).max(axis=0)
+            sus_cand = (
+                jnp.full((n,), NO_CANDIDATE, jnp.int32)
+                .at[subj_scatter]
+                .max(sus_col, mode="drop", unique_indices=True)
+            )
+            new_sus = jnp.maximum(state.sus_key, sus_cand)
+            state = state.replace(
+                view_key=new_view,
+                n_live=n_live,
+                sus_key=new_sus,
+                sus_since=jnp.where(
+                    new_sus > state.sus_key, state.tick, state.sus_since
+                ),
+            )
+            return state, newly_m.sum()
+
+        state, n_mr_deliveries = jax.lax.cond(
+            mr_any, _mr_apply, lambda st: (st, jnp.int32(0)), state
         )
         if D:
             state = state.replace(
@@ -1040,7 +1082,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             "gossip_msgs": sent,
             "rumor_sends": rumor_sent,
             "rumor_deliveries": newly_u.sum(),
-            "mr_deliveries": newly_m.sum(),
+            "mr_deliveries": n_mr_deliveries,
         }
 
     def _quiet(state: SparseState):
@@ -1271,33 +1313,39 @@ def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
     if params.delay_slots:
         keep_u = keep_u | state.pending_inf.any(axis=(0, 1))
 
-    age = state.minf_age.astype(jnp.int32)
-    forwarding_m = ((age > 0) & (age <= spread[:, None]) & state.up[:, None]).any(
-        axis=0
-    )
-    keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
-    pending_m = (
-        state.pending_minf.any(axis=(0, 1))
-        if params.delay_slots
-        else jnp.zeros_like(keep_m)
-    )
-    keep_m = keep_m | pending_m
-    if params.early_free:
-        covered = ((state.minf_age > 0) | ~state.up[:, None]).all(axis=0)
-        keep_m = keep_m & ~(covered & ~pending_m)
-    keep_m = keep_m & state.mr_active
-    freed = state.mr_active & ~keep_m
-    state = state.replace(
-        rumor_active=state.rumor_active & keep_u,
-        mr_active=keep_m,
-        mr_subject=jnp.where(freed, -1, state.mr_subject),
-        minf_age=jnp.where(freed[None, :], jnp.uint8(0), state.minf_age),
-    )
-    if params.delay_slots:
-        state = state.replace(
-            pending_minf=state.pending_minf & keep_m[None, None, :]
+    state = state.replace(rumor_active=state.rumor_active & keep_u)
+
+    def _sweep_m(state: SparseState):
+        age = state.minf_age.astype(jnp.int32)
+        forwarding_m = (
+            (age > 0) & (age <= spread[:, None]) & state.up[:, None]
+        ).any(axis=0)
+        keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
+        pending_m = (
+            state.pending_minf.any(axis=(0, 1))
+            if params.delay_slots
+            else jnp.zeros_like(keep_m)
         )
-    return state
+        keep_m = keep_m | pending_m
+        if params.early_free:
+            covered = ((state.minf_age > 0) | ~state.up[:, None]).all(axis=0)
+            keep_m = keep_m & ~(covered & ~pending_m)
+        keep_m = keep_m & state.mr_active
+        freed = state.mr_active & ~keep_m
+        state = state.replace(
+            mr_active=keep_m,
+            mr_subject=jnp.where(freed, -1, state.mr_subject),
+            minf_age=jnp.where(freed[None, :], jnp.uint8(0), state.minf_age),
+        )
+        if params.delay_slots:
+            state = state.replace(
+                pending_minf=state.pending_minf & keep_m[None, None, :]
+            )
+        return state
+
+    # the membership sweep's [N, M] passes are skipped while the pool is
+    # empty (same gating as the gossip phase's membership sections)
+    return jax.lax.cond(state.mr_active.any(), _sweep_m, lambda st: st, state)
 
 
 def _alloc_phase(state: SparseState, proposals, params: SparseParams):
@@ -1396,15 +1444,23 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
         & (state.rumor_created[None, :] < newest_u[:, None])
         & state.up[:, None]
     ).sum(axis=1)
-    newest_m = jnp.where(
-        state.minf_age > 0, state.mr_created[None, :], NEVER
-    ).max(axis=1)
-    seg_m = (
-        state.mr_active[None, :]
-        & (state.minf_age == 0)
-        & (state.mr_created[None, :] < newest_m[:, None])
-        & state.up[:, None]
-    ).sum(axis=1)
+    def _seg_m(st: SparseState):
+        newest_m = jnp.where(
+            st.minf_age > 0, st.mr_created[None, :], NEVER
+        ).max(axis=1)
+        return (
+            st.mr_active[None, :]
+            & (st.minf_age == 0)
+            & (st.mr_created[None, :] < newest_m[:, None])
+            & st.up[:, None]
+        ).sum(axis=1)
+
+    seg_m = jax.lax.cond(
+        state.mr_active.any(),
+        _seg_m,
+        lambda st: jnp.zeros((state.capacity,), jnp.int32),
+        state,
+    )
     metrics = {
         **fd_m,
         **g_m,
