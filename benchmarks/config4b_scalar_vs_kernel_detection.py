@@ -1,9 +1,10 @@
-"""Driver config #4b: crash-detection latency, scalar engine vs kernel.
+"""Driver config #4b: crash-detection latency across ALL THREE engines.
 
 Completes the cross-engine validation triad (2b: gossip dissemination,
 3b: FD false positives): an 8-node cluster loses one member without
-goodbye; measure how long an observer takes to REMOVE it. Both engines run
-the same protocol constants, so both should land just past the same
+goodbye; measure how long an observer takes to REMOVE it. The scalar
+engine, the dense kernel, AND the sparse record-queue kernel run the same
+protocol constants, so all three should land just past the same
 suspicion math (detect + suspicion timeout + dissemination):
 
 * scalar — full Cluster facade over emulator loopback; the "crash" is a
@@ -120,24 +121,58 @@ def kernel_side() -> float | None:
     return None  # never detected within budget: reported, not raised
 
 
+def sparse_side() -> float | None:
+    """Same experiment on the sparse record-queue engine. Its suspicion
+    stamp is per-episode and expiry runs every sweep_every ticks, so the
+    latency lands within one sweep period of the dense kernel's."""
+    from functools import partial
+
+    import jax
+
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    params = SP.SparseParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=2,
+        fd_every=round(PING_INTERVAL / TICK), sync_every=round(0.4 / TICK),
+        suspicion_mult=SUSPICION_MULT, sweep_every=2, rumor_slots=2,
+        mr_slots=16, announce_slots=8, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, N, warm=True, dense_links=True)
+    st = SP.crash_row(st, N - 1)
+    step = jax.jit(partial(SP.sparse_tick, params=params))
+    key = jax.random.PRNGKey(1)
+    for t in range(2000):
+        key, k2 = jax.random.split(key)
+        st, _ = step(st, k2)
+        cell = int(np.asarray(st.view_key[0, N - 1]))
+        if cell >= 0 and (cell & 3) == 3:
+            return (t + 1) * TICK
+    return None
+
+
 def main() -> None:
     analytic = suspicion_timeout(SUSPICION_MULT, N, PING_INTERVAL)
     s = asyncio.run(scalar_side())
     k = kernel_side()
-    log(f"scalar removal latency: {s}s, kernel: {k}s, "
-        f"suspicion math: {analytic:.2f}s")
+    sp = sparse_side()
+    log(f"scalar removal latency: {s}s, dense kernel: {k}s, "
+        f"sparse kernel: {sp}s, suspicion math: {analytic:.2f}s")
     ok = (
         s is not None
         and k is not None
+        and sp is not None
         and s >= analytic  # removal must wait out the suspicion window
         and k >= analytic
+        and sp >= analytic
         and abs(s - k) <= 0.6 * max(s, k) + 1.0
+        and abs(s - sp) <= 0.6 * max(s, sp) + 1.0
     )
     emit({
-        "config": "4b", "metric": "crash_removal_latency_scalar_vs_kernel",
+        "config": "4b", "metric": "crash_removal_latency_three_engines",
         "n": N,
         "scalar_seconds": round(s, 2) if s is not None else None,
-        "kernel_seconds": round(k, 2) if k is not None else None,
+        "dense_kernel_seconds": round(k, 2) if k is not None else None,
+        "sparse_kernel_seconds": round(sp, 2) if sp is not None else None,
         "suspicion_math_seconds": round(analytic, 2), "ok": bool(ok),
     })
 

@@ -158,6 +158,52 @@ class SparseParams:
     seed_rows: tuple = ()
     early_free: bool = True
     full_metrics: bool = False
+
+    @staticmethod
+    def from_config(
+        config,
+        capacity: int | None = None,
+        initial_size: int | None = None,
+        seed_rows: tuple = (0,),
+        mr_slots: int | None = None,
+    ) -> "SparseParams":
+        """Derive sparse-engine params from a ClusterConfig — the same
+        tick-unit mapping as ``SimParams.from_config`` (one tick = one
+        gossip period), plus pool sizing (default capacity // 8, the
+        measured churn high-water with 2.5x headroom)."""
+        sim = config.sim
+        cap = capacity or sim.capacity or (initial_size or 0)
+        if cap <= 1:
+            raise ValueError(
+                "sim capacity must be > 1 (set config.sim.capacity, or pass "
+                "capacity= / initial_size=)"
+            )
+        dt = sim.tick_interval
+        return SparseParams(
+            capacity=cap,
+            fanout=config.gossip.gossip_fanout,
+            repeat_mult=config.gossip.gossip_repeat_mult,
+            ping_req_k=config.failure_detector.ping_req_members,
+            fd_every=max(1, round(config.failure_detector.ping_interval / dt)),
+            sync_every=max(1, round(config.membership.sync_interval / dt)),
+            suspicion_mult=config.membership.suspicion_mult,
+            rumor_slots=sim.rumor_slots,
+            mr_slots=mr_slots or max(256, cap // 8),
+            seed_rows=tuple(seed_rows),
+            delay_slots=sim.delay_slots,
+            fd_direct_timeout_ticks=max(
+                0, int(config.failure_detector.ping_timeout / dt)
+            ),
+            fd_leg_timeout_ticks=max(
+                0,
+                int(
+                    (config.failure_detector.ping_interval
+                     - config.failure_detector.ping_timeout) / dt / 2
+                ),
+            ),
+            sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
+        )
+
     # hierarchical-namespace relatedness gate on every merge accept
     # (areNamespacesRelated, MembershipProtocolImpl.java:511-536); zero-cost
     # when False. Unrelated records never enter a view, so peer selection
