@@ -28,9 +28,14 @@ ROOT = HERE.parent
 def run(cmd: list, timeout: int = 1800) -> list:
     print(f"$ {' '.join(cmd)}", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    proc = subprocess.run(
-        cmd, cwd=ROOT, capture_output=True, text=True, timeout=timeout
-    )
+    try:
+        proc = subprocess.run(
+            cmd, cwd=ROOT, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired as e:
+        # a slow config must not discard the rest of the matrix
+        print(f"  TIMEOUT after {timeout}s", file=sys.stderr, flush=True)
+        return [{"cmd": " ".join(cmd), "error": "timeout", "timeout_s": timeout}]
     print(proc.stderr[-2000:], file=sys.stderr, flush=True)
     out = []
     for line in proc.stdout.splitlines():

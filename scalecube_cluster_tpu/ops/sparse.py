@@ -76,9 +76,12 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    bearing sends before that filter.
 
 Memory at flagship scale (v5e, 16 GB/chip): N=98,304 sharded over 8 chips =
-4.8 GB/chip for ``view_key`` + 0.4 GB for a 32k-slot ``minf_age`` plane; the
-single-chip ceiling is N≈57k (13 GB view_key) — N=65,536 needs 17.2 GB for
-the view matrix alone and cannot fit one 16 GB chip at 4 B/cell.
+4.8 GB/chip for ``view_key`` + pool planes (compile-proven at 13.2
+GiB/device incl. donation — ``COMPILE_PROOF_100K.json``). On ONE chip the
+4 B/cell arithmetic alone would allow N≈57k, but XLA working-set temps cap
+demonstrated single-chip runs at N=32,768 (N≥36,864 faults/OOMs — see
+``churn_single_chip_ceiling`` in ``BENCH_RESULTS_r03.json``); N=65,536
+needs 17.2 GB for the view matrix alone and can never fit.
 """
 
 from __future__ import annotations
@@ -838,6 +841,15 @@ def _suspicion_sweep(state: SparseState, params: SparseParams):
         )
         new_key = jnp.where(expired, st.view_key + 1, st.view_key)
         n_live = st.n_live - expired.sum(axis=1).astype(jnp.int32)
+        # episode reset: when NO up observer holds any SUSPECT cell after
+        # this sweep, all episodes are over — clearing the stamps re-arms
+        # the has_suspects skip gate (otherwise one transient suspicion
+        # would leave the O(N²) scan running every sweep_every forever)
+        any_suspect_left = (
+            ((new_key & 3) == RANK_SUSPECT) & st.up[:, None]
+        ).any()
+        sus_key = jnp.where(any_suspect_left, st.sus_key, NO_CANDIDATE)
+        sus_since = jnp.where(any_suspect_left, st.sus_since, NEVER)
         # announce each expiring SUBJECT once: the first (lowest) expiring
         # row is the elected announcer (deviation 3) — without the election,
         # every observer proposes the same DEAD fact and floods the
@@ -848,7 +860,10 @@ def _suspicion_sweep(state: SparseState, params: SparseParams):
         col = jnp.argmax(mine, axis=1).astype(jnp.int32)
         key = new_key[rows, col]
         return (
-            st.replace(view_key=new_key, n_live=n_live),
+            st.replace(
+                view_key=new_key, n_live=n_live, sus_key=sus_key,
+                sus_since=sus_since,
+            ),
             (col, key, rows, any_exp),
         )
 

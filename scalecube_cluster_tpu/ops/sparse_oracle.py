@@ -221,6 +221,13 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     exp_props[0][i] = j
                     exp_props[1][i] = int(o.view_key[i, j])
                     exp_props[3][i] = True
+        # episode reset when no up observer holds any SUSPECT cell
+        any_suspect_left = bool(
+            (((o.view_key & 3) == RANK_SUSPECT) & o.up[:, None]).any()
+        )
+        if not any_suspect_left:
+            o.sus_key[:] = NO_CAND
+            o.sus_since[:] = NEVER
     proposals.append(exp_props)
 
     # ---- gossip phase ----
