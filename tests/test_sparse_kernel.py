@@ -262,3 +262,43 @@ def test_segmentation_metric_dense():
     step = jax.jit(partial(K.tick, params=params))
     _st, ms = step(st, jax.random.PRNGKey(0))
     assert int(ms["gossip_segmentation"]) >= 1
+
+
+def test_cross_engine_convergence_rounds_match():
+    """Dense and sparse engines disseminate at statistically matching rates:
+    rumor-convergence rounds at N=256 over several seeds agree within 2
+    rounds of each other's mean (both already sit far inside the analytic
+    window — this pins the ENGINES to each other, not just to the math)."""
+    import scalecube_cluster_tpu.ops.kernel as K
+    import scalecube_cluster_tpu.ops.state as S
+
+    n, seeds = 256, (0, 1, 2, 3, 4)
+    budget = gossip_periods_to_sweep(3, n)
+
+    def dense_rounds(seed):
+        params = S.SimParams(capacity=n, rumor_slots=2, seed_rows=(0,))
+        st = S.init_state(params, n, warm=True)
+        st = S.spread_rumor(st, 0, origin=seed * 37 % n)
+        step = jax.jit(partial(K.run_ticks, n_ticks=budget, params=params))
+        _st, _k, ms, _w = step(st, jax.random.PRNGKey(seed))
+        cov = np.asarray(ms["rumor_coverage"])[:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        assert hit.size
+        return int(hit[0]) + 1
+
+    def sparse_rounds(seed):
+        params = SP.SparseParams(capacity=n, rumor_slots=2, mr_slots=32,
+                                 seed_rows=(0,))
+        st = SP.init_sparse_state(params, n, warm=True)
+        st = SP.spread_rumor(st, 0, origin=seed * 37 % n)
+        step = jax.jit(partial(SP.run_sparse_ticks, n_ticks=budget, params=params))
+        _st, _k, ms, _w = step(st, jax.random.PRNGKey(seed))
+        cov = np.asarray(ms["rumor_coverage"])[:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        assert hit.size
+        return int(hit[0]) + 1
+
+    d = [dense_rounds(s) for s in seeds]
+    sp = [sparse_rounds(s) for s in seeds]
+    assert abs(np.mean(d) - np.mean(sp)) <= 2.0, (d, sp)
+    assert max(max(d), max(sp)) <= budget
