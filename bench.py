@@ -176,7 +176,12 @@ def _measure_sparse_ticks_per_s(n: int) -> float:
     )
     budget = gossip_periods_to_sweep(params.repeat_mult, n)
     state = SP.init_sparse_state(params, n, warm=True)
-    step = jax.jit(partial(SP.run_sparse_ticks, n_ticks=budget, params=params))
+    # donate: an un-donated window holds TWO copies of the view matrix
+    # (19.4 GB at 49k) — past the 16 GB chip on its own
+    step = jax.jit(
+        partial(SP.run_sparse_ticks, n_ticks=budget, params=params),
+        donate_argnums=0,
+    )
     key = jax.random.PRNGKey(1)
     state = SP.spread_rumor(state, 0, origin=0)
     state, key, _ms, _w = step(state, key)

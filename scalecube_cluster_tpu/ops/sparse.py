@@ -320,8 +320,13 @@ def init_sparse_state(
         known = up[:, None] & up[None, :]
         if related is not None:
             known = known & related
+            n_live = known.sum(axis=1).astype(jnp.int32)
+        else:
+            # no [N, N] reduce on the common path — at 49k the eager
+            # intermediate alone is sized like the whole view matrix
+            n_live = jnp.where(up, n_initial, 0).astype(jnp.int32)
         view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
-        n_live = known.sum(axis=1).astype(jnp.int32)
+        del known  # the [N, N] bool staging plane must not outlive this line
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
         view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
