@@ -317,16 +317,21 @@ def init_sparse_state(
         ns_rel = jnp.ones((1, 1), bool)
         related = None
     if warm:
-        known = up[:, None] & up[None, :]
         if related is not None:
-            known = known & related
+            known = up[:, None] & up[None, :] & related
             n_live = known.sum(axis=1).astype(jnp.int32)
+            view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
+            del known
         else:
-            # no [N, N] reduce on the common path — at 49k the eager
-            # intermediate alone is sized like the whole view matrix
+            # fused under jit so the [N, N] bool staging plane never
+            # materializes (eagerly it is 2.4 GB at 49k and pushes the
+            # one-op working set past the chip's compute residency)
+            view_key = jax.jit(
+                lambda u: jnp.where(
+                    u[:, None] & u[None, :], ALIVE0_KEY, UNKNOWN_KEY
+                ).astype(jnp.int32)
+            )(up)
             n_live = jnp.where(up, n_initial, 0).astype(jnp.int32)
-        view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
-        del known  # the [N, N] bool staging plane must not outlive this line
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
         view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
