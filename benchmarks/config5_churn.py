@@ -37,10 +37,12 @@ def sparse_main(args) -> None:
 
     Churn is driver-controlled and never depends on protocol state, so the
     whole schedule (which rows crash/join each second) is precomputed
-    host-side and the ENTIRE run executes as one on-device lax.scan — one
-    dispatch total. The tunneled-TPU alternative (one dispatch per second)
-    measured ~6 host round trips × ~120 ms fixed cost per sim-second, which
-    swamps the actual device time at every N below ~100k."""
+    host-side and the run executes as a handful of multi-second on-device
+    lax.scan windows (--window-seconds each, ~4 dispatches at defaults).
+    Per-second dispatch measured ~6 host round trips × ~120 ms fixed cost
+    per sim-second, which swamps the device time at every N below ~100k;
+    one single whole-run dispatch is the other failure mode — the tunnel
+    kills RPCs past ~60-90 s of device time (a 49k 60-sim-second run)."""
     import time
 
     import jax
@@ -93,16 +95,26 @@ def sparse_main(args) -> None:
         # 2.4 GB at 49k and OOMs the single chip): row-reduce the fused
         # predicate, subtract the diagonal's self-ALIVE contribution
         n_up = st.up.sum()
-        alive_rows = jnp.where(
-            st.up[:, None] & st.up[None, :] & ((st.view_key & 3) == RANK_ALIVE),
-            1,
-            0,
-        ).sum()
+        # row-reduce to i32 [N] first, then accumulate in f32: the raw pair
+        # count passes 2^31 at N=46,342 and an i32 grand total overflows
+        # (f32 keeps the fraction exact to ~4e-8 at 49k)
+        alive_rows = (
+            jnp.where(
+                st.up[:, None] & st.up[None, :] & ((st.view_key & 3) == RANK_ALIVE),
+                1,
+                0,
+            )
+            .sum(axis=1)
+            .astype(jnp.float32)
+            .sum()
+        )
         diag = jnp.diagonal(st.view_key)
-        self_alive = (st.up & ((diag & 3) == RANK_ALIVE)).sum()
-        pairs = jnp.maximum(n_up * (n_up - 1), 1)
+        self_alive = (st.up & ((diag & 3) == RANK_ALIVE)).sum().astype(jnp.float32)
+        pairs = jnp.maximum(
+            n_up.astype(jnp.float32) * (n_up - 1).astype(jnp.float32), 1.0
+        )
         out = (
-            (alive_rows - self_alive).astype(jnp.float32) / pairs,
+            (alive_rows - self_alive) / pairs,
             ms["announce_dropped"].sum(),
             ms["mr_active_count"].max(),
         )
@@ -110,7 +122,9 @@ def sparse_main(args) -> None:
 
     def whole_run(st, key, cs, js):
         (st, key), outs = jax.lax.scan(second_body, (st, key), (cs, js))
-        return st, outs
+        # the evolved key comes back out so windowed dispatches continue the
+        # same key chain instead of replaying the first window's draws
+        return st, key, outs
 
     mesh = None
     if args.mesh:
@@ -130,21 +144,43 @@ def sparse_main(args) -> None:
         return st
 
     # the state is donated (one live copy on device: at 32k+ a second copy
-    # alone would exhaust a 16 GB chip) and rebuilt between runs
+    # alone would exhaust a 16 GB chip) and rebuilt between runs. The run is
+    # dispatched in windows of --window-seconds: the tunneled TPU kills
+    # single RPCs past ~60-90 s of device time (a 49k 60-sim-second run is
+    # ~90 s on-device), and a handful of ~120 ms host round trips is
+    # negligible against that span.
+    W = max(1, min(args.window_seconds, args.seconds))
+    while args.seconds % W:  # largest divisor of the run length <= requested
+        W -= 1
+    n_windows = args.seconds // W
+    if W < max(2, args.window_seconds // 2) and args.seconds > 4:
+        log(
+            f"WARNING: --seconds {args.seconds} has no divisor near "
+            f"--window-seconds {args.window_seconds}; using W={W} "
+            f"({n_windows} dispatches — ~120 ms host cost each lands in the "
+            f"timed span; pick a rounder --seconds for clean numbers)"
+        )
     run = jax.jit(whole_run, donate_argnums=(0,))
-    cs = jnp.asarray(crash_sched)
-    js = jnp.asarray(join_sched)
+    cs = jnp.asarray(crash_sched).reshape(n_windows, W, churn_per_s)
+    js = jnp.asarray(join_sched).reshape(n_windows, W, churn_per_s)
     key = jax.random.PRNGKey(0)
-    log("compiling + warm run...")
-    _st, _outs = run(fresh_state(), key, cs, js)
+    log(f"compiling + warm run ({n_windows} windows x {W} sim-seconds)...")
+    _st, _key, _outs = run(fresh_state(), key, cs[0], js[0])
     jax.block_until_ready(_st)
     del _st, _outs
     state = fresh_state()
     jax.block_until_ready(state)
     t0 = time.perf_counter()
-    st, (fracs, dropped_s, pool_s) = run(state, key, cs, js)
-    jax.block_until_ready(st)
+    outs = []
+    for w in range(n_windows):
+        state, key, out_w = run(state, key, cs[w], js[w])
+        outs.append(out_w)
+    jax.block_until_ready(state)
     wall = time.perf_counter() - t0
+    st = state
+    fracs, dropped_s, pool_s = (
+        jnp.concatenate([o[i] for o in outs]) for i in range(3)
+    )
     fracs = np.asarray(fracs)
     dropped = int(np.asarray(dropped_s).sum())
     pool_hwm = int(np.asarray(pool_s).max())
@@ -167,6 +203,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=16384)
     ap.add_argument("--seconds", type=int, default=60)
+    ap.add_argument("--window-seconds", type=int, default=15,
+                    help="sim-seconds per device dispatch (sparse engine)")
     ap.add_argument("--churn-pct-per-s", type=float, default=1.0)
     ap.add_argument("--mesh", action="store_true", help="shard over all devices")
     ap.add_argument("--sparse", action="store_true", help="record-queue engine")

@@ -112,6 +112,13 @@ def fetch_uniform(tick, salt: int, i, j, xp=jnp):
         b = b ^ (b >> u32(6))
         b = b + (b << u32(3))
         b = b ^ (b >> u32(11))
+        # The high-shift round must stay on the j-side: without it an
+        # adjacent-j delta of 1 only reaches ~2^13 before extraction, so the
+        # top-24-bit draws across one receiver row are nearly constant and
+        # the fetch gate passes/fails whole rows together under loss
+        # (min per-row std 0.0002 without this round, 0.273 ≈ iid with it —
+        # guarded by test_rand_stats.py).
+        b = b + (b << u32(15))
     return (b >> u32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
 
 

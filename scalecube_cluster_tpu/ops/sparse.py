@@ -72,8 +72,10 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    while their forwarding windows last — a second-order extra-loss term,
    ~fanout/N per edge). The known-infected/origin filters apply
    receiver-side, which cannot change state evolution (a filtered receiver
-   is by definition already infected); message counters tally payload-
-   bearing sends before that filter.
+   is by definition already infected); message counters tally deliveries
+   AFTER the origin/known-from filters and slot-collision drops (they count
+   rumor payloads that actually landed, a lower bound on wire sends — the
+   scalar oracle mirrors the same accounting).
 
 Memory at flagship scale (v5e, 16 GB/chip): N=98,304 sharded over 8 chips =
 4.8 GB/chip for ``view_key`` + pool planes (compile-proven at 13.2
@@ -155,6 +157,17 @@ class SparseParams:
     fd_accept_slots: int = 0
     refute_slots: int = 0
     delay_slots: int = 0
+    # Column-block width of the membership-apply dense pass (VERDICT r3
+    # item 1). The apply walks the view matrix in contiguous column blocks
+    # (dynamic_slice → elementwise merge → dynamic_update_slice), which XLA
+    # aliases fully in place — point/column scatters would instead force a
+    # whole-matrix layout copy per tick (the true cause of the r3
+    # single-chip ceiling; see _mr_apply). 0 = auto: whole width up to
+    # N=8192 (tests/small N pay zero loop overhead), else the largest
+    # power-of-two divisor of N ≤ 2048; must divide capacity when set
+    # explicitly. Blocking is BIT-EXACT (disjoint column ranges, identical
+    # per-cell expressions — lockstep-verified in test_sparse_chunked.py).
+    apply_block: int = 0
     fd_direct_timeout_ticks: int = 2
     fd_leg_timeout_ticks: int = 1
     sync_timeout_ticks: int = 15
@@ -416,7 +429,12 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
     do = replace | ok_fresh
     slot = jnp.where(replace, mslot, jnp.minimum(slot_fresh, M - 1))
     slot = jnp.where(do, slot, M)  # non-allocating entries dropped OOB
-    clear_slot = jnp.where(replace, slot, M)
+    # Distinct OOB sentinels (M + e): the unique_indices=True scatters below
+    # promise ALL indices distinct, and a repeated sentinel — even one that
+    # mode="drop" discards — makes that promise false (JAX documents the
+    # result as undefined). In-bounds entries are unique by the pool
+    # invariant; M + arange keeps the sentinels unique too.
+    clear_slot = jnp.where(replace, slot, M + jnp.arange(E, dtype=jnp.int32))
     age = state.minf_age.at[:, clear_slot].set(
         jnp.uint8(0), mode="drop", unique_indices=True
     )
@@ -720,6 +738,34 @@ def _sample_rejection(
     return jnp.maximum(idx, 0), idx >= 0
 
 
+def _chunk(total: int, requested: int, threshold: int, auto_block: int, word: int = 1) -> int:
+    """Resolve a working-set block size (see SparseParams.apply_block).
+
+    ``requested`` (non-zero) is validated and used as-is; auto (0) keeps the
+    whole plane when ``total <= threshold`` (test/small-N sizes pay zero
+    loop overhead) and otherwise picks the largest power-of-two divisor of
+    ``total`` that is ≤ ``auto_block`` and a multiple of ``word``. Falls
+    back to unchunked when no such divisor exists (odd sizes)."""
+    if requested:
+        if requested < 0 or total % requested or requested % word:
+            raise ValueError(
+                f"block {requested} must be positive, divide {total}, and be "
+                f"a multiple of {word}"
+            )
+        return requested
+    if total <= threshold:
+        return total
+    b = auto_block
+    # floor at auto_block/16: a degenerate tiny divisor (e.g. 2 for
+    # total=16386) would trade the temp win for thousands of sequential
+    # loop steps — past the floor, unchunked is the better program
+    while b >= max(2 * word, auto_block // 16):
+        if total % b == 0:
+            return b
+        b //= 2
+    return total
+
+
 def _pack_bits(x: jax.Array) -> jax.Array:
     """bool [R, L] -> u32 [R, ceil(L/32)] bitmap words (delivery payloads
     travel packed: 32x less gathered/OR'd data than bool planes)."""
@@ -796,11 +842,17 @@ def _fd_phase(state: SparseState, r, params: SparseParams):
     eff = accept & (jnp.cumsum(accept.astype(jnp.int32)) - 1 < V)
 
     def _write(st: SparseState) -> SparseState:
-        (vi,) = jnp.nonzero(eff, size=V, fill_value=n)
-        vi_c = jnp.minimum(vi, n - 1)
-        wrow = jnp.where(vi < n, vi_c, n)  # OOB -> drop
+        # one-hot elementwise write (j == tgt[i]), NOT a point scatter: any
+        # scatter into the [N, N] table forces a whole-matrix layout copy on
+        # TPU, while this fuses into one aliased in-place pass. The V-slot
+        # throttle (eff) is kept purely for protocol semantics (bounded
+        # verdict writes per round, mirrored by the oracle).
         return st.replace(
-            view_key=st.view_key.at[wrow, tgt[vi_c]].set(cand[vi_c], mode="drop")
+            view_key=jnp.where(
+                eff[:, None] & (rows[None, :] == tgt[:, None]),
+                cand[:, None],
+                st.view_key,
+            )
         )
 
     st = jax.lax.cond(eff.any(), _write, lambda s: s, state)
@@ -976,8 +1028,9 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         # while their forwarding window lasts — statistically a second-order
         # extra-loss term, ~fanout/N per edge); (b) the known-infected /
         # origin filters apply receiver-side (a filtered receiver is already
-        # infected, so state evolution is unchanged; message counters tally
-        # payload-bearing sends before that filter).
+        # infected, so state evolution is unchanged; rumor_sent tallies
+        # deliveries AFTER those filters and slot-collision drops — a lower
+        # bound on wire sends, see deviation 6 in the module docstring).
         sender_has = young_u.any(axis=1) | (ym_p != 0).any(axis=1)
         # ALL fanout slots batched into [F, N] tensors — TPU executes
         # kernels serially, so three sequential per-slot accumulate chains
@@ -1072,73 +1125,123 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
         )
 
         # membership-rumor infection + one-shot record application — all
-        # [N, M] work gated on the pool being non-empty (mr_any)
+        # [N, M] work gated on the pool being non-empty (mr_any).
+        #
+        # SCATTER-FREE at scale (round 4): on this TPU backend ANY point or
+        # column scatter into the donated [N, N] view matrix forces XLA to
+        # copy the whole matrix into a column-major layout (9 GB/tick at
+        # N=49k — the true cause of the r3 single-chip ceiling). The apply
+        # therefore goes dense-but-elementwise: the slot-space `newly` plane
+        # is scattered ROW-wise into a TRANSPOSED [subject, observer] bool
+        # bitmap (row scatters don't relayout), and the view update runs in
+        # contiguous column blocks of dynamic_slice → elementwise merge →
+        # dynamic_update_slice, which XLA aliases fully in place. Blocks are
+        # bit-exact with the old slot-space formulation: the accept gate,
+        # fetch draws, delta, and episode registration are the identical
+        # per-cell expressions, just evaluated at (observer, subject)
+        # instead of (observer, slot).
         def _mr_apply(state: SparseState):
             recv_m = _unpack_bits(recv_m_p, m) & (
                 state.mr_origin[None, :] != rows[:, None]
             )
-            newly_m = (
+            newly = (
                 recv_m
                 & (state.minf_age == 0)
                 & state.up[:, None]
                 & state.mr_active[None, :]
             )
-            state = state.replace(
-                minf_age=jnp.where(newly_m, jnp.uint8(1), state.minf_age)
-            )
-            # Pool subjects are UNIQUE among active slots (allocation
-            # supersedes-in-place, see _alloc_phase), so the winner at a
-            # cell IS the slot's own accepted candidate — no group-max, no
-            # second gather, and the column scatter carries unique indices.
-            subj = jnp.maximum(state.mr_subject, 0)  # clamped for the gather
-            own = jnp.take(state.view_key, subj, axis=1)  # [N, M]
-            cand = jnp.where(newly_m, state.mr_key[None, :], NO_CANDIDATE)
-            p_fetch = (
-                state.fetch_rt
-                if state.fetch_rt.ndim == 0
-                else jnp.take(state.fetch_rt, subj, axis=1)
-            )
-            accept = (
-                (cand > own)
-                & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
-                & _fetch_gate(
-                    state, SALT_GOSSIP, rows[:, None], subj[None, :], cand, p_fetch
-                )
-            )
-            if params.namespace_gate:
-                accept = accept & state.ns_rel[
-                    state.ns_id[:, None], state.ns_id[subj][None, :]
-                ]
-            vals = jnp.where(accept, cand, NO_CANDIDATE)
-            subj_scatter = jnp.where(state.mr_active, state.mr_subject, n)
-            new_view = state.view_key.at[:, subj_scatter].max(
-                vals, mode="drop", unique_indices=True
-            )
-            new_own = jnp.where(accept, cand, own)
-            delta = (
-                ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
-                - ((own & 3) != RANK_DEAD).astype(jnp.int32)
-            )
-            n_live = state.n_live + delta.sum(axis=1)
-            # episode registration for accepted SUSPECT records
-            sus_col = jnp.where(
-                accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE
-            ).max(axis=0)
-            sus_cand = (
+            minf = jnp.where(newly, jnp.uint8(1), state.minf_age)
+            # subject-dense staging: pool invariant (unique subjects among
+            # active slots) makes the row scatter collision-free; inactive
+            # slots go out of bounds and drop
+            subj_rows = jnp.where(state.mr_active, state.mr_subject, n)
+            nd_T = (
+                jnp.zeros((n, n), bool)
+                .at[subj_rows]
+                .max(newly.T, mode="drop")
+            )  # [subject, observer]
+            cand_j = (
                 jnp.full((n,), NO_CANDIDATE, jnp.int32)
-                .at[subj_scatter]
-                .max(sus_col, mode="drop", unique_indices=True)
+                .at[subj_rows]
+                .max(jnp.where(state.mr_active, state.mr_key, NO_CANDIDATE), mode="drop")
             )
+
+            NB = _chunk(n, params.apply_block, 8192, 2048)
+            nb = n // NB
+
+            def _block(b, carry):
+                vk, ndT, cj, dacc, sus, cnt = carry
+                c0 = b * NB
+                cols = c0 + jnp.arange(NB, dtype=jnp.int32)
+                nd = jax.lax.dynamic_slice(ndT, (c0, 0), (NB, n)).T  # [N, NB]
+                cand = jax.lax.dynamic_slice(cj, (c0,), (NB,))[None, :]
+                own = jax.lax.dynamic_slice(vk, (0, c0), (n, NB))
+                up_cols = jax.lax.dynamic_slice(state.up, (c0,), (NB,))
+                needs = (cand & 3) == RANK_ALIVE
+                u = fetch_uniform(state.tick, SALT_GOSSIP, rows[:, None], cols[None, :])
+                p_fetch = (
+                    state.fetch_rt
+                    if state.fetch_rt.ndim == 0
+                    else jax.lax.dynamic_slice(state.fetch_rt, (0, c0), (n, NB))
+                )
+                fetch_ok = ~needs | (up_cols[None, :] & (u < p_fetch))
+                accept = (
+                    nd
+                    & (cand > own)
+                    & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+                    & fetch_ok
+                )
+                if params.namespace_gate:
+                    ns_cols = jax.lax.dynamic_slice(state.ns_id, (c0,), (NB,))
+                    accept = accept & state.ns_rel[
+                        state.ns_id[:, None], ns_cols[None, :]
+                    ]
+                new_own = jnp.where(accept, cand, own)
+                vk = jax.lax.dynamic_update_slice(vk, new_own, (0, c0))
+                dacc = dacc + (
+                    ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
+                    - ((own & 3) != RANK_DEAD).astype(jnp.int32)
+                ).sum(axis=1)
+                cnt = cnt + accept.sum()
+                # episode registration for accepted SUSPECT records
+                sus = jax.lax.dynamic_update_slice(
+                    sus,
+                    jnp.where(
+                        accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE
+                    ).max(axis=0),
+                    (c0,),
+                )
+                return vk, ndT, cj, dacc, sus, cnt
+
+            # nd_T and cand_j ride the carry DELIBERATELY (not closed over):
+            # this is part of the measured layout recipe — the loop variant
+            # that achieved zero view-matrix copies threaded them, and
+            # loop-invariant operands reaching the body other ways re-poison
+            # layout assignment (see the r4 design notes above).
+            carry0 = (
+                state.view_key,
+                nd_T,
+                cand_j,
+                jnp.zeros((n,), jnp.int32),
+                jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                jnp.int32(0),
+            )
+            if nb == 1:
+                carry = _block(0, carry0)
+            else:
+                carry = jax.lax.fori_loop(0, nb, _block, carry0)
+            vk, _ndT, _cj, delta, sus_cand, _acc_cnt = carry
             new_sus = jnp.maximum(state.sus_key, sus_cand)
             state = state.replace(
-                view_key=new_view,
-                n_live=n_live,
+                view_key=vk,
+                minf_age=minf,
+                n_live=state.n_live + delta,
                 sus_key=new_sus,
                 sus_since=jnp.where(
                     new_sus > state.sus_key, state.tick, state.sus_since
                 ),
             )
-            return state, newly_m.sum()
+            return state, newly.sum()
 
         state, n_mr_deliveries = jax.lax.cond(
             mr_any, _mr_apply, lambda st: (st, jnp.int32(0)), state
@@ -1216,18 +1319,28 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
         )
     ok = valid_c & valid_pick & state.up[peer] & (r.sync_edge[caller] < p_rt)
 
+    # NO-REGATHER staging (round 4): the tick must never row-gather from a
+    # big buffer it just scattered into — XLA's mini-gather lowering stages
+    # the whole [N, N] operand in halves (a full-matrix copy per tick; with
+    # the old apply scatter's layout copy, the true cause of the r3
+    # single-chip ceiling and of SYNC's 40 ms/tick at 36k). Both gathers
+    # here read the PRISTINE pre-sync carry; the ACK phase never re-gathers:
+    # a peer row after the request merge IS new_p (duplicate slots write
+    # identical rows), and a caller row after the request merge is
+    # max(caller_table, new_p of the dup-group whose peer equals the caller)
+    # — reconstructed from a [K, K] match instead of a gather.
+    #
+    # Merge slots sharing a peer COMPACTLY ([K, K] + [K, N] scratch):
+    # dup_to_first[k] = first slot with slot k's peer; invalid slots get
+    # unique sentinels so they form singleton groups.
     caller_tables = state.view_key[caller]  # [K, N]
-    # Merge slots sharing a peer COMPACTLY ([K, K] + [K, N] scratch) instead
-    # of staging through an [N, N] scatter copy — the staging copy alone was
-    # ~2.4 ms/tick at N=16k. dup_to_first[k] = first slot with slot k's peer;
-    # invalid slots get unique sentinels so they form singleton groups.
-    cand_k = jnp.where(ok[:, None], caller_tables, NO_CANDIDATE)  # [K, N]
+    own_p = state.view_key[peer]  # [K, N]
     peer_eff = jnp.where(ok, peer, -1 - jnp.arange(K, dtype=jnp.int32))
     dup_to_first = jnp.argmax(peer_eff[:, None] == peer_eff[None, :], axis=1)
-    merged = jnp.full((K, n), NO_CANDIDATE, jnp.int32).at[dup_to_first].max(cand_k)
-    own_p = state.view_key[peer]
-    buf_p = jnp.maximum(own_p, merged[dup_to_first])  # [K, N]
     first_p = ok & (dup_to_first == jnp.arange(K))
+    cand_k = jnp.where(ok[:, None], caller_tables, NO_CANDIDATE)  # [K, N]
+    merged = jnp.full((K, n), NO_CANDIDATE, jnp.int32).at[dup_to_first].max(cand_k)
+    buf_p = jnp.maximum(own_p, merged[dup_to_first])  # [K, N]
     acc = (
         (buf_p > own_p)
         & ((own_p >= 0) | ((buf_p & 3) <= RANK_LEAVING))
@@ -1243,7 +1356,7 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     )
     if params.namespace_gate:
         acc = acc & state.ns_rel[state.ns_id[peer][:, None], state.ns_id[None, :]]
-    new_p = jnp.where(acc, buf_p, own_p)
+    new_p = jnp.where(acc, buf_p, own_p)  # >= own_p, so row-max == overwrite
     # duplicate peer slots recompute the IDENTICAL merged row; liveness
     # deltas count each distinct peer once (first_p)
     delta_p = (
@@ -1258,9 +1371,14 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
         axis=0
     )  # [N]
 
-    # SYNC_ACK: peer's post-merge table back to the caller
-    ack_cand = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)
-    own_rows = st.view_key[caller]
+    # SYNC_ACK: peer's post-merge table back to the caller, regather-free
+    ack_cand = jnp.where(ok[:, None], new_p, NO_CANDIDATE)
+    match = (caller[:, None] == peer[None, :]) & ok[None, :]
+    has_m = match.any(axis=1)
+    contrib = jnp.where(
+        has_m[:, None], new_p[jnp.argmax(match, axis=1)], NO_CANDIDATE
+    )
+    own_rows = jnp.maximum(caller_tables, contrib)  # post-request caller rows
     accept = (
         (ack_cand > own_rows)
         & ((own_rows >= 0) | ((ack_cand & 3) <= RANK_LEAVING))
@@ -1352,13 +1470,16 @@ def _refute_phase(state: SparseState, params: SparseParams):
     new_diag = jnp.where(eff, (((diag >> 2) + 1) << 2) | announce_rank, diag)
 
     def _apply(st: SparseState):
-        (vi,) = jnp.nonzero(eff, size=V, fill_value=n)
-        vi_c = jnp.minimum(vi, n - 1)
-        wrow = jnp.where(vi < n, vi_c, n)  # OOB -> drop
-        # a DEAD diagonal was counted out of the row's own live view
+        # one-hot elementwise diagonal write — see _fd_phase._write for why
+        # this must not be a point scatter. A DEAD diagonal was counted out
+        # of the row's own live view, hence the regain.
         regain = (eff & (rank == RANK_DEAD)).astype(jnp.int32)
         return st.replace(
-            view_key=st.view_key.at[wrow, wrow].set(new_diag[vi_c], mode="drop"),
+            view_key=jnp.where(
+                eff[:, None] & (rows[None, :] == rows[:, None]),
+                new_diag[:, None],
+                st.view_key,
+            ),
             n_live=st.n_live + regain,
         )
 

@@ -1,0 +1,109 @@
+"""Column-blocked dense-apply equivalence (SparseParams.apply_block).
+
+Round 4 made the membership apply scatter-free: a transposed
+[subject, observer] delivery bitmap plus a contiguous column-block
+dynamic_slice → elementwise merge → dynamic_update_slice walk (any point or
+column scatter into the [N, N] view matrix forces a whole-matrix layout
+copy on TPU — the r3 single-chip ceiling). Blocking is designed to be
+BIT-EXACT — disjoint column ranges, identical per-cell expressions — and
+these tests pin that: forced small blocks vs the unblocked trajectory,
+through churn, rumors, SYNC, FD, suspicion expiry, and refutation, on one
+device and on the 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.ops import sparse as SP
+
+BASE = SP.SparseParams(
+    capacity=24,
+    mr_slots=64,
+    announce_slots=8,
+    rumor_slots=4,
+    sync_every=10,
+    fd_every=3,
+    sweep_every=4,
+    sync_announce=3,
+    seed_rows=(0,),
+)
+
+
+def _run(params, ticks=120, seed=0):
+    st = SP.init_sparse_state(params, 20, warm=True)
+    st = SP.spread_rumor(st, 0, 3)
+    st = SP.crash_row(st, 5)
+    st = SP.join_row(st, 21, (0,))
+    key = jax.random.PRNGKey(seed)
+    step = jax.jit(SP.run_sparse_ticks, static_argnums=(2, 3))
+    st, key, ms, _ = step(st, key, ticks, params)
+    return st, ms
+
+
+def _assert_same(a, b):
+    sa, ma = a
+    sb, mb = b
+    for f in dataclasses.fields(SP.SparseState):
+        x, y = np.asarray(getattr(sa, f.name)), np.asarray(getattr(sb, f.name))
+        np.testing.assert_array_equal(x, y, err_msg=f"state field {f.name}")
+    for k in ma:
+        np.testing.assert_array_equal(
+            np.asarray(ma[k]), np.asarray(mb[k]), err_msg=f"metric {k}"
+        )
+
+
+@pytest.mark.parametrize("apply_block", [4, 8, 12])
+def test_blocked_matches_unblocked(apply_block):
+    ref = _run(BASE)
+    blocked = _run(dataclasses.replace(BASE, apply_block=apply_block))
+    _assert_same(ref, blocked)
+
+
+def test_blocked_matches_under_namespace_gate():
+    base = dataclasses.replace(BASE, namespace_gate=True)
+
+    def run(params):
+        st = SP.init_sparse_state(
+            params, 20, warm=True,
+            namespaces=["a/x"] * 12 + ["a/y"] * 12,
+        )
+        st = SP.crash_row(st, 5)
+        st = SP.join_row(st, 21, (0,))
+        key = jax.random.PRNGKey(3)
+        step = jax.jit(SP.run_sparse_ticks, static_argnums=(2, 3))
+        st, key, ms, _ = step(st, key, 80, params)
+        return st, ms
+
+    _assert_same(run(base), run(dataclasses.replace(base, apply_block=8)))
+
+
+def test_blocked_matches_on_mesh():
+    from scalecube_cluster_tpu.ops.sharding import make_mesh, shard_sparse_state
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh(jax.devices()[:8])
+    ref = _run(BASE, ticks=60)
+
+    params = dataclasses.replace(BASE, apply_block=8)
+    st = SP.init_sparse_state(params, 20, warm=True)
+    st = SP.spread_rumor(st, 0, 3)
+    st = SP.crash_row(st, 5)
+    st = SP.join_row(st, 21, (0,))
+    st = shard_sparse_state(st, mesh)
+    key = jax.random.PRNGKey(0)
+    step = jax.jit(SP.run_sparse_ticks, static_argnums=(2, 3))
+    st, key, ms, _ = step(st, key, 60, params)
+    _assert_same(ref, (st, ms))
+
+
+def test_block_validation():
+    with pytest.raises(ValueError):
+        _run(dataclasses.replace(BASE, apply_block=7))  # does not divide 24
+    with pytest.raises(ValueError):
+        _run(dataclasses.replace(BASE, apply_block=-8))  # negative
