@@ -18,10 +18,12 @@ MEASUREMENT METHODOLOGY (the one set of definitions every artifact uses):
 
 * ``swim_sim_speedup_vs_realtime_nX`` (THE headline, this file, also
   driver-recorded as BENCH_r{N}.json): wall-clock over ROUNDS full rumor
-  rounds of the DENSE engine, each round = one sweep-window scan
-  (budget = 2·(3·ceilLog2(N)+1) ticks) covering active dissemination AND
-  the quiescent tail — i.e. a time-average over the duty cycle a live
-  cluster actually runs.
+  rounds of the SPARSE engine — the flagship engine the scaling story
+  rests on (VERDICT r3 item 6; ``--engine dense`` selects the dense tick,
+  and the default run records BOTH engines' numbers) — each round = one
+  sweep-window scan (budget = 2·(3·ceilLog2(N)+1) ticks) covering active
+  dissemination AND the quiescent tail — i.e. a time-average over the duty
+  cycle a live cluster actually runs.
 * ``scaling_active_ticks_per_s`` (``--scaling``): ticks/s of ONE round's
   scan window per engine/size — same protocol work, no cross-round
   amortization. Higher than the headline's implied rate at small N (the
@@ -70,7 +72,8 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def _headline_rounds_dense():
+    """Dense-engine duty-cycle measurement (the r2/r3 headline)."""
     params = SimParams(
         capacity=N,
         fanout=3,
@@ -87,10 +90,6 @@ def main() -> None:
     state = init_state(params, N, warm=True)
     step = jax.jit(partial(run_ticks, n_ticks=budget, params=params))
     key = jax.random.PRNGKey(0)
-
-    # Force synchronous dispatch BEFORE timing (see module docstring), then
-    # compile + warm one full round outside the timed span.
-    _ = float(jnp.zeros((), jnp.float32))
     state = S.spread_rumor(state, 0, origin=0)
     state, key, ms, _w = step(state, key)
     warm_cov = np.asarray(ms["rumor_coverage"])[:, 0]
@@ -105,9 +104,65 @@ def main() -> None:
         hit = np.nonzero(cov >= 1.0)[0]
         convergence_ticks.append(int(hit[0]) + 1 if hit.size else None)
     dt = time.perf_counter() - t0
+    log(
+        f"dense: {ROUNDS} rounds x {budget} ticks, convergence at "
+        f"{convergence_ticks} (warm: {int(np.argmax(warm_cov >= 1.0)) + 1})"
+    )
+    return convergence_ticks, ROUNDS * budget / dt
 
-    if any(c is None for c in convergence_ticks):
-        log(f"convergence failures: {convergence_ticks} (budget {budget})")
+
+def _headline_rounds_sparse():
+    """Sparse-engine duty-cycle measurement — same rounds/budget contract."""
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    params = SP.SparseParams(
+        capacity=N, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8,
+        mr_slots=max(256, N // 16), seed_rows=(0,),
+    )
+    budget = gossip_periods_to_sweep(params.repeat_mult, N)
+    state = SP.init_sparse_state(params, N, warm=True)
+    step = jax.jit(
+        partial(SP.run_sparse_ticks, n_ticks=budget, params=params),
+        donate_argnums=0,
+    )
+    key = jax.random.PRNGKey(0)
+    state = SP.spread_rumor(state, 0, origin=0)
+    state, key, ms, _w = step(state, key)
+    warm_cov = np.asarray(ms["rumor_coverage"])[:, 0]
+    jax.block_until_ready(state)
+
+    convergence_ticks = []
+    t0 = time.perf_counter()
+    for r in range(ROUNDS):
+        state = SP.spread_rumor(state, 0, origin=(r * 97) % N)
+        state, key, ms, _w = step(state, key)
+        cov = np.asarray(ms["rumor_coverage"])[:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        convergence_ticks.append(int(hit[0]) + 1 if hit.size else None)
+    dt = time.perf_counter() - t0
+    log(
+        f"sparse: {ROUNDS} rounds x {budget} ticks, convergence at "
+        f"{convergence_ticks} (warm: {int(np.argmax(warm_cov >= 1.0)) + 1})"
+    )
+    return convergence_ticks, ROUNDS * budget / dt
+
+
+def main() -> None:
+    engine = "dense" if "--engine" in sys.argv and "dense" in sys.argv else "sparse"
+    budget = gossip_periods_to_sweep(3, N)
+
+    # Force synchronous dispatch BEFORE timing (see module docstring).
+    _ = float(jnp.zeros((), jnp.float32))
+    if engine == "sparse":
+        conv, ticks_per_s = _headline_rounds_sparse()
+        conv_d, ticks_per_s_dense = _headline_rounds_dense()
+    else:
+        conv, ticks_per_s = _headline_rounds_dense()
+        conv_d, ticks_per_s_dense = conv, ticks_per_s
+
+    if any(c is None for c in conv):
+        log(f"convergence failures: {conv} (budget {budget})")
         print(
             json.dumps(
                 {
@@ -121,19 +176,15 @@ def main() -> None:
         )
         return
 
-    total_ticks = ROUNDS * budget
-    ticks_per_s = total_ticks / dt
     speedup = ticks_per_s * TICK_SECONDS
-    log(
-        f"{ROUNDS} rumor rounds x {budget} ticks, convergence at "
-        f"{convergence_ticks} (warm round: {int(np.argmax(warm_cov >= 1.0)) + 1})"
-    )
-    log(f"{ticks_per_s:.1f} ticks/s at N={N} -> {speedup:.1f}x real time")
+    log(f"{ticks_per_s:.1f} ticks/s at N={N} ({engine}) -> {speedup:.1f}x real time")
     result = {
         "metric": f"swim_sim_speedup_vs_realtime_n{N}",
+        "engine": engine,
         "value": round(speedup, 2),
         "unit": "x",
         "vs_baseline": round(speedup, 2),
+        "dense_speedup_vs_realtime": round(ticks_per_s_dense * TICK_SECONDS, 2),
     }
     # --scaling: also measure the dense 8k/16k and sparse 4k-49k active
     # ticks/s curves (extra multi-GiB states + compiles, several minutes —

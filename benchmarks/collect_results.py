@@ -69,14 +69,18 @@ def main() -> None:
     results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "16384"])
     if not args.quick:
         results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "32768"])
-        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152"],
-                       timeout=3000)
+        # pool sized for dissemination health at 49k churn (~churn/s x 25;
+        # the default N/8 saturates and join coverage collapses — see the
+        # README staleness analysis)
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152",
+                        "--mr-slots", "12288"], timeout=3000)
     results += run([py, "benchmarks/config2b_scalar_vs_kernel_gossip.py"])
     if not args.quick:
         results += run([py, "benchmarks/config3b_scalar_vs_kernel_fd.py"],
                        timeout=3000)
     results += run([py, "benchmarks/config4b_scalar_vs_kernel_detection.py"])
     results += run([py, "benchmarks/compile_proof_100k.py"])
+    results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)
 
     artifact = {

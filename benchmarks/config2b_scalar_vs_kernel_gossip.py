@@ -38,11 +38,14 @@ from scalecube_cluster_tpu.utils.streams import EventStream
 
 from common import TickLoop, emit, log, make_emulated_mesh
 
-# N=50 is the reference experiment matrix's largest point
-# (GossipProtocolTest.java:47-63: N in {2..50}, loss in {0,10,25,50}%);
-# the loss points below are the matrix's N=50 rows plus the 25% stressor.
-N = 50
-INTERVAL = 0.05
+# The reference experiment matrix tops out at N=50
+# (GossipProtocolTest.java:47-63: N in {2..50}, loss in {0,10,25,50}%); the
+# round-4 run drives the scalar engine PAST it to N=128 (VERDICT r3 item 5:
+# cross-engine legs above the reference's own ceiling), with the gossip
+# clock slowed to 0.1 s so one event loop keeps timer fidelity at 128
+# protocol instances. Loss points = the matrix's rows plus the 25% stressor.
+N = 128
+INTERVAL = 0.1
 TRIALS = 5
 CONFIG = GossipConfig(gossip_interval=INTERVAL, gossip_fanout=3, gossip_repeat_mult=3)
 

@@ -85,9 +85,34 @@ def sparse_main(args) -> None:
 
     seeds = jnp.asarray(params.seed_rows, jnp.int32)
 
+    # staleness lag cohorts (VERDICT r3 item 3): for the cohort of members
+    # joined L sim-seconds ago, what fraction of up observers already hold
+    # the joiner's CURRENT identity key? The host knows the join schedule,
+    # so shifted cohort schedules ride the scan as extra inputs; rows are -1
+    # before second L. Worst-cohort coverage vs L brackets the announce-drop
+    # dissemination lag directly against the suspicion timeout.
+    LAGS = (1, 2, 6, 12)
+    lag_scheds = []
+    for lag in LAGS:
+        sched = np.full((args.seconds, churn_per_s), -1, np.int32)
+        if lag < args.seconds:
+            sched[lag:] = join_sched[:-lag] if lag else join_sched
+            # a cohort row crashed (and possibly rejoined with a NEWER
+            # identity) after its join would read falsely stale — the cohort
+            # tracks only members continuously up since joining
+            for sec in range(lag, args.seconds):
+                churned_since = set()
+                for s2 in range(sec - lag + 1, sec + 1):
+                    churned_since.update(int(r) for r in crash_sched[s2])
+                row = sched[sec]
+                mask = np.asarray([int(r) in churned_since for r in row])
+                row[mask] = -1
+        lag_scheds.append(sched)
+
     def second_body(carry, x):
         st, key = carry
-        crash, join = x
+        crash, join = x[0], x[1]
+        lag_cohorts = x[2:]
         st = st.replace(up=st.up.at[crash].set(False))
         st = SPS.join_rows(st, join, seeds)
         st, key, ms, _w = SPS.run_sparse_ticks(st, key, TICKS_PER_SECOND, params)
@@ -113,15 +138,52 @@ def sparse_main(args) -> None:
         pairs = jnp.maximum(
             n_up.astype(jnp.float32) * (n_up - 1).astype(jnp.float32), 1.0
         )
+        # identity staleness (r3 item 3): per SUBJECT j, how many up
+        # observers have not yet learned j's current identity/incarnation
+        # (view>>2 below j's own diag>>2 — unknown reads -1 and counts).
+        # One fused [N, N] read + axis-0 reduce; cohort numbers then come
+        # from cheap [K] point reads of the per-subject vector.
+        stale_count = (
+            jnp.where(
+                st.up[:, None]
+                & st.up[None, :]
+                & ((st.view_key >> 2) < (diag >> 2)[None, :]),
+                1,
+                0,
+            )
+            .sum(axis=0)
+            .astype(jnp.int32)
+        )  # [N] per subject
+        observers = jnp.maximum(n_up.astype(jnp.float32) - 1.0, 1.0)
+        lag_covs = []
+        for cohort in lag_cohorts:
+            c = jnp.maximum(cohort, 0)
+            ok_c = (cohort >= 0) & st.up[c]
+            cov = 1.0 - stale_count[c].astype(jnp.float32) / observers
+            cov = jnp.where(ok_c, cov, jnp.nan)
+            lag_covs.append(jnp.nanmin(cov))
+            lag_covs.append(jnp.nanmean(cov))
         out = (
             (alive_rows - self_alive) / pairs,
             ms["announce_dropped"].sum(),
             ms["mr_active_count"].max(),
+            (st.up & (stale_count > 0)).sum(),
+            stale_count.max(),
+            stale_count.sum(dtype=jnp.float32),
+            jnp.stack(lag_covs),
+            jnp.stack(
+                [
+                    ms["announce_dropped_fd"].sum(),
+                    ms["announce_dropped_expiry"].sum(),
+                    ms["announce_dropped_refute"].sum(),
+                    ms["announce_dropped_sync"].sum(),
+                ]
+            ),
         )
         return (st, key), out
 
-    def whole_run(st, key, cs, js):
-        (st, key), outs = jax.lax.scan(second_body, (st, key), (cs, js))
+    def whole_run(st, key, cs, js, lags):
+        (st, key), outs = jax.lax.scan(second_body, (st, key), (cs, js, *lags))
         # the evolved key comes back out so windowed dispatches continue the
         # same key chain instead of replaying the first window's draws
         return st, key, outs
@@ -163,9 +225,14 @@ def sparse_main(args) -> None:
     run = jax.jit(whole_run, donate_argnums=(0,))
     cs = jnp.asarray(crash_sched).reshape(n_windows, W, churn_per_s)
     js = jnp.asarray(join_sched).reshape(n_windows, W, churn_per_s)
+    lags_w = [
+        jnp.asarray(s).reshape(n_windows, W, churn_per_s) for s in lag_scheds
+    ]
     key = jax.random.PRNGKey(0)
     log(f"compiling + warm run ({n_windows} windows x {W} sim-seconds)...")
-    _st, _key, _outs = run(fresh_state(), key, cs[0], js[0])
+    _st, _key, _outs = run(
+        fresh_state(), key, cs[0], js[0], tuple(l[0] for l in lags_w)
+    )
     jax.block_until_ready(_st)
     del _st, _outs
     state = fresh_state()
@@ -173,20 +240,45 @@ def sparse_main(args) -> None:
     t0 = time.perf_counter()
     outs = []
     for w in range(n_windows):
-        state, key, out_w = run(state, key, cs[w], js[w])
+        state, key, out_w = run(
+            state, key, cs[w], js[w], tuple(l[w] for l in lags_w)
+        )
         outs.append(out_w)
     jax.block_until_ready(state)
     wall = time.perf_counter() - t0
     st = state
-    fracs, dropped_s, pool_s = (
-        jnp.concatenate([o[i] for o in outs]) for i in range(3)
-    )
+    (
+        fracs, dropped_s, pool_s, stale_subj_s, stale_max_s, stale_sum_s,
+        lagcov_s, drops_src_s,
+    ) = (jnp.concatenate([o[i] for o in outs]) for i in range(8))
     fracs = np.asarray(fracs)
     dropped = int(np.asarray(dropped_s).sum())
     pool_hwm = int(np.asarray(pool_s).max())
     for sec in range(9, args.seconds, 10):
         log(f"sim-second {sec+1}: alive_view_fraction={fracs[sec]:.4f}")
     steady = float(np.mean(fracs[len(fracs) // 2 :]))
+    # staleness analysis (r3 item 3): lag-cohort identity coverage in the
+    # steady half of the run, worst case over cohorts — brackets how long an
+    # announce-drop can leave a joiner's identity unknown, against the
+    # suspicion timeout that bounds harm
+    half = args.seconds // 2
+    lagcov = np.asarray(lagcov_s)  # [seconds, 2*len(LAGS)] (min, mean per lag)
+    staleness = {}
+    lag_to_90 = None
+    for li, lag in enumerate(LAGS):
+        mins = lagcov[half:, 2 * li]
+        means = lagcov[half:, 2 * li + 1]
+        mins = mins[~np.isnan(mins)]
+        means = means[~np.isnan(means)]
+        if mins.size:
+            staleness[f"lag{lag}s_cohort_cov_min"] = round(float(mins.min()), 4)
+            staleness[f"lag{lag}s_cohort_cov_mean"] = round(float(means.mean()), 4)
+            if lag_to_90 is None and float(mins.min()) >= 0.90:
+                lag_to_90 = lag
+    drops_src = np.asarray(drops_src_s).sum(axis=0)
+    suspicion_timeout_s = (
+        params.suspicion_mult * int(np.ceil(np.log2(n + 1))) * params.fd_every
+    ) / TICKS_PER_SECOND
     emit({
         "config": 5, "engine": "sparse", "metric": "churn_steady_state", "n": n,
         "mr_slots": m, "churn_pct_per_s": args.churn_pct_per_s,
@@ -195,6 +287,22 @@ def sparse_main(args) -> None:
         "ticks_per_s": round(args.seconds * TICKS_PER_SECOND / wall, 1),
         "steady_alive_view_fraction": round(steady, 4),
         "announce_dropped": dropped, "pool_high_water": pool_hwm,
+        "announce_dropped_by_source": {
+            "fd": int(drops_src[0]), "expiry": int(drops_src[1]),
+            "refute": int(drops_src[2]), "sync": int(drops_src[3]),
+        },
+        "staleness": {
+            **staleness,
+            "stale_subjects_high_water": int(np.asarray(stale_subj_s).max()),
+            "worst_subject_stale_observers_high_water": int(
+                np.asarray(stale_max_s).max()
+            ),
+            "steady_stale_pairs_mean": round(
+                float(np.asarray(stale_sum_s)[half:].mean()), 1
+            ),
+            "worst_cohort_lag_to_90pct_coverage_s": lag_to_90,
+            "suspicion_timeout_s": suspicion_timeout_s,
+        },
         "ok": steady > 0.98,
     })
 

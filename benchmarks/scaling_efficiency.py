@@ -5,18 +5,19 @@ cross-chip term was unmeasured. This script produces the two bounds a
 single-host environment can produce:
 
 1. **Measured mesh scaling efficiency** — sparse-engine ticks/s on an
-   8-virtual-device CPU mesh vs one CPU device at EQUAL per-device rows
-   (8×4096 = N 32,768 sharded vs 1×4096). GSPMD inserts the same collective
-   pattern (all-gathers for the payload row-pulls and SYNC row exchanges,
-   scatter-reductions into receiver rows) that an 8-chip TPU program gets,
-   so the ratio bounds the *fractional* cost of the communication+skew term
-   the projection previously asserted away. Two variants:
-
-   * ``flagship_scaling`` — pool sized like the flagship (M = N/8): includes
-     the engine's real O(N·M)-per-device growth, the honest weak-scaling
-     number;
-   * ``matched_work`` — M pinned equal for both runs, so per-device row work
-     is identical and the ratio isolates collectives + GSPMD overhead.
+   8-virtual-device CPU mesh vs one CPU device at EQUAL PER-DEVICE CELLS.
+   Since the round-4 scatter-free tick, the membership apply walks
+   [rows_local, N_global] — per-device work scales with global N, so
+   "equal rows" is NOT equal work; the work-matched comparison is
+   cells/device: 8-dev N=32,768 gives 4096×32,768 = 134M cells/device,
+   matched by 1-dev N=11,584 (11,584² = 134M). This is exactly the
+   flagship argument's shape (98,304/8 chips: 12,288×98,304 = 1.21G
+   cells/chip ≈ the 32k single-chip run's 1.07G). GSPMD inserts the same
+   collective pattern (all-gathers for payload row-pulls and SYNC row
+   exchanges, scatter-reductions into receiver rows) an 8-chip TPU program
+   gets, so the ratio bounds the fractional communication+skew term the
+   projection previously asserted away. A context row at 1-dev N=4096
+   (equal ROWS, the naive comparison) is also recorded.
 
 2. **Analytic cross-shard bytes/tick** at N=98,304 / 8 devices, enumerated
    from the sharded program's actual access pattern (receiver-pulled payload
@@ -114,35 +115,40 @@ def measured_efficiency() -> list:
     devices = jax.devices()
     assert len(devices) >= 8, f"need 8 virtual devices, have {len(devices)}"
     mesh8 = make_mesh(devices[:8])
-    n1, n8 = PER_DEVICE_ROWS, 8 * PER_DEVICE_ROWS
+    n8 = 8 * PER_DEVICE_ROWS  # 32,768 over 8 devices
+    n1_cells = 11_584  # 11,584^2 ~= 4096 x 32,768 cells/device
     out = []
 
-    # variant 1: flagship pool scaling (M = N/8)
-    t1 = _measure(n1, max(256, n1 // 8), None, "flagship 1-dev")
+    t1c = _measure(n1_cells, max(256, n1_cells // 8), None, "cells-matched 1-dev")
     t8 = _measure(n8, max(256, n8 // 8), mesh8, "flagship 8-dev")
+    t1r = _measure(PER_DEVICE_ROWS, max(256, PER_DEVICE_ROWS // 8), None,
+                   "rows-matched 1-dev (context)")
     out.append({
-        "config": "scaling_efficiency", "variant": "flagship_scaling",
-        "engine": "sparse", "per_device_rows": PER_DEVICE_ROWS,
-        "single_device": {"n": n1, "mr_slots": n1 // 8, "ticks_per_s": round(t1, 2)},
-        "mesh8": {"n": n8, "mr_slots": n8 // 8, "ticks_per_s": round(t8, 2)},
-        "weak_scaling_efficiency": round(t8 / t1, 3),
-        "note": "includes the engine's real O(N*M) per-device growth "
-                "(M scales with N) — the honest weak-scaling number",
+        "config": "scaling_efficiency", "variant": "cells_matched",
+        "engine": "sparse",
+        "single_device": {
+            "n": n1_cells, "mr_slots": n1_cells // 8,
+            "cells_per_device": n1_cells * n1_cells,
+            "ticks_per_s": round(t1c, 2),
+        },
+        "mesh8": {
+            "n": n8, "mr_slots": n8 // 8,
+            "cells_per_device": PER_DEVICE_ROWS * n8,
+            "ticks_per_s": round(t8, 2),
+        },
+        "scaling_efficiency": round(t8 / t1c, 3),
+        "note": "equal per-device view-matrix cells (the flagship argument's "
+                "shape: 98k/8 chips is 1.21G cells/chip vs 1.07G at 32k "
+                "single) — the ratio is the collectives+skew term",
     })
-
-    # variant 2: matched per-device work (equal M) -> isolates collectives
-    m_eq = 2048
-    t1m = _measure(n1, m_eq, None, "matched 1-dev")
-    t8m = _measure(n8, m_eq, mesh8, "matched 8-dev")
     out.append({
-        "config": "scaling_efficiency", "variant": "matched_work",
+        "config": "scaling_efficiency", "variant": "rows_matched_context",
         "engine": "sparse", "per_device_rows": PER_DEVICE_ROWS,
-        "single_device": {"n": n1, "mr_slots": m_eq, "ticks_per_s": round(t1m, 2)},
-        "mesh8": {"n": n8, "mr_slots": m_eq, "ticks_per_s": round(t8m, 2)},
-        "collectives_efficiency": round(t8m / t1m, 3),
-        "note": "M pinned equal, so per-device [rows, M] work matches and the "
-                "ratio isolates collective+skew overhead (SYNC's O(K*N) still "
-                "grows with global N — kept, it does on the real mesh too)",
+        "single_device": {"n": PER_DEVICE_ROWS, "ticks_per_s": round(t1r, 2)},
+        "mesh8": {"n": n8, "ticks_per_s": round(t8, 2)},
+        "naive_rows_efficiency": round(t8 / t1r, 3),
+        "note": "equal per-device ROWS — NOT equal work since the apply "
+                "walks [rows_local, N_global]; recorded for context only",
     })
     return out
 
@@ -217,9 +223,79 @@ def analytic_bytes(n: int = 98_304, d: int = 8, m: int = 16_384, r: int = 8) -> 
     }
 
 
+def collective_census(n: int = 98_304) -> dict:
+    """Count the collective ops in the COMPILED 8-device sharded sparse tick
+    — the latency side of the cross-chip budget (each ICI collective costs
+    ~5-15 µs of launch+sync on a v5e slice, independent of the byte
+    volume). The CPU-mesh 'measured efficiency' rows are dominated by
+    XLA:CPU's per-collective thread rendezvous (hundreds of µs each), so
+    the census is what actually transfers to TPU."""
+    import re
+
+    from scalecube_cluster_tpu.ops import sparse as SP
+    from scalecube_cluster_tpu.ops.sharding import (
+        make_mesh, make_sharded_sparse_tick, sparse_state_shardings,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(jax.devices()[:8])
+    params = SP.SparseParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=2, mr_slots=n // 8,
+        announce_slots=1024, seed_rows=(0, 1, 2, 3),
+    )
+    tiny = SP.init_sparse_state(
+        SP.SparseParams(capacity=16, rumor_slots=2, mr_slots=2, seed_rows=(0,)), 16
+    )
+    import dataclasses as _dc
+
+    sh = sparse_state_shardings(mesh)
+    shapes = {
+        "tick": (), "up": (n,), "epoch": (n,), "view_key": (n, n),
+        "n_live": (n,), "sus_key": (n,), "sus_since": (n,),
+        "force_sync": (n,), "leaving": (n,), "ns_id": (n,), "ns_rel": (1, 1),
+        "mr_active": (n // 8,), "mr_subject": (n // 8,), "mr_key": (n // 8,),
+        "mr_created": (n // 8,), "mr_origin": (n // 8,),
+        "minf_age": (n, n // 8), "rumor_active": (2,), "rumor_origin": (2,),
+        "rumor_created": (2,), "infected": (n, 2), "infected_at": (n, 2),
+        "infected_from": (n, 2), "loss": (), "fetch_rt": (), "delay_q": (),
+        "pending_minf": (0, n, n // 8), "pending_inf": (0, n, 2),
+        "pending_src": (0, n, 2),
+    }
+    state_abs = SP.SparseState(**{
+        f.name: jax.ShapeDtypeStruct(
+            shapes[f.name], getattr(tiny, f.name).dtype,
+            sharding=getattr(sh, f.name),
+        )
+        for f in _dc.fields(SP.SparseState)
+    })
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=NamedSharding(mesh, P()))
+    step = make_sharded_sparse_tick(mesh, params)
+    txt = step.lower(state_abs, key_abs).compile().as_text()
+    counts = {
+        kind: len(re.findall(kind, txt))
+        for kind in ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all")
+    }  # raw text occurrences — counts start/done pairs, an upper bound
+    total = sum(counts.values())
+    return {
+        "config": "scaling_efficiency", "variant": "collective_census",
+        "n": n, "devices": 8, "collectives_per_tick": counts,
+        "total_collectives": total,
+        "latency_budget_ms_at_10us_each": round(total * 10e-3, 2),
+        "note": "compiled-HLO census of the 8-way sharded sparse tick; at "
+                "~10 us per ICI collective this is the per-tick latency "
+                "floor the projection must absorb (200 ms tick budget)",
+    }
+
+
 def main() -> None:
     results = measured_efficiency()
     results.append(analytic_bytes())
+    try:
+        results.append(collective_census())
+    except Exception as e:  # census is best-effort (big compile)
+        log(f"collective census failed: {e}")
     for obj in results:
         emit(obj)
 

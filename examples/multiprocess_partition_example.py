@@ -1,0 +1,256 @@
+"""Multi-process partition repro over real TCP — the issue-187 analogue.
+
+The reference ships shell scripts that run a seed + nodes as separate OS
+processes and block one node's traffic with iptables, then watch SUSPECT →
+REMOVED and the rejoin-as-new-id flow
+(``/root/reference/examples/scripts/issues/187/README:1-8``). This script is
+that repro for the TPU-native framework's REAL transport path: three OS
+processes, each an asyncio `Cluster` over genuine TCP sockets, the
+"iptables" role played by the `NetworkEmulatorTransport` seam (block all
+inbound+outbound on the victim), asserting at the survivors:
+
+1. all three members see each other (full TCP join);
+2. after the block, survivors SUSPECT then REMOVE the victim within the
+   suspicion timeout;
+3. a fresh process joining from the victim's machine arrives as a NEW
+   member id (restart = new identity, `FailureDetectorTest.java:393-401`).
+
+Run: ``python examples/multiprocess_partition_example.py`` (exits 0 on
+success, ~20 s; also wrapped by ``tests/test_multiprocess_tcp.py``).
+
+Child protocol (stdin/stdout JSON lines): parent sends {"cmd": "block"|
+"unblock"|"members"|"exit"}; children emit {"event": ...} lines for ready,
+membership events, and command acks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+TIMINGS = dict(
+    ping_interval=0.3, ping_timeout=0.12, gossip_interval=0.1,
+    sync_interval=2.0, suspicion_mult=3,
+)
+
+
+def _config(seed=None, alias="node"):
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    cfg = (
+        ClusterConfig.default_local()
+        .with_failure_detector(
+            lambda f: f.replace(
+                ping_interval=TIMINGS["ping_interval"],
+                ping_timeout=TIMINGS["ping_timeout"],
+                ping_req_members=1,
+            )
+        )
+        .with_gossip(lambda g: g.replace(gossip_interval=TIMINGS["gossip_interval"]))
+        .with_membership(
+            lambda m: m.replace(
+                sync_interval=TIMINGS["sync_interval"],
+                suspicion_mult=TIMINGS["suspicion_mult"],
+                seed_members=(seed,) if seed else (),
+            )
+        )
+        .with_transport(lambda t: t.replace(transport_factory="tcp", port=0))
+        .replace(member_alias=alias)
+    )
+    return cfg
+
+
+async def child_main(seed: str | None, alias: str) -> None:
+    """One cluster node in its own OS process, TCP transport wrapped in the
+    emulator seam, driven by JSON commands on stdin."""
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.transport.api import TransportConfig, create_transport
+    from scalecube_cluster_tpu.transport.emulator import NetworkEmulatorTransport
+
+    emu_holder = {}
+
+    def transport_factory():
+        raw = create_transport(TransportConfig(port=0, transport_factory="tcp"))
+        wrapped = NetworkEmulatorTransport(raw)
+        emu_holder["emu"] = wrapped.network_emulator
+        return wrapped
+
+    cluster = new_cluster(_config(seed, alias)).transport_factory(transport_factory)
+    cluster = await cluster.start()
+
+    def out(obj):
+        print(json.dumps(obj), flush=True)
+
+    def on_event(ev):
+        out({
+            "event": "membership",
+            "type": ev.type.value,
+            "member": ev.member.id,
+            "alias": ev.member.alias,
+        })
+
+    cluster.listen_membership().subscribe(on_event)
+    out({"event": "ready", "address": cluster.address, "id": cluster.member().id})
+
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin
+    )
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        cmd = json.loads(line)["cmd"]
+        if cmd == "block":
+            emu = emu_holder["emu"]
+            emu.block_all_outbound()
+            emu.block_all_inbound()
+            out({"event": "ack", "cmd": "block"})
+        elif cmd == "unblock":
+            emu = emu_holder["emu"]
+            emu.unblock_all_outbound()
+            emu.unblock_all_inbound()
+            out({"event": "ack", "cmd": "unblock"})
+        elif cmd == "members":
+            out({
+                "event": "members",
+                "ids": sorted(m.id for m in cluster.members()),
+                "aliases": sorted(str(m.alias) for m in cluster.members()),
+            })
+        elif cmd == "exit":
+            out({"event": "ack", "cmd": "exit"})
+            break
+    await cluster.shutdown()
+
+
+class Node:
+    def __init__(self, seed: str | None, alias: str):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, __file__, "--child", alias] + ([seed] if seed else []),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+        )
+        self.alias = alias
+        self.events: list[dict] = []
+        self._buf = b""
+        ready = self._read_until(lambda o: o.get("event") == "ready", 30)
+        self.address = ready["address"]
+        self.id = ready["id"]
+
+    def send(self, cmd: str) -> None:
+        self.proc.stdin.write((json.dumps({"cmd": cmd}) + "\n").encode())
+        self.proc.stdin.flush()
+
+    def _read_until(self, pred, timeout: float):
+        # raw-fd line reader with a real deadline: selecting on the fd and
+        # THEN readline() would deadlock when Python's buffer already holds
+        # lines, and bare readline() would block forever on a hung child
+        import select
+
+        fd = self.proc.stdout.fileno()
+        deadline = time.time() + timeout
+        while True:
+            while b"\n" in self._buf:
+                line, self._buf = self._buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                obj = json.loads(line)
+                self.events.append(obj)
+                if pred(obj):
+                    return obj
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError(f"{self.alias}: timeout waiting for condition")
+            ready, _, _ = select.select([fd], [], [], remaining)
+            if not ready:
+                raise TimeoutError(f"{self.alias}: timeout waiting for condition")
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                raise RuntimeError(f"{self.alias}: child exited early")
+            self._buf += chunk
+
+    def wait_event(self, etype: str, member_id: str | None = None, timeout=30.0):
+        def pred(o):
+            return (
+                o.get("event") == "membership"
+                and o.get("type") == etype
+                and (member_id is None or o.get("member") == member_id)
+            )
+
+        for o in self.events:  # already seen?
+            if pred(o):
+                return o
+        return self._read_until(pred, timeout)
+
+    def members(self, timeout=10.0):
+        self.send("members")
+        return self._read_until(lambda o: o.get("event") == "members", timeout)
+
+    def stop(self):
+        try:
+            self.send("exit")
+            self.proc.wait(timeout=10)
+        except Exception:
+            self.proc.kill()
+
+
+def main() -> int:
+    print("== starting 3-process TCP cluster", flush=True)
+    nodes: list[Node] = []
+
+    def track(n: Node) -> Node:
+        nodes.append(n)
+        return n
+
+    seed = track(Node(None, "alice"))
+    bob = track(Node(seed.address, "bob"))
+    carol = track(Node(seed.address, "carol"))
+    try:
+        seed.wait_event("added", bob.id)
+        seed.wait_event("added", carol.id)
+        bob.wait_event("added", carol.id)
+        assert set(seed.members(timeout=15)["ids"]) == {seed.id, bob.id, carol.id}
+        print(f"== full join over real TCP: {seed.id}, {bob.id}, {carol.id}",
+              flush=True)
+
+        print("== blocking carol at the transport seam (issue-187 analogue)",
+              flush=True)
+        carol.send("block")
+        t0 = time.time()
+        seed.wait_event("removed", carol.id, timeout=60)
+        bob.wait_event("removed", carol.id, timeout=60)
+        print(f"== survivors removed carol after {time.time()-t0:.1f}s "
+              f"(SUSPECT -> suspicion timeout -> REMOVED)", flush=True)
+        assert carol.id not in seed.members()["ids"]
+
+        print("== rejoining from a fresh process", flush=True)
+        carol.stop()
+        carol2 = track(Node(seed.address, "carol"))
+        seed.wait_event("added", carol2.id, timeout=30)
+        assert carol2.id != carol.id, "restart must join as a NEW member id"
+        print(f"== rejoined as NEW id {carol2.id} (old {carol.id})", flush=True)
+        print("== PASS", flush=True)
+        return 0
+    finally:
+        # stop EVERY child (incl. carol/carol2 on mid-test failures) so a
+        # failing run never orphans cluster processes with open TCP ports
+        for n in nodes:
+            n.stop()
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        alias = sys.argv[i + 1]
+        seed = sys.argv[i + 2] if len(sys.argv) > i + 2 else None
+        asyncio.run(child_main(seed, alias))
+    else:
+        sys.exit(main())

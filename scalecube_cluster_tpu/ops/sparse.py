@@ -402,7 +402,9 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
     the stronger fact instead is strictly faster); lower/equal keys are
     already covered and are skipped. Fresh subjects take ascending free
     slots. Batch duplicates: max key wins, ties to the earliest entry.
-    Returns (state, allocated_count, dropped_count).
+    Returns (state, allocated_count, no_slot_mask) — the mask marks
+    fresh winners that found no free slot, per proposal entry (the
+    caller attributes pool-full drops to their proposal source).
     """
     E = subj_p.shape[0]
     M = state.mr_active.shape[0]
@@ -453,7 +455,7 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
                 False, mode="drop", unique_indices=True
             )
         )
-    return st, do.sum(), (fresh & ~ok_fresh).sum()
+    return st, do.sum(), fresh & ~ok_fresh
 
 
 def announce(state: SparseState, subject, key, origin) -> SparseState:
@@ -1151,20 +1153,25 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 & state.mr_active[None, :]
             )
             minf = jnp.where(newly, jnp.uint8(1), state.minf_age)
-            # subject-dense staging: pool invariant (unique subjects among
-            # active slots) makes the row scatter collision-free; inactive
-            # slots go out of bounds and drop
+            # subject-dense staging, PACKED along observers: row scatters on
+            # this backend are bytes-bound (~measured 9x cheaper for packed
+            # u32 rows than full bool rows), so the [subject, observer]
+            # bitmap is built as [N, ceil(N/32)] u32 words; pool invariant
+            # (unique subjects among active slots) makes the scatter
+            # collision-free; inactive slots go out of bounds and drop
             subj_rows = jnp.where(state.mr_active, state.mr_subject, n)
-            nd_T = (
-                jnp.zeros((n, n), bool)
+            Wo = (n + 31) // 32
+            nd_T_p = (
+                jnp.zeros((n, Wo), jnp.uint32)
                 .at[subj_rows]
-                .max(newly.T, mode="drop")
-            )  # [subject, observer]
+                .max(_pack_bits(newly.T), mode="drop")
+            )  # [subject, packed observers]
             cand_j = (
                 jnp.full((n,), NO_CANDIDATE, jnp.int32)
                 .at[subj_rows]
                 .max(jnp.where(state.mr_active, state.mr_key, NO_CANDIDATE), mode="drop")
             )
+            bit_idx = jnp.arange(32, dtype=jnp.uint32)
 
             NB = _chunk(n, params.apply_block, 8192, 2048)
             nb = n // NB
@@ -1173,7 +1180,16 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 vk, ndT, cj, dacc, sus, cnt = carry
                 c0 = b * NB
                 cols = c0 + jnp.arange(NB, dtype=jnp.int32)
-                nd = jax.lax.dynamic_slice(ndT, (c0, 0), (NB, n)).T  # [N, NB]
+                # [NB, Wo] packed words -> small transpose -> bit expansion
+                # along the (major) observer axis; the explicit transpose is
+                # the layout boundary that keeps the expansion's layout
+                # preference away from the vk carry (see r4 design notes)
+                pbT = jax.lax.dynamic_slice(ndT, (c0, 0), (NB, Wo)).T  # [Wo, NB]
+                nd = (
+                    ((pbT[:, None, :] >> bit_idx[None, :, None]) & 1)
+                    .astype(bool)
+                    .reshape(Wo * 32, NB)[:n]
+                )  # [N, NB]
                 cand = jax.lax.dynamic_slice(cj, (c0,), (NB,))[None, :]
                 own = jax.lax.dynamic_slice(vk, (0, c0), (n, NB))
                 up_cols = jax.lax.dynamic_slice(state.up, (c0,), (NB,))
@@ -1220,7 +1236,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             # layout assignment (see the r4 design notes above).
             carry0 = (
                 state.view_key,
-                nd_T,
+                nd_T_p,
                 cand_j,
                 jnp.zeros((n,), jnp.int32),
                 jnp.full((n,), NO_CANDIDATE, jnp.int32),
@@ -1555,6 +1571,11 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
     origin = jnp.concatenate([p[2] for p in proposals])
     valid = jnp.concatenate([p[3] for p in proposals])
     L = subject.shape[0]
+    # segment boundaries of the concatenated proposal vector, for per-source
+    # drop attribution (r4 staleness analysis: WHICH facts the compaction
+    # window crowds out — sync re-gossip drops are pool duplicates and
+    # harmless, fd/expiry/refute drops would delay genuinely new facts)
+    seg_ends = np.cumsum([int(p[0].shape[0]) for p in proposals])
 
     def _alloc(state: SparseState):
         (idx,) = jnp.nonzero(valid, size=E, fill_value=L)
@@ -1565,12 +1586,40 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
         )
         # dropped = compaction overflow (valid proposals beyond E) + fresh
         # winners that found no free slot; batch duplicates and superseded/
-        # already-covered proposals are not drops
+        # already-covered proposals are not drops. BOTH kinds attribute to
+        # their proposal source: no_slot is a per-compacted-entry mask whose
+        # entries map back to positions in the concatenated vector via idx.
+        rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+        over = valid & (rank >= E)
+        pos = jnp.arange(L)
+        noslot_pos = (
+            jnp.zeros((L,), bool).at[idx].max(no_slot & got, mode="drop")
+        )
+        dropped_pos = over | noslot_pos
+        seg_drops = [
+            jnp.where((pos >= lo) & (pos < hi), dropped_pos, False).sum()
+            for lo, hi in zip([0, *seg_ends[:-1]], seg_ends)
+        ]
         overflow = valid.sum() - got.sum()
-        return st, {"announce_dropped": overflow + no_slot, "announced": allocated}
+        return st, {
+            "announce_dropped": overflow + no_slot.sum(),
+            "announce_dropped_fd": seg_drops[0],
+            "announce_dropped_expiry": seg_drops[1],
+            "announce_dropped_refute": seg_drops[2],
+            "announce_dropped_sync": seg_drops[3],
+            "announced": allocated,
+        }
 
     def _skip(state: SparseState):
-        return state, {"announce_dropped": jnp.int32(0), "announced": jnp.int32(0)}
+        z = jnp.int32(0)
+        return state, {
+            "announce_dropped": z,
+            "announce_dropped_fd": z,
+            "announce_dropped_expiry": z,
+            "announce_dropped_refute": z,
+            "announce_dropped_sync": z,
+            "announced": z,
+        }
 
     return jax.lax.cond(valid.any(), _alloc, _skip, state)
 
@@ -1647,8 +1696,12 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
             & st.up[:, None]
         ).sum(axis=1)
 
+    # the membership-pool segmentation scan is [N, M] work; sampling it on
+    # sweep ticks only (it is a MONITORING metric, not protocol state —
+    # never read by the tick, not oracle-compared) keeps the common tick
+    # free of two extra [N, M] passes at flagship pool sizes
     seg_m = jax.lax.cond(
-        state.mr_active.any(),
+        state.mr_active.any() & ((state.tick % params.sweep_every) == 0),
         _seg_m,
         lambda st: jnp.zeros((state.capacity,), jnp.int32),
         state,

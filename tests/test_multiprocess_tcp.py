@@ -1,0 +1,33 @@
+"""Multi-process real-TCP partition repro as a CI test (VERDICT r3 item 7).
+
+Wraps ``examples/multiprocess_partition_example.py`` — three OS processes
+over genuine TCP sockets, block one at the NetworkEmulatorTransport seam,
+SUSPECT → REMOVED at the survivors, rejoin as a NEW member id (the
+reference's issue-187 scripts, ``examples/scripts/issues/187/README:1-8``).
+The only end-to-end proof that the real transports + scalar engine survive
+process boundaries.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = pathlib.Path(__file__).parent.parent / "examples" / "multiprocess_partition_example.py"
+
+
+def test_three_process_tcp_partition_and_rejoin():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT)],
+        capture_output=True, text=True, timeout=170, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"repro failed\nstdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    )
+    assert "== PASS" in proc.stdout
+    assert "rejoined as NEW id" in proc.stdout

@@ -143,3 +143,50 @@ def test_sparse_lockstep_soak(seed):
         oracle = SO.sparse_oracle_tick(st, k, SPARSE_PARAMS)
         SO.assert_sparse_equivalent(st_next, oracle)
         st = st_next
+
+
+# ---- wide sparse seed (round-3 verdict item 4: N=64 for the sparse engine
+# too, with the write throttles actually binding) ----
+
+_SPARSE_WIDE_PARAMS = SP.SparseParams(
+    capacity=64, fanout=3, repeat_mult=2, ping_req_k=3, fd_every=2,
+    sync_every=6, suspicion_mult=2, sweep_every=4, sample_tries=6,
+    rumor_slots=4, mr_slots=24, announce_slots=4, seed_rows=(0, 1),
+    fd_accept_slots=4, refute_slots=3, sync_announce=2, delay_slots=3,
+)
+
+
+def test_sparse_lockstep_soak_wide_n64():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(640)
+    st = SP.init_sparse_state(
+        _SPARSE_WIDE_PARAMS, 56, warm=True, dense_links=True, uniform_delay=0.7
+    )
+    loss = rng.integers(0, 20, size=(64, 64)).astype(np.float32) / 64.0
+    st = st.replace(
+        loss=jnp.asarray(loss), fetch_rt=SP._roundtrip(jnp.asarray(loss))
+    )
+    step = jax.jit(partial(SP.sparse_tick, params=_SPARSE_WIDE_PARAMS))
+    key = jax.random.PRNGKey(64_000)
+    for t in range(120):
+        if t == 8:
+            for r in (9, 21, 33, 45):
+                st = SP.crash_row(st, r)
+        if t == 12:
+            st = SP.spread_rumor(st, 0, origin=17)
+        if t == 30:
+            st = SP.join_rows(
+                st, jnp.asarray([56, 57, 58, 59]), jnp.asarray([0, 1])
+            )
+        if t == 55:
+            st = SP.begin_leave(st, 50)
+        if t == 60:
+            st = SP.crash_row(st, 50)
+        if t == 80:
+            st = SP.spread_rumor(st, 1, origin=3)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = SO.sparse_oracle_tick(st, k, _SPARSE_WIDE_PARAMS)
+        SO.assert_sparse_equivalent(st_next, oracle)
+        st = st_next

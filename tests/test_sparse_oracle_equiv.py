@@ -200,3 +200,129 @@ def test_sparse_lockstep_medium_haul():
         return st
 
     _run_lockstep(params, st, 777, 80, mutate=mutate)
+
+
+# ---- throttle-binding lockstep (VERDICT r3 item 4) -------------------------
+# The FD-verdict / refutation / announce throttles default to max(64, N/16)
+# and never bind at lockstep sizes, so the compaction/retry paths that
+# activate at 32k+ were mirrored-by-the-oracle but never oracle-VERIFIED.
+# These cases force tiny budgets and mass events (partition-style crash
+# waves, mass metadata bumps after blanket suspicion) so every throttle
+# actually drops writes, and the retry semantics must match bit-exactly.
+
+
+@pytest.mark.parametrize("seed", [0, 4, 13])
+def test_sparse_lockstep_throttles_bind(seed):
+    import jax.numpy as jnp
+
+    params = SP.SparseParams(
+        capacity=24, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=1,
+        sync_every=5, suspicion_mult=2, sweep_every=2, sample_tries=6,
+        rumor_slots=2, mr_slots=20, announce_slots=3, seed_rows=(0,),
+        fd_accept_slots=2, refute_slots=2, sync_announce=2,
+    )
+    rng = np.random.default_rng(seed)
+    st = SP.init_sparse_state(params, 20, warm=True, dense_links=True)
+
+    def mutate(t, st):
+        if t == 2:
+            # partition-style wave: half the cluster unreachable -> every
+            # prober wants to write SUSPECT, V=2 allows two per round
+            st = SP.set_link_loss(st, list(range(10)), list(range(10, 20)), 1.0)
+            st = SP.set_link_loss(st, list(range(10, 20)), list(range(10)), 1.0)
+        if t == 14:
+            st = SP.heal_partition(st, list(range(10)), list(range(10, 20)))
+        if t == 16:
+            # mass refutation pressure: every previously suspected row now
+            # needs the diagonal bump, refute_slots=2 forces multi-round
+            st = SP.crash_row(st, int(rng.integers(2, 9)))
+        return st
+
+    # own loop (not _run_lockstep): the metrics prove the throttles BOUND —
+    # the point of the test is oracle-verifying the retry paths WHILE they
+    # drop writes, not just passing on a quiet trajectory
+    step = jax.jit(partial(SP.sparse_tick, params=params))
+    key = jax.random.PRNGKey(seed)
+    suspect_writes = failed_probes = dropped = 0
+    for t in range(34):
+        st = mutate(t, st)
+        key, k = jax.random.split(key)
+        st_next, ms = step(st, k)
+        oracle = SO.sparse_oracle_tick(st, k, params)
+        SO.assert_sparse_equivalent(st_next, oracle)
+        st = st_next
+        suspect_writes += int(ms["fd_new_suspects"])
+        failed_probes += int(ms["fd_failed_probes"])
+        dropped += int(ms["announce_dropped"])
+    assert failed_probes > suspect_writes, (
+        f"FD throttle never bound: {failed_probes} failed probes, "
+        f"{suspect_writes} suspect writes at V=2"
+    )
+    assert dropped > 0, "announce throttle never bound"
+
+
+@pytest.mark.parametrize("seed", [6, 21])
+def test_sparse_lockstep_announce_starved(seed):
+    """announce_slots=2 under a join burst + crash wave: most proposals drop
+    (announce_dropped > 0 every round) and facts reach stragglers via SYNC —
+    deviation 3's heal path, oracle-verified while it binds."""
+    import jax.numpy as jnp
+
+    params = SP.SparseParams(
+        capacity=32, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=4, suspicion_mult=2, sweep_every=2, sample_tries=6,
+        rumor_slots=2, mr_slots=6, announce_slots=2, seed_rows=(0, 1),
+        fd_accept_slots=3, refute_slots=2, sync_announce=1,
+    )
+    rng = np.random.default_rng(seed)
+    st = SP.init_sparse_state(params, 24, warm=True, dense_links=True)
+
+    def mutate(t, st):
+        if t == 3:
+            st = SP.join_rows(
+                st, jnp.asarray([24, 25, 26, 27]), jnp.asarray([0, 1])
+            )
+        if t == 8:
+            for r in (5, 9, 13, 17):
+                st = SP.crash_row(st, r)
+        if t == 18:
+            st = SP.join_rows(st, jnp.asarray([28, 29]), jnp.asarray([0, 1]))
+        return st
+
+    _run_lockstep(params, st, seed, 30, mutate=mutate)
+
+
+def test_sparse_lockstep_throttled_n64():
+    """One N=64 throttled seed — the widest lockstep case (r3 had N=64 only
+    for the dense engine)."""
+    import jax.numpy as jnp
+
+    params = SP.SparseParams(
+        capacity=64, fanout=3, repeat_mult=2, ping_req_k=3, fd_every=2,
+        sync_every=6, suspicion_mult=2, sweep_every=4, sample_tries=6,
+        rumor_slots=3, mr_slots=16, announce_slots=4, seed_rows=(0, 1),
+        fd_accept_slots=4, refute_slots=3, sync_announce=2,
+    )
+    rng = np.random.default_rng(64)
+    st = SP.init_sparse_state(params, 56, warm=True, dense_links=True)
+    loss = rng.integers(0, 16, size=(64, 64)).astype(np.float32) / 64.0
+    import jax.numpy as jnp
+    st = st.replace(
+        loss=jnp.asarray(loss), fetch_rt=SP._roundtrip(jnp.asarray(loss))
+    )
+
+    def mutate(t, st):
+        if t == 4:
+            for r in (7, 19, 23, 31, 44):
+                st = SP.crash_row(st, r)
+        if t == 6:
+            st = SP.spread_rumor(st, 0, origin=12)
+        if t == 16:
+            st = SP.join_rows(
+                st, jnp.asarray([56, 57, 58, 59]), jnp.asarray([0, 1])
+            )
+        if t == 24:
+            st = SP.begin_leave(st, 40)
+        return st
+
+    _run_lockstep(params, st, 64, 32, mutate=mutate)
