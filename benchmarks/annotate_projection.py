@@ -1,0 +1,109 @@
+"""Fold the measured round-4 evidence into the north-star projection block.
+
+Reads BENCH_RESULTS_r{N}.json (written by collect_results.py), derives the
+projection inputs from the recorded configs — the 32k single-chip churn
+margin, the 49k single-chip run, the compile proof, the collectives bounds
+from scaling_efficiency — and writes the `north_star_projection` and
+`measurement_variance_note` blocks the round artifact carries.
+
+Usage: python benchmarks/annotate_projection.py --round 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True)
+    args = ap.parse_args()
+    path = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
+    data = json.loads(path.read_text())
+    cfgs = data["configs"]
+
+    def find(pred):
+        return next((c for c in cfgs if pred(c)), None)
+
+    churn32 = find(lambda c: c.get("config") == 5 and c.get("n") == 32768)
+    churn49 = find(lambda c: c.get("config") == 5 and c.get("n") == 49152)
+    sparse_proof = None
+    proof_path = ROOT / "COMPILE_PROOF_100K.json"
+    if proof_path.exists():
+        proof = json.loads(proof_path.read_text())
+        sparse_proof = next(
+            (p for p in proof["proofs"] if p.get("engine") == "sparse"), None
+        )
+    cells = find(lambda c: c.get("variant") == "cells_matched")
+    census = find(lambda c: c.get("variant") == "collective_census")
+    analytic = find(lambda c: c.get("variant") == "analytic_cross_shard_bytes")
+
+    evidence = []
+    if churn32:
+        evidence.append(
+            f"measured 32k single-chip churn: {churn32['speedup_vs_realtime']}x "
+            f"realtime ({churn32['ticks_per_s']} ticks/s vs 5 needed) — the "
+            "per-chip work proxy for 98,304/8 chips (view cells/chip "
+            "12288x98304=1.21G vs 1.07G at 32k single)"
+        )
+    if churn49:
+        evidence.append(
+            f"49,152 members now RUN on one chip ({churn49['speedup_vs_realtime']}x "
+            "realtime, 60 sim-seconds end-to-end) — the r3 ceiling was 32k; "
+            "1.13x the flagship's per-chip cell count executes with headroom "
+            "in a 16 GB budget"
+        )
+    if sparse_proof:
+        gib = sparse_proof["memory_analysis"]["peak_live_gib_per_device"]
+        evidence.append(
+            f"sharded 98,304 program compile-proven at {gib} GiB/device with "
+            "donation (COMPILE_PROOF_100K.json)"
+        )
+    collectives = {}
+    if analytic:
+        rt = analytic["at_realtime_5_ticks_per_s"]
+        collectives["ici_bytes_budget"] = (
+            f"{rt['gbytes_per_s_pull']} GB/s of cross-shard traffic at "
+            "realtime vs >=100 GB/s per-chip ICI (conservative) — "
+            f"{rt['ici_headroom_factor_pull']}x headroom"
+        )
+    if census:
+        collectives["ici_latency_budget"] = (
+            f"{census['total_collectives']} collectives/tick in the compiled "
+            f"8-way program -> ~{census['latency_budget_ms_at_10us_each']} ms "
+            "of launch latency at 10 us each, inside a 200 ms tick"
+        )
+    if cells:
+        collectives["cpu_mesh_measured_ratio"] = (
+            f"{cells['scaling_efficiency']} at equal per-device cells on the "
+            "8-virtual-CPU mesh — a heavily pessimistic lower bound (XLA:CPU "
+            "collectives are thread-rendezvous-bound at hundreds of us each, "
+            "see the census for the TPU-relevant latency figure)"
+        )
+
+    data["north_star_projection"] = {
+        "claim": "98,304 members, 1%/s churn, >=1x realtime on v5e-8",
+        "evidence": evidence,
+        "collectives_term_bounds": collectives,
+        "status": (
+            "projected from single-chip measurement + compile proof + "
+            "volume/latency bounds on the cross-chip term; execution "
+            "evidence needs the real 8-chip slice"
+        ),
+    }
+    data["measurement_variance_note"] = (
+        "tunneled-TPU wall clock varies ~+/-20% run-to-run and degrades "
+        "under host CPU load; all recorded runs were collected sequentially "
+        "on an idle host. Churn runs dispatch in multi-second windows "
+        "(the tunnel kills single RPCs past ~60-90 s of device time)."
+    )
+    path.write_text(json.dumps(data, indent=1))
+    print(json.dumps({"annotated": str(path), "evidence_lines": len(evidence)}))
+
+
+if __name__ == "__main__":
+    main()
