@@ -43,19 +43,27 @@ def main() -> None:
     analytic = find(lambda c: c.get("variant") == "analytic_cross_shard_bytes")
 
     evidence = []
+    flagship_n, devs = 98_304, 8
     if churn32:
+        n32 = churn32["n"]
+        cells_chip = flagship_n // devs * flagship_n
         evidence.append(
-            f"measured 32k single-chip churn: {churn32['speedup_vs_realtime']}x "
-            f"realtime ({churn32['ticks_per_s']} ticks/s vs 5 needed) — the "
-            "per-chip work proxy for 98,304/8 chips (view cells/chip "
-            "12288x98304=1.21G vs 1.07G at 32k single)"
+            f"measured {n32 // 1024}k single-chip churn: "
+            f"{churn32['speedup_vs_realtime']}x realtime "
+            f"({churn32['ticks_per_s']} ticks/s vs 5 needed) — the per-chip "
+            f"work proxy for {flagship_n:,}/{devs} chips (view cells/chip "
+            f"{flagship_n // devs}x{flagship_n}={cells_chip / 1e9:.2f}G vs "
+            f"{n32 * n32 / 1e9:.2f}G at {n32 // 1024}k single)"
         )
     if churn49:
+        n49 = churn49["n"]
+        ratio = n49 * n49 / (flagship_n // devs * flagship_n)
         evidence.append(
-            f"49,152 members now RUN on one chip ({churn49['speedup_vs_realtime']}x "
-            "realtime, 60 sim-seconds end-to-end) — the r3 ceiling was 32k; "
-            "1.13x the flagship's per-chip cell count executes with headroom "
-            "in a 16 GB budget"
+            f"{n49:,} members now RUN on one chip "
+            f"({churn49['speedup_vs_realtime']}x realtime, "
+            f"{churn49['sim_seconds']} sim-seconds end-to-end) — the r3 "
+            f"ceiling was 32k; {ratio:.2f}x the flagship's per-chip cell "
+            "count executes in a 16 GB budget"
         )
     if sparse_proof:
         gib = sparse_proof["memory_analysis"]["peak_live_gib_per_device"]

@@ -78,12 +78,16 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    scalar oracle mirrors the same accounting).
 
 Memory at flagship scale (v5e, 16 GB/chip): N=98,304 sharded over 8 chips =
-4.8 GB/chip for ``view_key`` + pool planes (compile-proven at 13.2
-GiB/device incl. donation — ``COMPILE_PROOF_100K.json``). On ONE chip the
-4 B/cell arithmetic alone would allow N≈57k, but XLA working-set temps cap
-demonstrated single-chip runs at N=32,768 (N≥36,864 faults/OOMs — see
-``churn_single_chip_ceiling`` in ``BENCH_RESULTS_r03.json``); N=65,536
-needs 17.2 GB for the view matrix alone and can never fit.
+4.8 GB/chip for ``view_key`` + pool planes (compile-proven at 11.6
+GiB/device incl. donation — ``COMPILE_PROOF_100K.json``). Round 4's
+scatter-free tick (see the design notes in ``_mr_apply`` / ``_sync_phase`` /
+``_fd_phase._write``: every point/column scatter into the [N, N] view
+forced a whole-matrix layout copy, and SYNC's gather-after-scatter staged
+another) moved the single-chip ceiling from N=32,768 (r3: 36,864 faulted)
+to **N=49,152 running 60 sim-seconds of churn end-to-end** (compiled
+memory upper bound 14.7 GiB vs 23.5 faulting in r3 — the
+``single_chip_memory`` entries in ``BENCH_RESULTS_r04.json``). N=65,536 needs 17.2 GB for the
+view matrix alone and can never fit one chip.
 """
 
 from __future__ import annotations
@@ -1176,6 +1180,13 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             NB = _chunk(n, params.apply_block, 8192, 2048)
             nb = n // NB
 
+            # rank-3 variant for the flagship shape (n % 32 == 0, no
+            # namespace gate): own reshapes [N, NB] -> [Wo, 32, NB] as a
+            # free row-major bitcast and the bit expansion never reshapes
+            # at all — measured ~9% faster than the rank-2 expansion. The
+            # two paths compute identical cells (lockstep-verified).
+            rank3 = n % 32 == 0 and not params.namespace_gate
+
             def _block(b, carry):
                 vk, ndT, cj, dacc, sus, cnt = carry
                 c0 = b * NB
@@ -1185,26 +1196,43 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 # the layout boundary that keeps the expansion's layout
                 # preference away from the vk carry (see r4 design notes)
                 pbT = jax.lax.dynamic_slice(ndT, (c0, 0), (NB, Wo)).T  # [Wo, NB]
-                nd = (
-                    ((pbT[:, None, :] >> bit_idx[None, :, None]) & 1)
-                    .astype(bool)
-                    .reshape(Wo * 32, NB)[:n]
-                )  # [N, NB]
-                cand = jax.lax.dynamic_slice(cj, (c0,), (NB,))[None, :]
+                cand = jax.lax.dynamic_slice(cj, (c0,), (NB,))
                 own = jax.lax.dynamic_slice(vk, (0, c0), (n, NB))
                 up_cols = jax.lax.dynamic_slice(state.up, (c0,), (NB,))
-                needs = (cand & 3) == RANK_ALIVE
-                u = fetch_uniform(state.tick, SALT_GOSSIP, rows[:, None], cols[None, :])
                 p_fetch = (
                     state.fetch_rt
                     if state.fetch_rt.ndim == 0
                     else jax.lax.dynamic_slice(state.fetch_rt, (0, c0), (n, NB))
                 )
-                fetch_ok = ~needs | (up_cols[None, :] & (u < p_fetch))
+                if rank3:
+                    nd = ((pbT[:, None, :] >> bit_idx[None, :, None]) & 1).astype(
+                        bool
+                    )  # [Wo, 32, NB] — no reshape
+                    cand_b = cand[None, None, :]
+                    own_b = own.reshape(Wo, 32, NB)  # free bitcast
+                    i_obs = rows.reshape(Wo, 32, 1)
+                    j_sub = cols[None, None, :]
+                    up_b = up_cols[None, None, :]
+                    pf = p_fetch if p_fetch.ndim == 0 else p_fetch.reshape(Wo, 32, NB)
+                else:
+                    nd = (
+                        ((pbT[:, None, :] >> bit_idx[None, :, None]) & 1)
+                        .astype(bool)
+                        .reshape(Wo * 32, NB)[:n]
+                    )  # [N, NB]
+                    cand_b = cand[None, :]
+                    own_b = own
+                    i_obs = rows[:, None]
+                    j_sub = cols[None, :]
+                    up_b = up_cols[None, :]
+                    pf = p_fetch
+                needs = (cand_b & 3) == RANK_ALIVE
+                u = fetch_uniform(state.tick, SALT_GOSSIP, i_obs, j_sub)
+                fetch_ok = ~needs | (up_b & (u < pf))
                 accept = (
                     nd
-                    & (cand > own)
-                    & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+                    & (cand_b > own_b)
+                    & ((own_b >= 0) | ((cand_b & 3) <= RANK_LEAVING))
                     & fetch_ok
                 )
                 if params.namespace_gate:
@@ -1212,21 +1240,27 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                     accept = accept & state.ns_rel[
                         state.ns_id[:, None], ns_cols[None, :]
                     ]
-                new_own = jnp.where(accept, cand, own)
-                vk = jax.lax.dynamic_update_slice(vk, new_own, (0, c0))
-                dacc = dacc + (
+                new_own = jnp.where(accept, cand_b, own_b)
+                delta = (
                     ((new_own & 3) != RANK_DEAD).astype(jnp.int32)
-                    - ((own & 3) != RANK_DEAD).astype(jnp.int32)
-                ).sum(axis=1)
+                    - ((own_b & 3) != RANK_DEAD).astype(jnp.int32)
+                )
+                sus_b = jnp.where(
+                    accept & ((cand_b & 3) == RANK_SUSPECT), cand_b, NO_CANDIDATE
+                )
+                if rank3:
+                    vk = jax.lax.dynamic_update_slice(
+                        vk, new_own.reshape(n, NB), (0, c0)
+                    )
+                    dacc = dacc + delta.sum(axis=2).reshape(n)
+                    sus_col = sus_b.max(axis=(0, 1))
+                else:
+                    vk = jax.lax.dynamic_update_slice(vk, new_own, (0, c0))
+                    dacc = dacc + delta.sum(axis=1)
+                    sus_col = sus_b.max(axis=0)
                 cnt = cnt + accept.sum()
                 # episode registration for accepted SUSPECT records
-                sus = jax.lax.dynamic_update_slice(
-                    sus,
-                    jnp.where(
-                        accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE
-                    ).max(axis=0),
-                    (c0,),
-                )
+                sus = jax.lax.dynamic_update_slice(sus, sus_col, (c0,))
                 return vk, ndT, cj, dacc, sus, cnt
 
             # nd_T and cand_j ride the carry DELIBERATELY (not closed over):

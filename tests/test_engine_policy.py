@@ -1,0 +1,44 @@
+"""The executable two-engine policy (sim.driver.auto_params, VERDICT r3 #8)."""
+
+from __future__ import annotations
+
+import pytest
+
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.ops.sparse import SparseParams
+from scalecube_cluster_tpu.ops.state import SimParams
+from scalecube_cluster_tpu.sim.driver import SimDriver, auto_params
+
+
+def test_small_fidelity_runs_dense():
+    assert isinstance(auto_params(256, per_link_fidelity=True), SimParams)
+    assert isinstance(auto_params(4096, link_delay=True), SimParams)
+    assert isinstance(auto_params(100), SimParams)  # tiny => dense
+
+
+def test_scale_runs_sparse():
+    assert isinstance(auto_params(16384), SparseParams)
+    # fidelity asks past the dense threshold still go sparse
+    assert isinstance(auto_params(16384, per_link_fidelity=True), SparseParams)
+
+
+def test_force_sparse_always_wins():
+    assert isinstance(
+        auto_params(1024, per_link_fidelity=True, force_sparse=True), SparseParams
+    )
+
+
+def test_config_path_with_overrides():
+    cfg = ClusterConfig.default_local()
+    p = auto_params(20000, config=cfg, sync_stagger=2, mr_slots=4096)
+    assert isinstance(p, SparseParams)
+    assert p.sync_stagger == 2 and p.mr_slots == 4096
+    d = auto_params(1024, per_link_fidelity=True, config=cfg)
+    assert isinstance(d, SimParams)
+
+
+def test_driver_selects_engine_from_auto_params():
+    drv = SimDriver(auto_params(2048), 64)
+    assert drv.sparse
+    drv2 = SimDriver(auto_params(256, per_link_fidelity=True), 64)
+    assert not drv2.sparse

@@ -64,6 +64,28 @@ def test_blocked_matches_unblocked(apply_block):
     _assert_same(ref, blocked)
 
 
+def test_rank3_path_blocked_matches_unblocked():
+    """capacity % 32 == 0 selects the rank-3 apply variant; forced small
+    blocks make it interact with the fori_loop walk (nb > 1) — the
+    combination no other test reaches (auto-sizing keeps n<=8192 single
+    -block)."""
+    base64 = dataclasses.replace(BASE, capacity=64, seed_rows=(0, 1))
+
+    def run64(params):
+        st = SP.init_sparse_state(params, 56, warm=True)
+        st = SP.spread_rumor(st, 0, 3)
+        st = SP.crash_row(st, 5)
+        st = SP.join_row(st, 60, (0,))
+        key = jax.random.PRNGKey(7)
+        step = jax.jit(SP.run_sparse_ticks, static_argnums=(2, 3))
+        st, key, ms, _ = step(st, key, 100, params)
+        return st, ms
+
+    ref = run64(base64)
+    for blk in (16, 32):
+        _assert_same(ref, run64(dataclasses.replace(base64, apply_block=blk)))
+
+
 def test_blocked_matches_under_namespace_gate():
     base = dataclasses.replace(BASE, namespace_gate=True)
 
