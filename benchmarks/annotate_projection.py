@@ -44,6 +44,21 @@ def main() -> None:
 
     evidence = []
     flagship_n, devs = 98_304, 8
+    # the proxy run is identified by its cells-matched shape (34,816^2 view
+    # cells ~= 12,288 x 98,304 — collected by collect_results.py)
+    proxy = find(lambda c: c.get("config") == 5 and c.get("n") == 34_816)
+    if proxy:
+        margin = round((proxy["speedup_vs_realtime"] - 1.0) * 100)
+        evidence.append(
+            f"flagship per-chip work proxy (N={proxy['n']:,}, pool "
+            f"{proxy['mr_slots']:,} — view and pool cells/device matched to "
+            f"the {flagship_n:,}/{devs} program): "
+            f"{proxy['speedup_vs_realtime']}x realtime measured end-to-end "
+            f"on one chip, steady fraction "
+            f"{proxy['steady_alive_view_fraction']} — a {margin}% margin for "
+            "the cross-chip term (bounded separately by the collective "
+            "census and volume budget below)"
+        )
     if churn32:
         n32 = churn32["n"]
         cells_chip = flagship_n // devs * flagship_n

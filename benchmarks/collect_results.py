@@ -74,6 +74,11 @@ def main() -> None:
         # README staleness analysis)
         results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152",
                         "--mr-slots", "12288"], timeout=3000)
+        # flagship per-chip work proxy: 34,816^2 view cells and
+        # 34,816 x 5,760 pool cells match the 98,304/8-chip program's
+        # per-device planes — the north-star projection's primary input
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "34816",
+                        "--mr-slots", "5760"], timeout=3000)
     results += run([py, "benchmarks/config2b_scalar_vs_kernel_gossip.py"])
     if not args.quick:
         results += run([py, "benchmarks/config3b_scalar_vs_kernel_fd.py"],
