@@ -47,7 +47,7 @@ def main() -> None:
     # the proxy run is identified by its cells-matched shape (34,816^2 view
     # cells ~= 12,288 x 98,304 — collected by collect_results.py)
     proxy = find(lambda c: c.get("config") == 5 and c.get("n") == 34_816)
-    if proxy:
+    if proxy and proxy.get("ok"):
         margin = round((proxy["speedup_vs_realtime"] - 1.0) * 100)
         evidence.append(
             f"flagship per-chip work proxy (N={proxy['n']:,}, pool "
