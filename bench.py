@@ -149,7 +149,11 @@ def _headline_rounds_sparse():
 
 
 def main() -> None:
-    engine = "dense" if "--engine" in sys.argv and "dense" in sys.argv else "sparse"
+    engine = "sparse"
+    if "--engine" in sys.argv:
+        i = sys.argv.index("--engine")
+        if i + 1 < len(sys.argv) and sys.argv[i + 1] == "dense":
+            engine = "dense"
     budget = gossip_periods_to_sweep(3, N)
 
     # Force synchronous dispatch BEFORE timing (see module docstring).

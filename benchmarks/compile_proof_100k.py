@@ -105,7 +105,7 @@ def prove_sparse(mesh) -> dict:
     # explicit shape map (clearer than heuristics)
     M, R = params.mr_slots, params.rumor_slots
     shapes = dict(
-        tick=(), up=(N,), epoch=(N,), view_key=(N, N), n_live=(N,),
+        tick=(), up=(N,), epoch=(N,), joined_at=(N,), view_key=(N, N), n_live=(N,),
         sus_key=(N,), sus_since=(N,), force_sync=(N,), leaving=(N,),
         ns_id=(N,), ns_rel=(1, 1),
         mr_active=(M,), mr_subject=(M,), mr_key=(M,), mr_created=(M,),

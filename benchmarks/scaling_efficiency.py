@@ -251,7 +251,7 @@ def collective_census(n: int = 98_304) -> dict:
 
     sh = sparse_state_shardings(mesh)
     shapes = {
-        "tick": (), "up": (n,), "epoch": (n,), "view_key": (n, n),
+        "tick": (), "up": (n,), "epoch": (n,), "joined_at": (n,), "view_key": (n, n),
         "n_live": (n,), "sus_key": (n,), "sus_since": (n,),
         "force_sync": (n,), "leaving": (n,), "ns_id": (n,), "ns_rel": (1, 1),
         "mr_active": (n // 8,), "mr_subject": (n // 8,), "mr_key": (n // 8,),

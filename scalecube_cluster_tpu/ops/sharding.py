@@ -116,6 +116,7 @@ def sparse_state_shardings(mesh: Mesh, dense_links: bool = False, delay_slots: i
         tick=rep,
         up=row,
         epoch=row,
+        joined_at=row,
         view_key=row2d,
         n_live=row,
         sus_key=row,

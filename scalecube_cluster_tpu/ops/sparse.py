@@ -48,13 +48,21 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    membership rumors would cost a 4 B/cell [N, M] plane and ~3 extra passes
    per tick — the exact cost this mode exists to avoid. Senders skip only the
    rumor's origin. (User rumors keep the full filter — their pool is tiny.)
-3. **Bounded announcements.** New-rumor allocation is capped per tick
-   (``announce_slots``) and per SYNC participant (``sync_announce`` — the
-   reference re-gossips every sync-accepted record); the suspicion sweep
-   announces one expiry per observer per sweep (every observer's own timer
-   fires anyway — the rumor merely accelerates). Overflow is counted
-   (``announce_dropped`` metric) and heals via SYNC, exactly like the
-   reference's dropped gossip under backpressure.
+3. **Bounded announcements with priority eviction.** New-rumor allocation is
+   capped per tick (``announce_slots``) and per SYNC participant
+   (``sync_announce`` — the reference re-gossips every sync-accepted
+   record); the suspicion sweep announces one expiry per observer per sweep
+   (every observer's own timer fires anyway — the rumor merely accelerates).
+   The reference's gossip queue admits every accepted record unconditionally
+   and sweeps only by age (``GossipProtocolImpl.getGossipsToRemove:350-358``);
+   the bounded-pool analogue (r5): a PRIORITY fact (FD verdict, suspicion
+   expiry, refutation, join/leave/metadata announce) that finds the pool
+   full EVICTS the most-covered majority-spread rumor instead of dropping —
+   the newest facts always get residency, and what is sacrificed is re-sends
+   of a record ~everyone already merged (its tail heals via SYNC). Only SYNC
+   re-gossip (pool duplicates by construction) and priority facts with no
+   majority-covered victim are ever dropped; drops are counted per source
+   (``announce_dropped_*``) and evictions as ``pool_evicted``.
 4. **Bounded rejection sampling** can miss a pick with probability
    (1 - live_fraction)^T per draw (T = ``sample_tries``); a miss skips that
    probe/peer for one round — statistically negligible at the live fractions
@@ -62,8 +70,14 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
 5. **Early rumor free**: a membership rumor whose up-members are all infected
    (and nothing in flight) frees its slot before the reference's age-based
    sweep (``getGossipsToRemove:350-358``) would — fewer redundant sends, no
-   semantic difference (every reachable node already merged it). Age-based
-   sweep still bounds the lifetime of never-fully-covered rumors.
+   semantic difference (every reachable node already merged it). Members who
+   joined AFTER the rumor was created are exempt from its coverage
+   requirement (r5): the reference never replays old gossips to a new
+   member — joiners learn pre-join facts through the SYNC full-table merge.
+   (Without the exemption the continuous joiner influx at large N keeps
+   coverage perpetually one-joiner-short and residency degrades to the full
+   age sweep — the measured r4 pool-saturation mechanism at N=49,152.)
+   Age-based sweep still bounds the lifetime of never-fully-covered rumors.
 6. **Receiver-pulled delivery with slot-collision drop.** Deliveries resolve
    through per-fanout-slot inverse sender indexes (one [N] point scatter +
    row gathers — ~2x the throughput of scattering payload planes by
@@ -263,6 +277,7 @@ class SparseState(struct.PyTreeNode):
     tick: jax.Array
     up: jax.Array  # bool [N]
     epoch: jax.Array  # i32 [N]
+    joined_at: jax.Array  # i32 [N] — tick of the row's latest join (0 at init)
     view_key: jax.Array  # i32 [N, N]
     n_live: jax.Array  # i32 [N]
     sus_key: jax.Array  # i32 [N]
@@ -367,6 +382,7 @@ def init_sparse_state(
         tick=jnp.int32(0),
         up=up,
         epoch=jnp.zeros((n,), jnp.int32),
+        joined_at=jnp.zeros((n,), jnp.int32),
         view_key=view_key,
         n_live=n_live,
         sus_key=jnp.full((n,), NO_CANDIDATE, jnp.int32),
@@ -396,7 +412,7 @@ def init_sparse_state(
     )
 
 
-def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
+def _allocate(state: SparseState, subj_p, key_p, orig_p, got, prio=None):
     """Allocate/supersede membership rumors for E compacted proposals.
 
     POOL INVARIANT: active slots carry UNIQUE subjects. A proposal matching
@@ -406,9 +422,25 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
     the stronger fact instead is strictly faster); lower/equal keys are
     already covered and are skipped. Fresh subjects take ascending free
     slots. Batch duplicates: max key wins, ties to the earliest entry.
-    Returns (state, allocated_count, no_slot_mask) — the mask marks
-    fresh winners that found no free slot, per proposal entry (the
-    caller attributes pool-full drops to their proposal source).
+
+    PRIORITY EVICTION (deviation 3, r5): when ``prio`` is given, a fresh
+    PRIORITY winner (FD verdict, suspicion expiry, refutation, join/leave
+    announce — anything that is not SYNC re-gossip of pool contents) that
+    finds no free slot EVICTS the active rumor closest to done: the fewest
+    still-uncovered members among those who NEED it (up and not exempt by
+    the joined-after-creation rule), ties to the lowest slot, among slots
+    with a majority of their needing members covered and not superseded by
+    this batch. The evicted rumor's tail heals via SYNC — the reference's
+    queue admits every accepted record unconditionally
+    (``GossipProtocolImpl.java:350-358`` sweeps only by age), and this is
+    the bounded-memory analogue: the newest facts always get residency,
+    what's sacrificed is re-sends of a rumor ~everyone already merged.
+    Prio winners drop only when no majority-covered victim exists (counted
+    by the caller's per-source drop attribution).
+
+    Returns (state, allocated_count, no_slot_mask, evicted_count) — the
+    mask marks fresh winners that found no slot (after eviction), per
+    proposal entry; the caller attributes those drops to their source.
     """
     E = subj_p.shape[0]
     M = state.mr_active.shape[0]
@@ -432,15 +464,57 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
     (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
     slot_fresh = free[jnp.clip(rank, 0, E - 1)]
     ok_fresh = fresh & (slot_fresh < M)
-    do = replace | ok_fresh
+    if prio is None:
+        ok_evict = jnp.zeros((E,), bool)
+        slot_evict = jnp.full((E,), M, jnp.int32)
+    else:
+        need = fresh & ~ok_fresh & prio
+        K = min(E, M)
+        erank_raw = jnp.cumsum(need.astype(jnp.int32)) - 1
+        erank = jnp.clip(erank_raw, 0, K - 1)
+
+        def _ev(_):
+            # who still NEEDS each rumor: up members not exempt by the
+            # joined-after-creation rule (down members neither need nor can
+            # receive it — counting them as "covered" would let a barely-
+            # spread rumor masquerade as a victim in down-heavy clusters).
+            # The [N, M] pass runs only when a prio winner needs a slot.
+            needs = state.up[:, None] & ~(
+                state.joined_at[:, None] > state.mr_created[None, :]
+            )
+            need_m = needs.sum(axis=0).astype(jnp.int32)
+            cov_m = (needs & (state.minf_age > 0)).sum(axis=0).astype(jnp.int32)
+            replace_tgt = (
+                jnp.zeros((M + 1,), bool)
+                .at[jnp.where(replace, mslot, M)]
+                .set(True)[:M]
+            )
+            # victim = fewest still-uncovered needing members ("closest to
+            # done"), gated on a majority of its needing members covered
+            evictable = state.mr_active & ~replace_tgt & (2 * cov_m >= need_m)
+            score = jnp.where(evictable, cov_m - need_m, jnp.iinfo(jnp.int32).min)
+            vals, victims = jax.lax.top_k(score, K)  # ties -> lowest slot
+            ok_e = need & (erank_raw < K) & (vals[erank] > jnp.iinfo(jnp.int32).min)
+            return ok_e, victims[erank].astype(jnp.int32)
+
+        def _no(_):
+            return jnp.zeros((E,), bool), jnp.full((E,), M, jnp.int32)
+
+        ok_evict, slot_evict = jax.lax.cond(need.any(), _ev, _no, None)
+    do = replace | ok_fresh | ok_evict
     slot = jnp.where(replace, mslot, jnp.minimum(slot_fresh, M - 1))
+    slot = jnp.where(ok_evict, slot_evict, slot)
     slot = jnp.where(do, slot, M)  # non-allocating entries dropped OOB
     # Distinct OOB sentinels (M + e): the unique_indices=True scatters below
     # promise ALL indices distinct, and a repeated sentinel — even one that
     # mode="drop" discards — makes that promise false (JAX documents the
     # result as undefined). In-bounds entries are unique by the pool
-    # invariant; M + arange keeps the sentinels unique too.
-    clear_slot = jnp.where(replace, slot, M + jnp.arange(E, dtype=jnp.int32))
+    # invariant (replace slots), top_k distinctness (evict slots, disjoint
+    # from replace targets by construction); M + arange keeps the sentinels
+    # unique too.
+    clear_slot = jnp.where(
+        replace | ok_evict, slot, M + jnp.arange(E, dtype=jnp.int32)
+    )
     age = state.minf_age.at[:, clear_slot].set(
         jnp.uint8(0), mode="drop", unique_indices=True
     )
@@ -459,20 +533,25 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got):
                 False, mode="drop", unique_indices=True
             )
         )
-    return st, do.sum(), fresh & ~ok_fresh
+    return st, do.sum(), fresh & ~ok_fresh & ~ok_evict, ok_evict.sum()
 
 
 def announce(state: SparseState, subject, key, origin) -> SparseState:
     """Host-side membership-rumor allocation (join/leave/metadata paths —
     the in-tick analogue is the allocation phase). Supersedes an existing
-    rumor about the same subject when strictly newer; silently skipped when
-    the pool is full (SYNC still converges, deviation 3)."""
-    st, _a, _d = _allocate(
+    rumor about the same subject when strictly newer; when the pool is full
+    it evicts the most-covered majority-spread rumor (priority eviction,
+    deviation 3). A drop remains possible only when NO majority-covered
+    victim exists (a pool full of brand-new facts) — the fact then reaches
+    peers via force_sync/SYNC; ``SimDriver.join`` detects and counts this
+    (``announce_dropped_host``) so /health still sees it."""
+    st, _a, _d, _e = _allocate(
         state,
         jnp.asarray([subject], jnp.int32),
         jnp.asarray([key], jnp.int32),
         jnp.asarray([origin], jnp.int32),
         jnp.ones((1,), bool),
+        prio=jnp.ones((1,), bool),
     )
     return st
 
@@ -504,6 +583,7 @@ def join_row(state: SparseState, row: int, seed_rows) -> SparseState:
     state = state.replace(
         up=state.up.at[row].set(True),
         epoch=state.epoch.at[row].set(new_epoch),
+        joined_at=state.joined_at.at[row].set(state.tick),
         view_key=state.view_key.at[row].set(row_key),
         n_live=state.n_live.at[row].set(n_live_row),
         force_sync=state.force_sync.at[row].set(True),
@@ -554,6 +634,7 @@ def join_rows(state: SparseState, rows, seed_rows) -> SparseState:
     state = state.replace(
         up=state.up.at[rows].set(True),
         epoch=epoch_after,
+        joined_at=state.joined_at.at[rows].set(state.tick),
         view_key=state.view_key.at[rows].set(row_key),
         n_live=state.n_live.at[rows].set(n_live_rows),
         force_sync=state.force_sync.at[rows].set(True),
@@ -572,9 +653,14 @@ def join_rows(state: SparseState, rows, seed_rows) -> SparseState:
         else state.pending_src,
     )
     # batch self-announces (supersede-capable: a joiner's fresh epoch beats a
-    # lingering death rumor about the same row); pool-full joiners still
-    # bootstrap via force_sync + the SYNC participants' re-gossip
-    state, _a, _d = _allocate(state, rows, self_keys, rows, jnp.ones((k,), bool))
+    # lingering death rumor about the same row); a full pool EVICTS
+    # most-covered rumors rather than dropping joiner identities (priority
+    # eviction, deviation 3 — the r4 49k staleness collapse traced exactly
+    # to joins announced into a saturated pool)
+    state, _a, _d, _e = _allocate(
+        state, rows, self_keys, rows, jnp.ones((k,), bool),
+        prio=jnp.ones((k,), bool),
+    )
     return state
 
 
@@ -661,6 +747,11 @@ def snapshot(state: SparseState) -> dict:
 
 
 def restore(arrays: dict) -> SparseState:
+    arrays = dict(arrays)
+    # pre-r5 checkpoints have no joined_at; all-zeros (joined at init) is
+    # the exact pre-r5 semantics (nobody exempt from rumor coverage)
+    if "joined_at" not in arrays:
+        arrays["joined_at"] = np.zeros(np.shape(arrays["up"]), np.int32)
     return SparseState(**{k: jnp.asarray(v) for k, v in arrays.items()})
 
 
@@ -1280,7 +1371,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 carry = _block(0, carry0)
             else:
                 carry = jax.lax.fori_loop(0, nb, _block, carry0)
-            vk, _ndT, _cj, delta, sus_cand, _acc_cnt = carry
+            vk, _ndT, _cj, delta, sus_cand, acc_cnt = carry
             new_sus = jnp.maximum(state.sus_key, sus_cand)
             state = state.replace(
                 view_key=vk,
@@ -1291,10 +1382,10 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                     new_sus > state.sus_key, state.tick, state.sus_since
                 ),
             )
-            return state, newly.sum()
+            return state, newly.sum(), acc_cnt
 
-        state, n_mr_deliveries = jax.lax.cond(
-            mr_any, _mr_apply, lambda st: (st, jnp.int32(0)), state
+        state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
+            mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)), state
         )
         if D:
             state = state.replace(
@@ -1307,6 +1398,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             "rumor_sends": rumor_sent,
             "rumor_deliveries": newly_u.sum(),
             "mr_deliveries": n_mr_deliveries,
+            "mr_accepts": n_mr_accepts,
         }
 
     def _quiet(state: SparseState):
@@ -1315,6 +1407,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             "rumor_sends": jnp.int32(0),
             "rumor_deliveries": jnp.int32(0),
             "mr_deliveries": jnp.int32(0),
+            "mr_accepts": jnp.int32(0),
         }
 
     return jax.lax.cond(work, _deliver, _quiet, state)
@@ -1570,7 +1663,20 @@ def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
         )
         keep_m = keep_m | pending_m
         if params.early_free:
-            covered = ((state.minf_age > 0) | ~state.up[:, None]).all(axis=0)
+            # members who joined AFTER a rumor was created are exempt from
+            # its coverage requirement: the reference never replays old
+            # gossips to new members — a joiner learns pre-join facts via
+            # SYNC (MembershipProtocolImpl.java onSyncAck full-table merge),
+            # and its own row was wiped at join anyway. Without the
+            # exemption, the continuous joiner influx at large N keeps every
+            # rumor's coverage perpetually one-joiner-short, early-free
+            # never fires, and residency degrades to the full age sweep —
+            # the measured r4 pool-saturation mechanism at N=49,152.
+            covered = (
+                (state.minf_age > 0)
+                | ~state.up[:, None]
+                | (state.joined_at[:, None] > state.mr_created[None, :])
+            ).all(axis=0)
             keep_m = keep_m & ~(covered & ~pending_m)
         keep_m = keep_m & state.mr_active
         freed = state.mr_active & ~keep_m
@@ -1615,8 +1721,12 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
         (idx,) = jnp.nonzero(valid, size=E, fill_value=L)
         got = idx < L
         idx = jnp.minimum(idx, L - 1)
-        st, allocated, no_slot = _allocate(
-            state, subject[idx], key[idx], origin[idx], got
+        # priority classes = the first three segments (fd, expiry, refute):
+        # genuinely new facts evict most-covered rumors when the pool is
+        # full; sync re-gossip (pool duplicates by construction) never does
+        prio = got & (idx < int(seg_ends[2]))
+        st, allocated, no_slot, evicted = _allocate(
+            state, subject[idx], key[idx], origin[idx], got, prio=prio
         )
         # dropped = compaction overflow (valid proposals beyond E) + fresh
         # winners that found no free slot; batch duplicates and superseded/
@@ -1642,6 +1752,7 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
             "announce_dropped_refute": seg_drops[2],
             "announce_dropped_sync": seg_drops[3],
             "announced": allocated,
+            "pool_evicted": evicted,
         }
 
     def _skip(state: SparseState):
@@ -1653,6 +1764,7 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
             "announce_dropped_refute": z,
             "announce_dropped_sync": z,
             "announced": z,
+            "pool_evicted": z,
         }
 
     return jax.lax.cond(valid.any(), _alloc, _skip, state)

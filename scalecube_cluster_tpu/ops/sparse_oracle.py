@@ -37,7 +37,8 @@ class _SO:
     def __init__(self, state: SparseState):
         self.tick = int(state.tick)
         for name in (
-            "up", "epoch", "view_key", "n_live", "sus_key", "sus_since",
+            "up", "epoch", "joined_at", "view_key", "n_live", "sus_key",
+            "sus_since",
             "force_sync", "leaving", "ns_id", "ns_rel", "mr_active", "mr_subject", "mr_key",
             "mr_created", "mr_origin", "minf_age", "rumor_active",
             "rumor_origin", "rumor_created", "infected", "infected_at",
@@ -614,8 +615,13 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         )
         keep = (t - int(o.mr_created[m]) <= sweep) or forwarding or pending
         if params.early_free:
+            # joined-after-creation members are exempt (deviation 5, r5):
+            # they learn pre-join facts via SYNC, never by gossip replay
             covered = all(
-                (not o.up[i]) or int(o.minf_age[i, m]) > 0 for i in range(n)
+                (not o.up[i])
+                or int(o.minf_age[i, m]) > 0
+                or int(o.joined_at[i]) > int(o.mr_created[m])
+                for i in range(n)
             )
             if covered and not pending:
                 keep = False
@@ -633,39 +639,87 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     origin = [x for p in proposals for x in p[2]]
     valid = [x for p in proposals for x in p[3]]
     if any(valid):
+        # priority classes = the first three proposal segments (fd, expiry,
+        # refute); sync re-gossip never evicts (kernel's _alloc_phase prio)
+        n_prio = sum(len(p[0]) for p in proposals[:3])
         compact = [i for i, v in enumerate(valid) if v][:E]
         entries = [
-            (int(subject[ci]), int(key_l[ci]), int(origin[ci])) for ci in compact
+            (int(subject[ci]), int(key_l[ci]), int(origin[ci]), ci < n_prio)
+            for ci in compact
         ]
         # batch dedup by subject: max key wins, tie -> earliest entry
         wins = []
-        for e, (s, kk, oo) in enumerate(entries):
+        for e, (s, kk, oo, pr) in enumerate(entries):
             lose = any(
                 s2 == s and (k2 > kk or (k2 == kk and e2 < e))
-                for e2, (s2, k2, _o2) in enumerate(entries)
+                for e2, (s2, k2, _o2, _p2) in enumerate(entries)
                 if e2 != e
             )
             if not lose:
-                wins.append((s, kk, oo))
+                wins.append((s, kk, oo, pr))
         pool_by_subject = {
             int(o.mr_subject[m]): m for m in range(M) if o.mr_active[m]
         }
+        # supersede comparisons read the PRE-batch keys, like the kernel's
+        # vectorized `replace` (an earlier win may have evicted-and-reused
+        # the matched slot; the kernel still compares against the old key
+        # and no-ops — the live key would wrongly overwrite the new tenant)
+        pre_mr_key = o.mr_key.copy()
         free = [m for m in range(M) if not o.mr_active[m]][:E]
+        # priority-eviction victim queue (deviation 3, r5), computed ONCE
+        # from the pre-allocation pool exactly like the kernel: fewest
+        # still-uncovered NEEDING members first (up & not exempt by the
+        # joined-after-creation rule), ties to the lowest slot; batch
+        # replace-targets and sub-majority slots excluded; min(E, M) victims
+        replace_tgt = {
+            pool_by_subject[s]
+            for s, kk, _oo, _pr in wins
+            if s in pool_by_subject and kk > int(o.mr_key[pool_by_subject[s]])
+        }
+        need_m = [0] * M
+        cov_m = [0] * M
+        for m in range(M):
+            for i in range(n):
+                if o.up[i] and not int(o.joined_at[i]) > int(o.mr_created[m]):
+                    need_m[m] += 1
+                    if int(o.minf_age[i, m]) > 0:
+                        cov_m[m] += 1
+        victims = sorted(
+            (
+                m
+                for m in range(M)
+                if o.mr_active[m]
+                and m not in replace_tgt
+                and 2 * cov_m[m] >= need_m[m]
+            ),
+            key=lambda m: (need_m[m] - cov_m[m], m),
+        )[: min(E, M)]
         fi = 0
-        for s, kk, oo in wins:
+        vi = 0
+        evicted_slots: set[int] = set()
+        for s, kk, oo, pr in wins:
             if s in pool_by_subject:
                 slot = pool_by_subject[s]
-                if kk <= int(o.mr_key[slot]):
+                if kk <= int(pre_mr_key[slot]):
                     continue  # already covered by an equal/stronger rumor
+                assert slot not in evicted_slots  # kernel: replace targets
+                # are excluded from eviction, so this cannot collide
                 # supersede in place: old infection column + pending cleared
                 o.minf_age[:, slot] = 0
                 if D:
                     o.pending_minf[:, :, slot] = False
-            else:
-                if fi >= len(free):
-                    continue
+            elif fi < len(free):
                 slot = free[fi]
                 fi += 1
+            elif pr and vi < len(victims):
+                slot = victims[vi]
+                vi += 1
+                evicted_slots.add(slot)
+                o.minf_age[:, slot] = 0
+                if D:
+                    o.pending_minf[:, :, slot] = False
+            else:
+                continue
             o.mr_active[slot] = True
             o.mr_subject[slot] = s
             o.mr_key[slot] = kk
@@ -678,7 +732,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
 def assert_sparse_equivalent(state: SparseState, o: _SO) -> None:
     pairs = {"tick": (int(state.tick), o.tick)}
     for name in (
-        "up", "epoch", "view_key", "n_live", "sus_key", "sus_since",
+        "up", "epoch", "joined_at", "view_key", "n_live", "sus_key",
+        "sus_since",
         "force_sync", "leaving", "mr_active", "mr_subject", "mr_key",
         "mr_created", "mr_origin", "minf_age", "rumor_active", "rumor_origin",
         "rumor_created", "infected", "infected_at", "infected_from",

@@ -223,6 +223,119 @@ def test_pool_exhaustion_heals_via_sync():
     assert dead.mean() > 0.99, f"convergence failed under pool pressure ({dead.mean():.3f})"
 
 
+def test_priority_eviction_joins_never_dropped():
+    """A full pool of majority-covered rumors must EVICT for a priority fact
+    (join self-announce) instead of dropping it — deviation 3 (r5): the
+    reference's queue admits every accepted record unconditionally
+    (GossipProtocolImpl.getGossipsToRemove:350-358 sweeps only by age), and
+    the r4 49k staleness collapse traced exactly to joins announced into a
+    saturated pool."""
+    n = 16
+    params = SP.SparseParams(
+        capacity=n, mr_slots=4, announce_slots=4, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n - 1, warm=True)
+    # fill the pool with 4 fully-covered rumors about subjects 1..4
+    for subj in (1, 2, 3, 4):
+        key = int(np.asarray(st.view_key[subj, subj])) + 4
+        st = SP.announce(st, subj, key, subj)
+    st = st.replace(
+        minf_age=jnp.where(
+            jnp.asarray(np.asarray(st.mr_active))[None, :],
+            jnp.uint8(2),
+            st.minf_age,
+        )
+    )
+    assert int(np.asarray(st.mr_active).sum()) == 4  # saturated
+    st = SP.join_row(st, n - 1, seed_rows=[0])
+    subjects = set(np.asarray(st.mr_subject)[np.asarray(st.mr_active)].tolist())
+    assert n - 1 in subjects, "join self-announce was dropped, not evicted"
+    assert int(np.asarray(st.mr_active).sum()) == 4  # still bounded
+
+
+def test_eviction_prefers_most_covered_and_spares_fresh():
+    """Eviction victim choice: highest effective coverage wins, ties to the
+    lowest slot; sub-majority (barely spread) rumors are never victims —
+    dropping the new fact is then the bounded-memory behavior (counted)."""
+    n = 16
+    params = SP.SparseParams(
+        capacity=n, mr_slots=3, announce_slots=4, seed_rows=(0,),
+    )
+    st = SP.init_sparse_state(params, n, warm=True)
+    for subj in (1, 2, 3):
+        key = int(np.asarray(st.view_key[subj, subj])) + 4
+        st = SP.announce(st, subj, key, subj)
+    # slot coverage: slot 0 fully covered, slot 1 majority (10/16),
+    # slot 2 barely spread (origin only) — victim must be slot 0
+    age = np.zeros((n, 3), np.uint8)
+    age[:, 0] = 2
+    age[:10, 1] = 2
+    age[3, 2] = 2
+    st = st.replace(minf_age=jnp.asarray(age))
+    key5 = int(np.asarray(st.view_key[5, 5])) + 4
+    st = SP.announce(st, 5, key5, 5)
+    active = np.asarray(st.mr_active)
+    subjects = np.asarray(st.mr_subject)
+    assert 5 in set(subjects[active].tolist())
+    assert 1 not in set(subjects[active].tolist()), "evicted the wrong slot"
+    assert {2, 3} <= set(subjects[active].tolist())
+    # now only sub-majority victims remain protected: a further announce
+    # finds slot 1 (10/16 covered) evictable but slot 2 (1/16) never
+    key6 = int(np.asarray(st.view_key[6, 6])) + 4
+    st = SP.announce(st, 6, key6, 6)
+    subjects = set(
+        np.asarray(st.mr_subject)[np.asarray(st.mr_active)].tolist()
+    )
+    assert 6 in subjects and 2 not in subjects and 3 in subjects
+
+
+def test_early_free_exempts_post_creation_joiners():
+    """Deviation 5 (r5): members who joined after a rumor's creation learn
+    pre-join facts via SYNC, so they must not block early-free — without the
+    exemption, continuous joins at large N pin every rumor to the full age
+    sweep (the measured r4 pool-saturation mechanism)."""
+    n = 12
+    params = SP.SparseParams(
+        capacity=n, sweep_every=2, seed_rows=(0,), early_free=True,
+        fd_every=1000, sync_every=1000,  # isolate the sweep behavior
+    )
+    st = SP.init_sparse_state(params, n - 1, warm=True)
+    key1 = int(np.asarray(st.view_key[1, 1])) + 4
+    st = SP.announce(st, 1, key1, 1)
+    # every pre-join up member infected, PAST its forwarding window
+    # (age > repeat_mult*ceil_log2(n_live) = 12): nobody can deliver the
+    # rumor to the joiner during the tick, so coverage of the joiner is
+    # impossible — exactly the large-N straggler situation
+    st = st.replace(
+        minf_age=st.minf_age.at[:, 0].set(jnp.uint8(14)).at[n - 1, 0].set(0)
+    )
+    st = st.replace(tick=jnp.int32(3))
+    st = SP.join_row(st, n - 1, seed_rows=[0])  # joiner, NOT infected
+    # suppress the joiner's force-SYNC: its re-gossip would re-announce the
+    # seed's (stale) record about subject 1 right after the sweep frees it
+    st = st.replace(force_sync=jnp.zeros_like(st.force_sync))
+    assert bool(np.asarray(st.mr_active)[0])
+    step = jax.jit(partial(SP.sparse_tick, params=params))
+    # next tick is a sweep tick (tick 4, sweep_every=2)
+    st2, _ = step(st, jax.random.PRNGKey(0))
+    mr_active = np.asarray(st2.mr_active)
+    active_subjects = np.asarray(st2.mr_subject)[mr_active]
+    # the rumor about subject 1 was freed despite the uncovered joiner;
+    # only the joiner's own self-announce may remain active
+    assert 1 not in set(active_subjects.tolist()), (
+        "early-free still blocked by a post-creation joiner"
+    )
+    # control: the same state WITHOUT the exemption would keep the slot —
+    # verified by marking the joiner as pre-creation (joined_at = 0)
+    st_ctl = st.replace(joined_at=st.joined_at.at[n - 1].set(0))
+    st3, _ = step(st_ctl, jax.random.PRNGKey(0))
+    subjects_ctl = np.asarray(st3.mr_subject)[np.asarray(st3.mr_active)]
+    assert 1 in set(subjects_ctl.tolist()), (
+        "control failed: an uncovered pre-creation member should block "
+        "early-free"
+    )
+
+
 def test_segmentation_metric():
     """A node missing an ACTIVE rumor older than its newest infection counts
     as a receive-stream gap (the reference's SequenceIdCollector

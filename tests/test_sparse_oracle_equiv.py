@@ -292,6 +292,54 @@ def test_sparse_lockstep_announce_starved(seed):
     _run_lockstep(params, st, seed, 30, mutate=mutate)
 
 
+@pytest.mark.parametrize("seed", [2, 17])
+def test_sparse_lockstep_priority_eviction_binds(seed):
+    """In-tick PRIORITY EVICTION (deviation 3, r5) oracle-verified while it
+    fires: a tiny pool under a crash wave + join bursts forces fd/expiry
+    proposals to evict most-covered rumors instead of dropping. The kernel's
+    top_k victim choice (coverage desc, lowest slot on ties) and the
+    oracle's sorted victim queue must agree bit-exactly every tick."""
+    import jax.numpy as jnp
+
+    params = SP.SparseParams(
+        capacity=24, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=1,
+        sync_every=8, suspicion_mult=2, sweep_every=2, sample_tries=6,
+        rumor_slots=2, mr_slots=4, announce_slots=6, seed_rows=(0, 1),
+        fd_accept_slots=4, refute_slots=2, sync_announce=2,
+        early_free=False,  # keep the pool full so eviction must fire
+    )
+    rng = np.random.default_rng(seed)
+    st = SP.init_sparse_state(params, 20, warm=True, dense_links=True)
+
+    def mutate(t, st):
+        if t == 2:
+            st = SP.join_rows(st, jnp.asarray([20, 21]), jnp.asarray([0, 1]))
+        if t == 6:
+            for r in (5, 9, 13):
+                st = SP.crash_row(st, int(r))
+        if t == 14:
+            st = SP.join_rows(st, jnp.asarray([22, 23]), jnp.asarray([0, 1]))
+        if t == 20:
+            st = SP.crash_row(st, int(rng.integers(2, 19)))
+        return st
+
+    step = jax.jit(partial(SP.sparse_tick, params=params))
+    key = jax.random.PRNGKey(seed)
+    evicted = dropped_prio = 0
+    for t in range(30):
+        st = mutate(t, st)
+        key, k = jax.random.split(key)
+        st_next, ms = step(st, k)
+        oracle = SO.sparse_oracle_tick(st, k, params)
+        SO.assert_sparse_equivalent(st_next, oracle)
+        st = st_next
+        evicted += int(ms["pool_evicted"])
+        dropped_prio += int(ms["announce_dropped_fd"]) + int(
+            ms["announce_dropped_expiry"]
+        )
+    assert evicted > 0, "priority eviction never fired — scenario too quiet"
+
+
 def test_sparse_lockstep_throttled_n64():
     """One N=64 throttled seed — the widest lockstep case (r3 had N=64 only
     for the dense engine)."""
