@@ -289,6 +289,74 @@ def collective_census(n: int = 98_304) -> dict:
     }
 
 
+def collective_microbench(iters: int = 200) -> dict:
+    """Measure ONE collective's cost on this 8-virtual-CPU mesh (VERDICT r4
+    item 3: close the loop on 'XLA:CPU collectives are rendezvous-bound at
+    hundreds of us' — measure it, then census x cost should reproduce the
+    observed sharded tick rate to first order).
+
+    A latency-probe all-gather ([8 x 128] f32 — small enough that wire
+    bytes are negligible, the cost is the 8-thread rendezvous) runs inside
+    a lax.scan of ``iters``; the gathered value feeds the carry so neither
+    DCE nor loop-invariant hoisting can delete it. Loop overhead is
+    measured by an identical scan without the collective and subtracted."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scalecube_cluster_tpu.ops.sharding import MEMBER_AXIS, make_mesh
+
+    mesh = make_mesh(jax.devices()[:8])
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    x = jax.device_put(x, NamedSharding(mesh, P(MEMBER_AXIS, None)))
+
+    def timed(with_collective: bool) -> float:
+        def local(xl):
+            # the carry starts DEVICE-LOCAL (varying) — a replicated
+            # jnp.float32(0) init trips shard_map's scan carry-type check
+            # once the body mixes in the local shard
+            c0 = xl.sum() * 0.0
+
+            def body(c, _):
+                y = xl + c  # carry-dependent: not loop-invariant
+                if with_collective:
+                    g = jax.lax.all_gather(y, MEMBER_AXIS)
+                    c = c + g.sum() * 1e-20
+                else:
+                    c = c + y.sum() * 1e-20
+                return c, ()
+
+            c, _ = jax.lax.scan(body, c0, None, length=iters)
+            # one pmean outside the loop makes the output replicated for
+            # out_specs=P() (identical overhead in both timed variants)
+            return jax.lax.pmean(c, MEMBER_AXIS)
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=P(MEMBER_AXIS, None), out_specs=P()
+            )
+        )
+        fn(x).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        fn(x).block_until_ready()
+        return time.perf_counter() - t0
+
+    base = timed(False)
+    coll = timed(True)
+    us = (coll - base) / iters * 1e6
+    log(f"collective microbench: {us:.1f} us/all-gather "
+        f"({coll*1e3:.1f} ms with, {base*1e3:.1f} ms without, {iters} iters)")
+    return {
+        "config": "scaling_efficiency", "variant": "collective_microbench",
+        "devices": 8, "iters": iters, "us_per_allgather": round(us, 1),
+        "note": "8-thread rendezvous latency of one small all-gather on the "
+                "virtual CPU mesh; multiply by the census count to predict "
+                "the sharded tick's collective overhead on THIS mesh (the "
+                "TPU ICI equivalent is ~1-10 us)",
+    }
+
+
 def main() -> None:
     results = measured_efficiency()
     results.append(analytic_bytes())
@@ -296,6 +364,10 @@ def main() -> None:
         results.append(collective_census())
     except Exception as e:  # census is best-effort (big compile)
         log(f"collective census failed: {e}")
+    try:
+        results.append(collective_microbench())
+    except Exception as e:
+        log(f"collective microbench failed: {e}")
     for obj in results:
         emit(obj)
 

@@ -62,7 +62,11 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    of a record ~everyone already merged (its tail heals via SYNC). Only SYNC
    re-gossip (pool duplicates by construction) and priority facts with no
    majority-covered victim are ever dropped; drops are counted per source
-   (``announce_dropped_*``) and evictions as ``pool_evicted``.
+   (``announce_dropped_*``) and evictions as ``pool_evicted``. SYNC
+   allocations additionally stop at 7/8 pool occupancy (backpressure):
+   without the reserve, the sync flood refills every freed slot with a
+   young sub-majority rumor and burst-time priority facts find no
+   evictable victim.
 4. **Bounded rejection sampling** can miss a pick with probability
    (1 - live_fraction)^T per draw (T = ``sample_tries``); a miss skips that
    probe/peer for one round — statistically negligible at the live fractions
@@ -159,7 +163,12 @@ class SparseParams:
     sync_stagger: int = 1
     suspicion_mult: int = 5
     sweep_every: int = 8
-    sample_tries: int = 8
+    # 4 tries/pick (r5 default, was 8): deviation-4 miss probability is
+    # (1-live_fraction)^4 per pick — 1e-8 at the ~0.99 live fractions SWIM
+    # operates at — and the sampler is ~7 ms/tick at 49k at tries=8 (the
+    # parameter-isolation table in docs/TPU_LAYOUT_NOTES.md); halving it
+    # was the final ~5% to >=1x realtime at N=49,152 single-chip.
+    sample_tries: int = 4
     rumor_slots: int = 16
     mr_slots: int = 1024
     announce_slots: int = 256
@@ -203,8 +212,11 @@ class SparseParams:
     ) -> "SparseParams":
         """Derive sparse-engine params from a ClusterConfig — the same
         tick-unit mapping as ``SimParams.from_config`` (one tick = one
-        gossip period), plus pool sizing (default capacity // 8, the
-        measured churn high-water with 2.5x headroom)."""
+        gossip period), plus pool sizing (default capacity // 16 — the r5
+        measured 1%/s-churn demand is ~N/27 with the joiner-exempt
+        early-free, so N/16 is ~1.7x headroom, and priority eviction +
+        sync backpressure absorb bursts beyond it; the r4 N/8 default
+        predates the early-free fix)."""
         sim = config.sim
         cap = capacity or sim.capacity or (initial_size or 0)
         if cap <= 1:
@@ -222,7 +234,7 @@ class SparseParams:
             sync_every=max(1, round(config.membership.sync_interval / dt)),
             suspicion_mult=config.membership.suspicion_mult,
             rumor_slots=sim.rumor_slots,
-            mr_slots=mr_slots or max(256, cap // 8),
+            mr_slots=mr_slots or max(256, cap // 16),
             seed_rows=tuple(seed_rows),
             delay_slots=sim.delay_slots,
             fd_direct_timeout_ticks=max(
@@ -412,7 +424,7 @@ def init_sparse_state(
     )
 
 
-def _allocate(state: SparseState, subj_p, key_p, orig_p, got, prio=None):
+def _allocate(state: SparseState, subj_p, key_p, orig_p, got, prio):
     """Allocate/supersede membership rumors for E compacted proposals.
 
     POOL INVARIANT: active slots carry UNIQUE subjects. A proposal matching
@@ -423,7 +435,8 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got, prio=None):
     already covered and are skipped. Fresh subjects take ascending free
     slots. Batch duplicates: max key wins, ties to the earliest entry.
 
-    PRIORITY EVICTION (deviation 3, r5): when ``prio`` is given, a fresh
+    PRIORITY EVICTION (deviation 3, r5): ``prio`` (required — every caller
+    must classify its proposals) marks priority entries. A fresh
     PRIORITY winner (FD verdict, suspicion expiry, refutation, join/leave
     announce — anything that is not SYNC re-gossip of pool contents) that
     finds no free slot EVICTS the active rumor closest to done: the fewest
@@ -464,43 +477,51 @@ def _allocate(state: SparseState, subj_p, key_p, orig_p, got, prio=None):
     (free,) = jnp.nonzero(~state.mr_active, size=E, fill_value=M)
     slot_fresh = free[jnp.clip(rank, 0, E - 1)]
     ok_fresh = fresh & (slot_fresh < M)
-    if prio is None:
-        ok_evict = jnp.zeros((E,), bool)
-        slot_evict = jnp.full((E,), M, jnp.int32)
-    else:
-        need = fresh & ~ok_fresh & prio
-        K = min(E, M)
-        erank_raw = jnp.cumsum(need.astype(jnp.int32)) - 1
-        erank = jnp.clip(erank_raw, 0, K - 1)
+    # SYNC-allocation backpressure (deviation 3, r5): non-priority
+    # allocations (sync re-gossip — duplicates of table state that any
+    # stale node also gets through its own sync) stop at 7/8 pool
+    # occupancy. Without the reserve, the sync flood refills every
+    # freed slot with a brand-new (sub-majority-covered) rumor, so at
+    # churn-burst time the pool holds no evictable majority-covered
+    # victims and priority facts drop — the measured 49k residual after
+    # eviction landed. rank-based: the e-th fresh winner sees occupancy
+    # a0 + rank (a conservative upper bound — replaces don't add slots).
+    cap_npr = (M * 7) // 8
+    a0 = state.mr_active.sum().astype(jnp.int32)
+    ok_fresh = ok_fresh & (prio | (a0 + rank < cap_npr))
+    need = fresh & ~ok_fresh & prio
+    K = min(E, M)
+    erank_raw = jnp.cumsum(need.astype(jnp.int32)) - 1
+    erank = jnp.clip(erank_raw, 0, K - 1)
 
-        def _ev(_):
-            # who still NEEDS each rumor: up members not exempt by the
-            # joined-after-creation rule (down members neither need nor can
-            # receive it — counting them as "covered" would let a barely-
-            # spread rumor masquerade as a victim in down-heavy clusters).
-            # The [N, M] pass runs only when a prio winner needs a slot.
-            needs = state.up[:, None] & ~(
-                state.joined_at[:, None] > state.mr_created[None, :]
-            )
-            need_m = needs.sum(axis=0).astype(jnp.int32)
-            cov_m = (needs & (state.minf_age > 0)).sum(axis=0).astype(jnp.int32)
-            replace_tgt = (
-                jnp.zeros((M + 1,), bool)
-                .at[jnp.where(replace, mslot, M)]
-                .set(True)[:M]
-            )
-            # victim = fewest still-uncovered needing members ("closest to
-            # done"), gated on a majority of its needing members covered
-            evictable = state.mr_active & ~replace_tgt & (2 * cov_m >= need_m)
-            score = jnp.where(evictable, cov_m - need_m, jnp.iinfo(jnp.int32).min)
-            vals, victims = jax.lax.top_k(score, K)  # ties -> lowest slot
-            ok_e = need & (erank_raw < K) & (vals[erank] > jnp.iinfo(jnp.int32).min)
-            return ok_e, victims[erank].astype(jnp.int32)
+    def _ev(_):
+        # who still NEEDS each rumor: up members not exempt by the
+        # joined-after-creation rule (down members neither need nor can
+        # receive it — counting them as "covered" would let a barely-
+        # spread rumor masquerade as a victim in down-heavy clusters).
+        # The [N, M] pass runs only when a prio winner needs a slot.
+        needs = state.up[:, None] & ~(
+            state.joined_at[:, None] > state.mr_created[None, :]
+        )
+        need_m = needs.sum(axis=0).astype(jnp.int32)
+        cov_m = (needs & (state.minf_age > 0)).sum(axis=0).astype(jnp.int32)
+        replace_tgt = (
+            jnp.zeros((M + 1,), bool)
+            .at[jnp.where(replace, mslot, M)]
+            .set(True)[:M]
+        )
+        # victim = fewest still-uncovered needing members ("closest to
+        # done"), gated on a majority of its needing members covered
+        evictable = state.mr_active & ~replace_tgt & (2 * cov_m >= need_m)
+        score = jnp.where(evictable, cov_m - need_m, jnp.iinfo(jnp.int32).min)
+        vals, victims = jax.lax.top_k(score, K)  # ties -> lowest slot
+        ok_e = need & (erank_raw < K) & (vals[erank] > jnp.iinfo(jnp.int32).min)
+        return ok_e, victims[erank].astype(jnp.int32)
 
-        def _no(_):
-            return jnp.zeros((E,), bool), jnp.full((E,), M, jnp.int32)
+    def _no(_):
+        return jnp.zeros((E,), bool), jnp.full((E,), M, jnp.int32)
 
-        ok_evict, slot_evict = jax.lax.cond(need.any(), _ev, _no, None)
+    ok_evict, slot_evict = jax.lax.cond(need.any(), _ev, _no, None)
     do = replace | ok_fresh | ok_evict
     slot = jnp.where(replace, mslot, jnp.minimum(slot_fresh, M - 1))
     slot = jnp.where(ok_evict, slot_evict, slot)
@@ -1423,9 +1444,23 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     rows = jnp.arange(n)
     P = params.sync_announce
     K = min(n, params.sync_slots or (n // params.sync_every + 32))
-    due = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
-    due = (due | state.force_sync) & state.up
-    (caller,) = jnp.nonzero(due, size=K, fill_value=n)
+    due_p = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
+    due_f = state.force_sync & state.up
+    due_p = due_p & state.up & ~due_f
+    # FORCE-SYNC callers take compaction slots BEFORE periodic ones (r5).
+    # The reference's join IS an immediate sync (MembershipProtocolImpl
+    # .start -> doInitialSync); with a single ascending-row compaction, a
+    # churn burst's high-row joiners queued behind ~N/sync_every periodic
+    # callers for tens of ticks — past their announce-rumor forwarding
+    # window (spread is sized by their seeds-only view), which killed their
+    # identity dissemination outright (the r4/r5 deaf-joiner collapse at
+    # 49k). Displaced periodic callers just miss one period — benign
+    # anti-entropy redundancy, and the overflow behavior the K cap already
+    # had. Layout: force callers ascending, then periodic ascending.
+    (cf,) = jnp.nonzero(due_f, size=K, fill_value=n)
+    nf = (cf < n).sum()
+    (cp,) = jnp.nonzero(due_p, size=K, fill_value=n)
+    caller = cf.at[jnp.arange(K) + nf].set(cp, mode="drop")
     valid_c = caller < n
     caller = jnp.minimum(caller, n - 1)
 
@@ -1706,10 +1741,26 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
     active pool, and assigned ascending free slots. Dropped proposals are
     counted (``announce_dropped``) — they reach stragglers via SYNC."""
     E = params.announce_slots
+    n = state.capacity
     subject = jnp.concatenate([p[0] for p in proposals])
     key = jnp.concatenate([p[1] for p in proposals])
     origin = jnp.concatenate([p[2] for p in proposals])
     valid = jnp.concatenate([p[3] for p in proposals])
+    # Pre-compaction pool dedup (r5): a proposal whose subject already has
+    # an equal-or-stronger active rumor would be SKIPPED at allocation
+    # ("already covered"), but when it lands beyond the E-compaction window
+    # it was counted as a DROP instead. Under churn most FD verdicts are
+    # duplicate suspicions of the same few subjects (every prober of a
+    # crashed node proposes the same key), which both miscounted
+    # announce_dropped_fd by orders of magnitude and crowded genuine facts
+    # out of the window. One [M]->[N] scatter builds the strongest active
+    # key per subject; covered proposals are invalidated up front.
+    pool_key_by_subject = (
+        jnp.full((n + 1,), NO_CANDIDATE, jnp.int32)
+        .at[jnp.where(state.mr_active, state.mr_subject, n)]
+        .max(jnp.where(state.mr_active, state.mr_key, NO_CANDIDATE), mode="drop")
+    )[:n]
+    valid = valid & (key > pool_key_by_subject[jnp.clip(subject, 0, n - 1)])
     L = subject.shape[0]
     # segment boundaries of the concatenated proposal vector, for per-source
     # drop attribution (r4 staleness analysis: WHICH facts the compaction

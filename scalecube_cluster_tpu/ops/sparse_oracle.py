@@ -396,15 +396,18 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     pre = o.snap()
     K = min(n, params.sync_slots or (n // params.sync_every + 32))
     P = params.sync_announce
-    due_rows = [
+    # force-sync callers take compaction slots before periodic ones (r5 —
+    # mirrors the kernel's two-stage nonzero layout: force ascending, then
+    # periodic ascending, first K)
+    due_force = [i for i in range(n) if pre.up[i] and bool(pre.force_sync[i])]
+    due_periodic = [
         i
         for i in range(n)
         if pre.up[i]
-        and (
-            ((t + i * params.sync_stagger) % params.sync_every) == 0
-            or bool(pre.force_sync[i])
-        )
-    ][:K]
+        and not bool(pre.force_sync[i])
+        and ((t + i * params.sync_stagger) % params.sync_every) == 0
+    ]
+    due_rows = (due_force[:K] + due_periodic[:K])[:K]
     seed_mask = None
     if params.seed_rows:
         seed_mask = np.zeros(n, bool)
@@ -638,6 +641,18 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     key_l = [x for p in proposals for x in p[1]]
     origin = [x for p in proposals for x in p[2]]
     valid = [x for p in proposals for x in p[3]]
+    # pre-compaction pool dedup (r5): proposals already covered by an
+    # equal-or-stronger active rumor are invalidated BEFORE the E window
+    # (mirrors the kernel's pool_key_by_subject scatter in _alloc_phase)
+    pool_key_by_subject: dict[int, int] = {}
+    for mm in range(M):
+        if o.mr_active[mm]:
+            pool_key_by_subject[int(o.mr_subject[mm])] = int(o.mr_key[mm])
+    valid = [
+        v
+        and int(key_l[ci]) > pool_key_by_subject.get(int(subject[ci]), NO_CAND)
+        for ci, v in enumerate(valid)
+    ]
     if any(valid):
         # priority classes = the first three proposal segments (fd, expiry,
         # refute); sync re-gossip never evicts (kernel's _alloc_phase prio)
@@ -694,7 +709,14 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             ),
             key=lambda m: (need_m[m] - cov_m[m], m),
         )[: min(E, M)]
-        fi = 0
+        # SYNC-allocation backpressure (deviation 3, r5): non-priority fresh
+        # allocations stop at 7/8 occupancy, exactly like the kernel's
+        # rank-based cap. A capped entry still CONSUMES its fresh rank (the
+        # kernel's cumsum rank has the same property), so the free slot it
+        # would have taken is skipped for later entries.
+        a0 = int(np.sum(o.mr_active))
+        cap_npr = (M * 7) // 8
+        fi = 0  # fresh rank: consumed by EVERY fresh win (kernel cumsum)
         vi = 0
         evicted_slots: set[int] = set()
         for s, kk, oo, pr in wins:
@@ -708,18 +730,20 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 o.minf_age[:, slot] = 0
                 if D:
                     o.pending_minf[:, :, slot] = False
-            elif fi < len(free):
-                slot = free[fi]
-                fi += 1
-            elif pr and vi < len(victims):
-                slot = victims[vi]
-                vi += 1
-                evicted_slots.add(slot)
-                o.minf_age[:, slot] = 0
-                if D:
-                    o.pending_minf[:, :, slot] = False
             else:
-                continue
+                r = fi
+                fi += 1
+                if r < len(free) and (pr or a0 + r < cap_npr):
+                    slot = free[r]
+                elif pr and vi < len(victims):
+                    slot = victims[vi]
+                    vi += 1
+                    evicted_slots.add(slot)
+                    o.minf_age[:, slot] = 0
+                    if D:
+                        o.pending_minf[:, :, slot] = False
+                else:
+                    continue
             o.mr_active[slot] = True
             o.mr_subject[slot] = s
             o.mr_key[slot] = kk

@@ -231,3 +231,209 @@ def test_namespace_visibility():
             await asyncio.gather(parent.shutdown(), child1.shutdown(), child2.shutdown())
 
     asyncio.run(run())
+
+
+# ---- r5 scenario families (VERDICT r4 item 5) ------------------------------
+
+
+def test_leave_gossip_came_before_alive():
+    """A LEAVING gossip about a never-seen member arriving BEFORE its
+    (lower-incarnation) ALIVE must win: the member appears, goes LEAVING,
+    and is removed — never resurrected by the late ALIVE (reference
+    MembershipProtocolTest.testLeaveClusterCameBeforeAlive:107-149,
+    onAliveAfterLeaving MembershipProtocolImpl.java:666-684)."""
+    from scalecube_cluster_tpu.models.member import Member
+    from scalecube_cluster_tpu.models.message import Message, Q_MEMBERSHIP_GOSSIP
+    from scalecube_cluster_tpu.models.record import MembershipRecord
+
+    async def run():
+        a, _ = await start_emulated()
+        b, _ = await start_emulated([a.address])
+        try:
+            await await_until(lambda: all(len(x.members()) == 2 for x in (a, b)))
+            phantom = Member(
+                id="leavingNodeId-1", address="memory://localhost:9236",
+                namespace="default",
+            )
+            events = []
+            a.listen_membership().subscribe(events.append)
+            # LEAVING at incarnation 5 first...
+            b.spread_gossip(Message.with_data(
+                MembershipRecord(phantom, MemberStatus.LEAVING, 5),
+                qualifier=Q_MEMBERSHIP_GOSSIP,
+            ))
+            await await_until(
+                lambda: any(e.is_leaving for e in events), timeout=5
+            )
+            # ...then the stale ALIVE at incarnation 4
+            b.spread_gossip(Message.with_data(
+                MembershipRecord(phantom, MemberStatus.ALIVE, 4),
+                qualifier=Q_MEMBERSHIP_GOSSIP,
+            ))
+            assert await await_until(
+                lambda: any(e.is_removed and e.member.id == phantom.id for e in events),
+                timeout=awaited_suspicion(3) + 5,
+            ), f"events: {events}"
+            kinds = [
+                ("added" if e.is_added else "leaving" if e.is_leaving else
+                 "removed" if e.is_removed else "other")
+                for e in events if e.member.id == phantom.id
+            ]
+            assert kinds == ["added", "leaving", "removed"], kinds
+            assert phantom.id not in trusted(a)
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown())
+
+    asyncio.run(run())
+
+
+def test_limited_seed_members():
+    """Five nodes where d and e seed only from b (which itself seeds from a):
+    the full mesh still converges — seed lists need not be complete or
+    symmetric (reference MembershipProtocolTest.testLimitedSeedMembers:
+    713-744)."""
+
+    async def run():
+        a, _ = await start_emulated()
+        b, _ = await start_emulated([a.address])
+        c, _ = await start_emulated([a.address])
+        d, _ = await start_emulated([b.address])
+        e, _ = await start_emulated([b.address])
+        nodes = (a, b, c, d, e)
+        try:
+            assert await await_until(
+                lambda: all(len(x.members()) == 5 for x in nodes), timeout=10
+            ), f"sizes: {[len(x.members()) for x in nodes]}"
+            ids = {x.member().id for x in nodes}
+            for x in nodes:
+                assert trusted(x) == ids
+                assert suspected(x) == set()
+        finally:
+            await asyncio.gather(*(x.shutdown() for x in nodes))
+
+    asyncio.run(run())
+
+
+def test_override_member_address():
+    """external_host/external_port NAT mapping: the member advertises the
+    overridden address, peers reach it through the real transport address,
+    and the cluster still converges (reference MembershipProtocolTest
+    .testOverrideMemberAddress:745-787, ClusterConfig.containerHost)."""
+
+    async def run():
+        inner = MemoryTransport(TransportConfig(port=7100))
+        emu = NetworkEmulatorTransport(inner)
+        cfg = make_test_config().replace(
+            external_host="public.example", external_port=7100
+        )
+        a = await new_cluster(cfg).transport_factory(lambda: emu).start()
+        # the NAT mapping itself: route the advertised public address to the
+        # node's bound transport (what the container's port forward does in
+        # the reference's containerHost setup)
+        MemoryTransportRegistry.default().bind(a.member().address, inner)
+        b, _ = await start_emulated([a.address])
+        try:
+            assert "public.example" in a.member().address
+            assert await await_until(
+                lambda: len(b.members()) == 2, timeout=8
+            )
+            assert a.member().id in trusted(b)
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown())
+
+    asyncio.run(run())
+
+
+def test_node_join_cluster_with_no_inbound():
+    """A joiner whose inbound is blocked never becomes a stable member (its
+    sync ACKs can't arrive, peers' pings to it fail) and itself trusts only
+    itself with no suspicions (reference MembershipProtocolTest
+    .testNodeJoinClusterWithNoInbound:788-814)."""
+
+    async def run():
+        a, _ = await start_emulated()
+        b, _ = await start_emulated([a.address])
+        await await_until(lambda: all(len(x.members()) == 2 for x in (a, b)))
+        emu_c = NetworkEmulatorTransport(MemoryTransport(TransportConfig()))
+        emu_c.network_emulator.block_all_inbound()
+        c = (
+            await new_cluster(make_test_config([a.address]))
+            .transport_factory(lambda: emu_c)
+            .start()
+        )
+        try:
+            # any transient record of c at a/b is suspected and removed
+            assert await await_until(
+                lambda: {m.id for m in a.members()}
+                == {a.member().id, b.member().id},
+                timeout=awaited_suspicion(3) + 6,
+            ), f"a.members: {[m.id for m in a.members()]}"
+            assert trusted(c) == {c.member().id}
+            assert suspected(c) == set()
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_node_join_no_inbound_then_inbound_recover():
+    """Unblocking the joiner's inbound lets the next sync round complete:
+    all three nodes converge to mutual trust (reference
+    MembershipProtocolTest.testNodeJoinClusterWithNoInboundThenInboundRecover
+    :815-851)."""
+
+    async def run():
+        a, _ = await start_emulated()
+        b, _ = await start_emulated([a.address])
+        await await_until(lambda: all(len(x.members()) == 2 for x in (a, b)))
+        emu_c = NetworkEmulatorTransport(MemoryTransport(TransportConfig()))
+        emu_c.network_emulator.block_all_inbound()
+        c = (
+            await new_cluster(make_test_config([a.address]))
+            .transport_factory(lambda: emu_c)
+            .start()
+        )
+        try:
+            await asyncio.sleep(1.0)
+            assert trusted(c) == {c.member().id}
+            emu_c.network_emulator.unblock_all_inbound()
+            ids = {a.member().id, b.member().id, c.member().id}
+            assert await await_until(
+                lambda: all(trusted(x) == ids for x in (a, b, c)),
+                timeout=awaited_suspicion(3) + 8,
+            ), f"a:{trusted(a)} b:{trusted(b)} c:{trusted(c)}"
+        finally:
+            await asyncio.gather(a.shutdown(), b.shutdown(), c.shutdown())
+
+    asyncio.run(run())
+
+
+def test_repeated_start_stop_on_fixed_port():
+    """Ten start/stop cycles of a member on one fixed port against a stable
+    seed: every restart joins as a NEW member id, the previous incarnation
+    is removed, and the seed never wedges (reference ClusterTest
+    .testMemberShutdownThenNewInstanceStarted + MembershipProtocolTest
+    .testRestartStoppedMembersOnSameAddresses:644-712)."""
+
+    async def run():
+        a, _ = await start_emulated()
+        try:
+            seen_ids = []
+            for cycle in range(10):
+                b, _ = await start_emulated([a.address], port=9100)
+                assert await await_until(
+                    lambda: b.member().id in trusted(a), timeout=8
+                ), f"cycle {cycle}: trusted(a)={trusted(a)}"
+                assert b.member().id not in seen_ids  # restart = new identity
+                seen_ids.append(b.member().id)
+                old_id = b.member().id
+                await b.shutdown()
+                assert await await_until(
+                    lambda: old_id not in trusted(a),
+                    timeout=awaited_suspicion(2) + 6,
+                ), f"cycle {cycle}: lingering {old_id}"
+            assert len(set(seen_ids)) == 10
+        finally:
+            await a.shutdown()
+
+    asyncio.run(run())
