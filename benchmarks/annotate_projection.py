@@ -104,7 +104,7 @@ def main() -> None:
         # is unmeasurable here) — express the latency floor as a sensitivity
         # and state the break-even cost at which 1x realtime dies, instead
         # of baking in 10 us as a constant
-        cnt = census["total_collectives"]
+        cnt = max(census["total_collectives"], 1)  # guard a zero-count census
         sens = {
             f"floor_ms_per_tick_at_{c}us": round(cnt * c / 1000.0, 2)
             for c in (5, 10, 50, 100)

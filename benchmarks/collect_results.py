@@ -69,16 +69,33 @@ def main() -> None:
     results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "16384"])
     if not args.quick:
         results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "32768"])
-        # pool sized for dissemination health at 49k churn (~churn/s x 25;
-        # the default N/8 saturates and join coverage collapses — see the
-        # README staleness analysis)
-        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152",
-                        "--mr-slots", "12288"], timeout=3000)
-        # flagship per-chip work proxy: 34,816^2 view cells and
-        # 34,816 x 5,760 pool cells match the 98,304/8-chip program's
-        # per-device planes — the north-star projection's primary input
+        # r5: the DEFAULT pool (N/16) is healthy at 49k — the r4 "saturates
+        # at N/8, needs 12288" account was a dissemination bug, not a pool
+        # sizing rule (see README protocol-health section)
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "49152"],
+                       timeout=3000)
+        # knee-sweep points bracketing the healthy envelope at 49k: demand
+        # high-water is ~1.8k; 1792 is marginal-healthy, 1280 collapses;
+        # 12288 reproduces the r4 configuration (healthy but 0.8x from
+        # [N, M] bandwidth)
+        for m_slots in ("12288", "1792", "1280"):
+            results += run([py, "benchmarks/config5_churn.py", "--sparse",
+                            "--n", "49152", "--mr-slots", m_slots], timeout=3000)
+        # flagship per-chip work proxy: 34,816^2 view cells/device match the
+        # 98,304/8-chip program's 12,288 x 98,304; pool 2,176 matches BOTH
+        # per-device pool cells (6,144 x 12,288 / 34,816) and pool-seconds
+        # (3.1 s at the proxy's 696 events/s vs flagship 6,144/1,966)
         results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "34816",
-                        "--mr-slots", "5760"], timeout=3000)
+                        "--mr-slots", "2176"], timeout=3000)
+        # long-haul allocation-dynamics stress (VERDICT r4 item 4): 7 sim-
+        # minutes, 1%/s churn plus a 10-s half-loss wave at t=30 (mass
+        # suspicion + refutation storm). The wave sits early so its
+        # recovery tail (suspicion timeout 80 s + refutation spread + a
+        # sync period ~ through t=170) clears the steady half the health
+        # gate judges.
+        results += run([py, "benchmarks/config5_churn.py", "--sparse", "--n", "34816",
+                        "--mr-slots", "2176", "--seconds", "420",
+                        "--loss-wave", "30:40:0.5"], timeout=3000)
     results += run([py, "benchmarks/config2b_scalar_vs_kernel_gossip.py"])
     if not args.quick:
         results += run([py, "benchmarks/config3b_scalar_vs_kernel_fd.py"],

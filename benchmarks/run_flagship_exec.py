@@ -1,0 +1,37 @@
+"""Opt-in: execute the REAL flagship shape (98,304 members / 8-way mesh) on
+the virtual CPU mesh and record the result (VERDICT r4 item 7 — upgrade the
+flagship program from "compile-proven" to "executes end-to-end somewhere").
+
+Slow by design (the CPU mesh time-slices all 8 shards; the view plane alone
+is 38.7 GB of host RAM): ticks, not throughput. Writes FLAGSHIP_EXEC_r{N}.json.
+
+    python benchmarks/run_flagship_exec.py --round 5 [--ticks 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True)
+    ap.add_argument("--ticks", type=int, default=3)
+    ap.add_argument("--n", type=int, default=98_304)
+    args = ap.parse_args()
+
+    import __graft_entry__ as g
+
+    result = g.dryrun_flagship_shape(n_devices=8, n=args.n, ticks=args.ticks)
+    out = pathlib.Path(__file__).parent.parent / f"FLAGSHIP_EXEC_r{args.round:02d}.json"
+    out.write_text(json.dumps(result, indent=1))
+    print(json.dumps({"wrote": str(out), **result}))
+
+
+if __name__ == "__main__":
+    main()

@@ -154,7 +154,7 @@ def shard_sparse_state(state, mesh: Mesh):
 
 
 def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
-    from .sparse import sparse_tick
+    from .sparse import mesh_context, sparse_tick
 
     if params.capacity % mesh.size != 0:
         raise ValueError(
@@ -162,21 +162,35 @@ def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
         )
     sh = sparse_state_shardings(mesh, dense_links, params.delay_slots)
     rep = NamedSharding(mesh, P())
-    return jax.jit(
-        partial(sparse_tick, params=params),
-        in_shardings=(sh, rep),
-        out_shardings=(sh, None),
-    )
+
+    def fn(state, key):
+        # the context is active DURING TRACING, which is when the tick's
+        # internal with_sharding_constraint calls (the word-sharded apply
+        # staging — see _mr_apply) need the mesh
+        with mesh_context(mesh):
+            return sparse_tick(state, key, params)
+
+    return jax.jit(fn, in_shardings=(sh, rep), out_shardings=(sh, None))
 
 
 def make_sharded_sparse_run(mesh: Mesh, params, n_ticks: int):
-    from .sparse import run_sparse_ticks
+    from .sparse import mesh_context, run_sparse_ticks
 
     if params.capacity % mesh.size != 0:
         raise ValueError(
             f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
         )
-    return jax.jit(partial(run_sparse_ticks, n_ticks=n_ticks, params=params))
+
+    def fn(state, key, watch_rows=None):
+        with mesh_context(mesh):
+            return run_sparse_ticks(
+                state, key, n_ticks, params, watch_rows=watch_rows
+            )
+
+    # donate the carried state like the single-device path — without it the
+    # window holds input AND output state copies (38.7 GB extra at the
+    # flagship shape's view plane)
+    return jax.jit(fn, donate_argnums=0)
 
 
 def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: bool = True):

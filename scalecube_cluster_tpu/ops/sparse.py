@@ -110,6 +110,8 @@ view matrix alone and can never fit one chip.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 
 import jax
@@ -140,6 +142,40 @@ from .rand import (
 from .state import ALIVE0_KEY, NEVER, NO_CANDIDATE_I32, delay_mean_to_q
 
 NO_CANDIDATE = NO_CANDIDATE_I32
+
+# Active device mesh during sharded tracing (set by the sharding module's
+# make_sharded_sparse_* builders). The tick itself is mesh-agnostic; a few
+# staging tensors carry explicit sharding constraints when a mesh is active
+# because GSPMD's default placement for them forces per-block all-gathers
+# (see _mr_apply's word-sharded delivery bitmap).
+_ACTIVE_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "sparse_active_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def mesh_context(mesh):
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
+def _constrain(x, *spec):
+    """with_sharding_constraint iff a sharded trace is active (no-op on the
+    single-device path). ``"member"`` entries resolve to the active mesh's
+    member axis."""
+    mesh = _ACTIVE_MESH.get()
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    spec = tuple(axis if s == "member" else s for s in spec)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec))
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1282,11 +1318,25 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 .at[subj_rows]
                 .max(_pack_bits(newly.T), mode="drop")
             )  # [subject, packed observers]
+            # Sharded placement (r5, VERDICT r4 item 3): WORD-sharded, not
+            # subject-sharded. Each device needs ALL subjects' bits for ITS
+            # observers — with the default subject-row sharding, every
+            # column-block dynamic_slice below all-gathers its subject
+            # range (298 all-gathers/tick in the r4 census). Word-sharding
+            # aligns with the observer row shards (rows/device is a
+            # multiple of 32 at every real mesh size), the packing of
+            # newly.T is word-local, and the subject-row scatter writes
+            # each device's own word columns — the whole staging and the
+            # block walk become collective-free.
+            nd_T_p = _constrain(nd_T_p, None, "member")
             cand_j = (
                 jnp.full((n,), NO_CANDIDATE, jnp.int32)
                 .at[subj_rows]
                 .max(jnp.where(state.mr_active, state.mr_key, NO_CANDIDATE), mode="drop")
             )
+            # replicated: built from replicated pool vectors, read by every
+            # device's block walk
+            cand_j = _constrain(cand_j, None)
             bit_idx = jnp.arange(32, dtype=jnp.uint32)
 
             NB = _chunk(n, params.apply_block, 8192, 2048)
@@ -1463,6 +1513,12 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     caller = cf.at[jnp.arange(K) + nf].set(cp, mode="drop")
     valid_c = caller < n
     caller = jnp.minimum(caller, n - 1)
+    # replicate the K-staging at its SOURCE: every [K]-indexed vector below
+    # derives from `caller`, and without the constraint GSPMD re-gathers
+    # each one independently (~40 small all-gathers/tick in the op-def
+    # census — the largest collective class in the sharded program)
+    caller = _constrain(caller, None)
+    valid_c = _constrain(valid_c, None)
 
     if params.seed_rows:
         seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
@@ -1471,8 +1527,8 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     peer_idx, peer_valid = _sample_rejection(
         state, caller, r.sync_try[caller], 1, params.sample_tries, extra_mask=seed_mask
     )
-    peer = peer_idx[:, 0]
-    valid_pick = peer_valid[:, 0]
+    peer = _constrain(peer_idx[:, 0], None)
+    valid_pick = _constrain(peer_valid[:, 0], None)
     if params.seed_rows:
         # Seed fallback: a caller whose live view is too sparse for rejection
         # sampling (a fresh joiner knows only the seeds — ~S/N hit rate)
@@ -1495,7 +1551,10 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
             _delay_q_at(state, peer, caller),
             params.sync_timeout_ticks,
         )
-    ok = valid_c & valid_pick & state.up[peer] & (r.sync_edge[caller] < p_rt)
+    ok = _constrain(
+        valid_c & valid_pick & state.up[peer] & (r.sync_edge[caller] < p_rt),
+        None,
+    )
 
     # NO-REGATHER staging (round 4): the tick must never row-gather from a
     # big buffer it just scattered into — XLA's mini-gather lowering stages
