@@ -130,31 +130,80 @@ def main() -> None:
             micro["us_per_allgather"] * census["total_collectives"] / 1000.0, 1
         )
         obs = cells.get("mesh8", {}).get("ticks_per_s")
+        obs_ms = round(1000.0 / obs, 0) if obs else None
+        # the measurement host's core count, recorded IN the measurement
+        # (annotation may run elsewhere); pre-r5 records lack it
+        ncores = cells.get("host_cores") or 1
+        floor = cells.get(
+            "compute_serialization_floor", round(min(1.0, ncores / 8), 3)
+        )
         collectives["cpu_mesh_closure"] = (
-            f"measured {micro['us_per_allgather']} us per all-gather on the "
-            f"8-virtual-CPU mesh x {census['total_collectives']} "
-            f"collectives/tick = {pred_ms} ms/tick of predicted collective "
-            f"overhead vs the observed {obs} ticks/s "
-            f"({round(1000.0 / obs, 0) if obs else '?'} ms/tick) — the "
-            "rendezvous-bound CPU collective cost explains the low CPU-mesh "
-            "scaling ratio by arithmetic, not rhetoric"
+            f"measured {micro['us_per_allgather']} us per all-gather x "
+            f"{census['total_collectives']} collectives/tick = {pred_ms} "
+            f"ms/tick of collective overhead vs {obs_ms} ms/tick observed "
+            f"on the 8-virtual-device mesh — i.e. collectives are "
+            f"{round(100.0 * pred_ms / obs_ms, 1) if obs_ms else '?'}% of "
+            f"the CPU-mesh tick. The low cells-matched ratio is the "
+            f"measurement host's compute serialization (8 virtual devices "
+            f"time-slicing {ncores} core(s): floor {floor}), NOT "
+            "communication — measured, closing the r4 loop: the CPU-mesh "
+            "ratio says nothing about ICI, the census x per-collective "
+            "cost does"
         )
     if cells:
         collectives["cpu_mesh_measured_ratio"] = (
             f"{cells['scaling_efficiency']} at equal per-device cells on the "
-            "8-virtual-CPU mesh — a heavily pessimistic lower bound (XLA:CPU "
-            "collectives are thread-rendezvous-bound at hundreds of us each, "
-            "see the census for the TPU-relevant latency figure)"
+            "8-virtual-CPU mesh — bounded below by the host's core count "
+            "(virtual devices time-slice the physical cores), see "
+            "cpu_mesh_closure for the decomposition"
         )
 
+    flag_exec = None
+    flag_path = ROOT / f"FLAGSHIP_EXEC_r{args.round:02d}.json"
+    if flag_path.exists():
+        flag = json.loads(flag_path.read_text())
+        if flag.get("ok"):
+            flag_exec = flag
+            evidence.append(
+                f"the EXACT flagship program ({flag['n']:,} members / "
+                f"{flag['devices']}-way mesh, churn burst + "
+                f"{flag['ticks']} ticks) executed end-to-end on the "
+                f"virtual CPU mesh ({flag['wall_seconds']} s wall — "
+                "execution proof, not throughput; "
+                f"FLAGSHIP_EXEC_r{args.round:02d}.json)"
+            )
+    # the status asserts only what the evidence list actually carries
+    status_parts = []
+    if proxy and proxy.get("ok"):
+        margin_x = round(proxy["speedup_vs_realtime"], 2)
+        status_parts.append(
+            f"single-chip per-chip proxy at {margin_x}x realtime"
+            + (" incl. a partition-wave stress run"
+               if find(lambda c: c.get("loss_wave") and c.get("ok")) else "")
+        )
+    if sparse_proof:
+        status_parts.append("compile proof")
+    if flag_exec:
+        status_parts.append(
+            "an end-to-end execution of the exact flagship shape on the "
+            "CPU mesh"
+        )
+    if census or analytic:
+        status_parts.append(
+            "volume/latency bounds"
+            + (" with a measured per-collective sensitivity" if micro else "")
+            + " on the cross-chip term"
+        )
     data["north_star_projection"] = {
         "claim": "98,304 members, 1%/s churn, >=1x realtime on v5e-8",
         "evidence": evidence,
         "collectives_term_bounds": collectives,
         "status": (
-            "projected from single-chip measurement + compile proof + "
-            "volume/latency bounds on the cross-chip term; execution "
-            "evidence needs the real 8-chip slice"
+            "projected from " + " + ".join(status_parts)
+            + "; per-chip REALTIME on a real 8-chip slice remains the one "
+            "unmeasured input"
+            if status_parts
+            else "insufficient recorded evidence — rerun the matrix"
         ),
     }
     data["measurement_variance_note"] = (

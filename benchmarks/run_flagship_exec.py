@@ -12,10 +12,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+# XLA:CPU's in-process collectives ABORT the process when a device thread
+# waits >40 s at a rendezvous ("Termination timeout ... Exiting to ensure a
+# consistent program state"). With 8 virtual devices time-slicing this
+# host's core(s), the 98k per-device compute between collectives far
+# exceeds that — raise both knobs before the CPU client exists.
+if "collective_call_terminate" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+    ).strip()
 
 
 def main() -> None:

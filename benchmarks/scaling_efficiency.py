@@ -41,6 +41,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
     os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+# XLA:CPU aborts the PROCESS when a virtual device waits >40 s at a
+# collective rendezvous; with the devices time-slicing few physical cores
+# the big sharded measures can exceed that under host contention (the
+# cause of the r5 matrix's mid-stage abort in AllGatherThunk::Execute)
+if "collective_call_terminate" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+    )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -144,9 +153,13 @@ def measured_efficiency() -> list:
             "ticks_per_s": round(t8, 2),
         },
         "scaling_efficiency": round(t8 / t1c, 3),
+        "host_cores": os.cpu_count(),
+        "compute_serialization_floor": round(min(1.0, (os.cpu_count() or 1) / 8), 3),
         "note": "equal per-device view-matrix cells (the flagship argument's "
                 "shape: 98k/8 chips is 1.21G cells/chip vs 1.07G at 32k "
-                "single) — the ratio is the collectives+skew term",
+                "single) — the ratio folds collectives, skew, AND the "
+                "host's virtual-device compute serialization "
+                "(floor = host_cores/8); see cpu_mesh_closure",
     })
     out.append({
         "config": "scaling_efficiency", "variant": "rows_matched_context",
