@@ -109,7 +109,7 @@ def main() -> None:
             f"floor_ms_per_tick_at_{c}us": round(cnt * c / 1000.0, 2)
             for c in (5, 10, 50, 100)
         }
-        if proxy and proxy.get("ok"):
+        if proxy and proxy.get("ok") and proxy["speedup_vs_realtime"] > 1.0:
             margin_ms = round(
                 (1.0 - 1.0 / proxy["speedup_vs_realtime"]) * 200.0, 1
             )
@@ -122,6 +122,18 @@ def main() -> None:
                 f"exceeds ~{sens['break_even_us_per_collective']} us "
                 f"(= {margin_ms} ms single-chip margin / {cnt} collectives); "
                 "TPU ICI collective launch is ~1-10 us, 1-2 orders below"
+            )
+        elif proxy and proxy.get("ok"):
+            # at (or below) 1x realtime there is NO margin to spend on
+            # collectives — a negative break-even would be nonsense
+            # (ADVICE r5); state it explicitly instead
+            sens["per_chip_margin_ms_at_realtime"] = 0.0
+            sens["note"] = (
+                "no margin at 1x: the per-chip proxy measured "
+                f"{proxy['speedup_vs_realtime']}x realtime (<= 1), so the "
+                "cross-chip term has zero latency budget — the flagship "
+                "claim needs a per-chip speedup first, not a cheaper "
+                "collective"
             )
         collectives["latency_sensitivity"] = sens
     micro = find(lambda c: c.get("variant") == "collective_microbench")

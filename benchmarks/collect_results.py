@@ -101,6 +101,9 @@ def main() -> None:
         results += run([py, "benchmarks/config3b_scalar_vs_kernel_fd.py"],
                        timeout=3000)
     results += run([py, "benchmarks/config4b_scalar_vs_kernel_detection.py"])
+    # r6 dispatch-pipeline before/after (donated + async driver vs the
+    # legacy per-window sync loop, dense N=4096)
+    results += run([py, "benchmarks/config6_dispatch.py"])
     results += run([py, "benchmarks/compile_proof_100k.py"])
     results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)

@@ -1,0 +1,217 @@
+"""Driver config #6: dispatch-pipeline before/after (the r6 tentpole).
+
+Measures what the pipelined tick engine actually buys on the driver→kernel
+dispatch path, dense N=4096 (the headline shape), CPU or TPU:
+
+* **legacy** — the pre-r6 driver loop, reproduced exactly: an UN-donated
+  jitted window (XLA copies every [N, N] plane — view_key, changed_at,
+  loss, fetch_rt, delay_q — at window entry) followed by a per-window
+  device→host readback of every metric plus the host-side counter folds
+  ``SimDriver.step()`` used to do. Each window therefore runs
+  copy → compute → sync → host work, serialized.
+* **pipelined** — the r6 ``SimDriver``: donated buffers (in-place state),
+  device-side health reductions, zero per-window transfers; the host
+  enqueues windows back-to-back and syncs ONCE at the end.
+* **floor** — the same total ticks as ONE fused scan (a single dispatch,
+  no per-window boundary at all): the pure-device reference that turns the
+  two loop timings into a host-overhead fraction.
+
+Timing is median-of-``--reps`` (default 5) spans per variant, interleaved
+A/B so drift hits both equally. Emits one JSON line with the media
+ticks/s per variant, the speedup ratio (acceptance: >= 1.3x on dense
+N=4096 CPU), host-overhead fractions, and the driver's dispatch/readback
+counters proving the no-consumer path stayed transfer-free.
+
+    python benchmarks/config6_dispatch.py [--n 4096] [--windows 24]
+        [--window-ticks 1] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+import numpy as np
+
+from common import emit, log
+
+TICK_SECONDS = 0.2
+
+
+def _params(n: int):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
+    )
+
+
+# The health-counter names the pre-r6 SimDriver.step() folded host-side
+# every window (none exist in dense metrics, but the dict scan itself —
+# and the np.asarray of every metric — is part of the legacy cost).
+_LEGACY_COUNTERS = (
+    "announce_dropped", "announce_dropped_fd", "announce_dropped_expiry",
+    "announce_dropped_refute", "announce_dropped_sync", "pool_evicted",
+    "announced", "announce_dropped_host",
+)
+
+
+class LegacyLoop:
+    """The pre-r6 engine, bit-for-bit: un-donated window + per-window full
+    metrics readback + host counter folds + per-window last-tick dict."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.ops.kernel import make_run
+        from scalecube_cluster_tpu.ops.state import init_state
+
+        params = _params(n)
+        self.windows = windows
+        self.step = make_run(params, window_ticks, donate=False)
+        self.state = init_state(params, n, warm=True)
+        self.key = jax.random.PRNGKey(0)
+        self.readbacks = 0
+        self.span_count = 0
+        self.state, self.key, _ms, _w = self.step(self.state, self.key)
+        jax.block_until_ready(self.state)  # compile + warm
+
+    def span(self) -> float:
+        t0 = time.perf_counter()
+        for _w_i in range(self.windows):
+            self.state, self.key, ms, _w = self.step(self.state, self.key)
+            counters = dict.fromkeys(_LEGACY_COUNTERS, 0)
+            for name in counters:
+                if name in ms:
+                    counters[name] += int(np.asarray(ms[name]).sum())
+            if "gossip_segmentation" in ms:
+                worst = int(np.asarray(ms["gossip_segmentation"]).max())
+                assert worst >= 0
+            last = {name: np.asarray(v[-1]) for name, v in ms.items()}
+            self.readbacks += len(last) + 1
+        jax.block_until_ready(self.state)
+        self.span_count += 1
+        return time.perf_counter() - t0
+
+
+class PipelinedLoop:
+    """The r6 SimDriver with no consumer attached: donated windows, zero
+    per-window transfers, one sync per span."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.d = SimDriver(_params(n), n, warm=True, seed=0)
+        self.d.step(window_ticks)  # compile + warm
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        t0 = time.perf_counter()
+        for _w_i in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        assert self.d.dispatch_stats["readbacks"] == base, (
+            "no-consumer step() performed a device->host readback"
+        )
+        return dt
+
+
+class FloorLoop:
+    """All ticks as ONE donated scan — the no-dispatch-boundary reference."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.ops.kernel import make_run
+        from scalecube_cluster_tpu.ops.state import init_state
+
+        params = _params(n)
+        self.step = make_run(params, windows * window_ticks)
+        self.state = init_state(params, n, warm=True)
+        self.key = jax.random.PRNGKey(0)
+        self.state, self.key, _ms, _w = self.step(self.state, self.key)
+        jax.block_until_ready(self.state)
+
+    def span(self) -> float:
+        t0 = time.perf_counter()
+        self.state, self.key, _ms, _w = self.step(self.state, self.key)
+        jax.block_until_ready(self.state)
+        return time.perf_counter() - t0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"warming 3 variants: N={args.n}, {args.reps} x {args.windows} "
+        f"windows of {args.window_ticks} tick(s)")
+    legacy_loop = LegacyLoop(args.n, args.windows, args.window_ticks)
+    pipe_loop = PipelinedLoop(args.n, args.windows, args.window_ticks)
+    floor_loop = FloorLoop(args.n, args.windows, args.window_ticks)
+
+    # INTERLEAVED reps (legacy/pipelined/floor per round) so host drift —
+    # thermal throttling, background load ramps — hits all variants alike
+    legacy_spans, pipe_spans, floor_spans = [], [], []
+    for rep in range(args.reps):
+        legacy_spans.append(legacy_loop.span())
+        pipe_spans.append(pipe_loop.span())
+        floor_spans.append(floor_loop.span())
+        log(f"rep {rep}: legacy {legacy_spans[-1]:.3f}s, "
+            f"pipelined {pipe_spans[-1]:.3f}s, floor {floor_spans[-1]:.3f}s")
+    total = args.windows * args.window_ticks
+    legacy_rb = legacy_loop.readbacks / max(legacy_loop.span_count * args.windows, 1)
+    dispatch = pipe_loop.d.dispatch_snapshot()
+
+    legacy = statistics.median(legacy_spans)
+    pipe = statistics.median(pipe_spans)
+    floor = statistics.median(floor_spans)
+    result = {
+        "config": 6,
+        "variant": "dispatch_pipeline",
+        "n": args.n,
+        "engine": "dense",
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "legacy_ticks_per_s": round(total / legacy, 1),
+        "pipelined_ticks_per_s": round(total / pipe, 1),
+        "fused_floor_ticks_per_s": round(total / floor, 1),
+        "speedup_pipelined_vs_legacy": round(legacy / pipe, 3),
+        # host-overhead fraction: time above the no-boundary device floor
+        "host_overhead_fraction_legacy": round(max(0.0, 1 - floor / legacy), 4),
+        "host_overhead_fraction_pipelined": round(max(0.0, 1 - floor / pipe), 4),
+        "legacy_readbacks_per_window": round(legacy_rb, 1),
+        "pipelined_dispatch": dispatch,
+        "spans_s": {
+            "legacy": [round(s, 4) for s in legacy_spans],
+            "pipelined": [round(s, 4) for s in pipe_spans],
+            "fused_floor": [round(s, 4) for s in floor_spans],
+        },
+    }
+    if cache_dir:
+        result["compile_cache"] = compile_cache.compile_cache_report()
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,9 +38,20 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=98_304)
     args = ap.parse_args()
 
+    # the 98k program's compile dominated the r5 wall clock (51 min,
+    # FLAGSHIP_EXEC_r05.json); with SCALECUBE_COMPILE_CACHE_DIR set, a
+    # re-execution loads the compiled executable from disk instead
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        print(f"persistent compile cache: {cache_dir}", file=sys.stderr)
+
     import __graft_entry__ as g
 
     result = g.dryrun_flagship_shape(n_devices=8, n=args.n, ticks=args.ticks)
+    if cache_dir:
+        result["compile_cache"] = compile_cache.compile_cache_report()
     out = pathlib.Path(__file__).parent.parent / f"FLAGSHIP_EXEC_r{args.round:02d}.json"
     out.write_text(json.dumps(result, indent=1))
     print(json.dumps({"wrote": str(out), **result}))

@@ -108,10 +108,7 @@ def _measure(n: int, m: int, mesh=None, label: str = "") -> float:
         # apply staging) — the same program the census counts
         step = make_sharded_sparse_run(mesh, params, TICKS)
     else:
-        step = jax.jit(
-            partial(SP.run_sparse_ticks, n_ticks=TICKS, params=params),
-            donate_argnums=0,
-        )
+        step = SP.make_sparse_run(params, TICKS)
     key = jax.random.PRNGKey(0)
     state, key, _ms, _w = step(state, key)  # compile + warm
     jax.block_until_ready(state)
@@ -338,7 +335,11 @@ def collective_microbench(iters: int = 200) -> dict:
     bytes are negligible, the cost is the 8-thread rendezvous) runs inside
     a lax.scan of ``iters``; the gathered value feeds the carry so neither
     DCE nor loop-invariant hoisting can delete it. Loop overhead is
-    measured by an identical scan without the collective and subtracted."""
+    measured by an identical scan without the collective and subtracted.
+    Each variant is timed ``reps`` times and the MEDIANS are differenced
+    (ADVICE r5): a single post-warmup run is one scheduler hiccup away
+    from skewing us_per_allgather, which feeds the cpu_mesh_closure
+    percentage in the projection artifact."""
     from functools import partial
 
     from jax.experimental.shard_map import shard_map
@@ -350,7 +351,9 @@ def collective_microbench(iters: int = 200) -> dict:
     x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
     x = jax.device_put(x, NamedSharding(mesh, P(MEMBER_AXIS, None)))
 
-    def timed(with_collective: bool) -> float:
+    reps = 5
+
+    def timed(with_collective: bool) -> list:
         def local(xl):
             # the carry starts DEVICE-LOCAL (varying) — a replicated
             # jnp.float32(0) init trips shard_map's scan carry-type check
@@ -377,18 +380,33 @@ def collective_microbench(iters: int = 200) -> dict:
             )
         )
         fn(x).block_until_ready()  # compile + warm
-        t0 = time.perf_counter()
-        fn(x).block_until_ready()
-        return time.perf_counter() - t0
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return ts
 
-    base = timed(False)
-    coll = timed(True)
+    import statistics
+
+    base_ts = timed(False)
+    coll_ts = timed(True)
+    base = statistics.median(base_ts)
+    coll = statistics.median(coll_ts)
     us = (coll - base) / iters * 1e6
     log(f"collective microbench: {us:.1f} us/all-gather "
-        f"({coll*1e3:.1f} ms with, {base*1e3:.1f} ms without, {iters} iters)")
+        f"(median of {reps}: {coll*1e3:.1f} ms with, {base*1e3:.1f} ms "
+        f"without, {iters} iters; spreads "
+        f"{[round(t*1e3, 1) for t in coll_ts]} / "
+        f"{[round(t*1e3, 1) for t in base_ts]})")
     return {
         "config": "scaling_efficiency", "variant": "collective_microbench",
-        "devices": 8, "iters": iters, "us_per_allgather": round(us, 1),
+        "devices": 8, "iters": iters, "reps": reps,
+        "us_per_allgather": round(us, 1),
+        "spread_ms": {
+            "with": [round(t * 1e3, 2) for t in coll_ts],
+            "without": [round(t * 1e3, 2) for t in base_ts],
+        },
         "note": "8-thread rendezvous latency of one small all-gather on the "
                 "virtual CPU mesh; multiply by the census count to predict "
                 "the sharded tick's collective overhead on THIS mesh (the "

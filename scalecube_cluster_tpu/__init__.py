@@ -11,6 +11,10 @@ implementation behind the same pluggable ``Transport`` boundary
 Public API mirrors the reference ``Cluster`` facade (``Cluster.java:10-151``).
 """
 
+from .compile_cache import (
+    compile_cache_report,
+    enable_persistent_compile_cache,
+)
 from .config import (
     ClusterConfig,
     FailureDetectorConfig,
@@ -40,5 +44,7 @@ __all__ = [
     "FailureDetectorEvent",
     "Message",
     "new_member_id",
+    "enable_persistent_compile_cache",
+    "compile_cache_report",
     "__version__",
 ]

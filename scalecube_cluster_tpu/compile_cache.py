@@ -1,0 +1,126 @@
+"""Persistent XLA compilation cache wiring + in-process jit audit.
+
+The flagship program (98,304 members / 8-way mesh) recompiles from scratch
+in every process — 51 minutes of the r5 flagship wall clock was
+non-overlapped compile + execute (``FLAGSHIP_EXEC_r05.json``). XLA ships a
+persistent on-disk compilation cache keyed on the lowered HLO (which covers
+capacity, mesh shape, and every static ``SimParams``/``SparseParams`` knob,
+since they are all baked into the traced program); enabling it makes
+repeated bench runs and flagship re-executions skip compilation entirely.
+
+Two layers, both exposed here:
+
+* :func:`enable_persistent_compile_cache` — point JAX at a cache directory
+  (``ClusterConfig.sim.compile_cache_dir`` > ``SCALECUBE_COMPILE_CACHE_DIR``
+  env > explicit argument). Safe to call late: JAX latches its
+  "is the cache usable" decision at the first compile, so this resets that
+  latch when supported.
+* :func:`compile_cache_report` — what the on-disk cache currently holds
+  (entry count + bytes), for bench artifacts and the monitor audit.
+
+The in-process side (which jitted window programs exist, how often each was
+dispatched, what the first dispatch cost) lives on the driver:
+``SimDriver.jit_cache_audit()`` merges its ``_step_cache`` stats with this
+module's on-disk report.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import stat as _stat
+import time
+from typing import Any, Dict, Optional
+
+ENV_VAR = "SCALECUBE_COMPILE_CACHE_DIR"
+
+_enabled_dir: Optional[str] = None
+
+
+def resolve_cache_dir(cache_dir: Optional[str] = None, config=None) -> Optional[str]:
+    """Resolution order: explicit arg > ``config.sim.compile_cache_dir`` >
+    ``SCALECUBE_COMPILE_CACHE_DIR`` env. None means "leave disabled"."""
+    if cache_dir:
+        return cache_dir
+    if config is not None:
+        sim = getattr(config, "sim", None)
+        if sim is not None and getattr(sim, "compile_cache_dir", None):
+            return sim.compile_cache_dir
+    return os.environ.get(ENV_VAR) or None
+
+
+def enable_persistent_compile_cache(
+    cache_dir: Optional[str] = None, config=None
+) -> Optional[str]:
+    """Enable JAX's persistent compilation cache at the resolved directory.
+
+    Returns the directory in effect (created if missing), or None when no
+    directory is configured anywhere — in which case nothing changes.
+    Thresholds are dropped to zero so even the small test-size programs
+    cache (the default gates skip sub-second compiles, which would make the
+    cache look broken in smoke runs). Idempotent; never raises on an older
+    jax without the knobs (the cache is then simply not enabled).
+    """
+    global _enabled_dir
+    path = resolve_cache_dir(cache_dir, config)
+    if not path:
+        return None
+    if _enabled_dir == path:
+        return path
+    import jax
+
+    pathlib.Path(path).mkdir(parents=True, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — knob varies across jax versions
+        return None
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 — thresholds are an optimization only:
+        pass  # the directory alone enables caching (default gates apply)
+    # JAX latches cache usability at the FIRST compile of the process; if
+    # anything compiled before this call (a warmup op, another module's
+    # import-time jit), the latch reads "no cache dir" forever. Reset it so
+    # late enabling still takes effect; best-effort across jax versions.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001
+        pass
+    _enabled_dir = path
+    return path
+
+
+def enabled_cache_dir() -> Optional[str]:
+    """The directory a successful enable call put in effect (None if never)."""
+    return _enabled_dir
+
+
+def compile_cache_report(cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """On-disk cache audit: entry count, total bytes, newest entry age.
+
+    The directory actually ENABLED takes precedence over the env var — an
+    audit must describe the cache in effect, not a configured-but-unused
+    one. One stat per entry (this runs on every /dispatch poll)."""
+    path = cache_dir or _enabled_dir or resolve_cache_dir(None)
+    if not path or not os.path.isdir(path):
+        return {"enabled": _enabled_dir is not None, "dir": path, "entries": 0,
+                "total_bytes": 0}
+    stats = []
+    for p in pathlib.Path(path).iterdir():
+        try:
+            s = p.stat()
+        except OSError:  # entry evicted/renamed by a concurrent process
+            continue
+        if _stat.S_ISREG(s.st_mode):
+            stats.append(s)
+    newest = max((s.st_mtime for s in stats), default=0.0)
+    return {
+        "enabled": _enabled_dir == path,
+        "dir": path,
+        "entries": len(stats),
+        "total_bytes": int(sum(s.st_size for s in stats)),
+        "newest_entry_age_s": round(time.time() - newest, 1) if stats else None,
+    }

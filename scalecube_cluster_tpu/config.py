@@ -145,6 +145,12 @@ class SimConfig:
     dense_links: bool = True  # dense NxN loss/delay matrices (sim emulator)
     delay_slots: int = 0  # pending-delivery ring depth (max link delay + 1 ticks)
     seed: int = 0
+    # Persistent XLA compilation-cache directory (None = disabled; the
+    # SCALECUBE_COMPILE_CACHE_DIR env var is the non-config fallback).
+    # Keyed on the lowered program, which covers capacity / mesh / every
+    # static kernel knob — repeated bench runs and the flagship program
+    # skip recompilation (see scalecube_cluster_tpu.compile_cache).
+    compile_cache_dir: Optional[str] = None
 
     def replace(self, **kw) -> "SimConfig":
         return replace(self, **kw)

@@ -843,3 +843,25 @@ def run_ticks(
     (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
     watched = ms.pop("_watched_keys") if watch_rows is not None else None
     return state, key, ms, watched
+
+
+def make_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Jitted :func:`run_ticks` window with the state buffers DONATED.
+
+    Donation lets XLA update the carried state in place instead of copying
+    every [N, N] plane (view_key, changed_at, loss, fetch_rt, delay_q —
+    ~5 x 67 MB per window at N=4096) at window entry; combined with JAX
+    async dispatch this is what makes back-to-back windows run device-bound
+    (the driver's pipelined step). The caller must treat the state it
+    passed in as CONSUMED — only the returned state is valid afterwards,
+    which is exactly how ``SimDriver`` (and every bench loop here) already
+    threads it. ``donate=False`` builds the copying variant, kept for
+    before/after measurement (benchmarks/config6_dispatch.py) and for
+    callers that must retain the input (lockstep equivalence tests).
+    """
+    from functools import partial
+
+    return jax.jit(
+        partial(run_ticks, n_ticks=n_ticks, params=params),
+        donate_argnums=0 if donate else (),
+    )

@@ -153,13 +153,31 @@ def shard_sparse_state(state, mesh: Mesh):
     )
 
 
-def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
-    from .sparse import mesh_context, sparse_tick
-
+def _check_sparse_word_alignment(mesh: Mesh, params) -> None:
+    """Sparse-tick mesh preconditions. Beyond plain row divisibility, the
+    word-sharded apply staging (``sparse._mr_apply``'s ``nd_T_p`` constraint,
+    P(None, 'member')) requires rows-per-device to be a multiple of 32 so
+    packed observer words align with the observer row shards — otherwise
+    GSPMD pads the word axis and the collective-free packed-word block walk
+    silently regresses into per-block all-gathers. Assert it up front."""
     if params.capacity % mesh.size != 0:
         raise ValueError(
             f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
         )
+    if params.capacity % (32 * mesh.size) != 0:
+        raise ValueError(
+            f"capacity {params.capacity} must be divisible by 32 * mesh size "
+            f"({32 * mesh.size}): the word-sharded apply staging packs "
+            "observers into u32 words that must align with the row shards "
+            "(pad capacity up to the next multiple and leave the extra rows "
+            "up=False — masks make padding free)"
+        )
+
+
+def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
+    from .sparse import mesh_context, sparse_tick
+
+    _check_sparse_word_alignment(mesh, params)
     sh = sparse_state_shardings(mesh, dense_links, params.delay_slots)
     rep = NamedSharding(mesh, P())
 
@@ -176,10 +194,7 @@ def make_sharded_sparse_tick(mesh: Mesh, params, dense_links: bool = False):
 def make_sharded_sparse_run(mesh: Mesh, params, n_ticks: int):
     from .sparse import mesh_context, run_sparse_ticks
 
-    if params.capacity % mesh.size != 0:
-        raise ValueError(
-            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
-        )
+    _check_sparse_word_alignment(mesh, params)
 
     def fn(state, key, watch_rows=None):
         with mesh_context(mesh):
@@ -198,9 +213,13 @@ def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: b
 
     Input state must already be placed via :func:`shard_state`; GSPMD
     propagates the row sharding through the scan (stacked metrics and
-    watched-row keys come out replicated/gathered as XLA chooses)."""
+    watched-row keys come out replicated/gathered as XLA chooses). The
+    carried state is donated, like the sparse window builder — without it
+    the window holds input AND output copies of every [N, N] plane."""
     if params.capacity % mesh.size != 0:
         raise ValueError(
             f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
         )
-    return jax.jit(partial(run_ticks, n_ticks=n_ticks, params=params))
+    return jax.jit(
+        partial(run_ticks, n_ticks=n_ticks, params=params), donate_argnums=0
+    )

@@ -76,8 +76,14 @@ scalar oracle :mod:`.sparse_oracle`, and safe for the protocol's guarantees):
    sweep (``getGossipsToRemove:350-358``) would — fewer redundant sends, no
    semantic difference (every reachable node already merged it). Members who
    joined AFTER the rumor was created are exempt from its coverage
-   requirement (r5): the reference never replays old gossips to a new
-   member — joiners learn pre-join facts through the SYNC full-table merge.
+   requirement (r5). This IS a deviation in its own right: in the reference
+   a new member enters ``remoteMembers`` (``GossipProtocolImpl.java:253``)
+   and ``selectGossipMembers`` draws from that live list, so gossips still
+   inside their spread window DO keep reaching it — the reference only
+   stops forwarding once the spread window closes. What bounds the gap here
+   is the joiner's forced initial SYNC: its full-table merge hands the
+   joiner every fact the freed rumor carried, so the at-most-one-spread-
+   window of missed forwards never outlives the bootstrap exchange.
    (Without the exemption the continuous joiner influx at large N keeps
    coverage perpetually one-joiner-short and residency degrades to the full
    age sweep — the measured r4 pool-saturation mechanism at N=49,152.)
@@ -809,7 +815,10 @@ def restore(arrays: dict) -> SparseState:
     # the exact pre-r5 semantics (nobody exempt from rumor coverage)
     if "joined_at" not in arrays:
         arrays["joined_at"] = np.zeros(np.shape(arrays["up"]), np.int32)
-    return SparseState(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    # copy=True: jnp.asarray zero-copies aligned numpy buffers on CPU and
+    # the driver DONATES restored state into the tick window — see the
+    # dense state.restore for the full use-after-free account
+    return SparseState(**{k: jnp.array(v, copy=True) for k, v in arrays.items()})
 
 
 # ---------------------------------------------------------------------------
@@ -1758,14 +1767,18 @@ def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
         keep_m = keep_m | pending_m
         if params.early_free:
             # members who joined AFTER a rumor was created are exempt from
-            # its coverage requirement: the reference never replays old
-            # gossips to new members — a joiner learns pre-join facts via
-            # SYNC (MembershipProtocolImpl.java onSyncAck full-table merge),
-            # and its own row was wiped at join anyway. Without the
-            # exemption, the continuous joiner influx at large N keeps every
-            # rumor's coverage perpetually one-joiner-short, early-free
-            # never fires, and residency degrades to the full age sweep —
-            # the measured r4 pool-saturation mechanism at N=49,152.
+            # its coverage requirement (deviation 5). The reference DOES
+            # keep forwarding in-window gossips to a new member (it joins
+            # remoteMembers, GossipProtocolImpl.java:253, and
+            # selectGossipMembers draws from that list); what bounds the
+            # exemption's gap is the joiner's forced initial SYNC — its
+            # full-table merge (onSyncAck) delivers every fact a freed
+            # rumor carried, and the joiner's own row was wiped at join
+            # anyway. Without the exemption, the continuous joiner influx
+            # at large N keeps every rumor's coverage perpetually
+            # one-joiner-short, early-free never fires, and residency
+            # degrades to the full age sweep — the measured r4
+            # pool-saturation mechanism at N=49,152.
             covered = (
                 (state.minf_age > 0)
                 | ~state.up[:, None]
@@ -2009,3 +2022,19 @@ def run_sparse_ticks(
     (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
     watched = ms.pop("_watched_keys") if watch_rows is not None else None
     return state, key, ms, watched
+
+
+def make_sparse_run(params: SparseParams, n_ticks: int, donate: bool = True):
+    """Jitted :func:`run_sparse_ticks` window with the state DONATED — the
+    sparse twin of ``kernel.make_run``. Donation is not optional at large N
+    (an un-donated window holds TWO copies of the view matrix: 19.4 GB at
+    49k, past the chip on its own — the bench loops have always donated);
+    this builder makes it the one shared spelling the driver, bench.py, and
+    the dispatch-pipeline bench all use. ``donate=False`` is for lockstep
+    comparisons that must keep the input state alive."""
+    import functools
+
+    return jax.jit(
+        functools.partial(run_sparse_ticks, n_ticks=n_ticks, params=params),
+        donate_argnums=0 if donate else (),
+    )

@@ -618,8 +618,10 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         )
         keep = (t - int(o.mr_created[m]) <= sweep) or forwarding or pending
         if params.early_free:
-            # joined-after-creation members are exempt (deviation 5, r5):
-            # they learn pre-join facts via SYNC, never by gossip replay
+            # joined-after-creation members are exempt (deviation 5, r5).
+            # The reference WOULD still forward in-window gossips to them
+            # (new members enter remoteMembers and the gossip peer draw);
+            # the joiner's forced initial SYNC is what bounds the gap here
             covered = all(
                 (not o.up[i])
                 or int(o.minf_age[i, m]) > 0

@@ -589,4 +589,10 @@ def snapshot(state: SimState) -> dict[str, np.ndarray]:
 
 
 def restore(arrays: dict[str, np.ndarray]) -> SimState:
-    return SimState(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    # copy=True is load-bearing: jnp.asarray ZERO-COPIES a 64-byte-aligned
+    # numpy array on CPU, so the restored leaves would alias npz-loaded
+    # buffers — which the driver then DONATES into the tick window. The
+    # donated alias is a use-after-free once the npz dict is collected
+    # (observed as a restored driver diverging with foreign data after a
+    # few windows); jax-owned copies make restored state donation-safe.
+    return SimState(**{k: jnp.array(v, copy=True) for k, v in arrays.items()})

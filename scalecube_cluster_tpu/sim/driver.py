@@ -14,12 +14,27 @@ device. The driver owns:
 * metrics history (per-tick scalars from the kernel);
 * checkpoint/resume of the full state (SURVEY.md §5.4 — an addition over
   the reference, whose state is soft).
+
+Dispatch is PIPELINED (r6): the jitted windows donate the state buffers
+(XLA updates the N×N planes in place instead of copying them at window
+entry), and ``step()`` never reads device results back on its own — the
+per-window health reductions (counter sums, pool high-water, segmentation
+worst) accumulate ON DEVICE and come to host only at an explicit sync
+point: :meth:`flush`, :meth:`health_snapshot`, :meth:`checkpoint`, or the
+``health_counters`` / ``pool_high_water`` / ``segmentation_warnings``
+properties. With no monitor, watch, or ``record_metrics`` consumer
+attached, a ``step()`` therefore performs ZERO device→host transfers and
+JAX async dispatch runs windows back-to-back while the host races ahead
+enqueueing — one ``block_until_ready`` per monitor poll, not per window.
+Attaching a consumer (a watch stream, ``record_metrics=True``) opts that
+driver into per-window readbacks, which ``dispatch_stats`` makes visible.
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -100,6 +115,12 @@ def auto_params(
 
     from ..ops import sparse as _sparse
 
+    if config is not None:
+        # a configured compile-cache directory takes effect before the first
+        # window compiles (persistent XLA cache; no-op when unset)
+        from .. import compile_cache as _cc
+
+        _cc.enable_persistent_compile_cache(config=config)
     force_sparse = overrides.pop("force_sparse", False)
     use_dense = (per_link_fidelity or link_delay) and capacity <= dense_threshold
     if capacity <= 512:
@@ -131,14 +152,22 @@ class SimDriver:
         mesh=None,
         record_metrics: bool = False,
         dense_links: bool | None = None,
+        compile_cache_dir: str | None = None,
     ):
         """``params`` selects the engine: a :class:`SimParams` drives the
         dense kernel, a :class:`.sparse.SparseParams` the sparse
         (record-queue) one — same driver surface either way.
         ``dense_links`` overrides the per-link matrix default (dense mode:
-        True; sparse mode: False — the lean scalar-loss layout)."""
+        True; sparse mode: False — the lean scalar-loss layout).
+        ``compile_cache_dir`` points the persistent XLA compilation cache
+        at a directory (``ClusterConfig.sim.compile_cache_dir`` /
+        ``SCALECUBE_COMPILE_CACHE_DIR`` are the config/env spellings)."""
         from ..ops import sparse as _sparse
 
+        if compile_cache_dir:
+            from .. import compile_cache as _cc
+
+            _cc.enable_persistent_compile_cache(compile_cache_dir)
         self.params = params
         self.sparse = isinstance(params, _sparse.SparseParams)
         self._ops = _sparse if self.sparse else _state
@@ -162,6 +191,10 @@ class SimDriver:
         else:
             self.state = init
         self._step_cache: Dict[tuple, Callable] = {}
+        # per-program dispatch stats for jit_cache_audit(): calls + first
+        # dispatch wall time (first dispatch includes the jit compile, or
+        # the persistent-cache load when one hits)
+        self._step_stats: Dict[tuple, dict] = {}
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed ^ 0x5EED)  # host-side (transport) draws
         self.n_initial = n_initial
@@ -173,16 +206,16 @@ class SimDriver:
         # checkGossipSegmentation, GossipProtocolImpl.java:217-236; default
         # threshold 1000, GossipConfig.java:12)
         self.segmentation_threshold = 1000
-        self.segmentation_warnings = 0
         self._watches: Dict[int, _Watch] = {}
         self._rumor_payloads: Dict[int, object] = {}
         self._next_member_ordinal = n_initial
         self._transports: Dict[int, object] = {}  # row -> SimTransport
         # engine-health accumulators (VERDICT r4 item 8: the sparse pool's
         # backpressure failure mode must be visible live, not only in the
-        # churn bench) — updated from window metrics in step(), exposed via
-        # health_snapshot() / MonitorServer's /health route
-        self.health_counters: Dict[str, int] = {
+        # churn bench). Per-window sums/maxima accumulate ON DEVICE (the
+        # _win_* fields below) and fold into these host dicts only at a
+        # flush() sync point — reading the public properties flushes.
+        self._health_counters: Dict[str, int] = {
             "announce_dropped": 0, "announce_dropped_fd": 0,
             "announce_dropped_expiry": 0, "announce_dropped_refute": 0,
             "announce_dropped_sync": 0, "pool_evicted": 0, "announced": 0,
@@ -190,14 +223,51 @@ class SimDriver:
             # pool with no majority-covered victim) — detected in join()
             "announce_dropped_host": 0,
         }
-        self.pool_high_water = 0
+        self._pool_high_water = 0
+        self._segmentation_warnings = 0
+        # device-resident deferred reductions (None = nothing staged)
+        self._win_names: List[str] = []
+        self._win_accum = None  # i32 [len(_win_names)] summed counter deltas
+        self._win_pool_hw = None  # i32 scalar max of mr_active_count
+        self._win_seg_warn = None  # i32 scalar count of over-threshold windows
+        self._join_probe = None  # i32 scalar count of dropped host announces
+        # health probes (the join() in-pool readback) run only for a
+        # registered consumer: MonitorServer.register_health or a
+        # health_snapshot() call turns this on
+        self._health_interest = False
+        # dispatch-pipeline observability (exposed via dispatch_snapshot()
+        # and monitor.py): queue_depth counts windows enqueued since the
+        # last host sync; readbacks counts device→host transfer events
+        self.dispatch_stats: Dict[str, int] = {
+            "windows_dispatched": 0, "ticks_dispatched": 0, "readbacks": 0,
+            "flushes": 0, "queue_depth": 0, "queue_high_water": 0,
+        }
+        # the deferred reductions accumulate in DEVICE i32 (x64 is off);
+        # bound the flush-free span so a busy counter can't wrap 2^31 on a
+        # long unmonitored soak — worst per-tick counter is ~announce_slots
+        # (<= a few thousand), so 100k ticks stays orders under the limit.
+        # The induced flush is one coalesced sync per cap-ful of ticks.
+        self._ticks_since_flush = 0
+        self.flush_ticks_cap = 100_000
+        # MonitorServer runs in another thread; its polls (health_snapshot,
+        # view_of via sim_snapshot) race the sim thread's step(). Donation
+        # makes an unsynchronized interleaving fatal (a poll can grab a
+        # self.state reference the sim thread donates before the poll
+        # dispatches → "Array has been deleted"), and the deferred
+        # accumulators would double-count if a flush interleaved a step's
+        # read-modify-write. One reentrant lock covers both; uncontended in
+        # single-thread use.
+        self._lock = threading.RLock()
         self._recent_joins: List[tuple] = []  # (tick, row) of driver joins
         self._join_horizon = 300  # ticks a join stays in the lag cohorts
 
     # -- time ---------------------------------------------------------------
     @property
     def tick(self) -> int:
-        return int(self.state.tick)
+        # locked: the monitor thread reads this (sim_snapshot) and the read
+        # must not interleave with a donating step — see self._lock
+        with self._lock:
+            return int(self.state.tick)
 
     # -- stepping -----------------------------------------------------------
     def _get_step(self, n_ticks: int, n_watch: int) -> Callable:
@@ -210,12 +280,6 @@ class SimDriver:
         from a single transfer."""
         cache_key = (n_ticks, n_watch)
         if cache_key not in self._step_cache:
-            if self.sparse:
-                from ..ops import sparse as _sparse
-
-                run = _sparse.run_sparse_ticks
-            else:
-                run = _kernel.run_ticks
             if self.mesh is not None:
                 from ..ops.sharding import make_sharded_run, make_sharded_sparse_run
 
@@ -226,58 +290,226 @@ class SimDriver:
                         self.mesh, self.params, n_ticks, self._dense_links
                     )
                 )
-            else:
-                self._step_cache[cache_key] = jax.jit(
-                    partial(run, n_ticks=n_ticks, params=self.params)
+            elif self.sparse:
+                from ..ops import sparse as _sparse
+
+                self._step_cache[cache_key] = _sparse.make_sparse_run(
+                    self.params, n_ticks
                 )
+            else:
+                self._step_cache[cache_key] = _kernel.make_run(
+                    self.params, n_ticks
+                )
+            self._step_stats[cache_key] = {"calls": 0, "first_dispatch_s": None}
         return self._step_cache[cache_key]
 
     def step(self, n_ticks: int = 1) -> dict:
         """Advance the sim ``n_ticks`` periods in one device call; returns
-        the last tick's metrics (host arrays).
+        the last tick's metrics (DEVICE arrays — coercing them to Python
+        numbers is the caller's explicit sync).
 
         The trajectory is identical to ``n_ticks`` single steps (the key
-        chain inside the window is the same split sequence). Metrics and
-        watched-row events for the whole window come back in one transfer;
-        per-tick metrics are appended to ``metrics_history`` only when
-        ``record_metrics=True`` was passed at construction."""
+        chain inside the window is the same split sequence). The call is
+        fully asynchronous on the no-consumer path: health reductions stay
+        on device (see :meth:`flush`), the donated state updates in place,
+        and back-to-back ``step()`` calls pipeline — window k+1 is enqueued
+        while window k executes. A watch or ``record_metrics=True`` opts
+        into one device→host readback per window (events/history must be
+        observed in order), which ``dispatch_stats`` counts."""
+        with self._lock:
+            return self._step_locked(n_ticks)
+
+    def _step_locked(self, n_ticks: int) -> dict:
         rows = sorted(self._watches)
         watch_arr = jnp.asarray(rows, dtype=jnp.int32) if rows else None
         step = self._get_step(n_ticks, len(rows))
+        stats = self._step_stats[(n_ticks, len(rows))]
+        t0 = time.perf_counter() if stats["calls"] == 0 else None
         self.state, self._key, ms, watched = step(
             self.state, self._key, watch_rows=watch_arr
         )
+        if t0 is not None:
+            # first dispatch = trace + compile (or persistent-cache load)
+            stats["first_dispatch_s"] = round(time.perf_counter() - t0, 4)
+        stats["calls"] += 1
+        ds = self.dispatch_stats
+        ds["windows_dispatched"] += 1
+        ds["ticks_dispatched"] += n_ticks
+        ds["queue_depth"] += 1
+        ds["queue_high_water"] = max(ds["queue_high_water"], ds["queue_depth"])
+        self._accumulate_window(ms)
+        self._ticks_since_flush += n_ticks
+        if self._ticks_since_flush >= self.flush_ticks_cap:
+            self.flush()  # i32 overflow guard — see flush_ticks_cap
         if self.record_metrics:
             host_ms = {name: np.asarray(v) for name, v in ms.items()}
+            self._note_readback(len(host_ms))
             for i in range(n_ticks):
                 self.metrics_history.append(
                     {name: v[i] for name, v in host_ms.items()}
                 )
         if rows:
             keys = np.asarray(watched)  # [n_ticks, W, N]
+            self._note_readback(1)
             for i in range(n_ticks):
                 for w_idx, row in enumerate(rows):
                     w = self._watches[row]
                     self._diff_row(w, keys[i, w_idx])
                     w.prev_key = keys[i, w_idx]
-        for name in self.health_counters:
-            if name in ms:
-                self.health_counters[name] += int(np.asarray(ms[name]).sum())
+        return {name: v[-1] for name, v in ms.items()}
+
+    # -- pipelined-dispatch bookkeeping -------------------------------------
+    def _note_readback(self, n: int = 1) -> None:
+        """Record ``n`` device→host transfer events. Any readback of this
+        window's outputs also drains the dispatch queue (results force every
+        enqueued predecessor), so the depth resets."""
+        with self._lock:
+            self.dispatch_stats["readbacks"] += n
+            self.dispatch_stats["queue_depth"] = 0
+
+    def _accumulate_window(self, ms: dict) -> None:
+        """Fold one window's metrics into the DEVICE-side reductions —
+        pure jnp ops, no transfer; host sees them at the next flush()."""
+        names = [n for n in self._health_counters if n in ms]
+        if names:
+            vec = jnp.stack([ms[n].sum() for n in names])
+            if self._win_accum is None:
+                self._win_accum, self._win_names = vec, names
+            else:
+                self._win_accum = self._win_accum + vec
         if "mr_active_count" in ms:
-            self.pool_high_water = max(
-                self.pool_high_water, int(np.asarray(ms["mr_active_count"]).max())
+            hw = ms["mr_active_count"].max()
+            self._win_pool_hw = (
+                hw if self._win_pool_hw is None else jnp.maximum(self._win_pool_hw, hw)
             )
         if "gossip_segmentation" in ms:
-            worst = int(np.asarray(ms["gossip_segmentation"]).max())
-            if worst > self.segmentation_threshold:
-                self.segmentation_warnings += 1
+            over = (
+                ms["gossip_segmentation"].max() > self.segmentation_threshold
+            ).astype(jnp.int32)
+            self._win_seg_warn = (
+                over if self._win_seg_warn is None else self._win_seg_warn + over
+            )
+
+    def flush(self) -> None:
+        """Coalesced host readback of every deferred reduction — THE sync
+        point of the pipelined driver (monitor-poll cadence, not window
+        cadence). Also drains the dispatch queue: forcing the newest staged
+        value forces every enqueued window before it. Thread-safe against a
+        concurrently stepping sim thread."""
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        flushed = 0
+        if self._win_accum is not None:
+            vals = np.asarray(self._win_accum)
+            for name, v in zip(self._win_names, vals):
+                self._health_counters[name] += int(v)
+            self._win_accum = None
+            flushed += 1
+        if self._win_pool_hw is not None:
+            self._pool_high_water = max(
+                self._pool_high_water, int(np.asarray(self._win_pool_hw))
+            )
+            self._win_pool_hw = None
+            flushed += 1
+        if self._win_seg_warn is not None:
+            new = int(np.asarray(self._win_seg_warn))
+            self._win_seg_warn = None
+            if new:
                 import logging
 
                 logging.getLogger(__name__).warning(
-                    "gossip stream fragmented: %d missing-older rumors at the "
-                    "worst node (threshold %d)", worst, self.segmentation_threshold
+                    "gossip stream fragmented past threshold %d in %d "
+                    "window(s) since the last flush",
+                    self.segmentation_threshold, new,
                 )
-        return {name: np.asarray(v[-1]) for name, v in ms.items()}
+            self._segmentation_warnings += new
+            flushed += 1
+        if self._join_probe is not None:
+            self._health_counters["announce_dropped_host"] += int(
+                np.asarray(self._join_probe)
+            )
+            self._join_probe = None
+            flushed += 1
+        if flushed:
+            self._note_readback(flushed)
+            self.dispatch_stats["flushes"] += 1
+        self._ticks_since_flush = 0
+
+    def sync(self) -> None:
+        """Block until every enqueued window has executed (no transfer)."""
+        with self._lock:
+            jax.block_until_ready(self.state)
+            self.dispatch_stats["queue_depth"] = 0
+
+    def dispatch_snapshot(self) -> dict:
+        """Pipeline observability: queue depth (windows enqueued since the
+        last host sync), total/per-window readback counts, flush count —
+        the numbers that make the dispatch overlap checkable instead of
+        asserted (exposed over HTTP via monitor.dispatch_snapshot)."""
+        with self._lock:
+            return self._dispatch_snapshot_locked()
+
+    def _dispatch_snapshot_locked(self) -> dict:
+        ds = dict(self.dispatch_stats)
+        w = max(ds["windows_dispatched"], 1)
+        ds["readbacks_per_window"] = round(ds["readbacks"] / w, 4)
+        ds["staged_reductions"] = sum(
+            x is not None
+            for x in (
+                self._win_accum, self._win_pool_hw, self._win_seg_warn,
+                self._join_probe,
+            )
+        )
+        return ds
+
+    def jit_cache_audit(self) -> dict:
+        """In-process jit-program cache audit + the persistent XLA cache
+        report: which window programs exist, how often each dispatched, and
+        what the first dispatch (compile or cache load) cost."""
+        from .. import compile_cache as _cc
+
+        with self._lock:  # _step_stats mutates under the lock in step()
+            programs = [
+                {
+                    "n_ticks": k[0], "n_watch": k[1], "calls": v["calls"],
+                    "first_dispatch_s": v["first_dispatch_s"],
+                }
+                for k, v in sorted(self._step_stats.items())
+            ]
+        return {
+            "programs": programs,
+            "persistent_cache": _cc.compile_cache_report(),
+        }
+
+    # -- deferred-health properties (reading = one coalesced flush) ---------
+    @property
+    def health_counters(self) -> Dict[str, int]:
+        self.flush()
+        return self._health_counters
+
+    @health_counters.setter
+    def health_counters(self, value: Dict[str, int]) -> None:
+        self._health_counters = dict(value)
+
+    @property
+    def pool_high_water(self) -> int:
+        self.flush()
+        return self._pool_high_water
+
+    @pool_high_water.setter
+    def pool_high_water(self, value: int) -> None:
+        self._pool_high_water = int(value)
+
+    @property
+    def segmentation_warnings(self) -> int:
+        self.flush()
+        return self._segmentation_warnings
+
+    @segmentation_warnings.setter
+    def segmentation_warnings(self, value: int) -> None:
+        self._segmentation_warnings = int(value)
 
     def run_until(
         self, predicate: Callable[["SimDriver"], bool], max_ticks: int = 10_000
@@ -372,6 +604,10 @@ class SimDriver:
         whose previous occupant is still SUSPECT/DEAD in peers' tables would
         conflate the two identities (the reference's restart-on-same-address
         gets a fresh member id precisely to avoid this)."""
+        with self._lock:
+            return self._join_locked(seed_rows)
+
+    def _join_locked(self, seed_rows: Sequence[int]) -> int:
         up = np.asarray(self.state.up)
         free = np.nonzero(~up)[0]
         if len(free) == 0:
@@ -390,15 +626,18 @@ class SimDriver:
         self._next_member_ordinal += 1
         # the joiner's self-announce can still drop if the pool holds ONLY
         # sub-majority-covered rumors (no eviction victim) — the exact
-        # invisibility the /health endpoint exists to surface, so count it
-        if self.sparse:
-            in_pool = bool(
-                np.asarray(
-                    (self.state.mr_subject == row) & self.state.mr_active
-                ).any()
+        # invisibility the /health endpoint exists to surface. The probe is
+        # GATED on a registered health consumer (ADVICE r5: an unmonitored
+        # interactive join must not pay a device→host sync) and even then
+        # stays a DEVICE scalar, batched into the next flush() readback.
+        if self.sparse and self._health_interest:
+            in_pool = (
+                (self.state.mr_subject == row) & self.state.mr_active
+            ).any()
+            miss = (~in_pool).astype(jnp.int32)
+            self._join_probe = (
+                miss if self._join_probe is None else self._join_probe + miss
             )
-            if not in_pool:
-                self.health_counters["announce_dropped_host"] += 1
         # bounded: prune past the cohort horizon on append (a monitor may
         # never poll health_snapshot — churn runs join continuously); dedup
         # by row (a crash+rejoin within the horizon is a NEW identity — the
@@ -467,18 +706,23 @@ class SimDriver:
 
     # -- views --------------------------------------------------------------
     def view_of(self, row: int) -> tuple[np.ndarray, np.ndarray]:
-        """(status, incarnation) of node ``row``'s table — one device gather."""
-        key = np.asarray(self.state.view_key[row])
+        """(status, incarnation) of node ``row``'s table — one device gather.
+        Lock-guarded: sim_snapshot calls this from the monitor thread, and
+        the read must not interleave with a donating step."""
+        with self._lock:
+            key = np.asarray(self.state.view_key[row])
         status = np.where(key < 0, np.int8(UNKNOWN), _RANK_TO_STATUS_NP[key & 3])
         inc = np.where(key < 0, 0, (key >> 2) & INC_MASK).astype(np.int32)
         return status, inc
 
     def status_of(self, observer: int, subject: int) -> MemberStatus | None:
-        s = _status_of_key(int(self.state.view_key[observer, subject]))
+        with self._lock:
+            s = _status_of_key(int(self.state.view_key[observer, subject]))
         return None if s == UNKNOWN else MemberStatus(s)
 
     def is_up(self, row: int) -> bool:
-        return bool(self.state.up[row])
+        with self._lock:
+            return bool(self.state.up[row])
 
     # -- engine health (VERDICT r4 item 8) -----------------------------------
     def health_snapshot(self) -> dict:
@@ -492,7 +736,18 @@ class SimDriver:
         monitor snapshot instead of a benchmark-only artifact.
 
         The staleness reduce is one fused [N, N] pass on device, computed
-        on demand (monitor polling cadence, not tick cadence)."""
+        on demand (monitor polling cadence, not tick cadence). Calling this
+        registers health interest (enabling the join() in-pool probe) and
+        performs the coalesced flush of every deferred per-window
+        reduction — this is the pipelined driver's one sync point. Safe to
+        call from the monitor thread while the sim thread steps (the
+        driver lock serializes against donation)."""
+        with self._lock:
+            return self._health_snapshot_locked()
+
+    def _health_snapshot_locked(self) -> dict:
+        self._health_interest = True
+        self._flush_locked()
         if not hasattr(self, "_health_fn"):
             def _stale(state):
                 up = state.up
@@ -530,7 +785,8 @@ class SimDriver:
             "engine": "sparse" if self.sparse else "dense",
             "tick": tick,
             "n_up": n_up,
-            "announce": dict(self.health_counters),
+            "announce": dict(self._health_counters),
+            "dispatch": self.dispatch_snapshot(),
             "staleness": {
                 "stale_subjects": int((stale > 0).sum()),
                 "worst_subject_stale_observers": int(stale.max()) if stale.size else 0,
@@ -544,9 +800,15 @@ class SimDriver:
             out["pool"] = {
                 "mr_slots": self.params.mr_slots,
                 "active_now": int(np.asarray(self.state.mr_active).sum()),
-                "high_water": self.pool_high_water,
+                "high_water": self._pool_high_water,
             }
         return out
+
+    def enable_health_probes(self) -> None:
+        """Register health interest without taking a snapshot (called by
+        ``MonitorServer.register_health``): turns on the join() in-pool
+        probe so host-path announce drops are counted from now on."""
+        self._health_interest = True
 
     # -- checkpoint/resume ---------------------------------------------------
     def checkpoint(self, path: str) -> None:
@@ -555,6 +817,11 @@ class SimDriver:
         reproduce the same member ids and payloads, not refabricate them)."""
         import pickle
 
+        with self._lock:
+            return self._checkpoint_locked(path, pickle)
+
+    def _checkpoint_locked(self, path: str, pickle) -> None:
+        self._flush_locked()  # fold staged device reductions into host counters
         host = {
             "members": dict(self.members),
             "rumor_payloads": dict(self._rumor_payloads),
@@ -563,8 +830,9 @@ class SimDriver:
             "metrics_len": len(self.metrics_history),
             # health accumulators belong to the timeline being checkpointed —
             # restoring must not report drops/joins from the abandoned branch
-            "health_counters": dict(self.health_counters),
-            "pool_high_water": self.pool_high_water,
+            "health_counters": dict(self._health_counters),
+            "pool_high_water": self._pool_high_water,
+            "segmentation_warnings": self._segmentation_warnings,
             "recent_joins": list(self._recent_joins),
         }
         np.savez_compressed(
@@ -577,8 +845,14 @@ class SimDriver:
     def restore(self, path: str) -> None:
         import pickle
 
+        with self._lock:
+            self._restore_locked(path, pickle)
+
+    def _restore_locked(self, path: str, pickle) -> None:
         data = dict(np.load(path))
-        self._key = jax.numpy.asarray(data.pop("_key"))
+        # copy=True: asarray may zero-copy the aligned npz buffer (see
+        # ops.state.restore) and the key rides through every jitted window
+        self._key = jax.numpy.array(data.pop("_key"), copy=True)
         host = pickle.loads(data.pop("_host").tobytes())
         self.members = host["members"]
         self._rumor_payloads = host["rumor_payloads"]
@@ -586,10 +860,16 @@ class SimDriver:
         self._rng = np.random.default_rng()
         self._rng.bit_generator.state = host["rng"]
         del self.metrics_history[host["metrics_len"] :]  # drop abandoned timeline
-        self.health_counters = dict(
-            host.get("health_counters", {k: 0 for k in self.health_counters})
+        # staged reductions belong to the abandoned timeline — discard them
+        self._win_accum = self._win_pool_hw = self._win_seg_warn = None
+        self._join_probe = None
+        self._health_counters = dict(
+            host.get("health_counters", {k: 0 for k in self._health_counters})
         )
-        self.pool_high_water = host.get("pool_high_water", 0)
+        self._pool_high_water = host.get("pool_high_water", 0)
+        # pre-r6 checkpoints lack the field; 0 matches the timeline rule
+        # (warnings from the abandoned branch must not survive a restore)
+        self._segmentation_warnings = host.get("segmentation_warnings", 0)
         self._recent_joins = [tuple(j) for j in host.get("recent_joins", [])]
         state = self._ops.restore(data)
         if self.mesh is not None:

@@ -174,12 +174,14 @@ def test_sharded_sparse_equivalence():
         shard_sparse_state,
     )
 
-    n = 32
+    # 256 = 32 words x 8 devices: the sharded sparse builders now assert
+    # capacity % (32 * mesh.size) == 0 (word-sharded apply staging)
+    n = 256
     params = SP.SparseParams(
         capacity=n, fd_every=2, sweep_every=2, sync_every=8, mr_slots=32,
         announce_slots=16, rumor_slots=2, seed_rows=(0,), delay_slots=3,
     )
-    st = SP.init_sparse_state(params, 30, warm=True, uniform_delay=0.7)
+    st = SP.init_sparse_state(params, n - 2, warm=True, uniform_delay=0.7)
     st = SP.crash_row(st, 9)
     st = SP.spread_rumor(st, 0, origin=4)
     mesh = make_mesh(jax.devices("cpu")[:8])
@@ -192,8 +194,10 @@ def test_sharded_sparse_equivalence():
         st, _ = step_1(st, k)
         st_sh, _ = step_sh(st_sh, k)
         if t == 10:
-            st = SP.join_row(st, 31, seed_rows=[0])
-            st_sh = shard_sparse_state(SP.join_row(st_sh, 31, seed_rows=[0]), mesh)
+            st = SP.join_row(st, n - 1, seed_rows=[0])
+            st_sh = shard_sparse_state(
+                SP.join_row(st_sh, n - 1, seed_rows=[0]), mesh
+            )
     for f in (
         "view_key", "n_live", "sus_key", "sus_since", "minf_age", "mr_active",
         "mr_subject", "mr_key", "infected", "pending_minf",
