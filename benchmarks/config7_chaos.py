@@ -1,0 +1,166 @@
+"""Driver config #7: tick-rate overhead of an armed-but-idle chaos engine.
+
+The r7 acceptance gate: arming the chaos scenario engine (sentinel state
+staged on device, timeline attached, checks at the default cadence) on a
+driver with NO event currently due must cost <= 2% tick rate vs the plain
+r6 pipelined driver on the SAME config as benchmarks/config6_dispatch.py
+(dense N=4096, 24 one-tick windows per span) — and must stay transfer-free
+per window (asserted via the driver's readback counter).
+
+Two interleaved variants, median-of-``--reps`` spans:
+
+* **pipelined** — the bare r6 SimDriver loop (config6's "pipelined").
+* **chaos_armed** — the same loop with a DriverChaosRunner armed on an
+  event-free scenario: per window the idle timeline is consulted (a no-op
+  list probe) and sentinel reductions run at the default check cadence
+  (latching facts sample soundly — chaos/sentinels.py).
+
+    python benchmarks/config7_chaos.py [--n 4096] [--windows 24]
+        [--window-ticks 1] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+
+from common import emit, log
+
+from scalecube_cluster_tpu.chaos import Scenario
+from scalecube_cluster_tpu.chaos.engine import DriverChaosRunner
+
+
+def _params(n: int):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
+    )
+
+
+class PipelinedLoop:
+    """config6's pipelined variant, verbatim: donated windows, no consumer."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.d = SimDriver(_params(n), n, warm=True, seed=0)
+        self.d.step(window_ticks)  # compile + warm
+        self.d.sync()
+
+    def span(self) -> float:
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        return time.perf_counter() - t0
+
+
+class ChaosArmedLoop:
+    """The same loop with an armed-but-idle chaos engine: per window the
+    idle timeline is probed and sentinel checks fire at the runner's
+    cadence — exactly what ``run_scenario`` does between events."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.d = SimDriver(_params(n), n, warm=True, seed=0)
+        self.scn = Scenario(name="armed-idle", events=[], horizon=1 << 30)
+        self.runner = DriverChaosRunner(self.d, self.scn)
+        self.check_every = self.runner.spec.check_interval
+        self.t = 0
+        self.d.step(window_ticks)  # compile + warm
+        self.t += window_ticks
+        self.runner._run_check()   # compile the sentinel program too
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        next_check = self.t + self.check_every
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.state, _labels = self.runner.timeline.apply_due(
+                self.d.state, self.t
+            )
+            self.d.step(self.window_ticks)
+            self.t += self.window_ticks
+            if self.t >= next_check:
+                self.runner._run_check()
+                next_check = self.t + self.check_every
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        assert self.d.dispatch_stats["readbacks"] == base, (
+            "armed-idle chaos performed a device->host readback"
+        )
+        return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"warming 2 variants: N={args.n}, {args.reps} x {args.windows} "
+        f"windows of {args.window_ticks} tick(s)")
+    pipe_loop = PipelinedLoop(args.n, args.windows, args.window_ticks)
+    chaos_loop = ChaosArmedLoop(args.n, args.windows, args.window_ticks)
+
+    pipe_spans, chaos_spans = [], []
+    for rep in range(args.reps):  # interleaved: drift hits both alike
+        pipe_spans.append(pipe_loop.span())
+        chaos_spans.append(chaos_loop.span())
+        log(f"rep {rep}: pipelined {pipe_spans[-1]:.3f}s, "
+            f"chaos-armed {chaos_spans[-1]:.3f}s")
+
+    total = args.windows * args.window_ticks
+    pipe = statistics.median(pipe_spans)
+    chaos = statistics.median(chaos_spans)
+    overhead_pct = round((chaos / pipe - 1.0) * 100.0, 2)
+    result = {
+        "config": 7,
+        "variant": "chaos_idle_overhead",
+        "n": args.n,
+        "engine": "dense",
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "sentinel_check_interval": chaos_loop.check_every,
+        "pipelined_ticks_per_s": round(total / pipe, 1),
+        "chaos_armed_ticks_per_s": round(total / chaos, 1),
+        "idle_overhead_pct": overhead_pct,
+        "within_budget": overhead_pct <= 2.0,
+        "chaos_dispatch": chaos_loop.d.dispatch_snapshot(),
+        "spans_s": {
+            "pipelined": [round(s, 4) for s in pipe_spans],
+            "chaos_armed": [round(s, 4) for s in chaos_spans],
+        },
+    }
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

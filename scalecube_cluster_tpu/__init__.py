@@ -16,6 +16,7 @@ from .compile_cache import (
     enable_persistent_compile_cache,
 )
 from .config import (
+    ChaosConfig,
     ClusterConfig,
     FailureDetectorConfig,
     GossipConfig,
@@ -30,6 +31,7 @@ from .models.record import MembershipRecord
 from .version import __version__
 
 __all__ = [
+    "ChaosConfig",
     "ClusterConfig",
     "FailureDetectorConfig",
     "GossipConfig",

@@ -1,0 +1,50 @@
+"""Chaos scenario engine: scripted fault timelines + SWIM invariant sentinels.
+
+A :class:`Scenario` is a declarative timeline of fault events (partitions,
+loss storms, link flaps, crashes, restarts) that compiles into between-window
+mutations of the device-resident link/status planes for every simulated
+engine (dense, sparse, mesh-sharded — :class:`DriverChaosRunner` /
+``SimDriver.run_scenario``) and into :class:`..transport.NetworkEmulator`
+settings for the scalar/real-transport engine
+(:class:`EmulatorChaosRunner`) — one scenario file exercises all four code
+paths.
+
+Alongside injection, invariant *sentinels* (:mod:`.sentinels`) evaluate the
+protocol guarantees the related rumor-spreading literature frames (PAPERS.md:
+"Simple and Optimal Randomized Fault-Tolerant Rumor Spreading", "Robust and
+Tuneable Family of Gossiping Algorithms"): no false-DEAD of a never-faulted
+member, bounded detection latency after a crash, view re-convergence within a
+budget after a heal, and incarnation/key monotonicity. Sentinel reductions
+accumulate ON DEVICE through the r6 deferred-readback machinery — an armed
+chaos engine adds zero per-window device→host transfers; violations surface
+at the sync points (``SimDriver.health_snapshot``, ``GET /chaos``, the final
+scenario report).
+"""
+
+from .events import Crash, LinkFlap, LossStorm, Partition, Restart, Scenario
+from .engine import (
+    DriverChaosRunner,
+    EmulatorChaosRunner,
+    ScenarioError,
+    StateTimeline,
+    run_driver_scenario,
+)
+from .sentinels import SentinelSpec, build_spec, init_sentinel_state, sentinel_report
+
+__all__ = [
+    "Partition",
+    "LossStorm",
+    "LinkFlap",
+    "Crash",
+    "Restart",
+    "Scenario",
+    "ScenarioError",
+    "StateTimeline",
+    "DriverChaosRunner",
+    "EmulatorChaosRunner",
+    "run_driver_scenario",
+    "SentinelSpec",
+    "build_spec",
+    "init_sentinel_state",
+    "sentinel_report",
+]

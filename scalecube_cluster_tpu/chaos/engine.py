@@ -1,0 +1,517 @@
+"""Scenario compilation + runners for every engine.
+
+One scenario, four code paths:
+
+* :class:`StateTimeline` compiles a :class:`.events.Scenario` into ordered
+  between-window mutations of a device-resident state (dense ``SimState`` or
+  sparse ``SparseState`` — the two ops modules expose the same mutator
+  names, and mesh-sharded states go through the identical functions).
+* :class:`DriverChaosRunner` / :func:`run_driver_scenario` drive a
+  ``SimDriver`` through a scenario with the on-device sentinels armed —
+  zero per-window device→host transfers (the r6 discipline); the final
+  report (or a ``/chaos`` poll) is the one sync point.
+* :class:`EmulatorChaosRunner` replays the same schedule onto
+  :class:`..transport.emulator.NetworkEmulator` instances for the
+  scalar/real-transport engine (crash = total network isolation, the
+  reference testlib idiom for killing a node without stopping its process).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .events import (
+    Crash,
+    LinkFlap,
+    LossStorm,
+    Partition,
+    Restart,
+    Scenario,
+    ScenarioError,
+)
+from .sentinels import build_spec, init_sentinel_state, sentinel_report
+
+
+@dataclass(frozen=True)
+class _Step:
+    """One scheduled timeline action (engine-agnostic)."""
+
+    tick: int
+    seq: int
+    kind: str
+    label: str
+    payload: tuple
+
+
+def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
+    """Expand a scenario into the ordered (tick, seq) action list both the
+    state and the emulator runners replay. Flap toggles materialize here;
+    a flap always ends CLEAR (a trailing up-toggle at ``until``)."""
+    steps: List[_Step] = []
+    seq = itertools.count()
+    for ev in scenario.events:
+        if isinstance(ev, Partition):
+            steps.append(_Step(ev.at, next(seq), "partition_block",
+                               f"partition@{ev.at}", (ev.groups,)))
+            if ev.heal_at is not None:
+                steps.append(_Step(ev.heal_at, next(seq), "partition_heal",
+                                   f"heal@{ev.heal_at}", (ev.groups,)))
+        elif isinstance(ev, LossStorm):
+            steps.append(_Step(ev.at, next(seq), "storm_start",
+                               f"storm({ev.pct}%)@{ev.at}", (ev.pct,)))
+            if ev.until is not None:
+                steps.append(_Step(ev.until, next(seq), "storm_end",
+                                   f"storm_end@{ev.until}", ()))
+        elif isinstance(ev, LinkFlap):
+            until = ev.until if ev.until is not None else horizon
+            if until is None:
+                raise ScenarioError(
+                    "LinkFlap without `until` needs a scenario horizon"
+                )
+            for k, t in enumerate(range(ev.at, until, ev.period)):
+                kind = "flap_down" if k % 2 == 0 else "flap_up"
+                steps.append(_Step(t, next(seq), kind, f"{kind}@{t}", (ev.pairs,)))
+            steps.append(_Step(until, next(seq), "flap_up",
+                               f"flap_end@{until}", (ev.pairs,)))
+        elif isinstance(ev, Crash):
+            steps.append(_Step(ev.at, next(seq), "crash",
+                               f"crash{list(ev.rows)}@{ev.at}", (ev.rows,)))
+        elif isinstance(ev, Restart):
+            steps.append(_Step(ev.at, next(seq), "restart",
+                               f"restart{list(ev.rows)}@{ev.at}",
+                               (ev.rows, ev.seed_rows)))
+    steps.sort(key=lambda s: (s.tick, s.seq))
+    return steps
+
+
+# ---------------------------------------------------------------------------
+# device-state timeline (dense / sparse / sharded)
+# ---------------------------------------------------------------------------
+
+
+class StateTimeline:
+    """Replays the schedule onto a device-resident state via the engine's ops
+    module (``ops.state`` or ``ops.sparse`` — same mutator surface).
+
+    Loss-storm semantics on dense links: the pre-storm loss matrix is
+    stashed (an independent device copy — the live plane gets donated away
+    by the next window) and the storm applies a FLOOR (existing blocks stay
+    blocked). Link mutations made while the storm is active are recorded
+    and replayed on top of the restored matrix at storm end, so a partition
+    that started mid-storm survives it and one healed mid-storm stays
+    healed.
+
+    ``on_restart(state, row, seed_rows) -> state`` lets a driver hook its
+    member-identity bookkeeping into Restart events; default is the raw
+    ``ops.join_row``.
+    """
+
+    def __init__(
+        self,
+        scenario: Scenario,
+        ops,
+        dense_links: bool,
+        on_restart: Optional[Callable] = None,
+        horizon: Optional[int] = None,
+    ):
+        self._ops = ops
+        self._on_restart = on_restart
+        self._steps = schedule(scenario, horizon=horizon)
+        self._i = 0
+        self._storm_stash = None  # pre-storm loss plane (independent copy)
+        self._storm_pct = 0.0  # active storm's floor, as a probability
+        self._storm_replay: List[Callable] = []
+        if not dense_links:
+            for s in self._steps:
+                if s.kind in ("partition_block", "partition_heal",
+                              "flap_down", "flap_up"):
+                    raise ScenarioError(
+                        f"{s.kind} needs per-link (dense) links; this engine "
+                        "runs scalar uniform loss — construct the driver "
+                        "with dense_links=True"
+                    )
+
+    def next_tick(self) -> Optional[int]:
+        return self._steps[self._i].tick if self._i < len(self._steps) else None
+
+    def boundaries(self) -> List[int]:
+        return sorted({s.tick for s in self._steps})
+
+    def apply_due(self, state, tick: int):
+        """Apply every action scheduled at or before ``tick``; returns
+        (state, labels). Pure device ops — nothing is read back."""
+        labels: List[str] = []
+        while self._i < len(self._steps) and self._steps[self._i].tick <= tick:
+            step = self._steps[self._i]
+            self._i += 1
+            state = self._apply(state, step)
+            labels.append(step.label)
+        return state, labels
+
+    # -- one action ----------------------------------------------------------
+    def _apply(self, state, step: _Step):
+        ops = self._ops
+        if step.kind == "partition_block":
+            (groups,) = step.payload
+
+            def fn(st, groups=groups, clear=0.0):
+                for a, b in itertools.combinations(groups, 2):
+                    st = ops.block_partition(st, list(a), list(b))
+                return st
+
+        elif step.kind == "partition_heal":
+            (groups,) = step.payload
+
+            def fn(st, groups=groups, clear=0.0):
+                for a, b in itertools.combinations(groups, 2):
+                    st = ops.set_link_loss(st, list(a), list(b), clear)
+                    st = ops.set_link_loss(st, list(b), list(a), clear)
+                return st
+
+        elif step.kind == "flap_down":
+            (pairs,) = step.payload
+
+            def fn(st, pairs=pairs, clear=0.0):
+                for s, d in pairs:
+                    st = ops.set_link_loss(st, [s], [d], 1.0)
+                return st
+
+        elif step.kind == "flap_up":
+            (pairs,) = step.payload
+
+            def fn(st, pairs=pairs, clear=0.0):
+                for s, d in pairs:
+                    st = ops.set_link_loss(st, [s], [d], clear)
+                return st
+
+        elif step.kind == "crash":
+            (rows,) = step.payload
+
+            def fn(st, rows=rows):
+                return ops.crash_rows(st, list(rows))
+
+        elif step.kind == "restart":
+            rows, seed_rows = step.payload
+
+            def fn(st, rows=rows, seed_rows=seed_rows):
+                for r in rows:
+                    if self._on_restart is not None:
+                        st = self._on_restart(st, r, list(seed_rows))
+                    else:
+                        st = ops.join_row(st, r, list(seed_rows))
+                return st
+
+        elif step.kind == "storm_start":
+            (pct,) = step.payload
+            return self._storm_start(state, pct)
+        elif step.kind == "storm_end":
+            return self._storm_end(state)
+        else:  # pragma: no cover - schedule() only emits the kinds above
+            raise ScenarioError(f"unknown timeline action {step.kind!r}")
+
+        if self._storm_stash is not None and step.kind in (
+            "partition_block", "partition_heal", "flap_down", "flap_up"
+        ):
+            # the CLEAN variant replays on the restored matrix at storm end;
+            # during the storm, links that clear only drop to the storm
+            # FLOOR (a mid-storm heal must not punch a loss-0 hole in the
+            # uniform storm the LossStorm contract promises)
+            self._storm_replay.append(fn)
+            return fn(state, clear=self._storm_pct)
+        return fn(state)
+
+    def _storm_start(self, state, pct: float):
+        import jax.numpy as jnp
+
+        if self._storm_stash is not None:
+            raise ScenarioError("overlapping LossStorms are not supported")
+        # independent copy: the live plane is donated away next window
+        self._storm_stash = jnp.array(state.loss, copy=True)
+        self._storm_pct = pct / 100.0
+        self._storm_replay = []
+        return self._ops.set_uniform_loss(state, pct / 100.0, floor=True)
+
+    def _storm_end(self, state):
+        if self._storm_stash is None:
+            raise ScenarioError("storm_end without an active storm")
+        loss = self._storm_stash
+        self._storm_stash = None
+        if loss.ndim == 0:
+            # pass the device scalar through (a float() here would be a
+            # device→host transfer mid-scenario)
+            state = self._ops.set_uniform_loss(state, loss)
+        else:
+            from ..ops.state import _roundtrip
+
+            state = state.replace(loss=loss, fetch_rt=_roundtrip(loss))
+        for fn in self._storm_replay:
+            state = fn(state)
+        self._storm_replay = []
+        return state
+
+
+# ---------------------------------------------------------------------------
+# SimDriver runner (dense / sparse / mesh-sharded)
+# ---------------------------------------------------------------------------
+
+
+class DriverChaosRunner:
+    """One scenario armed on one :class:`..sim.SimDriver`.
+
+    Arming registers the runner on the driver (``driver._chaos``) so
+    ``health_snapshot()`` and the monitor's ``GET /chaos`` can report live
+    sentinel state; :meth:`run` drives the scenario to its horizon. The
+    stepping loop performs NO device→host transfers: fault injection and
+    sentinel checks are pure device ops, and the one readback happens in the
+    final report (or whenever a monitor poll explicitly asks)."""
+
+    def __init__(self, driver, scenario: Scenario, config=None,
+                 sentinels: bool = True):
+        import jax
+
+        from ..ops import kernel as _kernel
+        from ..ops import sparse as _sparse
+
+        self.driver = driver
+        self.scenario = scenario
+        with driver._lock:
+            self.t0 = int(driver.state.tick)  # the one arm-time readback
+            view_key = driver.state.view_key
+        self.spec = build_spec(scenario, driver.params, config=config)
+        self.timeline = StateTimeline(
+            scenario,
+            driver._ops,
+            dense_links=driver._dense_links,
+            on_restart=self._restart,
+            horizon=self.spec.horizon,
+        )
+        self._sent = (
+            init_sentinel_state(view_key, self.spec, sparse=driver.sparse)
+            if sentinels
+            else None
+        )
+        self._spec_dev = self.spec.device_arrays(self.t0)
+        reduce_fn = (
+            _sparse.sentinel_reduce if driver.sparse else _kernel.sentinel_reduce
+        )
+        self._check = jax.jit(reduce_fn)
+        self.events_applied: List[Tuple[int, str]] = []
+        self.rel_tick = 0
+        self.done = False
+        self.last_report: Optional[dict] = None
+        driver._chaos = self
+
+    # -- Restart with driver identity bookkeeping (no device reads) ----------
+    def _restart(self, state, row: int, seed_rows):
+        d = self.driver
+        state = d._ops.join_row(state, row, seed_rows)
+        from ..models.member import Member
+        from ..sim.driver import row_address
+
+        d.members[row] = Member(
+            id=f"sim-{d._next_member_ordinal}", address=row_address(row)
+        )
+        d._next_member_ordinal += 1
+        return state
+
+    # -- the scenario loop ----------------------------------------------------
+    def run(self, max_window: int = 32) -> dict:
+        """Drive the scenario to its horizon; returns the structured report.
+        Windows split at event boundaries and sentinel-check ticks, capped at
+        ``max_window`` ticks each (the jit cache keys on window length, so a
+        scenario reuses a handful of compiled window programs)."""
+        d = self.driver
+        horizon = self.spec.horizon
+        check_every = self.spec.check_interval
+        next_check = check_every if self._sent is not None else horizon + 1
+        t = 0
+        while True:
+            # events due at t apply BEFORE the sentinel sample at t (a
+            # restart's convergence obligation must be judged against the
+            # post-restart view, and the same-tick heal against the healed
+            # links)
+            with d._lock:
+                d.state, labels = self.timeline.apply_due(d.state, t)
+            self.events_applied.extend((t, lab) for lab in labels)
+            if self._sent is not None and (t >= next_check or t >= horizon):
+                self._run_check()
+                next_check = t + check_every
+            if t >= horizon:
+                break
+            stops = [horizon, t + max_window, next_check]
+            nt = self.timeline.next_tick()
+            if nt is not None:
+                stops.append(nt)
+            stop = min(s for s in stops if s > t)
+            d.step(stop - t)
+            t = stop
+            self.rel_tick = t
+        self.done = True
+        report = self.report()  # THE sync point: one coalesced readback
+        self.last_report = report
+        return report
+
+    def _run_check(self) -> None:
+        d = self.driver
+        with d._lock:
+            self._sent = self._check(d.state, self._sent, self._spec_dev)
+
+    # -- reporting (the readback sites) ---------------------------------------
+    def report(self) -> dict:
+        """Structured scenario report. Reading it is a sync point (the
+        sentinel accumulators come to host here)."""
+        events = list(self.events_applied)  # monitor thread vs sim appends
+        rep = {
+            "scenario": self.scenario.name,
+            "armed": not self.done,
+            "t0": self.t0,
+            "horizon": self.spec.horizon,
+            "ticks_run": self.rel_tick,
+            "events_applied": [{"tick": t, "event": lab} for t, lab in events],
+        }
+        if self._sent is not None:
+            with self.driver._lock:
+                sent_host = {k: np.asarray(v) for k, v in self._sent.items()}
+            self.driver._note_readback(1)
+            rep["sentinels"] = sentinel_report(
+                sent_host, self.spec, final_tick=self.rel_tick
+            )
+            rep["violations"] = rep["sentinels"]["violations"]
+            rep["ok"] = rep["sentinels"]["ok"]
+        else:
+            rep["sentinels"] = None
+            rep["violations"] = 0
+            rep["ok"] = True
+        return rep
+
+    def snapshot(self) -> dict:
+        """Monitor-facing view (``GET /chaos`` / health_snapshot chaos
+        section): the full report plus progress — safe to call from the
+        monitor thread while the sim thread steps."""
+        return self.report()
+
+
+def run_driver_scenario(
+    driver,
+    scenario: Scenario,
+    *,
+    config=None,
+    sentinels: bool = True,
+    max_window: int = 32,
+) -> dict:
+    """Arm ``scenario`` on ``driver`` and run it to the horizon (the
+    function behind ``SimDriver.run_scenario``)."""
+    runner = DriverChaosRunner(driver, scenario, config=config, sentinels=sentinels)
+    return runner.run(max_window=max_window)
+
+
+# ---------------------------------------------------------------------------
+# NetworkEmulator runner (scalar / real-transport engine)
+# ---------------------------------------------------------------------------
+
+
+class EmulatorChaosRunner:
+    """Replays the same scenario schedule onto per-node
+    :class:`..transport.emulator.NetworkEmulator` instances.
+
+    ``emulators[i]`` and ``addresses[i]`` are row ``i``'s emulator and wire
+    address (the scenario's integer rows index this list). The caller owns
+    time: call :meth:`advance_to` with the current scenario-relative tick
+    (``elapsed_seconds / tick_interval`` for wall-clock engines) and every
+    due action is applied. Crash maps to total network isolation and
+    Restart to unblocking — the reference testlib's member-kill idiom for a
+    process that stays alive."""
+
+    def __init__(self, scenario: Scenario, emulators: Sequence,
+                 addresses: Sequence[str], horizon: Optional[int] = None):
+        if len(emulators) != len(addresses):
+            raise ScenarioError("emulators and addresses must align by row")
+        scenario.validate_rows(len(emulators))  # groups/pairs/rows/seeds
+        self.scenario = scenario
+        self._emus = list(emulators)
+        self._addrs = list(addresses)
+        self._steps = schedule(scenario, horizon=horizon)
+        self._i = 0
+        self.events_applied: List[Tuple[int, str]] = []
+
+    def next_tick(self) -> Optional[int]:
+        return self._steps[self._i].tick if self._i < len(self._steps) else None
+
+    def advance_to(self, tick: int) -> List[str]:
+        labels: List[str] = []
+        while self._i < len(self._steps) and self._steps[self._i].tick <= tick:
+            step = self._steps[self._i]
+            self._i += 1
+            self._apply(step)
+            self.events_applied.append((step.tick, step.label))
+            labels.append(step.label)
+        return labels
+
+    def report(self) -> dict:
+        return {
+            "scenario": self.scenario.name,
+            "engine": "emulator",
+            "events_applied": [
+                {"tick": t, "event": lab} for t, lab in self.events_applied
+            ],
+            "pending": len(self._steps) - self._i,
+        }
+
+    def _apply(self, step: _Step) -> None:
+        if step.kind == "partition_block":
+            (groups,) = step.payload
+            for a, b in itertools.combinations(groups, 2):
+                self._block(a, b)
+        elif step.kind == "partition_heal":
+            (groups,) = step.payload
+            for a, b in itertools.combinations(groups, 2):
+                self._unblock(a, b)
+        elif step.kind == "storm_start":
+            (pct,) = step.payload
+            for emu in self._emus:
+                emu.set_default_outbound_settings(pct, 0.0)
+        elif step.kind == "storm_end":
+            for emu in self._emus:
+                emu.set_default_outbound_settings(0.0, 0.0)
+        elif step.kind == "flap_down":
+            (pairs,) = step.payload
+            for s, d in pairs:
+                self._emus[s].block_outbound([self._addrs[d]])
+        elif step.kind == "flap_up":
+            (pairs,) = step.payload
+            for s, d in pairs:
+                self._emus[s].unblock_outbound([self._addrs[d]])
+        elif step.kind == "crash":
+            (rows,) = step.payload
+            for r in rows:
+                self._emus[r].block_all_outbound()
+                self._emus[r].block_all_inbound()
+        elif step.kind == "restart":
+            rows, _seeds = step.payload
+            for r in rows:
+                self._emus[r].unblock_all_outbound()
+                self._emus[r].unblock_all_inbound()
+
+    def _block(self, a, b) -> None:
+        addrs_a = [self._addrs[r] for r in a]
+        addrs_b = [self._addrs[r] for r in b]
+        for r in a:
+            self._emus[r].block_outbound(addrs_b)
+            self._emus[r].block_inbound(addrs_b)
+        for r in b:
+            self._emus[r].block_outbound(addrs_a)
+            self._emus[r].block_inbound(addrs_a)
+
+    def _unblock(self, a, b) -> None:
+        addrs_a = [self._addrs[r] for r in a]
+        addrs_b = [self._addrs[r] for r in b]
+        for r in a:
+            self._emus[r].unblock_outbound(addrs_b)
+            self._emus[r].unblock_inbound(addrs_b)
+        for r in b:
+            self._emus[r].unblock_outbound(addrs_a)
+            self._emus[r].unblock_inbound(addrs_a)

@@ -1,0 +1,226 @@
+"""The chaos scenario DSL: declarative fault events on a tick timeline.
+
+Event ticks are RELATIVE to the tick at which the scenario is armed (so one
+scenario file replays against any engine, at any point of a run). An event
+with ``at=t`` is applied *between windows*, after the simulation has
+completed tick ``t`` and before tick ``t+1`` runs — the same seam every host
+mutator (``ops.state`` / ``ops.sparse`` / the ``NetworkEmulator`` controls)
+already uses, so injection never perturbs an in-flight window.
+
+The fault vocabulary mirrors the reference testlib's ``NetworkEmulator``
+surface (loss percent, block/unblock, per-link settings) plus process-level
+churn (crash = hard kill, restart = fresh identity on the same row — the
+reference's restart-on-same-address-is-a-new-member rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+
+class ScenarioError(ValueError):
+    """A scenario that cannot be compiled (bad timeline / engine mismatch)."""
+
+
+def _rows(seq) -> Tuple[int, ...]:
+    return tuple(int(r) for r in seq)
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Symmetric network partition between member groups.
+
+    ``groups`` is a sequence of row groups; traffic between any two distinct
+    groups is blocked from tick ``at`` until ``heal_at`` (None = never heals
+    inside the scenario). Rows in no group keep all their links — they are
+    the bridge/bystander cohort the false-DEAD sentinel watches.
+    """
+
+    groups: Sequence[Sequence[int]]
+    at: int
+    heal_at: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "groups", tuple(_rows(g) for g in self.groups))
+        if len(self.groups) < 2 or any(not g for g in self.groups):
+            raise ScenarioError("Partition needs >= 2 non-empty groups")
+        if self.heal_at is not None and self.heal_at <= self.at:
+            raise ScenarioError("Partition.heal_at must be > at")
+
+
+@dataclass(frozen=True)
+class LossStorm:
+    """Uniform loss floor of ``pct`` percent on EVERY link in [at, until).
+
+    On dense-link engines the storm raises each link to at least ``pct``
+    (existing blocks stay blocked); at ``until`` the pre-storm link matrix is
+    restored and any partition/flap mutations made during the storm are
+    replayed on top. On scalar-loss (lean sparse) engines the storm swaps the
+    uniform loss scalar. On the emulator engine it becomes the default
+    outbound settings.
+    """
+
+    pct: float
+    at: int
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        if not (0.0 <= self.pct <= 100.0):
+            raise ScenarioError("LossStorm.pct must be in [0, 100]")
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("LossStorm.until must be > at")
+
+
+@dataclass(frozen=True)
+class LinkFlap:
+    """Directed links that toggle blocked/clear every ``period`` ticks.
+
+    ``pairs`` are (src, dst) row pairs; the link is DOWN during even
+    half-periods starting at ``at`` and restored to loss 0 during odd ones,
+    until ``until`` (required bounded — an unbounded flap has no horizon),
+    ending clear.
+    """
+
+    pairs: Sequence[Tuple[int, int]]
+    period: int
+    at: int = 0
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "pairs", tuple((int(s), int(d)) for s, d in self.pairs)
+        )
+        if not self.pairs:
+            raise ScenarioError("LinkFlap needs at least one (src, dst) pair")
+        if self.period < 1:
+            raise ScenarioError("LinkFlap.period must be >= 1")
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("LinkFlap.until must be > at")
+
+
+@dataclass(frozen=True)
+class Crash:
+    """Hard-kill ``rows`` at tick ``at`` (no goodbye; peers must detect)."""
+
+    rows: Sequence[int]
+    at: int
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("Crash needs at least one row")
+
+
+@dataclass(frozen=True)
+class Restart:
+    """Re-activate ``rows`` at tick ``at`` as FRESH identities (epoch bump —
+    the restart-is-a-new-member rule), bootstrapping via ``seed_rows``."""
+
+    rows: Sequence[int]
+    at: int
+    seed_rows: Sequence[int] = (0,)
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        object.__setattr__(self, "seed_rows", _rows(self.seed_rows))
+        if not self.rows:
+            raise ScenarioError("Restart needs at least one row")
+
+
+EVENT_TYPES = (Partition, LossStorm, LinkFlap, Crash, Restart)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A named, validated fault timeline + sentinel budgets.
+
+    ``horizon`` is the total tick span the scenario runs for (None = derived:
+    last event boundary plus the convergence budget). ``detect_budget`` /
+    ``converge_budget`` override the protocol-math defaults (0/None = auto
+    from the engine params — see :func:`.sentinels.build_spec`), and
+    ``check_interval`` sets the sentinel sampling cadence in ticks (sentinel
+    facts are latching/monotone, so sampling is sound — see sentinels.py).
+    """
+
+    name: str
+    events: Sequence
+    horizon: Optional[int] = None
+    detect_budget: Optional[int] = None
+    converge_budget: Optional[int] = None
+    check_interval: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(self.events))
+        for ev in self.events:
+            if not isinstance(ev, EVENT_TYPES):
+                raise ScenarioError(f"unknown scenario event {ev!r}")
+            if ev.at < 0:
+                raise ScenarioError(f"event {ev} starts before the arm tick")
+        if self.horizon is not None and self.horizon < 1:
+            raise ScenarioError("Scenario.horizon must be >= 1")
+
+    # -- derived views -------------------------------------------------------
+    def referenced_rows(self) -> set:
+        """Every row any event names: crash/restart targets + their seeds,
+        partition group members, flap endpoints."""
+        rows: set = set()
+        for ev in self.events:
+            for attr in ("rows", "seed_rows"):
+                rows.update(getattr(ev, attr, ()))
+            for g in getattr(ev, "groups", ()):
+                rows.update(g)
+            for s, d in getattr(ev, "pairs", ()):
+                rows.update((s, d))
+        return rows
+
+    def validate_rows(self, capacity: int) -> None:
+        """Fail FAST on rows outside ``[0, capacity)`` — a silent JAX
+        clamp/no-op would otherwise inject nothing and make the sentinels
+        watch the wrong (healthy) row."""
+        bad = sorted(r for r in self.referenced_rows()
+                     if not 0 <= r < capacity)
+        if bad:
+            raise ScenarioError(
+                f"scenario {self.name!r} references rows {bad} outside the "
+                f"{capacity}-row engine"
+            )
+
+    def last_event_tick(self) -> int:
+        """Last tick at which any timeline action fires (0 when eventless)."""
+        last = 0
+        for ev in self.events:
+            last = max(last, ev.at)
+            for attr in ("heal_at", "until"):
+                v = getattr(ev, attr, None)
+                if v is not None:
+                    last = max(last, v)
+        return last
+
+    def fault_touched_rows(
+        self, capacity: int, loss_storm_immunity_pct: float = 50.0
+    ) -> set:
+        """Rows any event may plausibly fault: crash/restart targets,
+        partition group members, flap endpoints — and EVERY row while a
+        ``LossStorm`` at or above ``loss_storm_immunity_pct`` is scripted
+        (heavy uniform loss can legitimately suspect anyone; below the
+        threshold the no-false-DEAD guarantee is expected to hold). The
+        complement is the never-faulted cohort the false-DEAD sentinel
+        protects."""
+        touched: set = set()
+        for ev in self.events:
+            if isinstance(ev, (Crash, Restart)):
+                touched.update(ev.rows)
+            elif isinstance(ev, Partition):
+                for g in ev.groups:
+                    touched.update(g)
+            elif isinstance(ev, LinkFlap):
+                for s, d in ev.pairs:
+                    touched.update((s, d))
+            elif isinstance(ev, LossStorm) and ev.pct >= loss_storm_immunity_pct:
+                touched.update(range(capacity))
+        return {r for r in touched if 0 <= r < capacity}
+
+    def replace(self, **kw) -> "Scenario":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,264 @@
+"""On-device SWIM invariant sentinels for chaos scenarios.
+
+Four protocol guarantees, each checkable as a pure reduction over the
+device-resident view planes (``view_key`` / ``up`` — shared by the dense and
+sparse states, so ONE reduction serves both engines, mesh-sharded included):
+
+1. **No false-DEAD** — a member no scenario event ever faulted must never be
+   marked DEAD by any up observer (the fault-tolerant rumor-spreading
+   guarantee: adversarial loss below the storm-immunity threshold must not
+   kill healthy members).
+2. **Bounded detection latency** — after ``Crash(rows, at)``, every up
+   observer marks each crashed row DEAD (or never knew it) within the
+   detection budget (suspicion math + dissemination slack).
+3. **Re-convergence** — after a heal/restart/storm-end boundary, all up
+   members see all up members ALIVE within the convergence budget (the
+   anti-entropy guarantee; seed-row SYNC is what re-bridges full splits).
+4. **Key/incarnation monotonicity** — each member's self record (packed
+   ``epoch | incarnation | rank`` key) never regresses between checks: the
+   lattice's monotone-merge contract, which all other guarantees build on.
+
+Every sentinel fact is LATCHING or monotone (a DEAD tombstone persists until
+rejoin, detection and convergence only ever become true, a key regression is
+counted against a remembered previous value), so the checks are sound under
+SAMPLING: the runner evaluates them every ``check_interval`` ticks — pure
+jnp ops staged on device through the r6 deferred-readback discipline, ZERO
+device→host transfers until a sync point (``health_snapshot`` / ``GET
+/chaos`` / the final report) reads the accumulators back.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .events import Crash, LinkFlap, LossStorm, Partition, Restart, Scenario
+
+
+def _ceil_log2(n: int) -> int:
+    return int(n).bit_length() if n > 0 else 0
+
+
+def default_detect_budget(params) -> int:
+    """Suspicion math + dissemination slack, in ticks: the suspicion window
+    (``suspicion_mult * ceilLog2(N) * fd_every``) doubled (first-probe and
+    expiry-sweep phase lag), plus two SYNC intervals for the DEAD record to
+    reach every observer through anti-entropy even if gossip misses some."""
+    return (
+        2 * params.suspicion_mult * _ceil_log2(params.capacity) * params.fd_every
+        + 2 * params.sync_every
+    )
+
+
+def default_converge_budget(params) -> int:
+    """Post-heal re-convergence is anti-entropy-limited for the stragglers
+    (nodes that must learn of their own premature death via a periodic
+    seed-SYNC and refute — benchmarks/config4_partition.py budgets 8 sync
+    intervals for the same reason), plus the detection slack for any death
+    rumors still in flight at the heal."""
+    return 8 * params.sync_every + default_detect_budget(params)
+
+
+@dataclass
+class SentinelSpec:
+    """Host-side compiled sentinel plan for one scenario (numpy arrays are
+    uploaded once at arm time; the per-check work is all on device)."""
+
+    capacity: int
+    never_faulted: np.ndarray  # bool [N]
+    crash_rows: np.ndarray  # i32 [K] — one entry per crashed row occurrence
+    crash_at: np.ndarray  # i32 [K]
+    crash_deadline: np.ndarray  # i32 [K]
+    crash_until: np.ndarray  # i32 [K] — restart tick (or horizon)
+    conv_from: np.ndarray  # i32 [C] — heal/restart/storm-end boundaries
+    conv_deadline: np.ndarray  # i32 [C]
+    conv_labels: List[str] = field(default_factory=list)
+    detect_budget: int = 0
+    converge_budget: int = 0
+    check_interval: int = 32
+    horizon: int = 0
+
+    def device_arrays(self, t0: int = 0) -> Dict[str, object]:
+        """Upload the spec once at arm time. ``t0`` is the absolute tick the
+        scenario was armed at; sentinel checks compare ``state.tick - t0``
+        against the (relative) event ticks, so detect/conv stamps come back
+        in scenario-relative ticks like every deadline in the report."""
+        import jax.numpy as jnp
+
+        return {
+            "t0": jnp.int32(t0),
+            "never_faulted": jnp.asarray(self.never_faulted),
+            "crash_rows": jnp.asarray(self.crash_rows),
+            "crash_at": jnp.asarray(self.crash_at),
+            "crash_until": jnp.asarray(self.crash_until),
+            "conv_from": jnp.asarray(self.conv_from),
+        }
+
+
+def build_spec(
+    scenario: Scenario, params, config=None, horizon: Optional[int] = None
+) -> SentinelSpec:
+    """Compile a scenario + engine params (``SimParams`` / ``SparseParams`` —
+    only the shared protocol knobs are read) into a :class:`SentinelSpec`.
+    ``config`` (a ClusterConfig) supplies ``chaos.*`` defaults; explicit
+    scenario fields win."""
+    n = params.capacity
+    scenario.validate_rows(n)
+    chaos_cfg = getattr(config, "chaos", None)
+    immunity = getattr(chaos_cfg, "loss_storm_immunity_pct", 50.0)
+    detect = scenario.detect_budget or getattr(
+        chaos_cfg, "detect_budget_ticks", 0
+    ) or default_detect_budget(params)
+    converge = scenario.converge_budget or getattr(
+        chaos_cfg, "converge_budget_ticks", 0
+    ) or default_converge_budget(params)
+    check = scenario.check_interval or getattr(
+        chaos_cfg, "check_interval_ticks", 0
+    ) or 32
+    # sampling must be able to observe a detection/convergence before its
+    # deadline passes; clamp the cadence well inside the tightest budget
+    check = max(1, min(check, detect // 4 or 1, converge // 4 or 1))
+
+    touched = scenario.fault_touched_rows(n, immunity)
+    never = np.ones((n,), bool)
+    never[sorted(touched)] = False
+
+    crash_rows: List[int] = []
+    crash_at: List[int] = []
+    crash_until: List[int] = []
+    conv_from: List[int] = []
+    conv_labels: List[str] = []
+    restarts: List[Restart] = [e for e in scenario.events if isinstance(e, Restart)]
+    for ev in scenario.events:
+        if isinstance(ev, Crash):
+            for r in ev.rows:
+                until = min(
+                    (rs.at for rs in restarts if r in rs.rows and rs.at > ev.at),
+                    default=np.iinfo(np.int32).max,
+                )
+                crash_rows.append(r)
+                crash_at.append(ev.at)
+                crash_until.append(until)
+        elif isinstance(ev, Partition) and ev.heal_at is not None:
+            conv_from.append(ev.heal_at)
+            conv_labels.append(f"partition_heal@{ev.heal_at}")
+        elif isinstance(ev, Restart):
+            conv_from.append(ev.at)
+            conv_labels.append(f"restart@{ev.at}")
+        elif isinstance(ev, LossStorm) and ev.until is not None:
+            conv_from.append(ev.until)
+            conv_labels.append(f"storm_end@{ev.until}")
+        elif isinstance(ev, LinkFlap) and ev.until is not None:
+            conv_from.append(ev.until)
+            conv_labels.append(f"flap_end@{ev.until}")
+
+    spec = SentinelSpec(
+        capacity=n,
+        never_faulted=never,
+        crash_rows=np.asarray(crash_rows, np.int32),
+        crash_at=np.asarray(crash_at, np.int32),
+        crash_deadline=np.asarray([a + detect for a in crash_at], np.int32),
+        crash_until=np.asarray(crash_until, np.int32),
+        conv_from=np.asarray(conv_from, np.int32),
+        conv_deadline=np.asarray([f + converge for f in conv_from], np.int32),
+        conv_labels=conv_labels,
+        detect_budget=detect,
+        converge_budget=converge,
+        check_interval=check,
+    )
+    auto_horizon = max(
+        scenario.last_event_tick() + 1,
+        int(max(spec.crash_deadline, default=0)),
+        int(max(spec.conv_deadline, default=0)),
+        2 * check,
+    )
+    spec.horizon = horizon or scenario.horizon or auto_horizon
+    return spec
+
+
+def init_sentinel_state(
+    view_key, spec: SentinelSpec, sparse: bool = False
+) -> Dict[str, object]:
+    """Fresh device-side sentinel accumulators, baselined on the current
+    view (one diag gather — a device op, not a transfer). ``sparse`` adds
+    the sparse engine's internal-consistency counter (``n_live`` drift)."""
+    import jax.numpy as jnp
+
+    n = spec.capacity
+    rows = jnp.arange(n)
+    sent = {
+        "prev_diag": view_key[rows, rows],
+        "key_regressions": jnp.int32(0),
+        "false_dead_max": jnp.int32(0),
+        "detect_tick": jnp.full((len(spec.crash_rows),), -1, jnp.int32),
+        "conv_tick": jnp.full((len(spec.conv_from),), -1, jnp.int32),
+    }
+    if sparse:
+        sent["n_live_drift"] = jnp.int32(0)
+    return sent
+
+
+def sentinel_report(sent_host: Dict[str, np.ndarray], spec: SentinelSpec,
+                    final_tick: int) -> dict:
+    """Fold the read-back accumulators into the structured scenario report
+    (the one host-side step; everything before it stayed on device)."""
+    detections = []
+    for k in range(len(spec.crash_rows)):
+        det = int(sent_host["detect_tick"][k])
+        deadline = int(spec.crash_deadline[k])
+        # only judge deadlines the run actually reached, and only crashes
+        # that PERSISTED through their whole budget — a quick-blip crash
+        # restarted before the deadline lapses the obligation (detection
+        # inside a window shorter than the suspicion math is impossible,
+        # and the restart's own convergence point takes over)
+        judged = (
+            final_tick >= deadline and int(spec.crash_until[k]) >= deadline
+        )
+        ok = (det >= 0 and det <= deadline) or not judged
+        detections.append({
+            "row": int(spec.crash_rows[k]),
+            "crashed_at": int(spec.crash_at[k]),
+            "deadline": deadline,
+            "detected_at": det if det >= 0 else None,
+            "ok": bool(ok),
+        })
+    convergence = []
+    for c in range(len(spec.conv_from)):
+        conv = int(sent_host["conv_tick"][c])
+        deadline = int(spec.conv_deadline[c])
+        judged = final_tick >= deadline
+        ok = (conv >= 0 and conv <= deadline) or not judged
+        convergence.append({
+            "label": spec.conv_labels[c],
+            "from": int(spec.conv_from[c]),
+            "deadline": deadline,
+            "converged_at": conv if conv >= 0 else None,
+            "ok": bool(ok),
+        })
+    false_dead = int(sent_host["false_dead_max"])
+    regress = int(sent_host["key_regressions"])
+    n_live_drift = int(sent_host.get("n_live_drift", 0))
+    violations = (
+        (1 if false_dead else 0)
+        + (1 if regress else 0)
+        + (1 if n_live_drift else 0)
+        + sum(1 for d in detections if not d["ok"])
+        + sum(1 for c in convergence if not c["ok"])
+    )
+    report = {
+        "false_dead_members_max": false_dead,
+        "key_regressions": regress,
+        "detections": detections,
+        "convergence": convergence,
+        "never_faulted_members": int(spec.never_faulted.sum()),
+        "detect_budget": spec.detect_budget,
+        "converge_budget": spec.converge_budget,
+        "check_interval": spec.check_interval,
+        "violations": violations,
+        "ok": violations == 0,
+    }
+    if "n_live_drift" in sent_host:
+        report["n_live_drift"] = n_live_drift
+    return report

@@ -121,6 +121,15 @@ class TransportConfig:
     max_frame_length: int = 2 * 1024 * 1024
     message_codec: str = "jdk"  # codec registry key, see transport/codecs.py
     transport_factory: Optional[str] = None  # factory registry key; None -> default
+    # Bounded reconnect for the stream (TCP/WebSocket) outbound path: a
+    # failed connect or mid-send connection drop retries up to
+    # ``reconnect_max_retries`` extra times with exponential backoff
+    # (base * 2^attempt, capped at max, +-50% jitter so a rebooting peer
+    # isn't stampeded); the give-up surfaces as a "reconnect_giveup"
+    # transport event. 0 retries restores the old fail-fast behavior.
+    reconnect_max_retries: int = 2
+    reconnect_base_delay: float = 0.05
+    reconnect_max_delay: float = 1.0
 
     def replace(self, **kw) -> "TransportConfig":
         return replace(self, **kw)
@@ -156,6 +165,29 @@ class SimConfig:
         return replace(self, **kw)
 
 
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Chaos scenario-engine knobs (new; no reference analogue — the sim's
+    fault-injection + invariant-sentinel subsystem, see ``chaos/``).
+
+    ``check_interval_ticks`` is the sentinel sampling cadence (sentinel facts
+    are latching/monotone, so sampling is sound and keeps an armed-but-idle
+    engine within noise of the plain pipelined driver). The budgets default
+    to protocol math when 0 (suspicion window + dissemination slack for
+    detection; 8 sync intervals + detection slack for re-convergence).
+    ``loss_storm_immunity_pct`` is the uniform-loss level at or above which
+    the no-false-DEAD sentinel stops vouching for untouched members (heavy
+    adversarial loss can legitimately suspect anyone)."""
+
+    check_interval_ticks: int = 32
+    detect_budget_ticks: int = 0  # 0 = auto from protocol math
+    converge_budget_ticks: int = 0  # 0 = auto
+    loss_storm_immunity_pct: float = 50.0
+
+    def replace(self, **kw) -> "ChaosConfig":
+        return replace(self, **kw)
+
+
 Lens = Callable
 
 
@@ -169,6 +201,7 @@ class ClusterConfig:
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
     sim: SimConfig = field(default_factory=SimConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     member_alias: Optional[str] = None
     external_host: Optional[str] = None  # container NAT mapping (ClusterConfig.java:236-300)
@@ -221,6 +254,9 @@ class ClusterConfig:
     def with_sim(self, op: Lens) -> "ClusterConfig":
         return replace(self, sim=op(self.sim))
 
+    def with_chaos(self, op: Lens) -> "ClusterConfig":
+        return replace(self, chaos=op(self.chaos))
+
     def replace(self, **kw) -> "ClusterConfig":
         return replace(self, **kw)
 
@@ -245,6 +281,14 @@ class ClusterConfig:
             raise ValueError("suspicion_mult must be > 0")
         if self.metadata_timeout <= 0:
             raise ValueError("metadata_timeout must be > 0")
+        if self.transport.reconnect_max_retries < 0:
+            raise ValueError("reconnect_max_retries must be >= 0")
+        if self.transport.reconnect_base_delay < 0:
+            raise ValueError("reconnect_base_delay must be >= 0")
+        if self.chaos.check_interval_ticks <= 0:
+            raise ValueError("chaos.check_interval_ticks must be > 0")
+        if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):
+            raise ValueError("chaos.loss_storm_immunity_pct must be in [0, 100]")
         return self
 
 

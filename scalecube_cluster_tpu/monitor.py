@@ -121,6 +121,7 @@ class MonitorServer:
         self._providers: Dict[str, Callable[[], Dict[str, Any]]] = {}
         self._health: Optional[Callable[[], Dict[str, Any]]] = None
         self._dispatch: Optional[Callable[[], Dict[str, Any]]] = None
+        self._chaos: Optional[Callable[[], Dict[str, Any]]] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
@@ -151,6 +152,10 @@ class MonitorServer:
         driver.enable_health_probes()
         self._health = lambda: driver.health_snapshot()
         self._dispatch = lambda: dispatch_snapshot(driver)
+        # ``/chaos``: the armed scenario's progress + sentinel report (r7).
+        # Registered alongside health because reading sentinel accumulators
+        # is a sync point of exactly the same cadence contract.
+        self._chaos = lambda: driver.chaos_snapshot()
 
     async def start(self) -> "MonitorServer":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -191,7 +196,12 @@ class MonitorServer:
                 "nodes": sorted(self._providers),
                 "health": self._health is not None,
                 "dispatch": self._dispatch is not None,
+                "chaos": self._chaos is not None,
             }
+        if path == "/chaos":
+            if self._chaos is None:
+                return b"404 Not Found", {"error": "no chaos provider registered"}
+            return b"200 OK", self._chaos()
         if path == "/health":
             if self._health is None:
                 return b"404 Not Found", {"error": "no health provider registered"}

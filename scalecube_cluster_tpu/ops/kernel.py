@@ -845,6 +845,82 @@ def run_ticks(
     return state, key, ms, watched
 
 
+def sentinel_core(
+    view_key: jax.Array,
+    up: jax.Array,
+    tick: jax.Array,
+    sent: dict,
+    spec: dict,
+) -> dict:
+    """One chaos-sentinel check over the shared view planes (chaos/sentinels
+    semantics; this array-level core serves BOTH engines — dense here, the
+    sparse wrapper in :func:`.sparse.sentinel_reduce`). Pure jnp reductions:
+    staged on device, nothing transferred — the r6 zero-readback discipline.
+
+    ``sent`` is the accumulator pytree from ``chaos.sentinels
+    .init_sentinel_state``; ``spec`` the uploaded ``SentinelSpec`` arrays.
+    Every update is latching/monotone, so sampled invocation is sound:
+
+    * ``false_dead_max`` — never-faulted up subjects currently tombstoned
+      (``key >= 0`` excludes unknown) by any up observer; DEAD latches until
+      a rejoin, so a sampled max cannot miss a violation.
+    * ``detect_tick[k]`` — first sampled tick at which EVERY up observer
+      reads crashed row k at rank DEAD (unknown, key -1, also reads rank 3:
+      "not a member" counts as detected, matching the reference's removal).
+    * ``conv_tick[c]`` — first sampled tick >= the recovery boundary where
+      all up pairs read each other ALIVE.
+    * ``key_regressions`` — self-record packed keys (epoch|inc|rank) that
+      moved BACKWARD since the previous check: a lattice-monotonicity break
+      (restarts bump the epoch high bits, so a legitimate rejoin still
+      rises).
+    """
+    n = view_key.shape[0]
+    rows = jnp.arange(n)
+    rank = view_key & 3  # UNKNOWN (-1) reads rank 3
+    rel = tick - spec["t0"]  # scenario-relative tick (spec ticks are relative)
+
+    diag = view_key[rows, rows]
+    sent = dict(sent)
+    sent["key_regressions"] = sent["key_regressions"] + (
+        diag < sent["prev_diag"]
+    ).sum().astype(jnp.int32)
+    sent["prev_diag"] = diag
+
+    nf_up = spec["never_faulted"] & up
+    false_dead = (
+        (view_key >= 0) & (rank == RANK_DEAD) & up[:, None] & nf_up[None, :]
+    ).any(axis=0).sum().astype(jnp.int32)
+    sent["false_dead_max"] = jnp.maximum(sent["false_dead_max"], false_dead)
+
+    crash_rows = spec["crash_rows"]
+    if crash_rows.shape[0]:
+        cols = rank[:, crash_rows]  # [N, K]
+        others_up = up[:, None] & (rows[:, None] != crash_rows[None, :])
+        detected = (~others_up | (cols == RANK_DEAD)).all(axis=0)
+        active = (
+            (rel >= spec["crash_at"])
+            & (rel <= spec["crash_until"])
+            & (sent["detect_tick"] < 0)
+        )
+        sent["detect_tick"] = jnp.where(
+            active & detected, rel, sent["detect_tick"]
+        )
+
+    if spec["conv_from"].shape[0]:
+        up2 = up[:, None] & up[None, :] & ~jnp.eye(n, dtype=bool)
+        converged = (~up2 | (rank == RANK_ALIVE)).all()
+        active = (rel >= spec["conv_from"]) & (sent["conv_tick"] < 0)
+        sent["conv_tick"] = jnp.where(
+            active & converged, rel, sent["conv_tick"]
+        )
+    return sent
+
+
+def sentinel_reduce(state: SimState, sent: dict, spec: dict) -> dict:
+    """Dense-engine chaos sentinel check (see :func:`sentinel_core`)."""
+    return sentinel_core(state.view_key, state.up, state.tick, sent, spec)
+
+
 def make_run(params: SimParams, n_ticks: int, donate: bool = True):
     """Jitted :func:`run_ticks` window with the state buffers DONATED.
 

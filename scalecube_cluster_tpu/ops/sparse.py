@@ -802,6 +802,50 @@ def heal_partition(state: SparseState, group_a, group_b) -> SparseState:
     return set_link_loss(s, group_b, group_a, 0.0)
 
 
+def set_uniform_loss(
+    state: SparseState, loss: float, floor: bool = False
+) -> SparseState:
+    """Uniform link loss across every link (chaos LossStorm site). Scalar
+    mode swaps the one loss scalar; dense mode rewrites the matrix — with
+    ``floor=True`` existing losses only ever RISE (``max(loss_ij, loss)``),
+    so partition blocks survive a storm. ``fetch_rt`` is re-derived here
+    (losses change only between ticks; see the dense state's account)."""
+    if state.loss.ndim == 0:
+        new_loss = jnp.float32(
+            jnp.maximum(state.loss, loss) if floor else loss
+        )
+    else:
+        new_loss = (
+            jnp.maximum(state.loss, jnp.float32(loss))
+            if floor
+            else jnp.full_like(state.loss, loss)
+        )
+    return state.replace(loss=new_loss, fetch_rt=_roundtrip(new_loss))
+
+
+def crash_rows(state: SparseState, rows) -> SparseState:
+    """Vectorized hard-kill of a whole crash cohort (chaos Crash site)."""
+    return state.replace(
+        up=state.up.at[jnp.asarray(rows, jnp.int32)].set(False)
+    )
+
+
+def sentinel_reduce(state: SparseState, sent: dict, spec: dict) -> dict:
+    """Sparse-engine chaos sentinel check: the shared view-plane core
+    (:func:`.kernel.sentinel_core`) plus the sparse-only internal
+    consistency sentinel — ``n_live`` (the incrementally maintained
+    non-DEAD column count that drives every ceilLog2 knob) must equal a
+    fresh recount for every up row; drift means the incremental updates
+    and the merge disagreed, a corruption no protocol-level check sees."""
+    from .kernel import sentinel_core
+
+    sent = sentinel_core(state.view_key, state.up, state.tick, sent, spec)
+    recount = ((state.view_key & 3) != RANK_DEAD).sum(axis=1).astype(jnp.int32)
+    drift = (state.up & (recount != state.n_live)).sum().astype(jnp.int32)
+    sent["n_live_drift"] = sent.get("n_live_drift", jnp.int32(0)) + drift
+    return sent
+
+
 def snapshot(state: SparseState) -> dict:
     return {
         f.name: np.asarray(getattr(state, f.name))

@@ -571,6 +571,28 @@ def set_link_delay(state: SimState, src, dst, mean_delay_ticks: float) -> SimSta
     )
 
 
+def set_uniform_loss(state: SimState, loss: float, floor: bool = False) -> SimState:
+    """Uniform link loss across every link (chaos LossStorm site). With
+    ``floor=True`` existing losses only ever RISE (``max(loss_ij, loss)``)
+    so partition blocks survive a storm; dense mode rewrites the matrix,
+    scalar mode swaps the one loss scalar. ``fetch_rt`` is re-derived (the
+    one full recompute is fine: losses change only between ticks)."""
+    if state.loss.ndim == 0:
+        new_loss = jnp.float32(jnp.maximum(state.loss, loss) if floor else loss)
+    else:
+        new_loss = (
+            jnp.maximum(state.loss, jnp.float32(loss))
+            if floor
+            else jnp.full_like(state.loss, loss)
+        )
+    return state.replace(loss=new_loss, fetch_rt=_roundtrip(new_loss))
+
+
+def crash_rows(state: SimState, rows) -> SimState:
+    """Vectorized hard-kill of a whole crash cohort (chaos Crash site)."""
+    return state.replace(up=state.up.at[jnp.asarray(rows, jnp.int32)].set(False))
+
+
 def block_partition(state: SimState, group_a, group_b) -> SimState:
     """Symmetric partition: drop all traffic between the two groups."""
     s = set_link_loss(state, group_a, group_b, 1.0)

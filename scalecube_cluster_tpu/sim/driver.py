@@ -63,6 +63,17 @@ def row_address(row: int) -> str:
     return f"sim://{row}"
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint file that cannot be restored (truncated, corrupt, schema
+    from the future, or written by the other engine) — raised instead of
+    letting numpy/pickle fail arbitrarily deep in the load path."""
+
+
+#: Checkpoint schema: 1 = the implicit pre-r7 layout (no version stamp),
+#: 2 = r7 crash-safe layout (tmp+rename, _schema + _crc32 + _engine fields).
+CHECKPOINT_SCHEMA = 2
+
+
 _RANK_TO_STATUS_NP = np.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=np.int8)
 
 
@@ -260,6 +271,10 @@ class SimDriver:
         self._lock = threading.RLock()
         self._recent_joins: List[tuple] = []  # (tick, row) of driver joins
         self._join_horizon = 300  # ticks a join stays in the lag cohorts
+        # armed chaos runner (chaos.DriverChaosRunner): fault timeline +
+        # on-device invariant sentinels; surfaced via chaos_snapshot(),
+        # health_snapshot()'s "chaos" section and the monitor's GET /chaos
+        self._chaos = None
 
     # -- time ---------------------------------------------------------------
     @property
@@ -802,6 +817,8 @@ class SimDriver:
                 "active_now": int(np.asarray(self.state.mr_active).sum()),
                 "high_water": self._pool_high_water,
             }
+        if self._chaos is not None:
+            out["chaos"] = self._chaos.snapshot()
         return out
 
     def enable_health_probes(self) -> None:
@@ -810,17 +827,80 @@ class SimDriver:
         probe so host-path announce drops are counted from now on."""
         self._health_interest = True
 
+    # -- chaos scenarios (fault timelines + invariant sentinels) -------------
+    def run_scenario(
+        self,
+        scenario,
+        *,
+        config=None,
+        sentinels: bool = True,
+        max_window: int = 32,
+    ) -> dict:
+        """Run a :class:`..chaos.Scenario` against this driver: scripted
+        fault events applied between windows (partitions, loss storms, link
+        flaps, crashes, restarts) with the on-device SWIM invariant
+        sentinels armed. Stepping stays transfer-free (the r6 pipelined
+        discipline — fault injection and sentinel checks are pure device
+        ops); the returned structured report is the one sync point. The
+        same scenario object runs unmodified on the dense, sparse, and
+        mesh-sharded drivers, and on the scalar engine via
+        :class:`..chaos.EmulatorChaosRunner`."""
+        from ..chaos.engine import run_driver_scenario
+
+        return run_driver_scenario(
+            self, scenario, config=config, sentinels=sentinels,
+            max_window=max_window,
+        )
+
+    def chaos_snapshot(self) -> dict:
+        """Live chaos view (``GET /chaos``): the armed scenario's progress +
+        sentinel report, or ``{"armed": False}`` when none was ever armed.
+        Reading sentinel accumulators is a sync point, like every other
+        snapshot — poll cadence, not window cadence."""
+        runner = self._chaos
+        if runner is None:
+            return {"armed": False}
+        return runner.snapshot()
+
     # -- checkpoint/resume ---------------------------------------------------
     def checkpoint(self, path: str) -> None:
         """Full resumable snapshot: device state + RNG chains + the host-side
         identity map and rumor payloads (restoring into a fresh driver must
-        reproduce the same member ids and payloads, not refabricate them)."""
+        reproduce the same member ids and payloads, not refabricate them).
+
+        Crash-safe: the archive is written to a temp file in the target
+        directory, fsynced, and moved into place with ``os.replace`` — a
+        crash mid-write can never leave a half-written file under ``path``.
+        The archive embeds a schema version, the engine name, and a CRC32 of
+        the host-side pickle; :meth:`restore` verifies all three and raises
+        :class:`CheckpointError` on truncated/corrupt/foreign files."""
+        import os
         import pickle
+        import tempfile
+        import zlib
 
         with self._lock:
-            return self._checkpoint_locked(path, pickle)
+            payload = self._checkpoint_payload_locked(pickle, zlib)
+        # mkstemp, not a pid-derived name: two concurrent checkpoint()s to
+        # the same path (monitor thread + user thread) must not truncate
+        # each other's half-written archive — each writes its own file and
+        # the os.replace()s serialize at the filesystem
+        target = os.path.abspath(path)
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(target) + ".tmp-",
+            dir=os.path.dirname(target),
+        )
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                np.savez_compressed(fh, **payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, target)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
-    def _checkpoint_locked(self, path: str, pickle) -> None:
+    def _checkpoint_payload_locked(self, pickle, zlib) -> dict:
         self._flush_locked()  # fold staged device reductions into host counters
         host = {
             "members": dict(self.members),
@@ -835,11 +915,14 @@ class SimDriver:
             "segmentation_warnings": self._segmentation_warnings,
             "recent_joins": list(self._recent_joins),
         }
-        np.savez_compressed(
-            path,
-            **self._ops.snapshot(self.state),
+        host_bytes = pickle.dumps(host)
+        return dict(
+            self._ops.snapshot(self.state),
             _key=np.asarray(self._key),
-            _host=np.frombuffer(pickle.dumps(host), dtype=np.uint8),
+            _host=np.frombuffer(host_bytes, dtype=np.uint8),
+            _schema=np.int32(CHECKPOINT_SCHEMA),
+            _crc32=np.uint32(zlib.crc32(host_bytes) & 0xFFFFFFFF),
+            _engine=np.bytes_(b"sparse" if self.sparse else b"dense"),
         )
 
     def restore(self, path: str) -> None:
@@ -849,11 +932,53 @@ class SimDriver:
             self._restore_locked(path, pickle)
 
     def _restore_locked(self, path: str, pickle) -> None:
-        data = dict(np.load(path))
+        import zlib
+
+        try:
+            with np.load(path) as npz:
+                data = dict(npz)
+        except FileNotFoundError:
+            raise
+        except Exception as exc:  # zipfile/npy deep failures -> one clear error
+            raise CheckpointError(
+                f"checkpoint {path!r} is unreadable (truncated or corrupt): {exc}"
+            ) from exc
+        schema = int(data.pop("_schema", 1))
+        if schema > CHECKPOINT_SCHEMA:
+            raise CheckpointError(
+                f"checkpoint {path!r} has schema {schema}, newer than this "
+                f"build's {CHECKPOINT_SCHEMA} — refusing a partial decode"
+            )
+        engine_raw = data.pop("_engine", None)
+        if engine_raw is not None:
+            engine = bytes(engine_raw.tobytes()).rstrip(b"\x00").decode()
+            mine = "sparse" if self.sparse else "dense"
+            if engine != mine:
+                raise CheckpointError(
+                    f"checkpoint {path!r} was written by the {engine} engine; "
+                    f"this driver runs the {mine} engine"
+                )
+        crc_expect = data.pop("_crc32", None)
+        if "_key" not in data or "_host" not in data:
+            raise CheckpointError(
+                f"checkpoint {path!r} is missing required members (truncated?)"
+            )
+        host_bytes = data.pop("_host").tobytes()
+        if crc_expect is not None and (
+            zlib.crc32(host_bytes) & 0xFFFFFFFF
+        ) != int(crc_expect):
+            raise CheckpointError(
+                f"checkpoint {path!r} failed its CRC32 check (corrupt)"
+            )
         # copy=True: asarray may zero-copy the aligned npz buffer (see
         # ops.state.restore) and the key rides through every jitted window
         self._key = jax.numpy.array(data.pop("_key"), copy=True)
-        host = pickle.loads(data.pop("_host").tobytes())
+        try:
+            host = pickle.loads(host_bytes)
+        except Exception as exc:
+            raise CheckpointError(
+                f"checkpoint {path!r} host section does not unpickle: {exc}"
+            ) from exc
         self.members = host["members"]
         self._rumor_payloads = host["rumor_payloads"]
         self._next_member_ordinal = host["next_member_ordinal"]
@@ -871,7 +996,12 @@ class SimDriver:
         # (warnings from the abandoned branch must not survive a restore)
         self._segmentation_warnings = host.get("segmentation_warnings", 0)
         self._recent_joins = [tuple(j) for j in host.get("recent_joins", [])]
-        state = self._ops.restore(data)
+        try:
+            state = self._ops.restore(data)
+        except TypeError as exc:  # missing/extra planes: foreign or truncated
+            raise CheckpointError(
+                f"checkpoint {path!r} state planes do not match this engine: {exc}"
+            ) from exc
         if self.mesh is not None:
             from ..ops.sharding import shard_sparse_state, shard_state
 

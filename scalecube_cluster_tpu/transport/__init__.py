@@ -4,6 +4,7 @@ from .api import (
     PeerUnavailableError,
     Transport,
     TransportError,
+    TransportEvent,
     bind_transport,
     create_transport,
     register_transport_factory,
@@ -21,6 +22,7 @@ from .websocket import WebsocketTransport
 __all__ = [
     "Transport",
     "TransportError",
+    "TransportEvent",
     "PeerUnavailableError",
     "Listeners",
     "bind_transport",

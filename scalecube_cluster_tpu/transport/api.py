@@ -37,6 +37,31 @@ class PeerUnavailableError(TransportError):
     """Destination address cannot be reached (no such peer / connect refused)."""
 
 
+from dataclasses import dataclass as _dataclass, field as _field
+import time as _time
+
+
+@_dataclass(frozen=True)
+class TransportEvent:
+    """Structured transport lifecycle event, emitted on a transport's
+    ``transport_events()`` stream (stream transports only today): reconnect
+    backoff attempts, the bounded-retry give-up, and outbound connection
+    losses. Gives operators/monitors the signal the old "dropping outbound
+    connection" log line swallowed.
+
+    kinds: ``reconnect_backoff`` (a retry is scheduled; ``attempts`` so
+    far, ``delay`` seconds), ``reconnect_giveup`` (retry budget exhausted —
+    the send raised), ``connection_lost`` (an established outbound channel
+    died and was evicted from the cache)."""
+
+    kind: str
+    address: str
+    attempts: int = 0
+    delay: float = 0.0
+    error: str = ""
+    ts: float = _field(default_factory=_time.time)
+
+
 class Transport(ABC):
     """The 4-method p2p messaging contract (reference Transport.java:11-79)."""
 

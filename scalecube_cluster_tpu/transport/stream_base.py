@@ -17,9 +17,17 @@ import logging
 from abc import abstractmethod
 from typing import Dict, Optional, Tuple
 
+import random
+
 from ..config import TransportConfig
 from ..models.message import Message
-from .api import Listeners, PeerUnavailableError, Transport, TransportError
+from .api import (
+    Listeners,
+    PeerUnavailableError,
+    Transport,
+    TransportError,
+    TransportEvent,
+)
 from .codecs import message_codec
 
 logger = logging.getLogger(__name__)
@@ -77,6 +85,9 @@ class StreamTransportBase(Transport):
         # peer address -> pending/established connection (TransportImpl.java:54)
         self._connections: Dict[str, "asyncio.Future[CachedConnection]"] = {}
         self._inbound_writers: set = set()
+        # transport lifecycle events (reconnect backoff/giveup, connection
+        # loss) — see api.TransportEvent; lazily consumed, never required
+        self._events = Listeners()
 
     # -- subclass hooks ------------------------------------------------------
     @abstractmethod
@@ -212,18 +223,69 @@ class StreamTransportBase(Transport):
             self._connections.pop(address, None)
             raise err from exc
 
+    def _emit_event(self, kind: str, address: str, attempts: int = 0,
+                    delay: float = 0.0, error: str = "") -> None:
+        self._events.emit(TransportEvent(
+            kind=kind, address=address, attempts=attempts, delay=delay,
+            error=error,
+        ))
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff with +-50% jitter, capped: attempt 1 waits
+        ~base, attempt 2 ~2*base, ... A synchronized retry stampede against
+        a rebooting peer is exactly what the jitter breaks up."""
+        base = self._config.reconnect_base_delay * (2 ** (attempt - 1))
+        return min(base, self._config.reconnect_max_delay) * (
+            0.5 + random.random()
+        )
+
     async def send(self, address: str, message: Message) -> None:
+        """Fire-and-forget send over the cached connection, with BOUNDED
+        reconnect: a failed connect or a connection that dies mid-send is
+        retried up to ``config.reconnect_max_retries`` extra times with
+        exponential backoff + jitter (the pre-r7 behavior silently dropped
+        the cached connection and failed the send). Exhausting the budget
+        raises ``PeerUnavailableError`` AND emits a ``reconnect_giveup``
+        transport event — churn monitoring must be able to see give-ups
+        without scraping logs. Retrying a write that may have partially
+        left the socket keeps at-most-once per ATTEMPT, like the
+        reference's reconnect-then-resend; SWIM tolerates duplicates by
+        design (every merge is idempotent)."""
         if self._stopped:
             raise TransportError("transport is stopped")
-        conn = await self._connect(address)
         payload = self._codec.encode(message)
         if len(payload) > self._config.max_frame_length:
             raise TransportError(f"frame too large: {len(payload)}")
-        try:
-            await conn.write_bytes(self._frame(payload))
-        except (ConnectionResetError, BrokenPipeError) as exc:
-            self._connections.pop(address, None)
-            raise PeerUnavailableError(f"send to {address} failed: {exc}") from exc
+        attempt = 0
+        while True:
+            try:
+                conn = await self._connect(address)
+                await conn.write_bytes(self._frame(payload))
+                return
+            except (PeerUnavailableError, ConnectionResetError,
+                    BrokenPipeError) as exc:
+                self._connections.pop(address, None)
+                attempt += 1
+                if self._stopped or attempt > self._config.reconnect_max_retries:
+                    self._emit_event(
+                        "reconnect_giveup", address, attempts=attempt,
+                        error=str(exc),
+                    )
+                    raise PeerUnavailableError(
+                        f"send to {address} failed after {attempt} "
+                        f"attempt(s): {exc}"
+                    ) from exc
+                delay = self._backoff_delay(attempt)
+                self._emit_event(
+                    "reconnect_backoff", address, attempts=attempt,
+                    delay=delay, error=str(exc),
+                )
+                await asyncio.sleep(delay)
 
     def listen(self) -> Listeners:
         return self._listeners
+
+    def transport_events(self) -> Listeners:
+        """Hot stream of :class:`..transport.api.TransportEvent` (reconnect
+        backoff / give-up, outbound connection loss)."""
+        return self._events

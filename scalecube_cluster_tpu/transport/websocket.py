@@ -218,6 +218,7 @@ class WebsocketTransport(StreamTransportBase):
         channel feed the same listen() stream as server-side ones."""
 
         async def _drain() -> None:
+            drop_error = ""
             try:
                 while not self._stopped:
                     payload = await _read_message(
@@ -227,9 +228,10 @@ class WebsocketTransport(StreamTransportBase):
                     if payload is None:  # peer CLOSE
                         break
                     self._listeners.emit(self._codec.decode(payload))
-            except (asyncio.IncompleteReadError, ConnectionResetError):
-                pass
+            except (asyncio.IncompleteReadError, ConnectionResetError) as exc:
+                drop_error = str(exc)
             except TransportError as exc:
+                drop_error = str(exc)
                 logger.warning(
                     "[%s] dropping outbound connection to %s: %s",
                     self._address, address, exc,
@@ -247,6 +249,13 @@ class WebsocketTransport(StreamTransportBase):
                     and fut.result() is conn
                 ):
                     self._connections.pop(address, None)
+                    # surfaced as a transport event so churn monitors see
+                    # channel loss without scraping logs; the next send()
+                    # runs the bounded-backoff reconnect
+                    if not self._stopped:
+                        self._emit_event(
+                            "connection_lost", address, error=drop_error,
+                        )
                 conn.close()
 
         conn.reader_task = asyncio.get_running_loop().create_task(_drain())

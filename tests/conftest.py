@@ -28,3 +28,11 @@ else:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soaks (tier-1 runs with -m 'not slow'; "
+        "opt in with -m slow)",
+    )

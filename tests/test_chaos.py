@@ -1,0 +1,558 @@
+"""Chaos scenario engine + invariant sentinels (r7 tentpole).
+
+The properties the subsystem must keep:
+
+1. ONE scenario object runs unmodified on the dense driver, the sparse
+   driver, the mesh-sharded driver, and (via the emulator runner) the
+   scalar/real-transport engine.
+2. A scripted partition→heal re-converges on every engine with ZERO
+   sentinel violations — and the scalar ORACLE agrees tick-for-tick with
+   the kernel through the whole injected timeline (fault injection must
+   not break the lockstep-equivalence contract).
+3. An injected protocol bug (a suppressed heal) is CAUGHT as a convergence
+   violation — the sentinels are falsifiable, not decorative.
+4. An armed chaos engine keeps the r6 pipelined discipline: fault
+   injection and sentinel checks perform zero per-window device→host
+   transfers; the report is the one sync point.
+5. Checkpoints are crash-safe: atomic tmp+rename writes, schema + CRC
+   validation, clear ``CheckpointError`` on truncated/corrupt/foreign
+   files instead of a numpy deep-failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.sparse as SP
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.chaos import (
+    Crash,
+    LinkFlap,
+    LossStorm,
+    Partition,
+    Restart,
+    Scenario,
+    ScenarioError,
+    StateTimeline,
+)
+from scalecube_cluster_tpu.chaos.engine import DriverChaosRunner
+from scalecube_cluster_tpu.ops.lattice import RANK_DEAD
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.sim.driver import CheckpointError
+
+
+def _dense_params(n=12, seeds=(0, 6)):
+    return S.SimParams(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=2, seed_rows=seeds,
+    )
+
+
+def _sparse_params(n=12, seeds=(0, 6)):
+    return SP.SparseParams(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, sweep_every=2, rumor_slots=2,
+        mr_slots=24, announce_slots=8, seed_rows=seeds,
+    )
+
+
+# One scripted partition→heal scenario, shared verbatim across every engine
+# (the acceptance property: same file, four code paths). The split covers
+# ALL rows, so re-merge can only happen through seed-row re-bridging
+# (ops/state.py seed_rows — selectSyncAddress draws from seeds ∪ members).
+SPLIT_SCENARIO = Scenario(
+    name="split-heal",
+    events=[Partition(groups=[range(0, 6), range(6, 12)], at=10, heal_at=70)],
+    horizon=320,
+    check_interval=8,
+)
+
+MIXED_SCENARIO = Scenario(
+    name="mixed-faults",
+    events=[
+        Crash(rows=[4], at=3),
+        Partition(groups=[range(0, 6), range(6, 12)], at=30, heal_at=90),
+        Restart(rows=[4], at=120, seed_rows=(0,)),
+        LossStorm(pct=20.0, at=150, until=170),
+    ],
+    horizon=400,
+    check_interval=8,
+)
+
+
+def _all_up_alive(driver) -> bool:
+    vk = np.asarray(driver.state.view_key)
+    up = np.asarray(driver.state.up)
+    up2 = up[:, None] & up[None, :] & ~np.eye(len(up), dtype=bool)
+    return bool((~up2 | ((vk & 3) == 0)).all())
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+def test_partition_heal_reconverges_with_zero_violations(engine):
+    """Acceptance: the scripted split→heal scenario re-merges both sides on
+    the dense AND sparse drivers with a clean sentinel report, and no
+    never-faulted row is ever marked DEAD (there are none here — the split
+    covers everyone — so the sentinel must also count zero cohort)."""
+    if engine == "dense":
+        d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    else:
+        d = SimDriver(_sparse_params(), 12, warm=True, seed=0, dense_links=True)
+    rep = d.run_scenario(SPLIT_SCENARIO)
+    assert rep["ok"], rep
+    assert rep["violations"] == 0
+    sent = rep["sentinels"]
+    assert sent["false_dead_members_max"] == 0
+    assert sent["key_regressions"] == 0
+    conv = sent["convergence"]
+    assert len(conv) == 1 and conv[0]["ok"]
+    assert conv[0]["converged_at"] is not None
+    assert conv[0]["converged_at"] <= conv[0]["deadline"]
+    assert _all_up_alive(d)  # both sides actually re-merged
+    if engine == "sparse":
+        assert sent["n_live_drift"] == 0
+    # the driver keeps the runner armed for monitor polls
+    snap = d.chaos_snapshot()
+    assert snap["scenario"] == "split-heal"
+    assert snap["armed"] is False  # run completed
+
+
+def test_mixed_scenario_detection_and_restart(engine_params=None):
+    """Crash detection latency is bounded and reported; the restarted row is
+    a FRESH identity (member ordinal advanced) and the cluster re-converges
+    after every recovery boundary."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    before = d.members[4].id
+    rep = d.run_scenario(MIXED_SCENARIO)
+    assert rep["ok"], rep
+    det = rep["sentinels"]["detections"]
+    assert len(det) == 1
+    assert det[0]["row"] == 4 and det[0]["detected_at"] is not None
+    assert det[0]["detected_at"] <= det[0]["deadline"]
+    assert all(c["ok"] for c in rep["sentinels"]["convergence"])
+    assert d.members[4].id != before  # restart = new member identity
+
+
+def test_scalar_oracle_agrees_through_partition_heal():
+    """The scalar oracle (the per-node reference semantics) must stay
+    bit-identical to the kernel through the injected split→heal timeline —
+    and both must re-merge. Fault injection happens through the SAME
+    StateTimeline the driver runner uses."""
+    params = S.SimParams(
+        capacity=8, fanout=2, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=5, suspicion_mult=2, rumor_slots=2, seed_rows=(0, 4),
+    )
+    scn = Scenario(
+        name="split-heal-oracle",
+        events=[Partition(groups=[range(0, 4), range(4, 8)], at=5, heal_at=45)],
+        horizon=150,
+    )
+    tl = StateTimeline(scn, S, dense_links=True)
+    st = S.init_state(params, 8, warm=True)
+    step = jax.jit(partial(K.tick, params=params))
+    key = jax.random.PRNGKey(11)
+    split_seen = False
+    for t in range(150):
+        st, _labels = tl.apply_due(st, t)
+        key, k = jax.random.split(key)
+        st_next, _m = step(st, k)
+        oracle = O.oracle_tick(st, k, params)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
+        if t == 44:  # just before the heal: the sides must have diverged
+            vk = np.asarray(st.view_key)
+            split_seen = bool(((vk[0, 4:] & 3) == RANK_DEAD).all())
+    assert split_seen, "partition never caused mutual removal"
+    vk = np.asarray(st.view_key)
+    assert ((vk & 3) == 0).all(), "kernel+oracle did not re-merge after heal"
+
+
+def test_suppressed_heal_is_caught_as_violation(monkeypatch):
+    """Falsifiability: if the heal never actually lands (an injected
+    protocol/injection bug), the convergence sentinel MUST flag it."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    runner = DriverChaosRunner(d, SPLIT_SCENARIO)
+    # suppress the heal action — the scenario still *promises* convergence
+    runner.timeline._steps = [
+        s for s in runner.timeline._steps if s.kind != "partition_heal"
+    ]
+    rep = runner.run()
+    assert not rep["ok"]
+    conv = rep["sentinels"]["convergence"]
+    assert len(conv) == 1 and not conv[0]["ok"]
+    assert conv[0]["converged_at"] is None
+    assert rep["violations"] >= 1
+
+
+def test_false_dead_sentinel_catches_injected_tombstone():
+    """A DEAD record forged about a member no event ever faulted must
+    surface as a false-DEAD violation (protocol-bug tripwire)."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    scn = Scenario(
+        name="crash-only",
+        events=[Crash(rows=[4], at=2)],
+        horizon=40, check_interval=4,
+    )
+    runner = DriverChaosRunner(d, scn)
+    # rows other than 4 are never-faulted; forge a tombstone about row 7
+    assert bool(runner.spec.never_faulted[7])
+    dead_key = np.int32((5 << 2) | RANK_DEAD)
+    d.state = d.state.replace(view_key=d.state.view_key.at[2, 7].set(dead_key))
+    rep = runner.run()
+    assert rep["sentinels"]["false_dead_members_max"] >= 1
+    assert not rep["ok"]
+
+
+def test_linkflap_and_scalar_loss_validation():
+    """Engine mismatch fails fast: per-link events need dense links; the
+    lean scalar-loss sparse driver must reject them with a clear error,
+    while a LossStorm (uniform) is allowed there."""
+    d = SimDriver(_sparse_params(), 12, warm=True, seed=0)  # scalar loss
+    flap = Scenario(
+        name="flap",
+        events=[LinkFlap(pairs=[(1, 2)], period=4, at=0, until=16)],
+        horizon=32,
+    )
+    with pytest.raises(ScenarioError, match="dense"):
+        d.run_scenario(flap)
+    storm = Scenario(
+        name="storm", events=[LossStorm(pct=10.0, at=2, until=6)], horizon=40,
+        check_interval=8,
+    )
+    rep = d.run_scenario(storm)
+    assert rep["ok"], rep
+
+
+def test_scenario_dsl_validation():
+    with pytest.raises(ScenarioError):
+        Partition(groups=[[1, 2]], at=0)  # one group is no partition
+    with pytest.raises(ScenarioError):
+        Partition(groups=[[1], [2]], at=10, heal_at=10)
+    with pytest.raises(ScenarioError):
+        LossStorm(pct=140.0, at=0)
+    with pytest.raises(ScenarioError):
+        LinkFlap(pairs=[], period=3)
+    with pytest.raises(ScenarioError):
+        Scenario(name="bad", events=[Crash(rows=[1], at=-3)])
+    # fault-touched cohort: storms below the immunity threshold leave the
+    # untouched rows vouched-for
+    scn = Scenario(
+        name="c",
+        events=[Crash(rows=[3], at=1), LossStorm(pct=10.0, at=2, until=4)],
+    )
+    assert scn.fault_touched_rows(8) == {3}
+    scn_hot = Scenario(name="h", events=[LossStorm(pct=80.0, at=2, until=4)])
+    assert scn_hot.fault_touched_rows(4) == {0, 1, 2, 3}
+
+
+def test_armed_chaos_steps_are_transfer_free(monkeypatch):
+    """Extends the r6 transfer-spy proof to an ARMED chaos engine: stepping
+    with sentinels staged (including sentinel checks and an applied fault)
+    performs zero device→host transfers; the report is the sync point."""
+    d = SimDriver(_sparse_params(), 12, warm=True, seed=1, dense_links=True)
+    scn = Scenario(
+        name="armed-idle",
+        events=[Partition(groups=[range(0, 6), range(6, 12)], at=2, heal_at=6)],
+        horizon=64, check_interval=4,
+    )
+    runner = DriverChaosRunner(d, scn)
+    d.step(2)  # compile the window program outside the spied region
+    d.sync()
+    base_readbacks = d.dispatch_stats["readbacks"]
+
+    transfers = []
+    real_asarray = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for t in (2, 6, 8, 12):
+            with d._lock:
+                d.state, _ = runner.timeline.apply_due(d.state, t)
+            d.step(4)
+            runner._run_check()
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"armed chaos stepping read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == base_readbacks
+
+    report = runner.report()  # the one sync point
+    assert report["sentinels"]["false_dead_members_max"] == 0
+    assert d.dispatch_stats["readbacks"] > base_readbacks
+
+
+def test_chaos_monitor_endpoint():
+    """GET /chaos serves the armed scenario's report; unarmed drivers say
+    so instead of 404-ing the whole monitor."""
+    import json
+    import urllib.request
+
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+
+    async def run():
+        server = await MonitorServer().start()
+        server.register_health(d)
+        loop = asyncio.get_running_loop()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return json.loads(resp.read())
+
+        index = await loop.run_in_executor(None, get, server.url + "/")
+        assert index["chaos"] is True
+        unarmed = await loop.run_in_executor(None, get, server.url + "/chaos")
+        assert unarmed == {"armed": False}
+        scn = Scenario(name="probe", events=[Crash(rows=[3], at=2)],
+                       horizon=60, check_interval=8)
+        await loop.run_in_executor(None, lambda: d.run_scenario(scn))
+        chaos = await loop.run_in_executor(None, get, server.url + "/chaos")
+        assert chaos["scenario"] == "probe"
+        assert chaos["sentinels"]["detections"][0]["row"] == 3
+        health = await loop.run_in_executor(None, get, server.url + "/health")
+        assert health["chaos"]["scenario"] == "probe"
+        await server.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# the scalar/real-transport engine (EmulatorChaosRunner)
+# ---------------------------------------------------------------------------
+
+
+def test_emulator_engine_runs_same_scenario():
+    """The SAME scenario vocabulary drives the scalar engine through
+    NetworkEmulator settings: a 3-node cluster partitions one member off,
+    peers suspect it, the heal unblocks it, and everyone re-trusts."""
+    from scalecube_cluster_tpu.config import ClusterConfig, TransportConfig
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.chaos import EmulatorChaosRunner
+    from scalecube_cluster_tpu.transport import (
+        MemoryTransport,
+        MemoryTransportRegistry,
+        NetworkEmulatorTransport,
+    )
+
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _helpers import await_until
+
+    MemoryTransportRegistry.reset_default()
+
+    def config(seeds=()):
+        return (
+            ClusterConfig.default_local()
+            .with_membership(lambda m: m.replace(
+                seed_members=list(seeds), sync_interval=0.4, sync_timeout=0.4,
+            ))
+            .with_failure_detector(lambda f: f.replace(
+                ping_interval=0.2, ping_timeout=0.1, ping_req_members=2,
+            ))
+            .with_gossip(lambda g: g.replace(gossip_interval=0.05))
+        )
+
+    scn = Scenario(
+        name="scalar-split-heal",
+        events=[Partition(groups=[[2], [0, 1]], at=2, heal_at=20)],
+        horizon=60,
+    )
+
+    async def run():
+        emus, clusters = [], []
+        a_addr = None
+        for i in range(3):
+            emu_t = NetworkEmulatorTransport(MemoryTransport(TransportConfig()))
+            c = new_cluster(config([a_addr] if a_addr else ())).transport_factory(
+                lambda t=emu_t: t
+            )
+            started = await c.start()
+            if a_addr is None:
+                a_addr = started.address
+            clusters.append(started)
+            emus.append(emu_t.network_emulator)
+        try:
+            assert await await_until(
+                lambda: all(len(c.members()) == 3 for c in clusters)
+            )
+            runner = EmulatorChaosRunner(
+                scn, emus, [c.address for c in clusters]
+            )
+            runner.advance_to(2)  # the partition block lands
+            victim = clusters[2].member().id
+
+            def suspected_everywhere():
+                return all(
+                    any(r.is_suspect and r.member.id == victim
+                        for r in c.membership_protocol.membership_records())
+                    for c in clusters[:2]
+                )
+
+            assert await await_until(suspected_everywhere, timeout=5)
+            runner.advance_to(20)  # the heal
+
+            def trusted_everywhere():
+                return all(
+                    any(r.is_alive and r.member.id == victim
+                        for r in c.membership_protocol.membership_records())
+                    for c in clusters[:2]
+                )
+
+            assert await await_until(trusted_everywhere, timeout=10)
+            rep = runner.report()
+            assert [e["event"] for e in rep["events_applied"]] == [
+                "partition@2", "heal@20",
+            ]
+        finally:
+            await asyncio.gather(*(c.shutdown() for c in clusters))
+
+    asyncio.run(run())
+    MemoryTransportRegistry.reset_default()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpoints (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_atomic_write_and_roundtrip(tmp_path):
+    d = SimDriver(_dense_params(), 12, warm=True, seed=3)
+    d.crash(4)
+    d.step(8)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    # atomic: no tmp litter next to the checkpoint
+    assert [f for f in os.listdir(tmp_path) if ".tmp-" in f] == []
+    d2 = SimDriver(_dense_params(), 12, warm=True, seed=99)
+    d2.restore(path)
+    assert np.array_equal(
+        np.asarray(d.state.view_key), np.asarray(d2.state.view_key)
+    )
+
+
+def test_truncated_checkpoint_raises_checkpoint_error(tmp_path):
+    """The regression the satellite demands: a REAL checkpoint, truncated,
+    must fail with CheckpointError — not a numpy/zipfile deep-failure."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=3)
+    d.step(5)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    blob = open(path, "rb").read()
+    for frac in (0.2, 0.6, 0.95):
+        cut = str(tmp_path / f"cut{frac}.npz")
+        with open(cut, "wb") as fh:
+            fh.write(blob[: int(len(blob) * frac)])
+        with pytest.raises(CheckpointError):
+            SimDriver(_dense_params(), 12, warm=True).restore(cut)
+
+
+def test_corrupt_checkpoint_raises_checkpoint_error(tmp_path):
+    d = SimDriver(_dense_params(), 12, warm=True, seed=3)
+    d.step(5)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    blob = bytearray(open(path, "rb").read())
+    mid = len(blob) // 2
+    for i in range(mid, mid + 64):  # stomp a stripe of the archive
+        blob[i] ^= 0x5A
+    bad = str(tmp_path / "bad.npz")
+    open(bad, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointError):
+        SimDriver(_dense_params(), 12, warm=True).restore(bad)
+
+
+def test_engine_mismatch_and_future_schema_rejected(tmp_path):
+    dense = SimDriver(_dense_params(), 12, warm=True, seed=3)
+    dense.step(3)
+    path = str(tmp_path / "dense.npz")
+    dense.checkpoint(path)
+    sparse = SimDriver(_sparse_params(), 12, warm=True, seed=3)
+    with pytest.raises(CheckpointError, match="dense"):
+        sparse.restore(path)
+    future = str(tmp_path / "future.npz")
+    np.savez(future, _schema=np.int32(99))
+    with pytest.raises(CheckpointError, match="schema"):
+        dense.restore(future)
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_quick_blip_crash_lapses_detection_obligation():
+    """A crash restarted before its detection deadline is a lapsed
+    obligation, not a violation — detection inside a 6-tick window is below
+    the suspicion math and the restart's convergence point takes over."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    scn = Scenario(
+        name="blip",
+        events=[Crash(rows=[4], at=10), Restart(rows=[4], at=16)],
+        horizon=200, check_interval=8,
+    )
+    rep = d.run_scenario(scn)
+    assert rep["ok"], rep
+    det = rep["sentinels"]["detections"][0]
+    assert det["ok"] and det["detected_at"] is None
+
+
+def test_out_of_range_rows_rejected_at_arm_time():
+    """Rows outside [0, capacity) must fail FAST with ScenarioError — a
+    silent JAX clamp would inject nothing and sentinel the wrong row."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    with pytest.raises(ScenarioError, match="outside"):
+        d.run_scenario(Scenario(name="oob", events=[Crash(rows=[12], at=2)]))
+    with pytest.raises(ScenarioError, match="outside"):
+        d.run_scenario(Scenario(
+            name="oob-group",
+            events=[Partition(groups=[[0], [99]], at=1, heal_at=5)],
+        ))
+    from scalecube_cluster_tpu.chaos import EmulatorChaosRunner
+    from scalecube_cluster_tpu.transport import NetworkEmulator
+
+    emus = [NetworkEmulator() for _ in range(3)]
+    with pytest.raises(ScenarioError, match="outside"):
+        EmulatorChaosRunner(
+            Scenario(name="oob-emu",
+                     events=[Partition(groups=[[0], [5]], at=1, heal_at=5)]),
+            emus, ["m0", "m1", "m2"],
+        )
+
+
+def test_mid_storm_heal_keeps_storm_floor():
+    """A heal landing while a LossStorm is active clears the partition only
+    down to the storm floor; the full clear replays at storm end."""
+    params = _dense_params(n=8, seeds=(0,))
+    st = S.init_state(params, 8, warm=True)
+    scn = Scenario(
+        name="storm-heal",
+        events=[
+            LossStorm(pct=40.0, at=0, until=20),
+            Partition(groups=[[0, 1, 2, 3], [4, 5, 6, 7]], at=5, heal_at=10),
+        ],
+        horizon=30,
+    )
+    tl = StateTimeline(scn, S, dense_links=True)
+    st, _ = tl.apply_due(st, 5)
+    loss = np.asarray(st.loss)
+    assert loss[0, 4] == 1.0  # blocked inside the storm
+    assert loss[0, 1] == np.float32(0.4)  # storm floor elsewhere
+    st, _ = tl.apply_due(st, 10)  # heal lands mid-storm
+    loss = np.asarray(st.loss)
+    assert loss[0, 4] == np.float32(0.4), "heal punched a hole in the storm"
+    st, _ = tl.apply_due(st, 20)  # storm ends: pre-storm matrix + replay
+    loss = np.asarray(st.loss)
+    assert (loss == 0.0).all()

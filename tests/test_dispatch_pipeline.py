@@ -125,6 +125,49 @@ def test_no_monitor_step_is_transfer_free(monkeypatch):
     assert d.dispatch_stats["queue_depth"] == 0
 
 
+def test_armed_idle_chaos_keeps_no_consumer_path_transfer_free(monkeypatch):
+    """r7 extension of the transfer-spy proof: an ARMED-BUT-IDLE chaos
+    engine (scenario attached, no event due, sentinels staged on device)
+    must not add a single device→host transfer to the no-consumer step
+    path — sentinel checks are pure jnp reductions folded at sync points,
+    exactly like the r6 health accumulators."""
+    from scalecube_cluster_tpu.chaos import Scenario
+    from scalecube_cluster_tpu.chaos.engine import DriverChaosRunner
+
+    params = SP.SparseParams(
+        capacity=32, fd_every=2, sync_every=8, sweep_every=2, mr_slots=16,
+        announce_slots=8, rumor_slots=2, seed_rows=(0,),
+    )
+    d = SimDriver(params, 24, warm=True, seed=1)
+    idle = Scenario(name="armed-idle", events=[], horizon=1000,
+                    check_interval=4)
+    runner = DriverChaosRunner(d, idle)
+    d.step(2)  # compile outside the spied region
+    d.sync()
+    base = d.dispatch_stats["readbacks"]
+
+    transfers = []
+    real_asarray = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+            runner._run_check()
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"armed-idle chaos step() read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == base
+    # the report is the sync point, and the idle run is violation-free
+    rep = runner.report()
+    assert rep["violations"] == 0
+
+
 def test_consumers_opt_into_per_window_readbacks():
     """record_metrics / a watch are registered consumers: they pay their
     per-window readback and the dispatch stats make that visible."""

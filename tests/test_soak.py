@@ -20,7 +20,10 @@ import scalecube_cluster_tpu.ops.kernel as K
 import scalecube_cluster_tpu.ops.oracle as O
 import scalecube_cluster_tpu.ops.state as S
 
-pytestmark = pytest.mark.skipif(
+# The lockstep soaks below gate on SOAK=1 (they cost ~7 min); the chaos
+# churn soak at the bottom instead carries the `slow` marker, so the tier-1
+# `-m 'not slow'` run skips it and a `-m slow` run exercises it.
+_soak_gate = pytest.mark.skipif(
     not os.environ.get("SOAK"), reason="long soak; set SOAK=1 to run"
 )
 
@@ -35,6 +38,7 @@ _STEP = jax.jit(partial(K.tick, params=PARAMS))
 
 
 @pytest.mark.parametrize("seed", range(12))
+@_soak_gate
 def test_lockstep_soak(seed):
     import jax.numpy as jnp
 
@@ -74,6 +78,7 @@ PARAMS_WIDE = S.SimParams(
 _STEP_WIDE = jax.jit(partial(K.tick, params=PARAMS_WIDE))
 
 
+@_soak_gate
 def test_lockstep_soak_wide_n64():
     import jax.numpy as jnp
 
@@ -115,6 +120,7 @@ _SPARSE_STEP = jax.jit(partial(SP.sparse_tick, params=SPARSE_PARAMS))
 
 
 @pytest.mark.parametrize("seed", range(8))
+@_soak_gate
 def test_sparse_lockstep_soak(seed):
     import jax.numpy as jnp
 
@@ -156,6 +162,7 @@ _SPARSE_WIDE_PARAMS = SP.SparseParams(
 )
 
 
+@_soak_gate
 def test_sparse_lockstep_soak_wide_n64():
     import jax.numpy as jnp
 
@@ -190,3 +197,45 @@ def test_sparse_lockstep_soak_wide_n64():
         oracle = SO.sparse_oracle_tick(st, k, _SPARSE_WIDE_PARAMS)
         SO.assert_sparse_equivalent(st_next, oracle)
         st = st_next
+
+
+# ---- chaos churn soak (r7: crash/restart churn over 10k ticks, `-m slow`) ----
+
+
+@pytest.mark.slow
+def test_chaos_churn_soak_10k_ticks():
+    """Long-haul scenario soak: 10k ticks of rolling crash/restart churn
+    (every 250 ticks a row hard-crashes and rejoins 120 ticks later as a
+    fresh identity) on the sparse driver, with every sentinel armed. The
+    whole run must finish with zero invariant violations: every crash
+    detected inside its budget, every restart re-converged, no untouched
+    member ever tombstoned, no key regression, no n_live drift."""
+    from scalecube_cluster_tpu.chaos import Crash, Restart, Scenario
+    from scalecube_cluster_tpu.sim import SimDriver
+
+    n = 64
+    params = SP.SparseParams(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=10, suspicion_mult=2, sweep_every=2, rumor_slots=2,
+        mr_slots=64, announce_slots=16, seed_rows=(0, 1),
+    )
+    events = []
+    rows = iter(range(4, 60))
+    for at in range(100, 9_500, 250):
+        r = next(rows)
+        events.append(Crash(rows=[r], at=at))
+        events.append(Restart(rows=[r], at=at + 120, seed_rows=(0,)))
+    scn = Scenario(
+        name="churn-soak", events=events, horizon=10_000, check_interval=25,
+    )
+    d = SimDriver(params, n, warm=True, seed=13)
+    rep = d.run_scenario(scn)
+    assert rep["ok"], rep
+    assert rep["ticks_run"] == 10_000
+    sent = rep["sentinels"]
+    assert sent["false_dead_members_max"] == 0
+    assert sent["key_regressions"] == 0
+    assert sent["n_live_drift"] == 0
+    assert len(sent["detections"]) == len(events) // 2
+    assert all(x["ok"] for x in sent["detections"])
+    assert all(c["ok"] for c in sent["convergence"])

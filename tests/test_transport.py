@@ -209,3 +209,99 @@ def test_websocket_fragmentation_and_ping():
             await server.stop()
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# bounded reconnect with exponential backoff (r7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _reconnect_cfg(factory, retries, base=0.02):
+    return TransportConfig(
+        transport_factory=factory, reconnect_max_retries=retries,
+        reconnect_base_delay=base, reconnect_max_delay=0.1,
+    )
+
+
+@pytest.mark.parametrize(
+    "factory,bogus",
+    [("tcp", "tcp://127.0.0.1:1"), ("websocket", "ws://127.0.0.1:1")],
+)
+def test_reconnect_bounded_backoff_gives_up_with_event(factory, bogus):
+    """A dead peer is retried exactly reconnect_max_retries extra times with
+    backoff, then the send fails AND the give-up surfaces as a structured
+    transport event (not just a log line)."""
+
+    async def run():
+        a = await bind_transport(_reconnect_cfg(factory, retries=2))
+        events = []
+        a.transport_events().subscribe(events.append)
+        try:
+            with pytest.raises(PeerUnavailableError, match="attempt"):
+                await a.send(bogus, Message.with_data("x", qualifier="q/x"))
+        finally:
+            await a.stop()
+        kinds = [e.kind for e in events]
+        assert kinds == ["reconnect_backoff", "reconnect_backoff",
+                        "reconnect_giveup"], kinds
+        giveup = events[-1]
+        assert giveup.address == bogus
+        assert giveup.attempts == 3  # initial try + 2 retries
+        assert all(e.delay > 0 for e in events[:-1])
+
+    asyncio.run(run())
+
+
+def test_reconnect_zero_retries_fails_fast():
+    async def run():
+        a = await bind_transport(_reconnect_cfg("tcp", retries=0))
+        events = []
+        a.transport_events().subscribe(events.append)
+        try:
+            with pytest.raises(PeerUnavailableError):
+                await a.send("tcp://127.0.0.1:1",
+                             Message.with_data("x", qualifier="q/x"))
+        finally:
+            await a.stop()
+        assert [e.kind for e in events] == ["reconnect_giveup"]
+        assert events[0].attempts == 1
+
+    asyncio.run(run())
+
+
+def test_reconnect_recovers_when_peer_comes_back():
+    """The point of retrying at all: a peer that returns inside the backoff
+    budget receives the message — no caller-side retry loop needed."""
+    import socket
+
+    async def run():
+        a = await bind_transport(_reconnect_cfg("tcp", retries=4, base=0.1))
+        with socket.socket() as s:  # reserve a port, then free it
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        target = f"tcp://127.0.0.1:{port}"
+        events = []
+        a.transport_events().subscribe(events.append)
+        b = None
+        send_task = asyncio.create_task(
+            a.send(target, Message.with_data("late", qualifier="q/late"))
+        )
+        try:
+            # let the first attempt fail, then bring the peer up
+            while not events:
+                await asyncio.sleep(0.01)
+            b = await bind_transport(TransportConfig(
+                transport_factory="tcp", port=port,
+            ))
+            inbox = b.listen().stream()
+            await asyncio.wait_for(send_task, 5)
+            msg = await asyncio.wait_for(inbox.get(), 2)
+            assert msg.data == "late"
+            assert any(e.kind == "reconnect_backoff" for e in events)
+            assert not any(e.kind == "reconnect_giveup" for e in events)
+        finally:
+            await a.stop()
+            if b is not None:
+                await b.stop()
+
+    asyncio.run(run())
