@@ -1,0 +1,134 @@
+"""Driver config #8: tick-rate overhead of an armed telemetry plane.
+
+The r8 acceptance gate: arming the telemetry plane (per-window device ring
+appends + host latency histograms + event bus) on the plain pipelined
+driver must cost within noise (<= 2%) of the unarmed r6 loop on the SAME
+config as configs 6/7 (dense N=4096, 24 one-tick windows per span) — and
+must stay transfer-free per window (asserted via the driver's readback
+counter, like config7's chaos gate).
+
+Two interleaved variants, median-of-``--reps`` spans:
+
+* **pipelined** — the bare r6 SimDriver loop (config6's "pipelined").
+* **telemetry_armed** — the same loop with ``arm_telemetry()``: every
+  window appends one f32 row (the engine's TELEMETRY_SERIES reduction +
+  sentinel columns) to the on-device metric ring and observes the two
+  host-side latency histograms.
+
+    python benchmarks/config8_telemetry.py [--n 4096] [--windows 24]
+        [--window-ticks 1] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+
+from common import emit, log
+
+
+def _params(n: int):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
+    )
+
+
+class Loop:
+    """config6's pipelined variant; ``armed=True`` adds the telemetry
+    plane — nothing else differs between the two loops."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int, armed: bool):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.armed = armed
+        self.d = SimDriver(_params(n), n, warm=True, seed=0)
+        if armed:
+            self.plane = self.d.arm_telemetry()
+        self.d.step(window_ticks)  # compile + warm (incl. the ring append)
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        if self.armed:
+            assert self.d.dispatch_stats["readbacks"] == base, (
+                "armed telemetry performed a device->host readback"
+            )
+        return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"warming 2 variants: N={args.n}, {args.reps} x {args.windows} "
+        f"windows of {args.window_ticks} tick(s)")
+    plain_loop = Loop(args.n, args.windows, args.window_ticks, armed=False)
+    armed_loop = Loop(args.n, args.windows, args.window_ticks, armed=True)
+
+    plain_spans, armed_spans = [], []
+    for rep in range(args.reps):  # interleaved: drift hits both alike
+        plain_spans.append(plain_loop.span())
+        armed_spans.append(armed_loop.span())
+        log(f"rep {rep}: pipelined {plain_spans[-1]:.3f}s, "
+            f"telemetry-armed {armed_spans[-1]:.3f}s")
+
+    total = args.windows * args.window_ticks
+    plain = statistics.median(plain_spans)
+    armed = statistics.median(armed_spans)
+    overhead_pct = round((armed / plain - 1.0) * 100.0, 2)
+    result = {
+        "config": 8,
+        "variant": "telemetry_overhead",
+        "n": args.n,
+        "engine": "dense",
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "ring_len": armed_loop.plane.config.ring_len,
+        "ring_series": len(armed_loop.plane.names),
+        "pipelined_ticks_per_s": round(total / plain, 1),
+        "telemetry_armed_ticks_per_s": round(total / armed, 1),
+        "armed_overhead_pct": overhead_pct,
+        "within_budget": overhead_pct <= 2.0,
+        "armed_dispatch": armed_loop.d.dispatch_snapshot(),
+        "ring_windows_appended": armed_loop.plane.ring.windows,
+        "spans_s": {
+            "pipelined": [round(s, 4) for s in plain_spans],
+            "telemetry_armed": [round(s, 4) for s in armed_spans],
+        },
+    }
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

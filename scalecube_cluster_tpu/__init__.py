@@ -22,6 +22,7 @@ from .config import (
     GossipConfig,
     MembershipConfig,
     SimConfig,
+    TelemetryConfig,
     TransportConfig,
 )
 from .models.events import FailureDetectorEvent, MembershipEvent, MembershipEventType
@@ -38,6 +39,7 @@ __all__ = [
     "MembershipConfig",
     "TransportConfig",
     "SimConfig",
+    "TelemetryConfig",
     "Member",
     "MemberStatus",
     "MembershipRecord",

@@ -304,6 +304,18 @@ class DriverChaosRunner:
         self.done = False
         self.last_report: Optional[dict] = None
         driver._chaos = self
+        # armed telemetry (r8): scenario lifecycle + applied fault events
+        # flow onto the unified event bus, and a violated final report
+        # triggers a flight-recorder dump (see _publish / run)
+        self._publish("scenario_armed", scenario=scenario.name,
+                      horizon=self.spec.horizon)
+
+    def _publish(self, kind: str, **fields) -> None:
+        plane = getattr(self.driver, "_telemetry", None)
+        if plane is not None:
+            plane.bus.publish(
+                "chaos", kind, tick=self.driver._host_tick, **fields
+            )
 
     # -- Restart with driver identity bookkeeping (no device reads) ----------
     def _restart(self, state, row: int, seed_rows):
@@ -337,6 +349,8 @@ class DriverChaosRunner:
             with d._lock:
                 d.state, labels = self.timeline.apply_due(d.state, t)
             self.events_applied.extend((t, lab) for lab in labels)
+            for lab in labels:
+                self._publish("event_applied", event=lab, rel_tick=t)
             if self._sent is not None and (t >= next_check or t >= horizon):
                 self._run_check()
                 next_check = t + check_every
@@ -353,6 +367,13 @@ class DriverChaosRunner:
         self.done = True
         report = self.report()  # THE sync point: one coalesced readback
         self.last_report = report
+        plane = getattr(d, "_telemetry", None)
+        if plane is not None:
+            # detection latencies -> histogram, completion -> bus; any
+            # violation writes the flight-recorder post-mortem artifact
+            dump = plane.ingest_chaos_report(report)
+            if dump is not None:
+                report["flight_dump"] = dump
         return report
 
     def _run_check(self) -> None:

@@ -399,6 +399,24 @@ class Cluster:
         self._require_started()
         return self._transport
 
+    def transport_events(self) -> Optional[Listeners]:
+        """The underlying transport's lifecycle-event stream (reconnect
+        backoff / give-up, connection loss — stream transports only; None
+        for transports without one). The r8 telemetry bus attaches here:
+        ``bus.attach_cluster(cluster)`` merges these with membership events
+        into the unified tick-stamped record stream."""
+        self._require_started()
+        # unwrap the decorator chain (SenderAwareTransport, and e.g. a
+        # NetworkEmulator wrapper under it) until some layer carries the
+        # event stream — the real wire transport may sit several deep
+        transport = self._transport
+        while transport is not None:
+            fn = getattr(transport, "transport_events", None)
+            if fn is not None:
+                return fn()
+            transport = getattr(transport, "_delegate", None)
+        return None
+
 
 def new_cluster(config: Optional[ClusterConfig] = None) -> Cluster:
     """Entry point mirroring ``new ClusterImpl()``."""

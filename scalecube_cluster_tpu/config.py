@@ -188,6 +188,35 @@ class ChaosConfig:
         return replace(self, **kw)
 
 
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """Telemetry-plane knobs (new; no reference analogue — the r8 on-device
+    metric rings, unified event bus, OpenMetrics exporter, and crash flight
+    recorder, see ``telemetry/``).
+
+    ``ring_len`` is the number of per-window rows the device metric ring
+    retains ([ring_len, n_metrics] f32, overwritten circularly — host reads
+    happen only at flush()/scrape sync points, never per window).
+    ``bus_capacity`` bounds the unified event bus (oldest records are
+    evicted; evictions are counted, never silent). ``flight_windows`` is K,
+    the ring-window depth a flight-recorder dump captures, and
+    ``flight_dir`` is where dump artifacts land (None = current directory
+    at dump time). ``latency_buckets`` are the histogram bucket upper
+    bounds, in seconds, for the window-dispatch / tick-latency histograms
+    the ``/metrics`` endpoint exports."""
+
+    ring_len: int = 512
+    bus_capacity: int = 4096
+    flight_windows: int = 64
+    flight_dir: Optional[str] = None
+    latency_buckets: Sequence[float] = (
+        0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    )
+
+    def replace(self, **kw) -> "TelemetryConfig":
+        return replace(self, **kw)
+
+
 Lens = Callable
 
 
@@ -202,6 +231,7 @@ class ClusterConfig:
     transport: TransportConfig = field(default_factory=TransportConfig)
     sim: SimConfig = field(default_factory=SimConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     member_alias: Optional[str] = None
     external_host: Optional[str] = None  # container NAT mapping (ClusterConfig.java:236-300)
@@ -257,6 +287,9 @@ class ClusterConfig:
     def with_chaos(self, op: Lens) -> "ClusterConfig":
         return replace(self, chaos=op(self.chaos))
 
+    def with_telemetry(self, op: Lens) -> "ClusterConfig":
+        return replace(self, telemetry=op(self.telemetry))
+
     def replace(self, **kw) -> "ClusterConfig":
         return replace(self, **kw)
 
@@ -289,6 +322,18 @@ class ClusterConfig:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):
             raise ValueError("chaos.loss_storm_immunity_pct must be in [0, 100]")
+        if self.telemetry.ring_len <= 0:
+            raise ValueError("telemetry.ring_len must be > 0")
+        if self.telemetry.bus_capacity <= 0:
+            raise ValueError("telemetry.bus_capacity must be > 0")
+        if self.telemetry.flight_windows <= 0:
+            raise ValueError("telemetry.flight_windows must be > 0")
+        if list(self.telemetry.latency_buckets) != sorted(
+            self.telemetry.latency_buckets
+        ) or any(b <= 0 for b in self.telemetry.latency_buckets):
+            raise ValueError(
+                "telemetry.latency_buckets must be positive and ascending"
+            )
         return self
 
 

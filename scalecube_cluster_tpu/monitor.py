@@ -122,6 +122,11 @@ class MonitorServer:
         self._health: Optional[Callable[[], Dict[str, Any]]] = None
         self._dispatch: Optional[Callable[[], Dict[str, Any]]] = None
         self._chaos: Optional[Callable[[], Dict[str, Any]]] = None
+        # OpenMetrics family providers, concatenated at /metrics scrape
+        # time (r8 telemetry plane); each returns a list of family dicts
+        self._metric_providers: List[Callable[[], List[Dict[str, Any]]]] = []
+        # unified event-bus tail provider for /events
+        self._events: Optional[Callable[[], List[Dict[str, Any]]]] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
@@ -157,6 +162,36 @@ class MonitorServer:
         # is a sync point of exactly the same cadence contract.
         self._chaos = lambda: driver.chaos_snapshot()
 
+    def register_telemetry(self, driver, plane=None) -> None:
+        """Serve the r8 telemetry plane: ``GET /metrics`` (OpenMetrics text
+        for this driver — counters, gauges, histograms) and ``GET /events``
+        (the unified event-bus tail as JSON). Arms the plane if the driver
+        has none yet, and registers the health/dispatch/chaos providers too
+        (a telemetry consumer wants all of them). Every scrape is a sync
+        point of the same contract as ``/health`` — poll cadence, never
+        window cadence; an unscraped driver stays transfer-free."""
+        if plane is None:
+            plane = driver.arm_telemetry()
+        elif driver._telemetry is None:
+            # an explicitly constructed plane must still be ATTACHED, or
+            # step() never appends and the ring stays empty forever
+            driver._telemetry = plane
+        self.register_health(driver)
+        # plane.families is THE scrape path (lock-guarded ring read +
+        # readback bookkeeping live there, one spelling)
+        self._metric_providers.append(plane.families)
+        bus = plane.bus
+        self._events = lambda: [r.as_dict() for r in bus.tail(256)]
+
+    def register_cluster_metrics(self, cluster, bus=None) -> None:
+        """Serve OpenMetrics for one scalar-engine Cluster node at
+        ``/metrics`` (appended to any sim families already registered)."""
+        from .telemetry.openmetrics import cluster_families
+
+        self._metric_providers.append(lambda: cluster_families(cluster, bus))
+        if bus is not None and self._events is None:
+            self._events = lambda: [r.as_dict() for r in bus.tail(256)]
+
     async def start(self) -> "MonitorServer":
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -178,17 +213,24 @@ class MonitorServer:
             while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                 pass  # drain headers
             path = request.split()[1].decode() if len(request.split()) > 1 else "/"
-            status, body = self._route(path)
-            payload = json.dumps(body).encode()
+            status, body = self._route(path.split("?", 1)[0])
+            if isinstance(body, bytes):  # pre-rendered (OpenMetrics text)
+                ctype, payload = self._text_content_type, body
+            else:
+                ctype, payload = b"application/json", json.dumps(body).encode()
             writer.write(
-                b"HTTP/1.1 " + status + b"\r\nContent-Type: application/json\r\n"
-                + f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload
+                b"HTTP/1.1 " + status + b"\r\nContent-Type: " + ctype
+                + f"\r\nContent-Length: {len(payload)}\r\n\r\n".encode()
+                + payload
             )
             await writer.drain()
         except Exception:  # noqa: BLE001 - monitor must never take a node down
             _log.exception("monitor request failed")
         finally:
             writer.close()
+
+    #: content type of bytes bodies (the OpenMetrics exposition)
+    _text_content_type = b"text/plain; version=0.0.4; charset=utf-8"
 
     def _route(self, path: str) -> tuple[bytes, Any]:
         if path == "/":
@@ -197,7 +239,20 @@ class MonitorServer:
                 "health": self._health is not None,
                 "dispatch": self._dispatch is not None,
                 "chaos": self._chaos is not None,
+                "metrics": bool(self._metric_providers),
+                "events": self._events is not None,
             }
+        if path == "/metrics":
+            if not self._metric_providers:
+                return b"404 Not Found", {"error": "no metrics provider registered"}
+            from .telemetry.openmetrics import render
+
+            families = [f for p in self._metric_providers for f in p()]
+            return b"200 OK", render(families).encode()
+        if path == "/events":
+            if self._events is None:
+                return b"404 Not Found", {"error": "no event bus registered"}
+            return b"200 OK", {"events": self._events()}
         if path == "/chaos":
             if self._chaos is None:
                 return b"404 Not Found", {"error": "no chaos provider registered"}

@@ -845,6 +845,76 @@ def run_ticks(
     return state, key, ms, watched
 
 
+# Per-window telemetry series (r8 metric rings). Order is the ring's column
+# layout; names are the docs/TELEMETRY.md + /metrics contract. The vector is
+# computed by PURE jnp reductions over the window's stacked metrics and the
+# post-window state — staged on device like the r6 health accumulators, so
+# an armed telemetry plane adds zero per-window device→host transfers.
+TELEMETRY_SERIES = (
+    "tick",  # window-end tick
+    "window_ticks",
+    "n_up",
+    "fd_probes",
+    "fd_failed_probes",
+    "fd_new_suspects",
+    "gossip_msgs",
+    "rumor_sends",
+    "rumor_deliveries",
+    "sync_roundtrips",
+    "gossip_segmentation_max",
+    "rumor_coverage_mean",  # over ACTIVE slots, at window end
+    "rumor_coverage_min",
+    "rumor_active_slots",
+    "alive_view_fraction",  # 0 when params.full_metrics is off
+    "false_suspect_pairs_max",
+    "convergence_lag",  # 1 - alive_view_fraction (meaningful iff full_metrics)
+)
+
+#: window metrics reduced by SUM into the telemetry vector (counters);
+#: everything else is a max or an end-of-window gauge.
+_TELEM_SUMS = (
+    "fd_probes", "fd_failed_probes", "fd_new_suspects", "gossip_msgs",
+    "rumor_sends", "rumor_deliveries", "sync_roundtrips",
+)
+
+
+def telemetry_window_core(ms: dict, state) -> list[jax.Array]:
+    """The engine-shared prefix of the telemetry window vector (everything in
+    :data:`TELEMETRY_SERIES`), as a list of f32 scalars. ``ms`` is a window's
+    stacked per-tick metrics (each leaf ``[n_ticks, ...]``); ``state`` the
+    post-window state. Pure jnp — callable on dense, sparse, and mesh-sharded
+    outputs alike (reductions come out replicated under GSPMD)."""
+    f32 = jnp.float32
+    n_ticks = next(iter(ms.values())).shape[0]
+    cov = ms["rumor_coverage"][-1]  # [R], end of window
+    active = state.rumor_active
+    n_active = jnp.maximum(active.sum(), 1)
+    cov_act = jnp.where(active, cov, 0.0)
+    alive_frac = ms["alive_view_fraction"][-1].astype(f32)
+    vec = [
+        state.tick.astype(f32),
+        f32(n_ticks),
+        ms["n_up"][-1].astype(f32),
+        *(ms[name].sum().astype(f32) for name in _TELEM_SUMS),
+        ms["gossip_segmentation"].max().astype(f32),
+        (cov_act.sum() / n_active).astype(f32),
+        jnp.where(
+            active.any(), jnp.where(active, cov, jnp.inf).min(), 0.0
+        ).astype(f32),
+        active.sum().astype(f32),
+        alive_frac,
+        ms["false_suspect_pairs"].max().astype(f32),
+        (1.0 - alive_frac).astype(f32),
+    ]
+    return vec
+
+
+def telemetry_window_vector(ms: dict, state: SimState) -> jax.Array:
+    """Dense-engine telemetry row: one [len(TELEMETRY_SERIES)] f32 vector per
+    window, appended to the device metric ring by the telemetry plane."""
+    return jnp.stack(telemetry_window_core(ms, state))
+
+
 def sentinel_core(
     view_key: jax.Array,
     up: jax.Array,

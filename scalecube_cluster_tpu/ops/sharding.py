@@ -208,6 +208,21 @@ def make_sharded_sparse_run(mesh: Mesh, params, n_ticks: int):
     return jax.jit(fn, donate_argnums=0)
 
 
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement on ``mesh`` — the home of every telemetry
+    tensor (the [ring_len, n_metrics] metric ring, its append vectors, the
+    staged per-window reductions). The ring is tiny and every window-summary
+    reduction over sharded metrics comes out replicated under GSPMD, so an
+    explicitly replicated ring keeps the append a collective-free local
+    update on every chip instead of letting placement inference scatter it."""
+    return NamedSharding(mesh, P())
+
+
+def place_replicated(x, mesh: Mesh):
+    """device_put onto the replicated sharding (telemetry ring placement)."""
+    return jax.device_put(x, replicated_sharding(mesh))
+
+
 def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: bool = True):
     """jit the batched ``run_ticks`` window over ``mesh``.
 

@@ -125,7 +125,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
-from .kernel import ceil_log2
+from .kernel import TELEMETRY_SERIES as _CORE_TELEMETRY_SERIES, ceil_log2
 from .lattice import (
     ALIVE,
     RANK_ALIVE,
@@ -844,6 +844,38 @@ def sentinel_reduce(state: SparseState, sent: dict, spec: dict) -> dict:
     drift = (state.up & (recount != state.n_live)).sum().astype(jnp.int32)
     sent["n_live_drift"] = sent.get("n_live_drift", jnp.int32(0)) + drift
     return sent
+
+
+# Sparse telemetry ring layout (r8): the engine-shared prefix (see
+# kernel.TELEMETRY_SERIES) plus the bounded-pool backpressure series — the
+# exact failure mode the r4 churn run exposed, now a per-window time series
+# instead of a one-shot snapshot.
+TELEMETRY_SERIES = _CORE_TELEMETRY_SERIES + (
+    "announced",
+    "announce_dropped",
+    "pool_evicted",
+    "mr_active_high_water",
+)
+
+
+def telemetry_window_vector(ms: dict, state: SparseState) -> jax.Array:
+    """Sparse-engine telemetry row: the shared core vector plus the pool
+    series, as one [len(TELEMETRY_SERIES)] f32 vector. Pure jnp — zero
+    device→host transfers; the mesh-sharded builders produce replicated
+    metric leaves so the same reduction serves the sharded driver."""
+    from .kernel import telemetry_window_core
+
+    f32 = jnp.float32
+    vec = telemetry_window_core(ms, state)
+    vec.extend(
+        [
+            ms["announced"].sum().astype(f32),
+            ms["announce_dropped"].sum().astype(f32),
+            ms["pool_evicted"].sum().astype(f32),
+            ms["mr_active_count"].max().astype(f32),
+        ]
+    )
+    return jnp.stack(vec)
 
 
 def snapshot(state: SparseState) -> dict:

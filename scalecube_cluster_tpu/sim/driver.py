@@ -275,6 +275,30 @@ class SimDriver:
         # on-device invariant sentinels; surfaced via chaos_snapshot(),
         # health_snapshot()'s "chaos" section and the monitor's GET /chaos
         self._chaos = None
+        # armed telemetry plane (r8, telemetry.TelemetryPlane): device
+        # metric ring + event bus + /metrics exporter + flight recorder;
+        # None = unarmed (the plane is a pure consumer — arming must never
+        # perturb the trajectory or add per-window transfers)
+        self._telemetry = None
+        # host-side tick shadow: lets bus records and flight dumps stamp the
+        # current tick WITHOUT a device read (step() advances it; restore
+        # re-seeds it from the checkpoint's host-visible tick plane)
+        self._host_tick = 0
+        # host-tracked free rumor slots (r8 satellite: the interactive
+        # spread_rumor path must not sync the donated pipeline). Slots the
+        # device sweeper frees are reclaimed lazily — only when this list
+        # runs dry does spread_rumor pay one coalesced readback.
+        self._free_rumor_slots = list(range(params.rumor_slots))
+        # deferred end-of-window rumor-coverage vector ([R], device) — the
+        # r8 satellite that folds rumor_coverage()'s old [N]-plane readback
+        # into the flush discipline; _rumor_cov_host is the flushed cache,
+        # _rumor_cov_dirty marks host mutations newer than the cache
+        self._win_rumor_cov = None
+        self._rumor_cov_host = None
+        self._rumor_cov_dirty = True
+        # rumors awaiting full coverage, slot -> spread tick (feeds the
+        # telemetry plane's rumor-spread histogram at flush time)
+        self._rumor_spread_pending: Dict[int, int] = {}
 
     # -- time ---------------------------------------------------------------
     @property
@@ -339,13 +363,14 @@ class SimDriver:
         watch_arr = jnp.asarray(rows, dtype=jnp.int32) if rows else None
         step = self._get_step(n_ticks, len(rows))
         stats = self._step_stats[(n_ticks, len(rows))]
-        t0 = time.perf_counter() if stats["calls"] == 0 else None
+        t0 = time.perf_counter()
         self.state, self._key, ms, watched = step(
             self.state, self._key, watch_rows=watch_arr
         )
-        if t0 is not None:
+        dispatch_s = time.perf_counter() - t0
+        if stats["calls"] == 0:
             # first dispatch = trace + compile (or persistent-cache load)
-            stats["first_dispatch_s"] = round(time.perf_counter() - t0, 4)
+            stats["first_dispatch_s"] = round(dispatch_s, 4)
         stats["calls"] += 1
         ds = self.dispatch_stats
         ds["windows_dispatched"] += 1
@@ -353,6 +378,11 @@ class SimDriver:
         ds["queue_depth"] += 1
         ds["queue_high_water"] = max(ds["queue_high_water"], ds["queue_depth"])
         self._accumulate_window(ms)
+        self._host_tick += n_ticks
+        if self._telemetry is not None:
+            # one pure-jnp ring append + host wall-clock histograms — the
+            # armed plane stays inside the zero-readback discipline
+            self._telemetry.on_window(ms, self.state, n_ticks, dispatch_s)
         self._ticks_since_flush += n_ticks
         if self._ticks_since_flush >= self.flush_ticks_cap:
             self.flush()  # i32 overflow guard — see flush_ticks_cap
@@ -404,6 +434,12 @@ class SimDriver:
             self._win_seg_warn = (
                 over if self._win_seg_warn is None else self._win_seg_warn + over
             )
+        if "rumor_coverage" in ms:
+            # end-of-window per-slot coverage: staging the LAST tick's [R]
+            # vector (a device reference, no transfer) supersedes any
+            # earlier staged window — coverage is a gauge, not a sum
+            self._win_rumor_cov = ms["rumor_coverage"][-1]
+            self._rumor_cov_dirty = False
 
     def flush(self) -> None:
         """Coalesced host readback of every deferred reduction — THE sync
@@ -447,6 +483,28 @@ class SimDriver:
             )
             self._join_probe = None
             flushed += 1
+        if self._win_rumor_cov is not None:
+            self._rumor_cov_host = np.asarray(self._win_rumor_cov)
+            self._win_rumor_cov = None
+            flushed += 1
+            if (
+                self._rumor_spread_pending
+                and self._telemetry is not None
+                and not self._rumor_cov_dirty
+            ):
+                # a rumor that reached every up member since its spread:
+                # record window-granular spread time (the /metrics
+                # rumor-spread histogram) and stop tracking it. Skipped
+                # while the staged vector is STALE (_rumor_cov_dirty: a
+                # spread/crash postdates the window) — a rumor spread into
+                # a reclaimed slot must not inherit the previous
+                # occupant's full-coverage plane as a bogus ~0-tick sample
+                for slot, t0 in list(self._rumor_spread_pending.items()):
+                    if self._rumor_cov_host[slot] >= 1.0:
+                        self._telemetry.hist_spread.observe(
+                            max(self._host_tick - t0, 1)
+                        )
+                        del self._rumor_spread_pending[slot]
         if flushed:
             self._note_readback(flushed)
             self.dispatch_stats["flushes"] += 1
@@ -474,7 +532,7 @@ class SimDriver:
             x is not None
             for x in (
                 self._win_accum, self._win_pool_hw, self._win_seg_warn,
-                self._join_probe,
+                self._join_probe, self._win_rumor_cov,
             )
         )
         return ds
@@ -663,13 +721,20 @@ class SimDriver:
             if tick - t <= self._join_horizon and r != row
         ]
         self._recent_joins.append((tick, row))
+        self._rumor_cov_dirty = True  # up-set changed under the cache
+        self._publish("driver", "join", row=row, member=self.members[row].id)
         return row
 
     def crash(self, row: int) -> None:
-        self.state = self._ops.crash_row(self.state, row)
+        with self._lock:
+            self.state = self._ops.crash_row(self.state, row)
+            self._rumor_cov_dirty = True  # up-set changed under the cache
+            self._publish("driver", "crash", row=row)
 
     def leave(self, row: int, crash_after_ticks: int = 0) -> None:
-        self.state = self._ops.begin_leave(self.state, row)
+        with self._lock:
+            self.state = self._ops.begin_leave(self.state, row)
+            self._publish("driver", "leave", row=row)
         if crash_after_ticks:
             self.step(crash_after_ticks)
             self.crash(row)
@@ -679,20 +744,65 @@ class SimDriver:
 
     # -- rumors (spreadGossip) ----------------------------------------------
     def spread_rumor(self, origin: int, payload: object) -> int:
-        """Start a user rumor; returns its slot. Payloads live host-side."""
-        active = np.asarray(self.state.rumor_active)
-        free = np.nonzero(~active)[0]
-        if len(free) == 0:
+        """Start a user rumor; returns its slot. Payloads live host-side.
+
+        Slot allocation is HOST-tracked (r8, same bug class as the r6
+        ``join()`` fix): the old path scanned ``rumor_active`` with a
+        blocking ``np.asarray`` on every call, syncing the whole donated
+        pipeline per interactive spread. Now a free-slot list is maintained
+        host-side; only when it runs dry (every host-known slot spent) does
+        the call pay ONE coalesced readback to reclaim slots the device
+        rumor sweep has since freed."""
+        with self._lock:
+            slot = self._claim_rumor_slot_locked()
+            self.state = self._ops.spread_rumor(self.state, slot, origin)
+            self._rumor_payloads[slot] = payload
+            self._rumor_cov_dirty = True  # cached coverage predates this rumor
+            self._rumor_spread_pending[slot] = self._host_tick
+            self._publish("driver", "rumor_spread", slot=slot, origin=origin)
+            return slot
+
+    def _claim_rumor_slot_locked(self) -> int:
+        if self._free_rumor_slots is None:
+            # unknown after restore: rebuild from the checkpointed state
+            self._free_rumor_slots = self._reclaim_rumor_slots_locked()
+        if not self._free_rumor_slots:
+            self._free_rumor_slots = self._reclaim_rumor_slots_locked()
+        if not self._free_rumor_slots:
             raise RuntimeError("no free rumor slots")
-        slot = int(free[0])
-        self.state = self._ops.spread_rumor(self.state, slot, origin)
-        self._rumor_payloads[slot] = payload
-        return slot
+        return self._free_rumor_slots.pop(0)
+
+    def _reclaim_rumor_slots_locked(self) -> list:
+        """One coalesced ``rumor_active`` readback (value semantics: reflects
+        every enqueued window) — the exhausted-list slow path only."""
+        active = np.asarray(self.state.rumor_active)
+        self._note_readback(1)
+        return [int(s) for s in np.nonzero(~active)[0]]
 
     def rumor_coverage(self, slot: int) -> float:
-        inf = np.asarray(self.state.infected[:, slot])
-        up = np.asarray(self.state.up)
-        return float(inf[up].sum() / max(up.sum(), 1))
+        """Fraction of up members infected with rumor ``slot``, evaluated at
+        the last window boundary. r8: reads the DEFERRED end-of-window
+        coverage vector (flushed with the other health accumulators — an
+        [R] transfer at the sync point) instead of pulling the full [N]
+        infection plane per call. When host mutations postdate the last
+        window (a rumor just spread, a member crashed), one jitted [R]
+        device reduce refreshes the cache instead."""
+        with self._lock:
+            self._flush_locked()
+            if self._rumor_cov_host is None or self._rumor_cov_dirty:
+                if not hasattr(self, "_cov_fn"):
+                    def _cov(state):
+                        up = state.up
+                        return (
+                            (state.infected & up[:, None]).sum(0).astype(jnp.float32)
+                            / jnp.maximum(up.sum(), 1)
+                        )
+
+                    self._cov_fn = jax.jit(_cov)
+                self._rumor_cov_host = np.asarray(self._cov_fn(self.state))
+                self._rumor_cov_dirty = False
+                self._note_readback(1)
+            return float(self._rumor_cov_host[slot])
 
     def rumor_payload(self, slot: int) -> object:
         return self._rumor_payloads.get(slot)
@@ -811,6 +921,24 @@ class SimDriver:
                 ),
             },
         }
+        # r8: per-slot user-rumor coverage from the DEFERRED end-of-window
+        # vector (flushed above with the other accumulators — never a fresh
+        # [N]-plane readback). ``stale`` marks host mutations (a spread, a
+        # crash) newer than the last window boundary.
+        cov = self._rumor_cov_host
+        out["rumors"] = {
+            "tracked_slots": sorted(self._rumor_payloads),
+            "coverage": (
+                {
+                    int(s): round(float(cov[s]), 4)
+                    for s in sorted(self._rumor_payloads)
+                    if s < len(cov)
+                }
+                if cov is not None
+                else None
+            ),
+            "stale": bool(self._rumor_cov_dirty),
+        }
         if self.sparse:
             out["pool"] = {
                 "mr_slots": self.params.mr_slots,
@@ -826,6 +954,48 @@ class SimDriver:
         ``MonitorServer.register_health``): turns on the join() in-pool
         probe so host-path announce drops are counted from now on."""
         self._health_interest = True
+
+    # -- telemetry plane (r8: rings + bus + /metrics + flight recorder) ------
+    def arm_telemetry(self, config=None, bus=None):
+        """Arm the telemetry plane on this driver; returns the
+        :class:`..telemetry.TelemetryPlane`. ``config`` is a
+        :class:`..config.ClusterConfig` or :class:`..config.TelemetryConfig`
+        (None = defaults); ``bus`` an existing :class:`..telemetry
+        .TelemetryBus` to merge into (e.g. one shared with transports).
+
+        Arming is a pure consumer: per window it appends ONE f32 row to the
+        on-device metric ring (a jnp reduction over the window's metric
+        outputs — never state the tick reads back), so the armed driver
+        keeps the r6 zero-per-window-readback discipline AND a bit-identical
+        trajectory (tests/test_telemetry.py holds both properties)."""
+        from ..config import ClusterConfig
+        from ..telemetry.plane import TelemetryPlane
+
+        with self._lock:
+            if self._telemetry is not None:
+                return self._telemetry
+            if isinstance(config, ClusterConfig):
+                config = config.telemetry
+            self._telemetry = TelemetryPlane(self, config=config, bus=bus)
+            self._telemetry.bus.publish(
+                "driver", "telemetry_armed", tick=self._host_tick,
+                engine="sparse" if self.sparse else "dense",
+                capacity=self.params.capacity,
+            )
+            return self._telemetry
+
+    @property
+    def telemetry(self):
+        """The armed :class:`..telemetry.TelemetryPlane`, or None."""
+        return self._telemetry
+
+    def _publish(self, source: str, kind: str, **fields) -> None:
+        """Emit one host-side lifecycle record onto the armed telemetry bus
+        (no-op when unarmed; never touches the device)."""
+        if self._telemetry is not None:
+            self._telemetry.bus.publish(
+                source, kind, tick=self._host_tick, **fields
+            )
 
     # -- chaos scenarios (fault timelines + invariant sentinels) -------------
     def run_scenario(
@@ -899,6 +1069,7 @@ class SimDriver:
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
+        self._publish("checkpoint", "saved", path=target)
 
     def _checkpoint_payload_locked(self, pickle, zlib) -> dict:
         self._flush_locked()  # fold staged device reductions into host counters
@@ -914,6 +1085,12 @@ class SimDriver:
             "pool_high_water": self._pool_high_water,
             "segmentation_warnings": self._segmentation_warnings,
             "recent_joins": list(self._recent_joins),
+            # r8: the host-tracked free rumor slots follow the timeline
+            # (None on load = unknown -> lazily reclaimed from the state)
+            "free_rumor_slots": (
+                list(self._free_rumor_slots)
+                if self._free_rumor_slots is not None else None
+            ),
         }
         host_bytes = pickle.dumps(host)
         return dict(
@@ -928,8 +1105,19 @@ class SimDriver:
     def restore(self, path: str) -> None:
         import pickle
 
-        with self._lock:
-            self._restore_locked(path, pickle)
+        try:
+            with self._lock:
+                self._restore_locked(path, pickle)
+        except CheckpointError as exc:
+            # a failed restore is a post-mortem moment: flight-record the
+            # last K windows + event tail before surfacing the error
+            if self._telemetry is not None:
+                self._telemetry.flight_record(
+                    "checkpoint_error",
+                    context={"path": path, "error": str(exc)},
+                )
+            raise
+        self._publish("checkpoint", "restored", path=path)
 
     def _restore_locked(self, path: str, pickle) -> None:
         import zlib
@@ -988,6 +1176,16 @@ class SimDriver:
         # staged reductions belong to the abandoned timeline — discard them
         self._win_accum = self._win_pool_hw = self._win_seg_warn = None
         self._join_probe = None
+        self._win_rumor_cov = None
+        self._rumor_cov_host = None
+        self._rumor_cov_dirty = True
+        self._rumor_spread_pending = {}
+        # None = unknown (pre-r8 checkpoint): reclaimed lazily from the
+        # restored state on the next spread_rumor
+        self._free_rumor_slots = host.get("free_rumor_slots")
+        # host tick shadow re-seeds from the checkpoint's tick plane (a
+        # host-side numpy value — not a device read)
+        self._host_tick = int(data["tick"])
         self._health_counters = dict(
             host.get("health_counters", {k: 0 for k in self._health_counters})
         )

@@ -1,0 +1,43 @@
+"""Telemetry plane (r8): on-device metric rings, the unified event bus, the
+OpenMetrics exporter, and the crash flight recorder.
+
+The observability subsystem that turns "works at scale" into "measured at
+scale": per-window time series recorded ON DEVICE with zero added
+device→host transfers (:mod:`.rings`), every discrete event — membership,
+chaos, transport — merged into one bounded tick-stamped stream
+(:mod:`.bus`), standard Prometheus/OpenMetrics export for both the sim
+drivers and the scalar engine (:mod:`.openmetrics`), and an atomic
+post-mortem artifact when a sentinel fires mid-soak (:mod:`.flight`).
+
+Entry points: ``SimDriver.arm_telemetry()`` returns the armed
+:class:`TelemetryPlane`; ``MonitorServer.register_telemetry`` serves
+``GET /metrics`` and ``GET /events``.
+"""
+
+from .bus import BusRecord, TelemetryBus
+from .flight import (
+    FlightRecorderError,
+    load_flight_dump,
+    replay_timeline,
+    write_flight_dump,
+)
+from .openmetrics import CONTENT_TYPE, Histogram, cluster_families, driver_families, render
+from .plane import SENTINEL_SERIES, TelemetryPlane
+from .rings import MetricRing
+
+__all__ = [
+    "BusRecord",
+    "TelemetryBus",
+    "FlightRecorderError",
+    "load_flight_dump",
+    "replay_timeline",
+    "write_flight_dump",
+    "CONTENT_TYPE",
+    "Histogram",
+    "cluster_families",
+    "driver_families",
+    "render",
+    "SENTINEL_SERIES",
+    "TelemetryPlane",
+    "MetricRing",
+]

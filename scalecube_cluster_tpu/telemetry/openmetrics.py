@@ -1,0 +1,262 @@
+"""OpenMetrics / Prometheus text exposition for both engines.
+
+``GET /metrics`` on :class:`..monitor.MonitorServer` renders metric
+families — counters, gauges, histograms — in the Prometheus text format
+(version 0.0.4, with a trailing ``# EOF`` so OpenMetrics parsers accept it
+too). Families come from provider callables registered on the server:
+
+* :func:`driver_families` — a :class:`SimDriver` + its armed
+  :class:`.plane.TelemetryPlane`: dispatch counters, announce-drop
+  counters by reason, the newest metric-ring row as gauges, the
+  window-dispatch / tick-latency / detection-latency / rumor-spread
+  histograms, and event-bus counters. Rendering is a SCRAPE SYNC POINT —
+  it flushes the driver's deferred reductions and reads the ring's newest
+  row, exactly like ``/health`` (poll cadence, never window cadence).
+* :func:`cluster_families` — the scalar/real-transport engine's
+  :class:`..cluster.Cluster`: cluster size, incarnation, per-status member
+  counts, plus transport-event counters when a bus is attached.
+
+Everything here is dependency-free host code (stdlib only — the repo rule).
+"""
+
+from __future__ import annotations
+
+import math
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PREFIX = "scalecube"
+
+#: content type of the rendered exposition
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (the Prometheus model): host-side
+    observations only (wall-clock timings, report-derived latencies), so it
+    never touches the device."""
+
+    def __init__(self, buckets: Sequence[float]):
+        if list(buckets) != sorted(buckets) or not buckets:
+            raise ValueError("histogram buckets must be non-empty ascending")
+        self.buckets = tuple(float(b) for b in buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +Inf bucket last
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect_left(self.buckets, value)] += 1
+        self.total += 1
+        self.sum += float(value)
+
+    def samples(self, name: str, labels: Optional[dict] = None) -> List[tuple]:
+        """Cumulative ``_bucket``/``_sum``/``_count`` sample tuples."""
+        labels = labels or {}
+        out, acc = [], 0
+        for le, c in zip(self.buckets, self.counts):
+            acc += c
+            out.append((f"{name}_bucket", {**labels, "le": _fmt(le)}, acc))
+        out.append((f"{name}_bucket", {**labels, "le": "+Inf"}, self.total))
+        out.append((f"{name}_sum", labels, self.sum))
+        out.append((f"{name}_count", labels, self.total))
+        return out
+
+
+def family(name: str, ftype: str, help_: str, samples: Iterable[tuple]) -> dict:
+    """One metric family: ``samples`` is an iterable of
+    ``(sample_name, labels_dict, value)`` tuples."""
+    return {"name": name, "type": ftype, "help": help_, "samples": list(samples)}
+
+
+def _fmt(v) -> str:
+    """Prometheus sample-value / le-label formatting."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value).replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+    )
+
+
+def render(families: Iterable[dict]) -> str:
+    """Prometheus text exposition of the given families (stable order as
+    given; duplicate family names are the caller's bug)."""
+    lines: List[str] = []
+    for fam in families:
+        lines.append(f"# HELP {fam['name']} {fam['help']}")
+        lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        for sample in fam["samples"]:
+            sname, labels, value = sample
+            if labels:
+                lab = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+                )
+                lines.append(f"{sname}{{{lab}}} {_fmt(value)}")
+            else:
+                lines.append(f"{sname} {_fmt(value)}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def _bus_families(bus) -> List[dict]:
+    stats = bus.stats()
+    return [
+        family(
+            f"{PREFIX}_bus_events_total", "counter",
+            "Telemetry-bus records published, by source and kind.",
+            [
+                (f"{PREFIX}_bus_events_total",
+                 {"source": src, "kind": kind}, n)
+                for (src, kind), n in sorted(bus.counts().items())
+            ],
+        ),
+        family(
+            f"{PREFIX}_bus_evicted_total", "counter",
+            "Telemetry-bus records evicted by the bounded retention.",
+            [(f"{PREFIX}_bus_evicted_total", {}, stats["evicted"])],
+        ),
+    ]
+
+
+def driver_families(driver, plane) -> List[dict]:
+    """Metric families for one SimDriver + armed TelemetryPlane. Calling
+    this IS the scrape sync point: it flushes the deferred reductions and
+    reads the metric ring's newest row back (one coalesced transfer)."""
+    counters = dict(driver.health_counters)  # property read = the flush
+    ds = driver.dispatch_snapshot()
+    engine = "sparse" if driver.sparse else "dense"
+    base = {"engine": engine}
+    fams = [
+        family(
+            f"{PREFIX}_ticks_total", "counter",
+            "Simulated gossip periods dispatched.",
+            [(f"{PREFIX}_ticks_total", base, ds["ticks_dispatched"])],
+        ),
+        family(
+            f"{PREFIX}_windows_total", "counter",
+            "Jitted windows dispatched.",
+            [(f"{PREFIX}_windows_total", base, ds["windows_dispatched"])],
+        ),
+        family(
+            f"{PREFIX}_readbacks_total", "counter",
+            "Device-to-host transfer events (sync points only on the "
+            "no-consumer path).",
+            [(f"{PREFIX}_readbacks_total", base, ds["readbacks"])],
+        ),
+        family(
+            f"{PREFIX}_flushes_total", "counter",
+            "Coalesced deferred-reduction flushes.",
+            [(f"{PREFIX}_flushes_total", base, ds["flushes"])],
+        ),
+        family(
+            f"{PREFIX}_dispatch_queue_depth", "gauge",
+            "Windows enqueued since the last host sync.",
+            [(f"{PREFIX}_dispatch_queue_depth", base, ds["queue_depth"])],
+        ),
+        family(
+            f"{PREFIX}_announce_dropped_total", "counter",
+            "Membership-rumor announce drops, by reason.",
+            [
+                (f"{PREFIX}_announce_dropped_total",
+                 {**base, "reason": name[len("announce_dropped_"):] or "total"},
+                 v)
+                for name, v in sorted(counters.items())
+                if name.startswith("announce_dropped_")
+            ],
+        ),
+        family(
+            f"{PREFIX}_announced_total", "counter",
+            "Membership rumors allocated into the pool.",
+            [(f"{PREFIX}_announced_total", base, counters.get("announced", 0))],
+        ),
+        family(
+            f"{PREFIX}_pool_evicted_total", "counter",
+            "Priority evictions of majority-covered rumors.",
+            [(f"{PREFIX}_pool_evicted_total", base,
+              counters.get("pool_evicted", 0))],
+        ),
+    ]
+    # newest ring row -> per-series gauges (the live window values; the
+    # full retained series rides the flight recorder, not the scrape).
+    # Ring reads must hold the driver lock: the sim thread's per-window
+    # append DONATES the ring buffer, and an unsynchronized monitor-thread
+    # read can hit the deleted pre-append array (the r6 RLock discipline).
+    with driver._lock:
+        latest = plane.ring.latest_values()
+    fams.append(
+        family(
+            f"{PREFIX}_window", "gauge",
+            "Newest metric-ring window row, by series name.",
+            [
+                (f"{PREFIX}_window", {**base, "series": name}, value)
+                for name, value in sorted(latest.items())
+            ],
+        )
+    )
+    fams.append(
+        family(
+            f"{PREFIX}_ring_windows_total", "counter",
+            "Window rows appended to the device metric ring.",
+            [(f"{PREFIX}_ring_windows_total", base, plane.ring.windows)],
+        )
+    )
+    for hname, hist, help_ in (
+        ("window_dispatch_seconds", plane.hist_dispatch,
+         "Host wall time to enqueue one jitted window."),
+        ("tick_latency_seconds", plane.hist_tick,
+         "Per-tick host latency (window dispatch time / ticks)."),
+        ("detection_latency_ticks", plane.hist_detection,
+         "Crash-detection latency observed by chaos sentinels, in ticks."),
+        ("rumor_spread_ticks", plane.hist_spread,
+         "Ticks from rumor creation to full coverage."),
+    ):
+        fams.append(
+            family(f"{PREFIX}_{hname}", "histogram", help_,
+                   hist.samples(f"{PREFIX}_{hname}", base))
+        )
+    fams.extend(_bus_families(plane.bus))
+    return fams
+
+
+def cluster_families(cluster, bus=None) -> List[dict]:
+    """Metric families for one scalar-engine Cluster node."""
+    mp = cluster.membership_protocol
+    member = cluster.member()
+    base = {"engine": "scalar", "member": member.id}
+    fams = [
+        family(
+            f"{PREFIX}_cluster_size", "gauge",
+            "Members in this node's view (incl. itself).",
+            [(f"{PREFIX}_cluster_size", base, len(mp.members()))],
+        ),
+        family(
+            f"{PREFIX}_incarnation", "gauge",
+            "This node's own incarnation number.",
+            [(f"{PREFIX}_incarnation", base, mp.incarnation)],
+        ),
+        family(
+            f"{PREFIX}_members", "gauge",
+            "Members by status, as seen by this node.",
+            [
+                (f"{PREFIX}_members", {**base, "status": "alive"},
+                 len(mp.alive_members())),
+                (f"{PREFIX}_members", {**base, "status": "suspected"},
+                 len(mp.suspected_members())),
+                (f"{PREFIX}_members", {**base, "status": "removed"},
+                 len(mp.removed_members())),
+            ],
+        ),
+    ]
+    if bus is not None:
+        fams.extend(_bus_families(bus))
+    return fams

@@ -1,0 +1,174 @@
+"""TelemetryPlane: one object wiring rings + bus + exporter + flight recorder
+onto a :class:`..sim.SimDriver`.
+
+Arming (``SimDriver.arm_telemetry``) is consumer-NEUTRAL in the r6 sense:
+the per-window work is one pure-jnp reduction (the engine's
+``telemetry_window_vector`` plus the armed chaos runner's sentinel margins)
+appended to the device metric ring by a donated jitted update — zero
+device→host transfers, zero effect on the protocol state trajectory
+(the row is computed FROM the window's metric outputs; it never feeds back
+into the tick). Host transfers happen only at the explicit sync points:
+a ``/metrics`` scrape, :meth:`collect`, or a flight-recorder dump.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..config import TelemetryConfig
+from .bus import TelemetryBus
+from .flight import default_dump_path, write_flight_dump
+from .openmetrics import Histogram, driver_families, render
+from .rings import MetricRing
+
+#: ring columns appended after the engine series: the armed chaos runner's
+#: latching sentinel accumulators, sampled per window (0 when unarmed)
+SENTINEL_SERIES = ("sentinel_false_dead_max", "sentinel_key_regressions")
+
+#: default bucket boundaries for the tick-count histograms (detection
+#: latency, rumor spread) — powers of two up to a long suspicion window
+TICK_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+class TelemetryPlane:
+    """The armed telemetry state of one driver (driver._telemetry)."""
+
+    def __init__(self, driver, config: Optional[TelemetryConfig] = None,
+                 bus: Optional[TelemetryBus] = None):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = config or TelemetryConfig()
+        self.config = cfg
+        self.driver = driver
+        if driver.sparse:
+            from ..ops import sparse as engine
+        else:
+            from ..ops import kernel as engine
+        self._engine = engine
+        self.names = tuple(engine.TELEMETRY_SERIES) + SENTINEL_SERIES
+        self.ring = MetricRing(self.names, cfg.ring_len, mesh=driver.mesh)
+        self.bus = bus or TelemetryBus(cfg.bus_capacity)
+        self.hist_dispatch = Histogram(cfg.latency_buckets)
+        self.hist_tick = Histogram(cfg.latency_buckets)
+        self.hist_detection = Histogram(TICK_BUCKETS)
+        self.hist_spread = Histogram(TICK_BUCKETS)
+        self.flight_dumps: List[str] = []
+        # one cached device zero for the unarmed sentinel columns (a fresh
+        # jnp scalar per window would be a per-window host→device upload)
+        self._zero = jnp.int32(0)
+        vector_fn = engine.telemetry_window_vector
+
+        def _row(ms, state, false_dead, key_regr):
+            return jnp.concatenate(
+                [
+                    vector_fn(ms, state),
+                    jnp.stack([false_dead, key_regr]).astype(jnp.float32),
+                ]
+            )
+
+        self._row_fn = jax.jit(_row)
+
+    # -- the per-window device path (called under the driver lock) -----------
+    def on_window(self, ms, state, n_ticks: int, dispatch_s: float) -> None:
+        """Fold one window into the ring (pure device ops) and the host-side
+        latency histograms (wall-clock only — no transfers)."""
+        runner = self.driver._chaos
+        sent = getattr(runner, "_sent", None) if runner is not None else None
+        false_dead = sent["false_dead_max"] if sent else self._zero
+        key_regr = sent["key_regressions"] if sent else self._zero
+        self.ring.append(self._row_fn(ms, state, false_dead, key_regr))
+        self.hist_dispatch.observe(dispatch_s)
+        self.hist_tick.observe(dispatch_s / max(n_ticks, 1))
+
+    # -- sync points ----------------------------------------------------------
+    # Every ring read takes the DRIVER lock: the sim thread's append donates
+    # the ring buffer, so an unsynchronized monitor-thread read can hit the
+    # deleted pre-append array ("Array has been deleted" — the same race the
+    # r6 driver lock exists for). The lock is reentrant; sim-thread callers
+    # nest fine.
+
+    def collect(self, k: Optional[int] = None) -> dict:
+        """Ring snapshot + bus stats (one coalesced device→host transfer)."""
+        with self.driver._lock:
+            snap = self.ring.snapshot(k)
+        self.driver._note_readback(1)
+        return {
+            "ring": {
+                "names": snap["names"],
+                "windows": snap["windows"],
+                "rows": [[float(v) for v in row] for row in snap["rows"]],
+            },
+            "bus": self.bus.stats(),
+            "flight_dumps": list(self.flight_dumps),
+        }
+
+    def families(self) -> list:
+        """This driver's OpenMetrics families — THE scrape path (the
+        monitor's /metrics provider and :meth:`metrics_text` both route
+        here, so the sync-point bookkeeping has one spelling)."""
+        fams = driver_families(self.driver, self)
+        self.driver._note_readback(1)  # the ring's newest-row read
+        return fams
+
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body — rendering IS the scrape sync point."""
+        return render(self.families())
+
+    # -- chaos ingestion -------------------------------------------------------
+    def ingest_chaos_report(self, report: dict) -> Optional[str]:
+        """Feed one FINAL scenario report: detection latencies into the
+        histogram, sentinel outcomes onto the bus, and — on any violation —
+        a flight-recorder dump. Returns the dump path if one was written.
+        Call once per completed scenario (the runner does)."""
+        sent = report.get("sentinels") or {}
+        for det in sent.get("detections", ()):
+            if det.get("detected_at") is not None:
+                self.hist_detection.observe(
+                    det["detected_at"] - det["crashed_at"]
+                )
+        self.bus.publish(
+            "chaos", "scenario_complete", tick=self.driver._host_tick,
+            scenario=report.get("scenario", "?"),
+            violations=report.get("violations", 0),
+            ok=report.get("ok", True),
+        )
+        if report.get("violations"):
+            return self.flight_record(
+                "sentinel_violation",
+                context={
+                    "scenario": report.get("scenario"),
+                    "violations": report.get("violations"),
+                    "sentinels": sent,
+                },
+            )
+        return None
+
+    # -- flight recorder -------------------------------------------------------
+    def flight_record(self, reason: str, context: Optional[dict] = None,
+                      path: Optional[str] = None) -> str:
+        """Dump the last K ring windows + the bus tail atomically; returns
+        the artifact path. Reading the ring here is a sync point — by
+        design: the flight is recorded when something already went wrong."""
+        self.bus.publish(
+            "flight", "dump", tick=self.driver._host_tick, reason=reason
+        )
+        with self.driver._lock:
+            snap = self.ring.snapshot(self.config.flight_windows)
+        self.driver._note_readback(1)
+        target = path or default_dump_path(self.config.flight_dir, reason)
+        out = write_flight_dump(
+            target,
+            reason=reason,
+            engine="sparse" if self.driver.sparse else "dense",
+            ring_snapshot=snap,
+            bus_tail=[r.as_dict() for r in self.bus.tail()],
+            context=context,
+        )
+        self.flight_dumps.append(out)
+        return out
+
+    # -- timestamping hook for bus adapters -----------------------------------
+    def tick_now(self) -> int:
+        """The driver's host-side tick shadow (never a device read)."""
+        return self.driver._host_tick

@@ -1,0 +1,107 @@
+"""On-device metric rings: fixed-shape [ring_len, n_metrics] window series.
+
+The r6/r7 deferred-accumulator discipline, generalized from "a handful of
+scalar reductions" to a full per-window TIME SERIES: every ``step()``
+appends one f32 row (the engine's ``telemetry_window_vector`` — see
+``ops.kernel.TELEMETRY_SERIES`` / ``ops.sparse.TELEMETRY_SERIES``) to a
+circular device buffer via a donated jitted update. Nothing is transferred
+per window — the row is a pure jnp reduction over the window's stacked
+metrics, and the ring lives on device until an explicit sync point
+(:meth:`MetricRing.snapshot`, a ``/metrics`` scrape, or a flight-recorder
+dump) reads it back in one coalesced transfer.
+
+The cursor is HOST state (one Python int): a window append is a host event,
+so the host always knows how many rows exist and where the next one goes —
+no device round trip is ever needed to index the ring. Under a mesh the
+buffer is placed replicated (``ops.sharding.replicated_sharding``): window
+summaries of sharded metrics come out replicated under GSPMD, so the append
+stays a collective-free local update on every chip.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class MetricRing:
+    """Circular [ring_len, n_metrics] f32 device buffer of per-window rows.
+
+    ``names`` fixes the column layout (the engine's ``TELEMETRY_SERIES``).
+    :meth:`append` is the per-window device-only path; :meth:`snapshot` /
+    :meth:`last` are the host sync points.
+    """
+
+    def __init__(self, names: Sequence[str], ring_len: int, mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        if ring_len <= 0:
+            raise ValueError("ring_len must be > 0")
+        self.names = tuple(names)
+        self.ring_len = int(ring_len)
+        buf = jnp.zeros((self.ring_len, len(self.names)), jnp.float32)
+        if mesh is not None:
+            from ..ops.sharding import place_replicated
+
+            buf = place_replicated(buf, mesh)
+        self._buf = buf
+        self._windows = 0  # host-side append count (cursor = windows % len)
+        # donated in-place row write: the ring must never force a copy of
+        # itself per window (it is carried across every step of a run)
+        self._append = jax.jit(
+            lambda buf, row, idx: buf.at[idx].set(row), donate_argnums=0
+        )
+
+    @property
+    def windows(self) -> int:
+        """Total rows ever appended (>= ring_len means the ring wrapped)."""
+        return self._windows
+
+    def append(self, row) -> None:
+        """Write one window row ([n_metrics] f32 device array). Pure device
+        op — zero device→host transfers."""
+        import jax.numpy as jnp
+
+        idx = jnp.int32(self._windows % self.ring_len)
+        self._buf = self._append(self._buf, row, idx)
+        self._windows += 1
+
+    def last(self, k: Optional[int] = None) -> np.ndarray:
+        """The most recent ``k`` rows (default: all retained), OLDEST first —
+        one coalesced device→host transfer (the sync point)."""
+        have = min(self._windows, self.ring_len)
+        k = have if k is None else min(int(k), have)
+        if k <= 0:
+            return np.zeros((0, len(self.names)), np.float32)
+        buf = np.asarray(self._buf)
+        if self._windows >= self.ring_len:  # wrapped: unroll from the cursor
+            cursor = self._windows % self.ring_len
+            ordered = np.concatenate([buf[cursor:], buf[:cursor]], axis=0)
+        else:
+            ordered = buf[:have]
+        return ordered[-k:]
+
+    def snapshot(self, k: Optional[int] = None) -> Dict[str, object]:
+        """Host view of the ring: column names + the last ``k`` rows in
+        time order + append count. THE ring readback site."""
+        rows = self.last(k)
+        return {
+            "names": list(self.names),
+            "ring_len": self.ring_len,
+            "windows": self._windows,
+            "rows": rows,
+        }
+
+    def latest_values(self) -> Dict[str, float]:
+        """name -> value of the newest row ({} before the first append)."""
+        rows = self.last(1)
+        if rows.shape[0] == 0:
+            return {}
+        return {n: float(v) for n, v in zip(self.names, rows[-1])}
+
+    def series(self, name: str, k: Optional[int] = None) -> List[float]:
+        """One named column of the retained window series, oldest first."""
+        col = self.names.index(name)
+        return [float(v) for v in self.last(k)[:, col]]

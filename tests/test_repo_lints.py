@@ -1,0 +1,73 @@
+"""Tier-1 repo lints (r8 CI tooling satellite).
+
+1. Donation-safety: no zero-copy ``jnp.asarray`` on restore/donation paths
+   anywhere in the package — the r6 use-after-free class (an aligned npz
+   buffer aliased into state the driver later donates) must stay dead.
+   The lint is also exercised on a known-bad fixture so a silently broken
+   lint can't report a false clean.
+2. Pytest-marker audit: every soak/slow test is reachable from a marker
+   expression (``-m slow``) and every custom marker is registered.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.audit_pytest_markers import audit, registered_markers
+from tools.lint_donation_safety import lint_file, lint_tree
+
+
+def test_package_is_donation_safe():
+    findings = lint_tree(os.path.join(REPO, "scalecube_cluster_tpu"))
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lint_catches_the_r6_bug_class(tmp_path):
+    """Falsifiability: the exact pre-r6-fix restore spelling must be
+    flagged, in all three shapes (asarray in restore, copy-less array in
+    restore, asarray next to np.load), and the suppression comment works."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def restore(arrays):
+            return {k: jnp.asarray(v) for k, v in arrays.items()}
+
+        def _restore_locked(data):
+            return jnp.array(data, copy=False)
+
+        def load_checkpoint(path):
+            with np.load(path) as npz:
+                return jnp.asarray(npz["view_key"])
+
+        def fine(path):
+            with np.load(path) as npz:
+                return jnp.array(npz["x"], copy=True)
+
+        def suppressed(arrays):
+            with np.load(arrays) as npz:
+                return jnp.asarray(npz["x"])  # lint: allow-zero-copy
+    """))
+    findings = lint_file(str(bad))
+    assert len(findings) == 3
+    assert {f.function for f in findings} == {
+        "restore", "_restore_locked", "load_checkpoint"
+    }
+
+
+def test_marker_audit_is_clean():
+    """Every soak-class test is reachable via -m slow; markers registered."""
+    findings = audit(os.path.join(REPO, "tests"))
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_slow_marker_is_registered():
+    assert "slow" in registered_markers(
+        os.path.join(REPO, "tests", "conftest.py")
+    )

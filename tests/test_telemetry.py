@@ -1,0 +1,644 @@
+"""Telemetry plane (r8 tentpole): rings, bus, exporter, flight recorder.
+
+The properties the subsystem must keep:
+
+1. NEUTRALITY — an armed telemetry plane never perturbs the trajectory:
+   armed-vs-unarmed drivers stay bit-identical in state lockstep, dense
+   AND sparse (the ring row is computed FROM the window outputs, never fed
+   back into the tick).
+2. ZERO ADDED TRANSFERS — the r6 transfer-spy proof extended: an armed
+   plane's step() path performs no device→host transfers; the scrape /
+   collect() / flight dump are the sync points.
+3. ``GET /metrics`` serves VALID Prometheus/OpenMetrics text for a sim
+   driver and the scalar engine (line-grammar + histogram-invariant
+   checked, not just "it returned 200").
+4. A chaos run with a forced sentinel violation writes a flight-recorder
+   dump whose loader replays a timeline containing the violation; a failed
+   checkpoint restore does the same.
+5. The r8 driver satellites hold: spread_rumor() no longer syncs the
+   donated pipeline, and rumor_coverage() rides the deferred accumulators
+   (surfaced per slot in health_snapshot()).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import re
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import scalecube_cluster_tpu.ops.sparse as SP
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.chaos import Partition, Scenario
+from scalecube_cluster_tpu.chaos.engine import DriverChaosRunner
+from scalecube_cluster_tpu.config import ClusterConfig, TelemetryConfig
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.telemetry import (
+    MetricRing,
+    TelemetryBus,
+    load_flight_dump,
+    replay_timeline,
+)
+
+
+def _dense_params(n=16):
+    return S.SimParams(
+        capacity=n, fd_every=2, sync_every=8, suspicion_mult=2,
+        rumor_slots=2, seed_rows=(0,),
+    )
+
+
+def _sparse_params(n=32):
+    return SP.SparseParams(
+        capacity=n, fd_every=2, sync_every=8, sweep_every=2, mr_slots=16,
+        announce_slots=8, rumor_slots=2, suspicion_mult=2, seed_rows=(0,),
+    )
+
+
+def _state_fields(state):
+    return [f.name for f in dataclasses.fields(type(state))]
+
+
+# ---------------------------------------------------------------------------
+# 1. neutrality
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+def test_armed_and_unarmed_drivers_stay_in_bit_identical_lockstep(engine):
+    """Same seed, same host mutations, one driver armed: every state leaf
+    must stay identical window for window."""
+    params = _dense_params() if engine == "dense" else _sparse_params()
+    n0 = 12 if engine == "dense" else 24
+    a = SimDriver(params, n0, warm=True, seed=11)
+    b = SimDriver(params, n0, warm=True, seed=11)
+    b.arm_telemetry(TelemetryConfig(ring_len=8))
+    for w in range(4):
+        if w == 1:
+            for d in (a, b):
+                d.crash(5)
+                d.spread_rumor(origin=3, payload="p")
+        if w == 2:
+            for d in (a, b):
+                d.join(seed_rows=(0,))
+        a.step(3)
+        b.step(3)
+        for name in _state_fields(a.state):
+            x = np.asarray(getattr(a.state, name))
+            y = np.asarray(getattr(b.state, name))
+            assert np.array_equal(x, y), (
+                f"armed/unarmed divergence in {name} at window {w}"
+            )
+    assert np.array_equal(np.asarray(a._key), np.asarray(b._key))
+    assert b.telemetry.ring.windows == 4
+
+
+# ---------------------------------------------------------------------------
+# 2. transfer-spy: zero added per-window d2h
+# ---------------------------------------------------------------------------
+
+
+def test_armed_telemetry_step_is_transfer_free(monkeypatch):
+    """r8 extension of the r6 transfer-spy proof: with the telemetry plane
+    armed (ring appends + bus + histograms live), the no-consumer step()
+    path must still perform ZERO device→host transfers — the scrape is the
+    only sync point."""
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=1)
+    plane = d.arm_telemetry(TelemetryConfig(ring_len=16))
+    d.step(2)  # compile outside the spied region
+    d.sync()
+    base = d.dispatch_stats["readbacks"]
+
+    transfers = []
+    real_asarray = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"armed-telemetry step() read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == base
+    assert plane.ring.windows == 6  # every window reached the device ring
+
+    # the scrape IS a sync point and reads the series back
+    snap = plane.collect()
+    assert snap["ring"]["windows"] == 6
+    assert d.dispatch_stats["readbacks"] > base
+
+
+def test_spread_rumor_does_not_sync_the_pipeline(monkeypatch):
+    """r8 satellite: the interactive spread path must not read the device
+    while host-tracked free slots remain (the r6 join() bug class)."""
+    params = _sparse_params()
+    d = SimDriver(params, 24, warm=True, seed=2)
+    d.step(2)
+    d.sync()
+
+    transfers = []
+    real_asarray = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        d.step(2)
+        slot = d.spread_rumor(origin=3, payload="a")
+        d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"spread_rumor read back: {transfers}"
+    assert slot == 0
+    assert d.dispatch_stats["readbacks"] == 0
+
+    # exhaustion path: host list empty -> ONE coalesced reclaim readback
+    d.spread_rumor(origin=4, payload="b")  # slot 1, list now empty
+    d.step(60)  # device sweep frees both slots eventually
+    before = d.dispatch_stats["readbacks"]
+    slot3 = d.spread_rumor(origin=5, payload="c")
+    assert slot3 in (0, 1)
+    assert d.dispatch_stats["readbacks"] == before + 1
+
+
+def test_rumor_coverage_rides_the_deferred_accumulators():
+    """r8 satellite: coverage comes from the flushed end-of-window [R]
+    vector (no per-call [N]-plane pull) and shows up per slot in
+    health_snapshot(); a pre-window read falls back to a device reduce."""
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=3)
+    slot = d.spread_rumor(origin=5, payload="x")
+    # no window yet: fallback reduce gives the exact origin-only coverage
+    assert d.rumor_coverage(slot) == pytest.approx(1.0 / 24)
+    d.step(40)
+    assert d.rumor_coverage(slot) == 1.0
+    # the value came from the staged window vector, not a fresh plane read
+    assert d._rumor_cov_host is not None
+    snap = d.health_snapshot()
+    assert snap["rumors"]["coverage"][slot] == 1.0
+    assert snap["rumors"]["stale"] is False
+
+    # oracle check: deferred value == direct recompute from the state
+    inf = np.asarray(d.state.infected[:, slot])
+    up = np.asarray(d.state.up)
+    assert d.rumor_coverage(slot) == pytest.approx(
+        float(inf[up].sum()) / max(int(up.sum()), 1)
+    )
+
+
+def test_free_rumor_slots_survive_checkpoint_roundtrip(tmp_path):
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=4)
+    d.spread_rumor(origin=1, payload="kept")
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    fresh = SimDriver(_sparse_params(), 24, warm=True, seed=99)
+    fresh.restore(path)
+    assert fresh._free_rumor_slots == d._free_rumor_slots
+    # slot 0 is taken on both: the next spread gets slot 1, no readback
+    before = fresh.dispatch_stats["readbacks"]
+    assert fresh.spread_rumor(origin=2, payload="y") == 1
+    assert fresh.dispatch_stats["readbacks"] == before
+
+
+def test_mesh_sharded_driver_writes_the_same_ring():
+    """The mesh-sharded builders feed the identical ring layout: window
+    summaries of sharded metrics reduce to replicated scalars and the
+    replicated ring appends collective-free (8 virtual CPU devices)."""
+    from scalecube_cluster_tpu.ops.sharding import make_mesh
+
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    params = S.SimParams(
+        capacity=64, fd_every=2, sync_every=8, suspicion_mult=2,
+        rumor_slots=2, seed_rows=(0,),
+    )
+    d = SimDriver(params, 48, warm=True, seed=0, mesh=mesh)
+    plane = d.arm_telemetry(TelemetryConfig(ring_len=8))
+    d.step(3)
+    d.step(3)
+    snap = plane.collect()
+    assert snap["ring"]["windows"] == 2
+    assert snap["ring"]["names"] == list(plane.names)
+    latest = dict(zip(plane.names, snap["ring"]["rows"][-1]))
+    assert latest["n_up"] == 48.0
+    assert latest["tick"] == 6.0
+
+
+# ---------------------------------------------------------------------------
+# 3. rings + bus unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_metric_ring_wraps_in_time_order():
+    ring = MetricRing(("a", "b"), ring_len=4)
+    for i in range(6):
+        ring.append(jnp.asarray([float(i), float(10 * i)], jnp.float32))
+    assert ring.windows == 6
+    rows = ring.last()
+    assert rows.shape == (4, 2)
+    assert [int(v) for v in rows[:, 0]] == [2, 3, 4, 5]  # oldest first
+    assert ring.series("b", k=2) == [40.0, 50.0]
+    assert ring.latest_values() == {"a": 5.0, "b": 50.0}
+
+
+def test_bus_is_bounded_ordered_and_counted():
+    bus = TelemetryBus(capacity=4)
+    seen = []
+    bus.subscribe(seen.append)
+    for i in range(6):
+        bus.publish("t", "k", tick=i, i=i)
+    tail = bus.tail()
+    assert [r.tick for r in tail] == [2, 3, 4, 5]  # bounded, oldest evicted
+    assert [r.seq for r in tail] == [2, 3, 4, 5]  # total order preserved
+    assert len(seen) == 6  # subscribers saw every record
+    stats = bus.stats()
+    assert stats["published"] == 6 and stats["evicted"] == 2
+    assert bus.counts()[("t", "k")] == 6
+
+
+def test_bus_merges_membership_and_feeds_tick_logger(tmp_path):
+    """The unified stream: a driver watch's membership events land on the
+    bus tick-stamped, and the bus pipes into TickLogger as JSON lines."""
+    from scalecube_cluster_tpu.monitor import TickLogger
+
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=5)
+    plane = d.arm_telemetry()
+    log_path = str(tmp_path / "ticks.jsonl")
+    logger = TickLogger(log_path)
+    plane.bus.pipe_to_tick_logger(logger)
+    plane.bus.attach_membership(d.watch(1), "sim-1", tick_fn=plane.tick_now)
+    d.crash(7)
+    d.step(120)
+    logger.close()
+    removed = [
+        r for r in plane.bus.tail()
+        if r.source == "membership" and r.kind == "removed"
+    ]
+    assert any(r.fields["address"] == "sim://7" for r in removed)
+    assert all(r.tick >= 0 for r in removed)  # host tick shadow stamped
+    lines = [json.loads(l) for l in open(log_path)]
+    assert any(l.get("event") == "membership:removed" for l in lines)
+    # lifecycle records merged into the SAME stream
+    kinds = {(r.source, r.kind) for r in plane.bus.tail()}
+    assert ("driver", "crash") in kinds
+
+
+# ---------------------------------------------------------------------------
+# 4. /metrics endpoint validity (sim + scalar)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+    r"(-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$"
+)
+
+
+def _assert_valid_exposition(text: str) -> dict:
+    """Line-grammar check + histogram invariants; returns name -> value."""
+    values = {}
+    typed = {}
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, ftype = line.split(" ", 3)
+            typed[name] = ftype
+            continue
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE ", "# EOF")), line
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+        values[line.rsplit(" ", 1)[0]] = line.rsplit(" ", 1)[1]
+    assert text.rstrip("\n").endswith("# EOF")
+    # histogram invariant: bucket counts are cumulative and end at _count
+    for name, ftype in typed.items():
+        if ftype != "histogram":
+            continue
+        buckets = [
+            float(v) for k, v in values.items()
+            if k.startswith(f"{name}_bucket")
+        ]
+        assert buckets == sorted(buckets), f"{name} buckets not cumulative"
+    return values
+
+
+def test_metrics_endpoint_serves_valid_openmetrics_for_sim_driver():
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=6)
+    d.arm_telemetry()
+    d.spread_rumor(origin=3, payload="x")
+    d.step(8)
+    d.step(8)
+
+    async def run():
+        from scalecube_cluster_tpu.monitor import MonitorServer
+
+        server = await MonitorServer().start()
+        server.register_telemetry(d)
+        loop = asyncio.get_running_loop()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.headers.get("Content-Type"), resp.read().decode()
+
+        ctype, text = await loop.run_in_executor(
+            None, get, server.url + "/metrics"
+        )
+        index = await loop.run_in_executor(
+            None, lambda: json.loads(urllib.request.urlopen(
+                server.url + "/", timeout=5).read())
+        )
+        events = await loop.run_in_executor(
+            None, lambda: json.loads(urllib.request.urlopen(
+                server.url + "/events", timeout=5).read())
+        )
+        await server.stop()
+        return ctype, text, index, events
+
+    ctype, text, index, events = asyncio.run(run())
+    assert ctype.startswith("text/plain")
+    assert index["metrics"] is True and index["events"] is True
+    values = _assert_valid_exposition(text)
+    assert values['scalecube_ticks_total{engine="sparse"}'] == "16"
+    assert values['scalecube_windows_total{engine="sparse"}'] == "2"
+    # the ring's newest window rides the scrape as gauges
+    assert 'scalecube_window{engine="sparse",series="n_up"}' in values
+    # histogram families present with samples
+    assert any(k.startswith("scalecube_window_dispatch_seconds_bucket")
+               for k in values)
+    # the event bus tail is served as JSON
+    kinds = {(e["source"], e["kind"]) for e in events["events"]}
+    assert ("driver", "telemetry_armed") in kinds
+    assert ("driver", "rumor_spread") in kinds
+
+
+def test_metrics_endpoint_serves_valid_openmetrics_for_scalar_engine():
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.transport import MemoryTransportRegistry
+
+    MemoryTransportRegistry.reset_default()
+
+    async def run():
+        cfg = ClusterConfig.default_local().with_membership(
+            lambda m: m.replace(sync_interval=0.5)
+        )
+        alice = await new_cluster(cfg).start()
+        bob = await new_cluster(
+            cfg.with_membership(lambda m: m.replace(
+                seed_members=[alice.address], sync_interval=0.5))
+        ).start()
+        bus = TelemetryBus(64)
+        bus.attach_cluster(alice)
+        for _ in range(100):
+            if len(alice.members()) == 2:
+                break
+            await asyncio.sleep(0.05)
+        server = await MonitorServer().start()
+        server.register_cluster_metrics(alice, bus=bus)
+        loop = asyncio.get_running_loop()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.read().decode()
+
+        text = await loop.run_in_executor(None, get, server.url + "/metrics")
+        await server.stop()
+        await bob.shutdown()
+        await alice.shutdown()
+        return text
+
+    try:
+        text = asyncio.run(run())
+    finally:
+        MemoryTransportRegistry.reset_default()
+    values = _assert_valid_exposition(text)
+    size_key = next(k for k in values if k.startswith("scalecube_cluster_size"))
+    assert values[size_key] == "2"
+    assert any(k.startswith("scalecube_members{") for k in values)
+
+
+# ---------------------------------------------------------------------------
+# 5. flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_forced_sentinel_violation_writes_replayable_flight_dump(tmp_path):
+    """Acceptance: a chaos run with a forced violation (the r7 suppressed-
+    heal trick) must produce a flight dump whose replayed timeline contains
+    the violation and the scenario's event trail."""
+    params = S.SimParams(
+        capacity=12, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=2, seed_rows=(0, 6),
+    )
+    d = SimDriver(params, 12, warm=True, seed=0)
+    d.arm_telemetry(TelemetryConfig(
+        ring_len=64, flight_windows=32, flight_dir=str(tmp_path)
+    ))
+    scenario = Scenario(
+        name="split-never-heals",
+        events=[Partition(groups=[range(0, 6), range(6, 12)], at=10,
+                          heal_at=70)],
+        horizon=320, check_interval=8,
+    )
+    runner = DriverChaosRunner(d, scenario)
+    # suppress the heal: the scenario still PROMISES convergence
+    runner.timeline._steps = [
+        s for s in runner.timeline._steps if s.kind != "partition_heal"
+    ]
+    rep = runner.run()
+    assert rep["violations"] >= 1
+    assert "flight_dump" in rep
+
+    dump = load_flight_dump(rep["flight_dump"])
+    assert dump["reason"] == "sentinel_violation"
+    assert dump["context"]["violations"] == rep["violations"]
+    assert len(dump["ring"]["rows"]) > 0
+    timeline = replay_timeline(dump)
+    text = "\n".join(timeline)
+    assert "sentinel_violation" in text
+    assert "chaos:event_applied" in text  # the fault trail replays
+    assert "window" in text  # ring series interleaved
+    # sentinel margins were recorded INTO the ring while armed
+    names = dump["ring"]["names"]
+    assert "sentinel_false_dead_max" in names
+
+
+def test_checkpoint_error_triggers_flight_dump(tmp_path):
+    d = SimDriver(_dense_params(), 12, warm=True, seed=7)
+    plane = d.arm_telemetry(TelemetryConfig(flight_dir=str(tmp_path)))
+    d.step(4)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    with open(path, "r+b") as fh:  # corrupt the archive
+        fh.seek(30)
+        fh.write(b"\xde\xad\xbe\xef" * 8)
+    from scalecube_cluster_tpu.sim.driver import CheckpointError
+
+    with pytest.raises(CheckpointError):
+        d.restore(path)
+    assert len(plane.flight_dumps) == 1
+    dump = load_flight_dump(plane.flight_dumps[0])
+    assert dump["reason"] == "checkpoint_error"
+    assert dump["context"]["path"] == path
+    lines = replay_timeline(dump)
+    assert any("flight:dump" in l for l in lines)
+
+
+def test_flight_dump_rejects_garbage_and_future_schema(tmp_path):
+    from scalecube_cluster_tpu.telemetry import FlightRecorderError
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(FlightRecorderError, match="unreadable"):
+        load_flight_dump(str(bad))
+    future = tmp_path / "future.json"
+    future.write_text(json.dumps({"_schema": 99}))
+    with pytest.raises(FlightRecorderError, match="newer"):
+        load_flight_dump(str(future))
+
+
+# ---------------------------------------------------------------------------
+# 6. review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_scrape_during_stepping_does_not_crash():
+    """Monitor-thread ring reads race the sim thread's DONATING ring
+    append; unsynchronized, a scrape hits the deleted pre-append buffer
+    ('Array has been deleted'). The plane serializes every ring read under
+    the driver lock — hammer both threads to hold it."""
+    import threading
+
+    d = SimDriver(_dense_params(), 12, warm=True, seed=9)
+    plane = d.arm_telemetry(TelemetryConfig(ring_len=4))
+    d.step(1)
+    d.sync()
+    errors = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                plane.metrics_text()
+                plane.collect()
+            except Exception as exc:  # noqa: BLE001 - the assertion target
+                errors.append(exc)
+                return
+
+    th = threading.Thread(target=scraper)
+    th.start()
+    try:
+        for _ in range(30):
+            d.step(1)
+    finally:
+        stop.set()
+        th.join()
+    assert errors == []
+
+
+def test_reclaimed_slot_spread_is_not_falsely_marked_complete():
+    """A rumor spread into a reclaimed slot must not inherit the previous
+    occupant's full-coverage plane as a bogus ~0-tick spread sample: the
+    flush-time histogram feed skips stale staged vectors."""
+    params = _sparse_params()
+    d = SimDriver(params, 24, warm=True, seed=10)
+    plane = d.arm_telemetry()
+    d.spread_rumor(origin=1, payload="a")
+    d.spread_rumor(origin=2, payload="b")  # host free list now empty
+    d.step(60)  # both spread fully; the device sweep frees the slots
+    d.flush()  # observes a + b with their real latencies
+    assert d._rumor_spread_pending == {}
+    base = plane.hist_spread.total
+    d.step(1)  # stage a fresh (pre-reclaim) coverage vector: both cols 1.0
+    slot = d.spread_rumor(origin=3, payload="c")  # reclaims a freed slot
+    d.flush()  # staged vector predates c — must NOT record it
+    assert slot in d._rumor_spread_pending
+    assert plane.hist_spread.total == base
+    d.step(60)
+    d.flush()  # c has genuinely spread by now: recorded once, with latency
+    assert slot not in d._rumor_spread_pending
+    assert plane.hist_spread.total == base + 1
+
+
+def test_transport_events_unwraps_the_whole_decorator_chain():
+    """transport_events() must probe every _delegate layer (SenderAware
+    over an emulator wrapper over the wire transport), not just one."""
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.utils.streams import EventStream
+
+    class Inner:
+        def __init__(self):
+            self.ev = EventStream()
+
+        def transport_events(self):
+            return self.ev
+
+    class Wrap:
+        def __init__(self, delegate):
+            self._delegate = delegate
+
+    c = new_cluster()
+    c._membership = object()  # satisfies _require_started
+    inner = Inner()
+    c._transport = Wrap(Wrap(inner))
+    assert c.transport_events() is inner.ev
+    c._transport = Wrap(Wrap(object()))  # no stream anywhere in the chain
+    assert c.transport_events() is None
+
+
+def test_register_telemetry_attaches_an_explicit_plane():
+    """A plane constructed by hand and passed to register_telemetry must be
+    armed on the driver — otherwise step() never appends and the ring
+    stays empty forever."""
+    import asyncio as _asyncio
+
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.telemetry import TelemetryPlane
+
+    d = SimDriver(_dense_params(), 12, warm=True, seed=12)
+    plane = TelemetryPlane(d)
+    assert d.telemetry is None  # constructing alone does not arm
+
+    async def run():
+        server = await MonitorServer().start()
+        server.register_telemetry(d, plane)
+        await server.stop()
+
+    _asyncio.run(run())
+    assert d.telemetry is plane
+    d.step(2)
+    assert plane.ring.windows == 1
+
+
+# ---------------------------------------------------------------------------
+# 7. config plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_config_validation_and_lens():
+    cfg = ClusterConfig.default_sim().with_telemetry(
+        lambda t: t.replace(ring_len=128, bus_capacity=512)
+    )
+    assert cfg.validate().telemetry.ring_len == 128
+    with pytest.raises(ValueError, match="ring_len"):
+        cfg.with_telemetry(lambda t: t.replace(ring_len=0)).validate()
+    with pytest.raises(ValueError, match="latency_buckets"):
+        cfg.with_telemetry(
+            lambda t: t.replace(latency_buckets=(1.0, 0.5))
+        ).validate()
+    # arm_telemetry accepts the full ClusterConfig and picks .telemetry
+    d = SimDriver(_dense_params(), 12, warm=True, seed=8)
+    plane = d.arm_telemetry(cfg)
+    assert plane.ring.ring_len == 128
+    assert d.arm_telemetry() is plane  # idempotent

@@ -1,0 +1,3 @@
+"""Repo CI / correctness tooling (run as tier-1 tests — see
+tests/test_repo_lints.py): the donation-safety lint and the pytest-marker
+audit."""

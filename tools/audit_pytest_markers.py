@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Pytest-marker audit: every soak/slow test must be reachable from a
+marker expression, and every custom marker must be registered.
+
+The r7 soak work left two selection mechanisms side by side: the ``slow``
+marker (tier-1 excludes it with ``-m 'not slow'``; ``-m slow`` opts in)
+and ad-hoc ``SOAK=1`` env gates that NO marker expression can reach. This
+audit pins the policy:
+
+1. Every test whose name (or module name) contains ``soak`` carries an
+   explicit ``@pytest.mark.slow`` (directly, via a decorator alias
+   assigned from ``pytest.mark.slow``, or via module ``pytestmark``) — so
+   ``-m slow`` reaches the entire soak surface even when an env gate also
+   applies.
+2. Every ``pytest.mark.<name>`` used under tests/ is either a pytest
+   builtin or registered in conftest.py (``markers`` ini lines) — unknown
+   markers would make ``-m`` expressions silently select nothing.
+
+AST-based; run directly (exit 1 on findings) or through
+``tests/test_repo_lints.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "timeout",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.message}"
+
+
+def _mark_names(node: ast.AST) -> Set[str]:
+    """marker names in one decorator / pytestmark expression."""
+    names: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            chain: List[str] = []
+            cur: ast.AST = sub
+            while isinstance(cur, ast.Attribute):
+                chain.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name) and cur.id == "pytest" and (
+                len(chain) >= 2 and chain[-1] == "mark"
+            ):
+                names.add(chain[-2])
+    return names
+
+
+def _module_facts(path: str):
+    """(aliases: var -> mark names, pytestmark names, test funcs, used)."""
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    aliases: Dict[str, Set[str]] = {}
+    module_marks: Set[str] = set()
+    used: Set[str] = set()
+    tests: List[tuple] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            marks = _mark_names(node.value)
+            if marks:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if tgt.id == "pytestmark":
+                            module_marks |= marks
+                        else:
+                            aliases[tgt.id] = marks
+    for node in ast.walk(tree):
+        marks = set()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                direct = _mark_names(dec)
+                marks |= direct
+                used |= direct
+                # decorator alias (e.g. ``_soak_gate = pytest.mark.skipif(...)``)
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if isinstance(base, ast.Name) and base.id in aliases:
+                    marks |= aliases[base.id]
+            if node.name.startswith("test"):
+                tests.append((node.name, node.lineno, marks))
+    used |= module_marks
+    for marks_set in aliases.values():
+        used |= marks_set
+    return module_marks, tests, used
+
+
+def registered_markers(conftest_path: str) -> Set[str]:
+    """Markers declared via ``config.addinivalue_line("markers", "...")``."""
+    if not os.path.exists(conftest_path):
+        return set()
+    with open(conftest_path, "r") as fh:
+        source = fh.read()
+    names: Set[str] = set()
+    for m in re.finditer(
+        r'addinivalue_line\(\s*["\']markers["\']\s*,\s*["\']([a-zA-Z_][a-zA-Z0-9_]*)',
+        source,
+    ):
+        names.add(m.group(1))
+    return names
+
+
+def audit(tests_dir: str) -> List[Finding]:
+    findings: List[Finding] = []
+    known = registered_markers(os.path.join(tests_dir, "conftest.py"))
+    for name in sorted(os.listdir(tests_dir)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        path = os.path.join(tests_dir, name)
+        module_marks, tests, used = _module_facts(path)
+        module_is_soak = "soak" in name.lower()
+        for tname, lineno, marks in tests:
+            effective = marks | module_marks
+            if (module_is_soak or "soak" in tname.lower()) and (
+                "slow" not in effective
+            ):
+                findings.append(Finding(
+                    path, lineno,
+                    f"soak test {tname} is not reachable from a marker "
+                    "expression — add @pytest.mark.slow (env gates alone "
+                    "cannot be selected with -m)",
+                ))
+        for mark in sorted(used - BUILTIN_MARKS - known):
+            findings.append(Finding(
+                path, 0,
+                f"marker {mark!r} is not registered in tests/conftest.py — "
+                "-m expressions over it select nothing",
+            ))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    tests_dir = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    )
+    findings = audit(tests_dir)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} marker-audit finding(s)")
+        return 1
+    print("pytest-marker audit: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

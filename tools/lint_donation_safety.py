@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Donation-safety lint: flag zero-copy ``jnp.asarray`` on restore paths.
+
+The bug class (found in r6, regression-tested in test_dispatch_pipeline.
+test_restored_state_is_donation_safe): ``jnp.asarray`` ZERO-COPIES a
+64-byte-aligned numpy array on CPU, so state restored from an npz archive
+can alias the archive's buffers. The pipelined driver then DONATES that
+state into a jitted window — a use-after-free once the npz dict is
+collected, observed as a restored driver silently diverging with foreign
+data several windows later. The fix is ``jnp.array(..., copy=True)``
+(jax-owned buffers); this lint keeps the class from coming back.
+
+Rules (AST-based, no imports of the linted code):
+
+1. In any function whose name contains ``restore``: calls to
+   ``jnp.asarray`` / ``jax.numpy.asarray`` are flagged, and ``jnp.array``
+   calls must pass an explicit ``copy=True``.
+2. In any function that calls ``np.load`` / ``numpy.load`` (an npz/npy
+   deserialization site): ``jnp.asarray`` of anything is flagged — the
+   loaded buffers are exactly the aligned-host-memory case.
+
+A line may opt out with a ``# lint: allow-zero-copy`` comment (for code
+that provably never reaches a donated program).
+
+Run directly (``python tools/lint_donation_safety.py [root]``, exit 1 on
+findings) or through the tier-1 test ``tests/test_repo_lints.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import List, Optional
+
+SUPPRESS = "lint: allow-zero-copy"
+
+#: attribute chains that spell the jax asarray entry point
+_ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
+_ARRAY_CHAINS = {("jnp", "array"), ("jax", "numpy", "array")}
+_NPLOAD_CHAINS = {("np", "load"), ("numpy", "load")}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    function: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
+
+
+def _attr_chain(node: ast.AST) -> Optional[tuple]:
+    """``jnp.asarray`` -> ("jnp", "asarray"); None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _calls_in(fn: ast.AST):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain is not None:
+                yield node, chain
+
+
+def _suppressed(source_lines: List[str], lineno: int) -> bool:
+    line = source_lines[lineno - 1] if 0 < lineno <= len(source_lines) else ""
+    return SUPPRESS in line
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, "<module>",
+                        f"unparseable: {exc.msg}")]
+    lines = source.splitlines()
+    findings: List[Finding] = []
+
+    funcs = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in funcs:
+        is_restore = "restore" in fn.name.lower()
+        loads_np = any(
+            chain in _NPLOAD_CHAINS for _, chain in _calls_in(fn)
+        )
+        if not (is_restore or loads_np):
+            continue
+        why = (
+            "a restore path" if is_restore
+            else "a function that deserializes numpy archives"
+        )
+        for call, chain in _calls_in(fn):
+            if _suppressed(lines, call.lineno):
+                continue
+            if chain in _ASARRAY_CHAINS:
+                findings.append(Finding(
+                    path, call.lineno, fn.name,
+                    f"jnp.asarray in {why} can zero-copy an aligned host "
+                    "buffer that a later donated window frees — use "
+                    "jnp.array(..., copy=True)",
+                ))
+            elif is_restore and chain in _ARRAY_CHAINS:
+                copy_kw = next(
+                    (kw for kw in call.keywords if kw.arg == "copy"), None
+                )
+                if copy_kw is None or not (
+                    isinstance(copy_kw.value, ast.Constant)
+                    and copy_kw.value.value is True
+                ):
+                    findings.append(Finding(
+                        path, call.lineno, fn.name,
+                        "jnp.array on a restore path must pass an explicit "
+                        "copy=True (donation safety)",
+                    ))
+    return findings
+
+
+def lint_tree(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", ".pytest_cache")
+        ]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, name)))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scalecube_cluster_tpu",
+    )
+    findings = lint_tree(root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} donation-safety finding(s)")
+        return 1
+    print("donation-safety lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
