@@ -159,8 +159,13 @@ def probe_backend(
     return False, attempts
 
 
-def _headline_rounds_dense():
-    """Dense-engine duty-cycle measurement (the r2/r3 headline)."""
+def _headline_rounds_dense(plane_dtype: str = "i32"):
+    """Dense-engine duty-cycle measurement (the r2/r3 headline).
+
+    ``plane_dtype="i16"`` measures the r9 bit-plane-packed engine (narrow
+    keys + word-parallel sweeps — benchmarks/config9_bitplane.py is the
+    packed-vs-unpacked A/B; this records the packed engine's headline
+    number). Default stays "i32" for round-over-round comparability."""
     params = SimParams(
         capacity=N,
         fanout=3,
@@ -172,6 +177,7 @@ def _headline_rounds_dense():
         rumor_slots=8,
         seed_rows=(0,),
         full_metrics=False,  # headline measures throughput; only coverage needed
+        key_dtype=plane_dtype,
     )
     budget = gossip_periods_to_sweep(params.repeat_mult, N)
     state = init_state(params, N, warm=True)
@@ -242,6 +248,13 @@ def main() -> None:
         i = sys.argv.index("--engine")
         if i + 1 < len(sys.argv) and sys.argv[i + 1] == "dense":
             engine = "dense"
+    # r9: --plane-dtype i16 runs the dense side on the bit-plane-packed
+    # engine (config9's record shape; trajectories are decode-identical)
+    plane_dtype = "i32"
+    if "--plane-dtype" in sys.argv:
+        i = sys.argv.index("--plane-dtype")
+        if i + 1 < len(sys.argv):
+            plane_dtype = sys.argv[i + 1]
     budget = gossip_periods_to_sweep(3, N)
 
     # Persistent compile cache (no-op unless SCALECUBE_COMPILE_CACHE_DIR or
@@ -276,14 +289,13 @@ def main() -> None:
             time.sleep(PROBE_BACKOFF_S)
             return fn()
 
+    _dense = lambda: _headline_rounds_dense(plane_dtype)  # noqa: E731
     try:
         if engine == "sparse":
             conv, ticks_per_s = _measure_with_retry(_headline_rounds_sparse, "sparse")
-            conv_d, ticks_per_s_dense = _measure_with_retry(
-                _headline_rounds_dense, "dense"
-            )
+            conv_d, ticks_per_s_dense = _measure_with_retry(_dense, "dense")
         else:
-            conv, ticks_per_s = _measure_with_retry(_headline_rounds_dense, "dense")
+            conv, ticks_per_s = _measure_with_retry(_dense, "dense")
             conv_d, ticks_per_s_dense = conv, ticks_per_s
     except Exception:  # noqa: BLE001 — leave a parseable artifact either way
         emit_failure("measure", 1, attempts, traceback.format_exc())
@@ -313,6 +325,7 @@ def main() -> None:
         "unit": "x",
         "vs_baseline": round(speedup, 2),
         "dense_speedup_vs_realtime": round(ticks_per_s_dense * TICK_SECONDS, 2),
+        "dense_plane_dtype": plane_dtype,
     }
     if cache_dir:
         result["compile_cache"] = compile_cache.compile_cache_report()

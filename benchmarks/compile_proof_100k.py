@@ -174,13 +174,14 @@ def prove_dense(mesh) -> dict:
         dense_links=False,
     )
     R = params.rumor_slots
+    WR = (R + 31) // 32  # r9: the dense infection bitmaps are word-packed
     shapes = dict(
         tick=(), up=(N,), epoch=(N,), view_key=(N, N), changed_at=(N, N),
         force_sync=(N,), leaving=(N,), ns_id=(N,), ns_rel=(1, 1),
         rumor_active=(R,), rumor_origin=(R,),
-        rumor_created=(R,), infected=(N, R), infected_at=(N, R),
+        rumor_created=(R,), infected=(N, WR), infected_at=(N, R),
         infected_from=(N, R), loss=(), fetch_rt=(), delay_q=(),
-        pending_key=(0, N, N), pending_inf=(0, N, R), pending_src=(0, N, R),
+        pending_key=(0, N, N), pending_inf=(0, N, WR), pending_src=(0, N, R),
     )
     dtypes = {
         f.name: getattr(tiny, f.name).dtype for f in dataclasses.fields(SimState)

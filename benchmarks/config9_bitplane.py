@@ -1,0 +1,297 @@
+"""Driver config #9: bit-plane compaction — packed vs unpacked dense engine.
+
+Two sections, one JSON artifact (``BITPLANE_BENCH_r09.json``):
+
+1. **Throughput** (the r9 acceptance gate): packed (``plane_dtype="i16"`` —
+   narrow keys + word-parallel sweeps) vs unpacked (``"i32"`` — the r8
+   engine) dense ticks/s at N=4096 on the SAME config6/7/8 workload (warm
+   cluster, 24 one-tick windows per span, interleaved median-of-``--reps``
+   spans so host drift hits both alike). Gate: packed >= 1.5x unpacked.
+   Both loops must stay transfer-free per window (readback counter).
+
+2. **Max-N feasibility probe**: the largest dense N (doubling ladder from
+   ``--probe-base``, default 12288 — the 8-chip flagship program's
+   per-device member rows, the capacity family config5/compile-proof use)
+   whose one-window program fits a fixed device budget
+   (default 16 GiB — one v5e chip's HBM, the repo's dense-engine target
+   part), measured from the COMPILER's own numbers
+   (``compiled.memory_analysis()``: arguments + temps + un-aliased
+   outputs), not hand math. Profiles probed:
+
+   * ``unpacked_fidelity`` — the r8 default dense profile (i32 keys,
+     per-link [N, N] loss/rt/delay matrices): the pre-r9 ceiling.
+   * ``packed_lean`` — the r9 large-N dense profile (i16 keys, packed bit
+     planes, scalar uniform links): the new ceiling.
+   * plus both same-profile controls (``unpacked_lean``,
+     ``packed_fidelity``) so the key-narrowing and the link-matrix terms
+     are separable in the artifact.
+
+   Gate: the packed ceiling is >= 2x the unpacked-fidelity ceiling. The
+   headline ratio compares each mode's CANONICAL profile (fidelity is what
+   r6-r8 dense benches ran; lean is the documented packed large-N mode) —
+   the same-profile controls are in the JSON for the narrower reading.
+   ``--verify`` (default on) actually allocates + runs one window at each
+   canonical ceiling as an end-to-end existence proof.
+
+    python benchmarks/config9_bitplane.py [--n 4096] [--windows 24]
+        [--reps 5] [--budget-gib 16] [--probe-base 4096] [--no-verify]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+from functools import partial
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+import jax.numpy as jnp
+
+from common import emit, log
+
+
+def _params(n: int, kd: str, full_metrics: bool = False):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=full_metrics, key_dtype=kd,
+    )
+
+
+class Loop:
+    """config6/7/8's pipelined SimDriver loop; only the key dtype differs
+    between the two variants."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int, kd: str):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.d = SimDriver(_params(n, kd), n, warm=True, seed=0)
+        self.d.step(window_ticks)  # compile + warm
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        assert self.d.dispatch_stats["readbacks"] == base, (
+            "bench loop performed a device->host readback"
+        )
+        return dt
+
+
+# -- max-N probe ------------------------------------------------------------
+
+PROFILES = {
+    # (key_dtype, dense_links)
+    "unpacked_fidelity": ("i32", True),
+    "unpacked_lean": ("i32", False),
+    "packed_fidelity": ("i16", True),
+    "packed_lean": ("i16", False),
+}
+
+
+def _window_bytes(n: int, kd: str, dense_links: bool) -> dict:
+    """Compiler-reported bytes of one donated 1-tick window at capacity n:
+    arguments (the resident state), temps, and un-aliased outputs — the
+    peak working set XLA plans for, with zero host allocation."""
+    from scalecube_cluster_tpu.ops.kernel import run_ticks
+    from scalecube_cluster_tpu.ops.state import init_state
+
+    params = _params(n, kd)
+    # tiny concrete state gives the leaf dtypes; shapes scale analytically
+    tiny = init_state(_params(64, kd), 64, warm=True, dense_links=dense_links)
+
+    def scale(x):
+        shape = tuple(n if d in (64,) else d for d in x.shape)
+        return jax.ShapeDtypeStruct(shape, x.dtype)
+
+    absstate = jax.tree.map(scale, tiny)
+    fn = jax.jit(partial(run_ticks, n_ticks=1, params=params), donate_argnums=0)
+    c = fn.lower(absstate, jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+    ma = c.memory_analysis()
+    peak = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + max(ma.output_size_in_bytes - ma.alias_size_in_bytes, 0)
+    )
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "peak_bytes": int(peak),
+    }
+
+
+def probe_max_n(budget_bytes: int, base_n: int) -> dict:
+    """Doubling sweep per profile: the largest N whose one-window program
+    the compiler plans within the budget."""
+    out = {}
+    for name, (kd, dense_links) in PROFILES.items():
+        n = base_n
+        ceiling, detail = 0, None
+        while True:
+            stats = _window_bytes(n, kd, dense_links)
+            fits = stats["peak_bytes"] <= budget_bytes
+            log(
+                f"probe {name} N={n}: peak "
+                f"{stats['peak_bytes'] / 2**30:.2f} GiB "
+                f"({'fits' if fits else 'over budget'})"
+            )
+            if not fits:
+                break
+            ceiling, detail = n, stats
+            n *= 2
+        out[name] = {
+            "max_n": ceiling,
+            "key_dtype": kd,
+            "dense_links": dense_links,
+            "window_bytes_at_max_n": detail,
+            "first_infeasible_n": n,
+        }
+    return out
+
+
+def verify_ceiling(n: int, kd: str, dense_links: bool) -> dict:
+    """Existence proof: allocate the state and run one donated window at
+    the probed ceiling, for real, on this host."""
+    from scalecube_cluster_tpu.ops.kernel import make_run
+    from scalecube_cluster_tpu.ops.state import init_state
+
+    params = _params(n, kd)
+    t0 = time.perf_counter()
+    st = init_state(params, n, warm=True, dense_links=dense_links)
+    jax.block_until_ready(st)
+    alloc_s = time.perf_counter() - t0
+    run = make_run(params, n_ticks=1)
+    key = jax.random.PRNGKey(0)
+    t0 = time.perf_counter()
+    st, key, ms, _ = run(st, key, watch_rows=None)
+    jax.block_until_ready(st)
+    first_s = time.perf_counter() - t0  # includes compile
+    t0 = time.perf_counter()
+    st, key, ms, _ = run(st, key, watch_rows=None)
+    jax.block_until_ready(st)
+    warm_s = time.perf_counter() - t0
+    del st, ms
+    return {
+        "n": n, "key_dtype": kd, "dense_links": dense_links,
+        "alloc_s": round(alloc_s, 3), "first_window_s": round(first_s, 3),
+        "warm_tick_s": round(warm_s, 3), "ok": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--budget-gib", type=float, default=16.0)
+    ap.add_argument("--probe-base", type=int, default=12288)
+    ap.add_argument("--no-verify", action="store_true")
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"throughput: N={args.n}, {args.reps} x {args.windows} windows of "
+        f"{args.window_ticks} tick(s), interleaved packed/unpacked")
+    unpacked = Loop(args.n, args.windows, args.window_ticks, "i32")
+    packed = Loop(args.n, args.windows, args.window_ticks, "i16")
+    u_spans, p_spans = [], []
+    for rep in range(args.reps):  # interleaved: drift hits both alike
+        u_spans.append(unpacked.span())
+        p_spans.append(packed.span())
+        log(f"rep {rep}: unpacked {u_spans[-1]:.3f}s, packed {p_spans[-1]:.3f}s")
+    total = args.windows * args.window_ticks
+    u = statistics.median(u_spans)
+    p = statistics.median(p_spans)
+    speedup = round(u / p, 3)
+
+    budget = int(args.budget_gib * 2**30)
+    log(f"max-N probe: budget {args.budget_gib} GiB, doubling from "
+        f"{args.probe_base}")
+    ceilings = probe_max_n(budget, args.probe_base)
+    unpacked_ceiling = ceilings["unpacked_fidelity"]["max_n"]
+    packed_ceiling = ceilings["packed_lean"]["max_n"]
+    if unpacked_ceiling == 0 or packed_ceiling == 0:
+        # the ladder's base step already misses the budget: there is no
+        # ceiling to compare — fail loudly instead of recording a vacuous
+        # 0 >= 2*0 "pass" and running a degenerate capacity-0 verify
+        raise SystemExit(
+            f"max-N probe degenerate: probe base {args.probe_base} does not "
+            f"fit the {args.budget_gib} GiB budget "
+            f"(unpacked_ceiling={unpacked_ceiling}, "
+            f"packed_ceiling={packed_ceiling}) — lower --probe-base or "
+            "raise --budget-gib"
+        )
+
+    verifies = []
+    if not args.no_verify:
+        for name in ("unpacked_fidelity", "packed_lean"):
+            c = ceilings[name]
+            log(f"verifying {name} ceiling N={c['max_n']} end-to-end ...")
+            verifies.append(verify_ceiling(
+                c["max_n"], c["key_dtype"], c["dense_links"]
+            ))
+
+    result = {
+        "config": 9,
+        "variant": "bitplane_compaction",
+        "n": args.n,
+        "engine": "dense",
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "unpacked_ticks_per_s": round(total / u, 1),
+        "packed_ticks_per_s": round(total / p, 1),
+        "packed_speedup": speedup,
+        "meets_1p5x_gate": speedup >= 1.5,
+        "max_n_probe": {
+            "budget_gib": args.budget_gib,
+            "method": "compiled.memory_analysis() peak (args+temps+"
+                      "unaliased outputs) of one donated 1-tick window, "
+                      "doubling ladder from the flagship per-device row "
+                      "count (coarse by design — first_infeasible_n "
+                      "records each profile's next step)",
+            "profiles": ceilings,
+            "unpacked_ceiling_n": unpacked_ceiling,
+            "packed_ceiling_n": packed_ceiling,
+            "ceiling_ratio": (
+                round(packed_ceiling / unpacked_ceiling, 2)
+                if unpacked_ceiling else None
+            ),
+            "meets_2x_gate": packed_ceiling >= 2 * unpacked_ceiling,
+            "note": "headline compares each mode's canonical profile "
+                    "(r8 dense default = per-link fidelity i32; r9 packed "
+                    "large-N = lean links + i16 + packed planes); "
+                    "same-profile controls included above",
+            "verified": verifies,
+        },
+        "spans_s": {
+            "unpacked": [round(s, 4) for s in u_spans],
+            "packed": [round(s, 4) for s in p_spans],
+        },
+    }
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

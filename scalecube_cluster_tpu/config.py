@@ -153,6 +153,13 @@ class SimConfig:
     record_queue: int = 32
     dense_links: bool = True  # dense NxN loss/delay matrices (sim emulator)
     delay_slots: int = 0  # pending-delivery ring depth (max link delay + 1 ticks)
+    # Precedence-key plane dtype (r9 bit-plane compaction): "i32" is the
+    # r0-r8 wide layout (the oracle-lockstep default — incarnations to
+    # 2^21, 256 row-reuse epochs); "i16" halves the dominant [N, N] key
+    # plane and switches the dense kernel to word-parallel packed-mask
+    # sweeps (ops/bitplane.py), under the narrow saturation rule
+    # (incarnation cap 511 + epoch fold 16 — lattice.KeyLayout).
+    plane_dtype: str = "i32"
     seed: int = 0
     # Persistent XLA compilation-cache directory (None = disabled; the
     # SCALECUBE_COMPILE_CACHE_DIR env var is the non-config fallback).
@@ -318,6 +325,8 @@ class ClusterConfig:
             raise ValueError("reconnect_max_retries must be >= 0")
         if self.transport.reconnect_base_delay < 0:
             raise ValueError("reconnect_base_delay must be >= 0")
+        if self.sim.plane_dtype not in ("i32", "i16"):
+            raise ValueError("sim.plane_dtype must be 'i32' or 'i16'")
         if self.chaos.check_interval_ticks <= 0:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):

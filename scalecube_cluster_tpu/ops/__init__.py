@@ -11,8 +11,11 @@ axis.
 
 Modules:
 
-* :mod:`lattice`  — the ``isOverrides`` record-precedence lattice as int32
-  key packing (scatter-max-joinable).
+* :mod:`lattice`  — the ``isOverrides`` record-precedence lattice as a
+  packed monotone key (scatter-max-joinable; int32 wide / int16 narrow
+  layouts, r9).
+* :mod:`bitplane` — the repo's ONE word-packing spelling (bool ⇄ uint32
+  bit planes, popcounts, bit-rank selection — r9).
 * :mod:`rand`     — per-tick random draw layout shared by kernel and oracle.
 * :mod:`state`    — ``SimState`` pytree + ``SimParams`` static config + host
   mutation helpers (join/crash/leave/rumor/link control).

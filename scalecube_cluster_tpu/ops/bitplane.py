@@ -1,0 +1,187 @@
+"""Word-packed bit-plane layout: the ONE packing spelling in the repo (r9).
+
+Every boolean "plane" the engines carry or derive — the dense rumor
+infection bitmaps (``SimState.infected`` / ``pending_inf``), the sparse
+delivery payload words (``ops/sparse.py``), and the dense kernel's derived
+``known`` / live-view masks — shares a single layout: bits pack along the
+LAST axis, little-endian within a 32-bit word::
+
+    packed[..., w] bit b  <=>  bool_plane[..., w * 32 + b]
+
+``bool [..., L]  <->  uint32 [..., ceil(L/32)]``, with the tail word's
+unused high bits ALWAYS ZERO (pack pads with False; mutators must preserve
+the invariant — :func:`tail_mask` is the word mask of valid bits). Keeping
+the dead bits zero is what makes :func:`popcount` reductions correct
+without re-masking at every use.
+
+Why this exists (ISSUE 4 tentpole): the dense tick is memory-bandwidth-
+bound, and a bool plane costs one BYTE per edge on every pass. Packing
+turns mask traffic into 1/8 the bytes and turns mask reductions
+(cluster-size counts, alive-view fractions, selection-sampler candidate
+ranks) into word-parallel popcounts. The sparse engine proved the layout
+first (its delivery payloads travel packed); r9 lifts those helpers here
+and makes the dense engine store + sweep its bit planes the same way.
+
+Design note — derived masks are NOT stored: ``known`` (``view_key >= 0``)
+and the live-view mask (``rank != DEAD``) are recomputed (and packed) from
+``view_key`` inside the tick rather than carried as state. A stored copy
+would be a second source of truth the merge phases could desynchronize;
+packing the derived mask costs one fused pass over the plane that produced
+it, which every consumer was already paying.
+
+All helpers take ``xp`` (``jnp`` or ``np``) so the scalar oracle replays
+the exact packing arithmetic host-side, like :func:`.rand.fetch_uniform`.
+Reductions are integer end-to-end (uint32 words -> int32 counts): no
+float64 promotion can sneak into a packed reduction
+(``tools/lint_plane_dtypes.py`` guards the spelling).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+WORD = 32  # bits per packed word (uint32 lanes — the TPU-native int width)
+
+
+def words_for(length: int) -> int:
+    """Packed words needed for ``length`` bits (ceil division)."""
+    return (int(length) + WORD - 1) // WORD
+
+
+def tail_mask(length: int, xp=jnp):
+    """uint32 [W] mask of VALID bits per word: all-ones except the tail
+    word, whose bits past ``length % 32`` are zero. The packed-plane
+    invariant is ``plane == plane & tail_mask`` everywhere."""
+    w = words_for(length)
+    full = np.full((w,), 0xFFFFFFFF, np.uint32)
+    rem = int(length) % WORD
+    if rem:
+        full[-1] = np.uint32((1 << rem) - 1)
+    return xp.asarray(full)
+
+
+def pack_bits(x, xp=jnp):
+    """bool [..., L] -> uint32 [..., ceil(L/32)] bitmap words.
+
+    Tail bits beyond L are padded False, so the tail-word invariant holds
+    by construction. (Lifted from the sparse engine's ``_pack_bits``, r4;
+    generalized to any leading shape for the [D, N, R] pending rings.)"""
+    *lead, L = x.shape
+    w = words_for(L)
+    pad = w * WORD - L
+    if pad:
+        widths = [(0, 0)] * len(lead) + [(0, pad)]
+        x = xp.pad(x, widths)
+    xr = x.reshape(*lead, w, WORD).astype(xp.uint32)
+    shifts = xp.arange(WORD, dtype=xp.uint32)
+    return (xr << shifts).sum(axis=-1, dtype=xp.uint32)
+
+
+def unpack_bits(p, length: int, xp=jnp):
+    """uint32 [..., W] -> bool [..., length]."""
+    *lead, w = p.shape
+    bits = (p[..., None] >> xp.arange(WORD, dtype=xp.uint32)) & xp.uint32(1)
+    return bits.astype(bool).reshape(*lead, w * WORD)[..., :length]
+
+
+# -- word-parallel boolean algebra (trivial, but naming the ops keeps call
+# -- sites readable and gives the lint one spelling to bless) --------------
+
+
+def word_and(a, b):
+    return a & b
+
+
+def word_or(a, b):
+    return a | b
+
+
+def word_andnot(a, b):
+    """a & ~b — the masked-clear sweep (e.g. "known minus self")."""
+    return a & ~b
+
+
+def popcount(w, xp=jnp):
+    """Per-word set-bit counts, uint32 -> int32 (SWAR, no float anywhere).
+
+    The classic 5-op parallel bit count; integer end-to-end so packed
+    reductions can never promote to float64 under x64 mode."""
+    u32 = xp.uint32
+    w = w.astype(u32)
+    w = w - ((w >> u32(1)) & u32(0x55555555))
+    w = (w & u32(0x33333333)) + ((w >> u32(2)) & u32(0x33333333))
+    w = (w + (w >> u32(4))) & u32(0x0F0F0F0F)
+    return ((w * u32(0x01010101)) >> u32(24)).astype(xp.int32)
+
+
+def popcount_rows(p, xp=jnp):
+    """uint32 [..., W] -> int32 [...]: set bits along the packed axis (the
+    word-parallel replacement of ``bool_plane.sum(axis=-1)``)."""
+    return popcount(p, xp=xp).sum(axis=-1, dtype=xp.int32)
+
+
+def popcount_total(p, xp=jnp):
+    """Whole-plane set-bit count as an int32 scalar."""
+    return popcount(p, xp=xp).sum(dtype=xp.int32)
+
+
+def row_gather(p, idx):
+    """Gather packed rows ``p[idx]`` — one gather of W words per row
+    instead of L bools (how the sparse payload pull has always worked;
+    named here so dense call sites use the same spelling)."""
+    return p[idx]
+
+
+def diag_words(n: int, xp=jnp):
+    """uint32 [N, W]: row i holds the single bit for column i — the packed
+    identity matrix, for clearing/checking self-bits in [N, N] masks."""
+    rows = xp.arange(n, dtype=xp.uint32)
+    w = words_for(n)
+    word_idx = xp.arange(w, dtype=xp.uint32)
+    return xp.where(
+        word_idx[None, :] == (rows // WORD)[:, None],
+        xp.uint32(1) << (rows % WORD)[:, None],
+        xp.uint32(0),
+    )
+
+
+def select_bit(word, r, xp=jnp):
+    """Index of the ``r``-th (1-indexed) set bit of each uint32 ``word``.
+
+    Branch-free 32-step sweep: the running popcount first equals ``r`` AT
+    the r-th set bit and only increments on set bits, so the matching
+    position is unique. Out-of-range ranks (r < 1 or r > popcount) return
+    0 — callers mask those slots (same garbage-but-masked contract as the
+    selection samplers)."""
+    word = word.astype(xp.uint32)
+    r = r.astype(xp.int32)
+    cnt = xp.zeros(word.shape, xp.int32)
+    out = xp.zeros(word.shape, xp.int32)
+    for b in range(WORD):
+        bit = ((word >> xp.uint32(b)) & xp.uint32(1)).astype(xp.int32)
+        cnt = cnt + bit
+        out = xp.where((bit == 1) & (cnt == r), xp.int32(b), out)
+    return out
+
+
+# -- single-bit / single-column mutators (host-side state edits) -----------
+
+
+def set_bit(p, row, col):
+    """Set bit ``col`` of packed row ``row`` (jnp, copy-on-write)."""
+    w, b = int(col) // WORD, int(col) % WORD
+    return p.at[row, w].set(p[row, w] | jnp.uint32(1 << b))
+
+
+def clear_col(p, col):
+    """Clear bit ``col`` across ALL rows of a packed [N, W] plane."""
+    w, b = int(col) // WORD, int(col) % WORD
+    return p.at[:, w].set(p[:, w] & jnp.uint32(~(1 << b) & 0xFFFFFFFF))
+
+
+def col_bits(p, col):
+    """bool [...]: bit ``col`` of every packed row (one word gather, not an
+    unpack of the whole plane)."""
+    w, b = int(col) // WORD, int(col) % WORD
+    return (p[..., w] >> jnp.uint32(b)) & jnp.uint32(1) == 1

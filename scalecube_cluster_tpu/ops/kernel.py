@@ -61,12 +61,15 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from . import bitplane as bp
 from .lattice import (
-    NO_CANDIDATE,
     RANK_ALIVE,
     RANK_DEAD,
     RANK_LEAVING,
     RANK_SUSPECT,
+    bump_inc,
+    key_np_dtype,
+    no_candidate,
 )
 from .rand import (
     SALT_GOSSIP,
@@ -89,6 +92,20 @@ def ceil_log2(n: jnp.ndarray) -> jnp.ndarray:
     return (n[..., None] >= (1 << jnp.arange(31, dtype=jnp.int32))).sum(-1).astype(jnp.int32)
 
 
+def _packed(params: SimParams) -> bool:
+    """Static switch: the r9 packed mode (narrow i16 keys + word-parallel
+    bit-plane sweeps) vs the legacy full-width spellings. Both modes compute
+    the SAME picks, counts, and accepted records — the packed path just
+    moves mask traffic into uint32 words (ops/bitplane.py)."""
+    return params.key_dtype == "i16"
+
+
+def _noc(params: SimParams) -> int:
+    """Scatter-max identity for the configured key dtype (python int —
+    weakly typed at use sites, so i16 planes stay i16)."""
+    return no_candidate(key_np_dtype(params.key_dtype))
+
+
 def _live_view_mask(state: SimState) -> jax.Array:
     """candidates[i, j] — j is in node i's member list (known, not DEAD, not
     self): the FD ping list / gossip member list / SYNC address pool, which
@@ -100,50 +117,33 @@ def _live_view_mask(state: SimState) -> jax.Array:
     return known_live & ~jnp.eye(n, dtype=bool)
 
 
+def _known_live_words(state: SimState) -> jax.Array:
+    """Word-packed ``rank != DEAD`` plane (diag INCLUDED) — the packed
+    mode's one derived membership bit plane per phase, serving cluster-size
+    popcounts and (self-bit cleared) the selection samplers. Derived, never
+    stored: see the design note in :mod:`.bitplane`."""
+    return bp.pack_bits((state.view_key & 3) != RANK_DEAD)
+
+
 def _cluster_size(state: SimState) -> jax.Array:
     """Node i's view of cluster size (incl. itself) — drives the log2 knobs."""
     return ((state.view_key & 3) != RANK_DEAD).sum(axis=1).astype(jnp.int32)
 
 
-def _merge(
-    state: SimState,
-    recv_key: jax.Array,
-    receiver_up: jax.Array,
-) -> tuple[SimState, jax.Array]:
-    """Fold delivered candidate keys into receivers' tables + rumor stream.
-
-    ``recv_key[i, j]`` is the max precedence key delivered to node i about
-    member j this phase (NO_CANDIDATE where nothing arrived). The TABLE
-    accepts on the overrides gate (key strictly greater, and SUSPECT/DEAD
-    rejected for unknown members — ``MembershipRecord.isOverrides``
-    null-record rule). The RUMOR layer updates independently:
-
-    Accepted updates (re-)enter the gossip stream via ``changed_at``
-    (receivers forward a newly learned record for their own spread window —
-    the reference's per-receiver rumor forwarding). Because each cell's key
-    is strictly monotone (DEAD is a kept tombstone, never removed — see
-    ``lattice.py`` deviation 2), a given key is accepted at most once per
-    cell, so every rumor's forwarding is bounded (SIR) and the whole system
-    converges monotonically — no death-rumor/refutation cycles.
-
-    Returns (state, accepted mask).
-    """
-    own = state.view_key
-    known = own >= 0
-    alive_or_leaving = (recv_key & 3) <= RANK_LEAVING
-    accept = (
-        (recv_key > own)
-        & (recv_key > NO_CANDIDATE)
-        & (known | alive_or_leaving)
-        & receiver_up[:, None]
-    )
-    return (
-        state.replace(
-            view_key=jnp.where(accept, recv_key, own),
-            changed_at=jnp.where(accept, state.tick, state.changed_at),
-        ),
-        accept,
-    )
+# NOTE on the merge-accept gate (spelled inline at each phase's merge —
+# the gossip scatter-max fold, the SYNC REQ and ACK merges): ``buf`` holds
+# the cellwise max of own key and every delivered candidate, and a cell
+# accepts iff the winner strictly overrides (``buf > own``), the
+# null-record rule holds (SUSPECT/DEAD rejected for unknown members —
+# ``MembershipRecord.isOverrides``), the receiver is up, and the ALIVE
+# metadata-fetch gate passes. Accepted updates (re-)enter the gossip
+# stream via ``changed_at``; because each cell's key is strictly monotone
+# (DEAD is a kept tombstone — ``lattice.py`` deviation 2), a given key is
+# accepted at most once per cell, so every rumor's forwarding is bounded
+# (SIR) and the system converges monotonically. (A standalone ``_merge``
+# helper used to restate this; it had no callers and hardcoded the i32
+# NO_CANDIDATE sentinel, so the r9 key-dtype work removed it rather than
+# leave a dtype trap.)
 
 
 def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -168,6 +168,24 @@ def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Arra
     k = u.shape[1]
     c = mask.sum(axis=1).astype(jnp.int32)  # [N] candidate counts
     cs = jnp.cumsum(mask.astype(jnp.int32), axis=1)  # [N, N]
+    # rank -> column: first j with cs[i, j] >= x+1 for all k draws at once —
+    # one batched binary search over the sorted cumsum rows (O(N·k·log N))
+    # instead of k full [N, N] argmax sweeps. Invalid slots (x+1 > c) return
+    # n (clipped below): garbage the caller masks via `valid`.
+    targets = _insertion_ranks(c, u) + 1  # [N, k]
+    idx = jax.vmap(lambda row, t: jnp.searchsorted(row, t, side="left"))(cs, targets)
+    idx = jnp.minimum(idx, mask.shape[1] - 1).astype(jnp.int32)
+    valid = jnp.arange(k, dtype=jnp.int32)[None, :] < c[:, None]
+    return idx, valid
+
+
+def _insertion_ranks(c: jax.Array, u: jax.Array) -> jax.Array:
+    """The shared rank-insertion draw of both samplers: the s-th pick draws
+    a rank in ``[0, c - s)`` and is shifted up past the already-taken ranks
+    in ascending order. Depends only on the candidate COUNTS, so the packed
+    and full-width samplers consume identical uniforms into identical
+    ranks — the lockstep invariant between the modes."""
+    k = u.shape[1]
     ranks: list[jax.Array] = []
     for s in range(k):
         avail = jnp.maximum(c - s, 1)
@@ -178,13 +196,39 @@ def _sample_distinct(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Arra
             for t in range(len(ranks)):
                 x = x + (x >= prev[t]).astype(jnp.int32)
         ranks.append(x)
-    # rank -> column: first j with cs[i, j] >= x+1 for all k draws at once —
-    # one batched binary search over the sorted cumsum rows (O(N·k·log N))
-    # instead of k full [N, N] argmax sweeps. Invalid slots (x+1 > c) return
-    # n (clipped below): garbage the caller masks via `valid`.
-    targets = jnp.stack(ranks, 1) + 1  # [N, k]
-    idx = jax.vmap(lambda row, t: jnp.searchsorted(row, t, side="left"))(cs, targets)
-    idx = jnp.minimum(idx, mask.shape[1] - 1).astype(jnp.int32)
+    return jnp.stack(ranks, 1)  # [N, k]
+
+
+def _sample_distinct_words(
+    mask_w: jax.Array, n: int, u: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Word-parallel :func:`_sample_distinct`: same picks, packed mask.
+
+    The full-width sampler's dominant cost at large N is the [N, N] int32
+    cumsum it materializes just to map ranks to columns (~64 MB written +
+    re-read per selection at N=4096 — the single biggest term of the r8 FD
+    tick). Here the cumulative counts live at WORD granularity
+    ([N, ceil(N/32)] via popcount), the binary search runs over words, and
+    the final bit offset comes from a 32-step in-word bit-rank sweep
+    (:func:`.bitplane.select_bit`) — the rank→column answer is the same
+    "first column with cumulative count >= target" in both spellings, so
+    picks are bit-identical given the same mask and uniforms.
+
+    Returns (idx [N, k], valid [N, k]) under the same garbage-but-masked
+    contract as the full-width sampler."""
+    k = u.shape[1]
+    pc = bp.popcount(mask_w)  # [N, W] per-word counts
+    cs = jnp.cumsum(pc, axis=1)  # [N, W] — words, not columns
+    c = cs[:, -1]  # [N] candidate counts
+    targets = _insertion_ranks(c, u) + 1  # [N, k]
+    wi = jax.vmap(lambda row, t: jnp.searchsorted(row, t, side="left"))(cs, targets)
+    wi = jnp.minimum(wi, mask_w.shape[1] - 1).astype(jnp.int32)
+    prior = jnp.where(
+        wi > 0, jnp.take_along_axis(cs, jnp.maximum(wi - 1, 0), axis=1), 0
+    )
+    word = jnp.take_along_axis(mask_w, wi, axis=1)  # [N, k]
+    bit = bp.select_bit(word, targets - prior)
+    idx = jnp.minimum(wi * bp.WORD + bit, n - 1).astype(jnp.int32)
     valid = jnp.arange(k, dtype=jnp.int32)[None, :] < c[:, None]
     return idx, valid
 
@@ -284,8 +328,12 @@ def _fd_phase(
     n = state.capacity
     rows = jnp.arange(n)
 
-    cand = _live_view_mask(state)
-    sel_idx, sel_valid = _sample_distinct(cand, r.fd_sel)
+    if _packed(params):
+        # live view as packed words, self-bit cleared word-parallel
+        selw = bp.word_andnot(_known_live_words(state), bp.diag_words(n))
+        sel_idx, sel_valid = _sample_distinct_words(selw, n, r.fd_sel)
+    else:
+        sel_idx, sel_valid = _sample_distinct(_live_view_mask(state), r.fd_sel)
     tgt = sel_idx[:, 0]
     has_tgt = sel_valid[:, 0] & state.up
 
@@ -363,14 +411,24 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
     incarnation (rank 2 -> 3 is key+1). ``changed_at`` is the suspicion
     start: every accepted change that leaves a cell SUSPECT also (re)stamps
     it, so a separate suspect_since plane would always equal it."""
-    suspect = (state.view_key & 3) == RANK_SUSPECT
+    recompute = _packed(params)
+    # Packed mode recomputes the suspect mask INSIDE the rare sweep branch:
+    # a mask captured by the lax.cond closure is a cond operand, so the
+    # legacy spelling materializes an [N, N] bool plane every tick just to
+    # take its any() — on the quiet steady state that write+read was the
+    # single biggest term of the packed tick. The gate reduce fuses into
+    # one pass over view_key; the sweep branch (rare) pays the recompute.
+    suspect = None if recompute else (state.view_key & 3) == RANK_SUSPECT
 
     def _sweep(state: SimState) -> SimState:
+        sus = (
+            (state.view_key & 3) == RANK_SUSPECT if recompute else suspect
+        )
         timeout = (
             params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
         )
         expired = (
-            suspect
+            sus
             & (state.tick - state.changed_at >= timeout[:, None])
             & state.up[:, None]
         )
@@ -381,20 +439,40 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
 
     # No SUSPECT cell anywhere (the healthy steady state) -> nothing can
     # expire; skip the timer compare + both plane writes.
-    return jax.lax.cond(suspect.any(), _sweep, lambda st: st, state)
+    has_suspect = (
+        ((state.view_key & 3) == RANK_SUSPECT).any() if recompute else suspect.any()
+    )
+    return jax.lax.cond(has_suspect, _sweep, lambda st: st, state)
 
 
 def _gossip_phase(
     state: SimState, r: RoundRandoms, params: SimParams
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
+    R = params.rumor_slots
+    NOC = _noc(params)
     rows = jnp.arange(n)
-    spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
+    if _packed(params):
+        # one packed live plane serves the spread window (popcount cluster
+        # sizes) AND, self-bit cleared, the fanout peer sampler below
+        klw = _known_live_words(state)
+        spread = params.repeat_mult * ceil_log2(bp.popcount_rows(klw))  # [N]
+    else:
+        klw = None
+        spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
 
-    known = state.view_key >= 0
-    young = known & (state.tick - state.changed_at < spread[:, None])
+    def _young_of(st: SimState) -> jax.Array:
+        return (st.view_key >= 0) & (st.tick - st.changed_at < spread[:, None])
+
+    # Packed mode defers the [N, N] young plane to the active branch: a
+    # plane captured by the _deliver closure is a lax.cond operand and gets
+    # MATERIALIZED every tick — quiet ticks only need its any(-1) reduce,
+    # which fuses into one pass over view_key/changed_at. (The [N, R]
+    # rumor plane is tiny and stays shared.)
+    young = None if _packed(params) else _young_of(state)
+    inf_b = bp.unpack_bits(state.infected, R)  # stored packed (r9)
     rumor_young = (
-        state.infected
+        inf_b
         & state.rumor_active[None, :]
         & (state.tick - state.infected_at < spread[:, None])
     )  # [N, R]
@@ -407,19 +485,26 @@ def _gossip_phase(
     # drops out exactly when the real system would go quiet on the wire.
     # Under the delay model, messages already in flight (the current tick's
     # pending-ring slot) are work too, even if every sender is quiet.
-    sender_has = young.any(axis=1) | rumor_young.any(axis=1)  # [N]
+    young_any_pre = _young_of(state).any(axis=1) if young is None else young.any(axis=1)
+    sender_has = young_any_pre | rumor_young.any(axis=1)  # [N]
     D = params.delay_slots
     gossip_work = sender_has.any()
     if D:
         slot_now = state.tick % D
         arriving_key = state.pending_key[slot_now]  # [N, N]
-        arriving_inf = state.pending_inf[slot_now]  # [N, R]
+        arriving_inf = bp.unpack_bits(state.pending_inf[slot_now], R)  # [N, R]
         arriving_src = state.pending_src[slot_now]  # [N, R]
-        gossip_work = gossip_work | (arriving_key > NO_CANDIDATE).any() | arriving_inf.any()
+        gossip_work = gossip_work | (arriving_key > NOC).any() | arriving_inf.any()
 
     def _deliver(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
-        peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
-        piggyback = jnp.where(young, state.view_key, NO_CANDIDATE)  # [N, N]
+        if _packed(params):
+            peers, peer_valid = _sample_distinct_words(
+                bp.word_andnot(klw, bp.diag_words(n)), n, r.gossip_sel
+            )
+        else:
+            peers, peer_valid = _sample_distinct(_live_view_mask(state), r.gossip_sel)
+        yg = _young_of(state) if young is None else young
+        piggyback = jnp.where(yg, state.view_key, NOC)  # [N, N]
         # Scatter-max deliveries directly onto a working copy of the table
         # (buf = max(own, best delivered candidate) cellwise), then apply
         # the overrides gate on the winner: buf > own ⟺ the best candidate
@@ -431,13 +516,16 @@ def _gossip_phase(
             recv_inf = arriving_inf
             recv_src = arriving_src
             pend_key = state.pending_key
-            pend_inf = state.pending_inf
+            # the infection ring is STORED packed; the in-phase scatters
+            # need per-receiver bool rows, so the (small-D fidelity) ring
+            # round-trips through bools and repacks at the end
+            pend_inf_b = bp.unpack_bits(state.pending_inf, R)
             pend_src = state.pending_src
         else:
             buf = state.view_key
-            recv_inf = jnp.zeros_like(state.infected)
+            recv_inf = jnp.zeros((n, R), bool)
             recv_src = jnp.full_like(state.infected_from, -1)
-        young_any = young.any(axis=1)  # [N] — membership payload exists
+        young_any = yg.any(axis=1)  # [N] — membership payload exists
         sent = jnp.int32(0)
         rumor_sent = jnp.int32(0)
         for s in range(params.fanout):
@@ -479,16 +567,16 @@ def _gossip_phase(
                 ok_late = ok & (d > 0)
                 slot_d = (state.tick + d) % D  # d ∈ [1, D-1] ⇒ never slot_now
                 pend_key = pend_key.at[slot_d, p].max(
-                    jnp.where(ok_late[:, None], piggyback, NO_CANDIDATE)
+                    jnp.where(ok_late[:, None], piggyback, NOC)
                 )
                 late_r = send_r & ok_late[:, None]
-                pend_inf = pend_inf.at[slot_d, p].max(late_r)
+                pend_inf_b = pend_inf_b.at[slot_d, p].max(late_r)
                 pend_src = pend_src.at[slot_d, p].max(
                     jnp.where(late_r, rows[:, None], -1)
                 )
             else:
                 ok_now = ok
-            buf = buf.at[p].max(jnp.where(ok_now[:, None], piggyback, NO_CANDIDATE))
+            buf = buf.at[p].max(jnp.where(ok_now[:, None], piggyback, NOC))
             now_r = send_r & ok_now[:, None]
             recv_inf = recv_inf.at[p].max(now_r)
             recv_src = recv_src.at[p].max(jnp.where(now_r, rows[:, None], -1))
@@ -512,9 +600,11 @@ def _gossip_phase(
             changed_at=jnp.where(accept, state.tick, state.changed_at),
         )
 
-        newly_inf = recv_inf & ~st.infected & st.up[:, None] & st.rumor_active[None, :]
+        newly_inf = recv_inf & ~inf_b & st.up[:, None] & st.rumor_active[None, :]
         st = st.replace(
-            infected=st.infected | newly_inf,
+            # the infection merge is the literal word-parallel OR of the
+            # packed bitmaps (SequenceIdCollector dedup = bitmap OR)
+            infected=bp.word_or(st.infected, bp.pack_bits(newly_inf)),
             infected_at=jnp.where(newly_inf, st.tick, st.infected_at),
             # remember one delivering peer (max row id among this tick's
             # senders — deterministic, oracle-mirrorable) as the compact
@@ -524,8 +614,8 @@ def _gossip_phase(
         if D:
             # current slot is consumed; d ≥ 1 scatters never target it
             st = st.replace(
-                pending_key=pend_key.at[slot_now].set(NO_CANDIDATE),
-                pending_inf=pend_inf.at[slot_now].set(False),
+                pending_key=pend_key.at[slot_now].set(NOC),
+                pending_inf=bp.pack_bits(pend_inf_b.at[slot_now].set(False)),
                 pending_src=pend_src.at[slot_now].set(-1),
             )
         return st, {
@@ -570,13 +660,19 @@ def _sync_phase(
     # SYNC peers come from the live view PLUS the configured seeds
     # (selectSyncAddress: seedMembers ∪ members) — seeds re-bridge healed
     # partitions after mutual removal.
-    caller_tables = state.view_key[caller]  # [K, N]
+    NOC = _noc(params)
+    caller_tables = state.view_key[caller]  # [K, N] — packed-word row gather
     cand = (caller_tables & 3) != RANK_DEAD
     if params.seed_rows:
         seed_mask = jnp.zeros((n,), bool).at[jnp.asarray(params.seed_rows)].set(True)
         cand = cand | seed_mask[None, :]
     cand = cand & (rows[None, :] != caller[:, None])
-    peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[caller][:, None])
+    if _packed(params):
+        peer_idx, peer_valid = _sample_distinct_words(
+            bp.pack_bits(cand), n, r.sync_sel[caller][:, None]
+        )
+    else:
+        peer_idx, peer_valid = _sample_distinct(cand, r.sync_sel[caller][:, None])
     peer = peer_idx[:, 0]  # [K]
     # Round trip: SYNC out and SYNC_ACK back must both survive (and beat
     # syncTimeout under the delay model — MembershipConfig.java:15).
@@ -598,10 +694,10 @@ def _sync_phase(
     # delivered candidate overrides), then written back row-locally: only
     # the ≤K peer rows are touched, and duplicate peer slots recompute the
     # identical row so the scatter-max write is conflict-free.
+    own_p = state.view_key[peer]  # [K, N] — gathered BEFORE any scatter
     buf = state.view_key.at[peer].max(
-        jnp.where(ok[:, None], caller_tables, NO_CANDIDATE)
+        jnp.where(ok[:, None], caller_tables, NOC)
     )
-    own_p = state.view_key[peer]  # [K, N]
     buf_p = buf[peer]  # [K, N]
     acc = (
         (buf_p > own_p)
@@ -618,8 +714,20 @@ def _sync_phase(
     )
     if params.namespace_gate:
         acc = acc & state.ns_rel[state.ns_id[peer][:, None], state.ns_id[None, :]]
+    if _packed(params):
+        # Fold the write-back into the scatter-maxed buffer itself instead
+        # of re-scattering into the ORIGINAL plane: ``state.view_key`` has
+        # no later consumer then, so the vk -> buf -> merged chain aliases
+        # in place (one full-plane copy per SYNC instead of two). Exactly
+        # the legacy cells: duplicate peer slots compute identical rows
+        # (every term is a function of the peer row alone), and
+        # ``where(acc, buf_p, own_p) >= own_p = vk[peer]`` cellwise, so
+        # .set here equals the legacy .max.
+        merged_vk = buf.at[peer].set(jnp.where(acc, buf_p, own_p))
+    else:
+        merged_vk = state.view_key.at[peer].max(jnp.where(acc, buf_p, own_p))
     st = state.replace(
-        view_key=state.view_key.at[peer].max(jnp.where(acc, buf_p, own_p)),
+        view_key=merged_vk,
         changed_at=state.changed_at.at[peer].max(
             jnp.where(acc, state.tick, jnp.int32(-(1 << 30)))
         ),
@@ -629,7 +737,7 @@ def _sync_phase(
     # Row-local: accepted keys only grow, so scatter-max writes the merged
     # caller rows without touching the rest of the matrix (invalid/duplicate
     # slots contribute values that lose the max, a no-op).
-    ack_cand = jnp.where(ok[:, None], st.view_key[peer], NO_CANDIDATE)  # [K, N]
+    ack_cand = jnp.where(ok[:, None], st.view_key[peer], NOC)  # [K, N]
     own_rows = st.view_key[caller]
     accept = (
         (ack_cand > own_rows)
@@ -684,7 +792,10 @@ def _refute_phase(state: SimState) -> SimState:
         | (state.leaving & (rank != RANK_LEAVING))
     )
     announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
-    new_diag = (((diag >> 2) + 1) << 2) | announce_rank
+    # incarnation+1 through the layout-aware SATURATING bump: a narrow
+    # (i16) key must never carry into its epoch bits (lattice.bump_inc;
+    # identical to the historical +1 below the cap)
+    new_diag = bump_inc(diag, announce_rank)
 
     def _apply(st: SimState) -> SimState:
         return st.replace(
@@ -711,15 +822,21 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
     n_up = state.up.sum().astype(jnp.int32)
     sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
     keep = state.tick - state.rumor_created <= sweep
-    spread = params.repeat_mult * ceil_log2(_cluster_size(state))  # [N]
+    if _packed(params):
+        sizes = bp.popcount_rows(_known_live_words(state))
+    else:
+        sizes = _cluster_size(state)
+    spread = params.repeat_mult * ceil_log2(sizes)  # [N]
     forwarding = (
-        state.infected
+        bp.unpack_bits(state.infected, params.rumor_slots)
         & state.up[:, None]
         & (state.tick - state.infected_at < spread[:, None])
     ).any(axis=0)
     keep = keep | forwarding
     if params.delay_slots:
-        keep = keep | state.pending_inf.any(axis=(0, 1))
+        keep = keep | bp.unpack_bits(
+            state.pending_inf, params.rumor_slots
+        ).any(axis=(0, 1))
     return state.replace(rumor_active=state.rumor_active & keep)
 
 
@@ -760,17 +877,30 @@ def tick(
 
     if params.full_metrics:
         up2 = state.up[:, None] & state.up[None, :]
-        pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
         off_diag = ~jnp.eye(state.capacity, dtype=bool)
         rank = state.view_key & 3  # -1 (unknown) reads rank 3, never ALIVE/SUSPECT
-        alive_pairs = (up2 & off_diag & (rank == RANK_ALIVE)).sum()
-        false_suspects = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
+        if _packed(params):
+            # word-parallel health reductions: pack the pair masks once,
+            # count set bits with integer popcounts (no [N, N] i32 reduce,
+            # no float promotion — same integers as the bool sums)
+            n_up = state.up.sum()
+            pairs = jnp.maximum(n_up * n_up - n_up, 1)
+            base = up2 & off_diag
+            alive_pairs = bp.popcount_total(bp.pack_bits(base & (rank == RANK_ALIVE)))
+            false_suspects = bp.popcount_total(
+                bp.pack_bits(base & (rank == RANK_SUSPECT))
+            )
+        else:
+            pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)  # ordered up-pairs, excl self
+            alive_pairs = (up2 & off_diag & (rank == RANK_ALIVE)).sum()
+            false_suspects = (up2 & off_diag & (rank == RANK_SUSPECT)).sum()
         alive_frac = alive_pairs.astype(jnp.float32) / pairs
     else:  # static lite mode: skip the [N, N] health passes
         alive_frac = jnp.float32(0.0)
         false_suspects = jnp.int32(0)
+    inf_b = bp.unpack_bits(state.infected, params.rumor_slots)
     coverage = (
-        (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
+        (inf_b & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)
     )
     # Gossip segmentation (the reference warns when a receiver's
@@ -780,12 +910,12 @@ def tick(
     # infection — holes in its receive stream. Reported as the worst node's
     # count; the driver warns past the configured threshold.
     newest = jnp.where(
-        state.infected, state.rumor_created[None, :], NEVER_I32
+        inf_b, state.rumor_created[None, :], NEVER_I32
     ).max(axis=1)
     seg = (
         (
             state.rumor_active[None, :]
-            & ~state.infected
+            & ~inf_b
             & (state.rumor_created[None, :] < newest[:, None])
             & state.up[:, None]
         )

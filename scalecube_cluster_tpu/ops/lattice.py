@@ -58,7 +58,7 @@ tombstones:
 The "no record yet" case (reference: only ALIVE/LEAVING accepted against an
 absent record) is NOT part of the key: unknown entries get key ``-1`` and a
 separate accept gate blocks SUSPECT/DEAD candidates for unknown members
-(see ``kernel._merge``).
+(see the merge-accept gates in ``kernel``'s gossip/SYNC phases).
 
 Incarnations must stay below ``2**21`` to fit the packing; they only grow by
 refutations/metadata bumps, so this is never a practical limit.
@@ -80,7 +80,9 @@ UNKNOWN = 4  # kernel-internal: "I have no record for this member"
 # initialize an XLA backend at import time, which breaks multi-process
 # workers that must call jax.distributed.initialize first — see ops.dcn).
 UNKNOWN_KEY = -1
-NO_CANDIDATE = jnp.iinfo(jnp.int32).min  # scatter-max identity (python int)
+# Wide-layout scatter-max identity (python int). Dtype-generic call
+# sites use :func:`no_candidate` instead (i16 keys use int16 min).
+NO_CANDIDATE = jnp.iinfo(jnp.int32).min
 
 # Ranks inside the packed key (key & 3). Note -1 (UNKNOWN_KEY) & 3 == 3, so
 # rank tests against ALIVE/LEAVING/SUSPECT are safe without a key >= 0 guard;
@@ -90,11 +92,90 @@ RANK_LEAVING = 1
 RANK_SUSPECT = 2
 RANK_DEAD = 3
 
-# Bit layout: rank [0:2), incarnation [2:23), epoch [23:31).
+# Bit layout (wide / i32 keys): rank [0:2), incarnation [2:23), epoch [23:31).
 INC_BITS = 21
 EPOCH_SHIFT = 2 + INC_BITS
 INC_MASK = (1 << INC_BITS) - 1
 EPOCH_MASK = 0xFF
+
+
+import dataclasses as _dc
+
+
+@_dc.dataclass(frozen=True)
+class KeyLayout:
+    """Bit layout of one packed precedence-key dtype.
+
+    r9 adds a NARROW (int16) key: ``epoch << 11 | incarnation << 2 | rank``
+    — 2 bytes/cell instead of 4 on the dominant [N, N] plane (the dense
+    tick is bandwidth-bound; see ops/bitplane.py). The narrowing rule,
+    enforced at every key-construction site:
+
+    * **incarnation saturates** at ``inc_mask`` (511 for i16): refutation /
+      metadata bumps use :func:`bump_inc`, which clamps instead of carrying
+      into the epoch bits. Saturation keeps the lattice monotone (a key
+      never regresses) at the cost of refutations past the cap no longer
+      out-ranking the matching SUSPECT — 511 suspicion episodes of one
+      member inside one identity epoch, far outside any bench or chaos
+      scenario, and a documented reason to run ``plane_dtype="i32"``.
+    * **epoch folds** to ``epoch_mask`` (mod 16 for i16, mod 256 for i32):
+      row-reuse generations wrap sooner, so the driver's prefer-forgotten-
+      rows policy carries more of the aliasing burden (same rule as the
+      i32 wrap at 256, just a shorter cycle — see ``state.join_row``).
+
+    While every incarnation stays below the cap and every row is reused
+    fewer than ``epoch_mask + 1`` times, the narrow key's DECODED
+    (status, incarnation, epoch) trajectory is bit-identical to the wide
+    key's — the packed-vs-unpacked lockstep contract r9's tests pin.
+    """
+
+    inc_bits: int
+    epoch_bits: int
+
+    @property
+    def epoch_shift(self) -> int:
+        return 2 + self.inc_bits
+
+    @property
+    def inc_mask(self) -> int:
+        return (1 << self.inc_bits) - 1
+
+    @property
+    def epoch_mask(self) -> int:
+        return (1 << self.epoch_bits) - 1
+
+
+#: wide layout (int32): the r0-r8 layout, the oracle-lockstep default.
+LAYOUT_I32 = KeyLayout(inc_bits=INC_BITS, epoch_bits=8)
+#: narrow layout (int16): rank [0:2), incarnation [2:11), epoch [11:15).
+LAYOUT_I16 = KeyLayout(inc_bits=9, epoch_bits=4)
+
+#: SimParams.key_dtype / SimConfig.plane_dtype spellings -> (np dtype, layout)
+KEY_DTYPES = {"i32": _np.int32, "i16": _np.int16}
+_LAYOUTS = {"i32": LAYOUT_I32, "i16": LAYOUT_I16}
+
+
+def layout_for(dtype) -> KeyLayout:
+    """KeyLayout for a key array/dtype (i16 -> narrow, anything else wide)."""
+    if _np.dtype(dtype) == _np.int16:
+        return LAYOUT_I16
+    return LAYOUT_I32
+
+
+def layout_of(name: str) -> KeyLayout:
+    """KeyLayout for a config spelling ("i32" / "i16")."""
+    return _LAYOUTS[name]
+
+
+def key_np_dtype(name: str):
+    if name not in KEY_DTYPES:
+        raise ValueError(f"key dtype must be one of {sorted(KEY_DTYPES)}, got {name!r}")
+    return KEY_DTYPES[name]
+
+
+def no_candidate(dtype) -> int:
+    """Scatter-max identity for a key dtype (its most negative value)."""
+    return int(_np.iinfo(_np.dtype(dtype)).min)
 
 # rank lookup by status code: ALIVE->0, SUSPECT->2, LEAVING->1, DEAD->3
 # (numpy at module scope — converted to device constants inside the jitted
@@ -105,26 +186,46 @@ _RANK_TO_STATUS = _np.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=_np.int8)
 
 
 def precedence_key(
-    status: jnp.ndarray, incarnation: jnp.ndarray, epoch: jnp.ndarray | int = 0
+    status: jnp.ndarray,
+    incarnation: jnp.ndarray,
+    epoch: jnp.ndarray | int = 0,
+    dtype=jnp.int32,
 ) -> jnp.ndarray:
-    """Pack (status, incarnation[, epoch]) into the monotone int32 key.
+    """Pack (status, incarnation[, epoch]) into the monotone key of
+    ``dtype`` (int32 wide / int16 narrow — see :class:`KeyLayout` for the
+    narrow saturation + fold rule, applied here at the one packing site).
 
     UNKNOWN entries map to ``UNKNOWN_KEY`` (-1) so any known record beats
     them (the ALIVE/LEAVING-only gate is applied separately).
     """
+    lay = layout_for(dtype)
     status = status.astype(jnp.int32)
+    inc = jnp.minimum(incarnation.astype(jnp.int32), lay.inc_mask)
     key = (
-        (jnp.int32(epoch) << EPOCH_SHIFT)
-        | (incarnation.astype(jnp.int32) << 2)
+        ((jnp.int32(epoch) & lay.epoch_mask) << lay.epoch_shift)
+        | (inc << 2)
         | jnp.asarray(_RANK)[status]
     )
-    return jnp.where(status == UNKNOWN, UNKNOWN_KEY, key)
+    return jnp.where(status == UNKNOWN, UNKNOWN_KEY, key).astype(dtype)
+
+
+def bump_inc(key: jnp.ndarray, rank) -> jnp.ndarray:
+    """Incarnation+1 at the same epoch with the given rank — the refutation
+    / metadata-update bump, SATURATING at the layout's incarnation cap so a
+    narrow key can never carry into its epoch bits (a carry would
+    impersonate the row's next identity). Identical to the historical
+    ``((key >> 2) + 1) << 2 | rank`` everywhere below the cap."""
+    lay = layout_for(key.dtype)
+    inc = jnp.minimum(((key >> 2) & lay.inc_mask) + 1, lay.inc_mask)
+    epoch_bits = (key >> lay.epoch_shift) << lay.epoch_shift
+    return (epoch_bits | (inc << 2) | rank).astype(key.dtype)
 
 
 def decode_key(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Unpack a winning candidate key back to ``(status, incarnation)``."""
+    lay = layout_for(key.dtype)
     status = jnp.asarray(_RANK_TO_STATUS)[(key & 3).astype(jnp.int32)]
-    return status, ((key >> 2) & INC_MASK).astype(jnp.int32)
+    return status, ((key >> 2) & lay.inc_mask).astype(jnp.int32)
 
 
 def key_status(key: jnp.ndarray) -> jnp.ndarray:
@@ -135,10 +236,15 @@ def key_status(key: jnp.ndarray) -> jnp.ndarray:
 
 
 def key_inc(key: jnp.ndarray) -> jnp.ndarray:
-    """Incarnation of a packed table key; 0 where no record."""
-    return jnp.where(key < 0, 0, (key >> 2) & INC_MASK).astype(jnp.int32)
+    """Incarnation of a packed table key; 0 where no record. Layout follows
+    the key dtype (narrow int16 keys decode with the narrow masks)."""
+    lay = layout_for(key.dtype)
+    return jnp.where(key < 0, 0, (key >> 2) & lay.inc_mask).astype(jnp.int32)
 
 
 def key_epoch(key: jnp.ndarray) -> jnp.ndarray:
     """Identity epoch of a packed table key; 0 where no record."""
-    return jnp.where(key < 0, 0, (key >> EPOCH_SHIFT) & EPOCH_MASK).astype(jnp.int32)
+    lay = layout_for(key.dtype)
+    return jnp.where(
+        key < 0, 0, (key >> lay.epoch_shift) & lay.epoch_mask
+    ).astype(jnp.int32)

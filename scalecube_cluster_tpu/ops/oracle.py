@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import bitplane
 from .lattice import (
     ALIVE,
     DEAD,
@@ -26,6 +27,7 @@ from .lattice import (
     RANK_SUSPECT,
     SUSPECT,
     UNKNOWN,
+    layout_for,
 )
 from .rand import (
     SALT_GOSSIP,
@@ -86,7 +88,12 @@ class _O:
         self.r_active = np.asarray(state.rumor_active).copy()
         self.r_origin = np.asarray(state.rumor_origin).copy()
         self.r_created = np.asarray(state.rumor_created).copy()
-        self.infected = np.asarray(state.infected).copy()
+        # the state stores the infection bitmaps word-packed (r9); the
+        # oracle loops per (node, slot), so mirror them as bools
+        r = state.infected_at.shape[1]
+        self.infected = bitplane.unpack_bits(
+            np.asarray(state.infected), r, xp=np
+        ).copy()
         self.infected_at = np.asarray(state.infected_at).copy()
         self.infected_from = np.asarray(state.infected_from).copy()
         self.ns_id = np.asarray(state.ns_id).copy()
@@ -95,7 +102,9 @@ class _O:
         self.fetch_rt = np.asarray(state.fetch_rt).copy()
         self.delay_q = np.asarray(state.delay_q).copy()
         self.pending_key = np.asarray(state.pending_key).copy()
-        self.pending_inf = np.asarray(state.pending_inf).copy()
+        self.pending_inf = bitplane.unpack_bits(
+            np.asarray(state.pending_inf), r, xp=np
+        ).copy()
         self.pending_src = np.asarray(state.pending_src).copy()
 
     def snap(self):
@@ -255,9 +264,10 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         arr_key = pre.pending_key[slot_now]
         arr_inf = pre.pending_inf[slot_now]
         arr_src = pre.pending_src[slot_now]
+        noc = np.iinfo(arr_key.dtype).min  # key-dtype scatter-max identity
         for i in range(n):
             for j in range(n):
-                if arr_key[i, j] > np.iinfo(np.int32).min:
+                if arr_key[i, j] > noc:
                     recv_key[i, j] = max(recv_key[i, j], int(arr_key[i, j]))
             for ru in range(params.rumor_slots):
                 if arr_inf[i, ru]:
@@ -329,7 +339,7 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 o.infected_from[i, ru] = recv_src[i, ru]
     if D:
         # the consumed ring slot resets (kernel clears it after the merge)
-        o.pending_key[slot_now] = np.iinfo(np.int32).min
+        o.pending_key[slot_now] = np.iinfo(o.pending_key.dtype).min
         o.pending_inf[slot_now] = False
         o.pending_src[slot_now] = -1
 
@@ -400,7 +410,15 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         rank = diag & 3
         if rank in (RANK_SUSPECT, RANK_DEAD) or (o.leaving[i] and rank != RANK_LEAVING):
             new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
-            o.key[i, i] = (((diag >> 2) + 1) << 2) | new_rank
+            # layout-aware SATURATING bump (mirror of lattice.bump_inc):
+            # a narrow key must never carry into its epoch bits
+            lay = layout_for(o.key.dtype)
+            inc = min(((diag >> 2) & lay.inc_mask) + 1, lay.inc_mask)
+            o.key[i, i] = (
+                ((diag >> lay.epoch_shift) << lay.epoch_shift)
+                | (inc << 2)
+                | new_rank
+            )
             o.changed[i, i] = t
 
     # ---- rumor sweep (per-receiver hold semantics, kernel._rumor_sweep) ----
@@ -437,11 +455,21 @@ def assert_equivalent(state: SimState, o: _O) -> None:
         "force_sync": (np.asarray(state.force_sync), o.force_sync),
         "leaving": (np.asarray(state.leaving), o.leaving),
         "rumor_active": (np.asarray(state.rumor_active), o.r_active),
-        "infected": (np.asarray(state.infected), o.infected),
+        "infected": (
+            bitplane.unpack_bits(
+                np.asarray(state.infected), o.infected.shape[1], xp=np
+            ),
+            o.infected,
+        ),
         "infected_at": (np.asarray(state.infected_at), o.infected_at),
         "infected_from": (np.asarray(state.infected_from), o.infected_from),
         "pending_key": (np.asarray(state.pending_key), o.pending_key),
-        "pending_inf": (np.asarray(state.pending_inf), o.pending_inf),
+        "pending_inf": (
+            bitplane.unpack_bits(
+                np.asarray(state.pending_inf), o.infected.shape[1], xp=np
+            ),
+            o.pending_inf,
+        ),
         "pending_src": (np.asarray(state.pending_src), o.pending_src),
     }
     for name, (a, b) in pairs.items():

@@ -80,16 +80,35 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
     )
 
 
+def _check_dense_word_alignment(mesh: Mesh, params: SimParams) -> None:
+    """Dense-tick mesh preconditions. Plain row divisibility always; in the
+    r9 packed mode (``key_dtype="i16"``) additionally require
+    ``capacity % (32 * mesh.size) == 0`` — the SAME word-alignment rule the
+    sparse builders enforce: the packed-mask sweeps (`_known_live_words`,
+    the word samplers, the popcount health reductions) pack [N, N] masks
+    into u32 words along columns, and word-aligned row shards keep every
+    derived word plane shard-local under GSPMD (an unaligned capacity pads
+    the word axis and silently reintroduces per-phase all-gathers)."""
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    if params.key_dtype == "i16" and params.capacity % (32 * mesh.size) != 0:
+        raise ValueError(
+            f"capacity {params.capacity} must be divisible by 32 * mesh size "
+            f"({32 * mesh.size}) in packed (plane_dtype='i16') mode — same "
+            "word-alignment rule as the sparse word builders (pad capacity "
+            "up and leave the extra rows up=False; masks make padding free)"
+        )
+
+
 def make_sharded_tick(mesh: Mesh, params: SimParams, dense_links: bool = True):
     """jit the tick with explicit in/out shardings over ``mesh``.
 
     Capacity must be divisible by the mesh size (pad rows and leave them
     ``up=False`` otherwise — masks make padding free).
     """
-    if params.capacity % mesh.size != 0:
-        raise ValueError(
-            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
-        )
+    _check_dense_word_alignment(mesh, params)
     sh = state_shardings(mesh, dense_links, params.delay_slots)
     rep = NamedSharding(mesh, P())
     return jax.jit(
@@ -231,10 +250,7 @@ def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: b
     watched-row keys come out replicated/gathered as XLA chooses). The
     carried state is donated, like the sparse window builder — without it
     the window holds input AND output copies of every [N, N] plane."""
-    if params.capacity % mesh.size != 0:
-        raise ValueError(
-            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
-        )
+    _check_dense_word_alignment(mesh, params)
     return jax.jit(
         partial(run_ticks, n_ticks=n_ticks, params=params), donate_argnums=0
     )

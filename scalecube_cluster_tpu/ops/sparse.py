@@ -1005,25 +1005,10 @@ def _chunk(total: int, requested: int, threshold: int, auto_block: int, word: in
     return total
 
 
-def _pack_bits(x: jax.Array) -> jax.Array:
-    """bool [R, L] -> u32 [R, ceil(L/32)] bitmap words (delivery payloads
-    travel packed: 32x less gathered/OR'd data than bool planes)."""
-    nrows, L = x.shape
-    W = (L + 31) // 32
-    pad = W * 32 - L
-    if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad)))
-    xr = x.reshape(nrows, W, 32).astype(jnp.uint32)
-    return (xr << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
-        axis=2, dtype=jnp.uint32
-    )
-
-
-def _unpack_bits(p: jax.Array, L: int) -> jax.Array:
-    """u32 [..., W] -> bool [..., L]."""
-    *lead, W = p.shape
-    b = ((p[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1).astype(bool)
-    return b.reshape(*lead, W * 32)[..., :L]
+# Packing helpers moved to ops/bitplane.py (r9): ONE packing spelling in
+# the repo, shared with the dense engine's packed planes. The local names
+# stay so the sparse word builders read as before.
+from .bitplane import pack_bits as _pack_bits, unpack_bits as _unpack_bits
 
 
 # ---------------------------------------------------------------------------

@@ -24,12 +24,16 @@ import numpy as np
 from flax import struct
 
 from ..config import ClusterConfig
+from . import bitplane
 from .lattice import (
     ALIVE,
     RANK_LEAVING,
     UNKNOWN_KEY,
+    bump_inc,
     key_inc,
+    key_np_dtype,
     key_status,
+    no_candidate,
     precedence_key,
 )
 
@@ -102,6 +106,18 @@ class SimParams:
     # this is what re-bridges a fully partitioned cluster after both sides
     # removed each other).
     seed_rows: tuple = ()
+    # Packed-plane mode (r9, ISSUE 4): "i32" keeps the r0-r8 wide key plane
+    # and the legacy full-width mask sweeps; "i16" stores view_key (and the
+    # pending-key rings) as narrow int16 precedence keys — half the bytes on
+    # the tick's dominant plane — and switches the kernel's selection
+    # sampler, cluster-size counts, and health reductions to word-parallel
+    # popcount sweeps over packed bit planes (ops/bitplane.py). Decoded
+    # trajectories are bit-identical between the modes while incarnations
+    # stay under the narrow cap and row reuse under the narrow epoch fold
+    # (lattice.KeyLayout documents the saturation rule; the lockstep tests
+    # in tests/test_bitplane_engine.py pin it). Config spelling:
+    # ClusterConfig.sim.plane_dtype.
+    key_dtype: str = "i32"
 
     @staticmethod
     def from_config(
@@ -125,6 +141,7 @@ class SimParams:
         dt = sim.tick_interval
         return SimParams(
             capacity=cap,
+            key_dtype=sim.plane_dtype,
             fanout=config.gossip.gossip_fanout,
             repeat_mult=config.gossip.gossip_repeat_mult,
             ping_req_k=config.failure_detector.ping_req_members,
@@ -221,7 +238,7 @@ class SimState(struct.PyTreeNode):
     tick: jax.Array  # i32 scalar
     up: jax.Array  # bool [N] — process running (host/churn controlled)
     epoch: jax.Array  # i32 [N] — row identity generation (bumped on reuse)
-    view_key: jax.Array  # i32 [N, N] — packed precedence key, -1 = unknown
+    view_key: jax.Array  # i32/i16 [N, N] — packed precedence key, -1 = unknown
     changed_at: jax.Array  # i32 [N, N]
     force_sync: jax.Array  # bool [N] — immediate SYNC request (join bootstrap)
     leaving: jax.Array  # bool [N] — graceful-leave intent (survives record overwrites)
@@ -230,19 +247,36 @@ class SimState(struct.PyTreeNode):
     rumor_active: jax.Array  # bool [R]
     rumor_origin: jax.Array  # i32 [R]
     rumor_created: jax.Array  # i32 [R]
-    infected: jax.Array  # bool [N, R]
+    infected: jax.Array  # u32 [N, ceil(R/32)] — WORD-PACKED infection bitmap (r9)
     infected_at: jax.Array  # i32 [N, R]
     infected_from: jax.Array  # i32 [N, R] — delivering peer, -1 origin/none
     loss: jax.Array  # f32 [N, N]
     fetch_rt: jax.Array  # f32 [N, N] — derived round-trip probability (see above)
     delay_q: jax.Array  # f32 [N, N] or scalar — geometric delay parameter
-    pending_key: jax.Array  # i32 [D, N, N] — delayed candidate-key ring
-    pending_inf: jax.Array  # bool [D, N, R] — delayed rumor-infection ring
+    pending_key: jax.Array  # i32/i16 [D, N, N] — delayed candidate-key ring
+    pending_inf: jax.Array  # u32 [D, N, ceil(R/32)] — WORD-PACKED delayed-infection ring
     pending_src: jax.Array  # i32 [D, N, R] — delayed rumor source ring
 
     @property
     def capacity(self) -> int:
         return self.up.shape[0]
+
+    @property
+    def rumor_slots(self) -> int:
+        return self.rumor_origin.shape[0]
+
+    @property
+    def infected_bool(self) -> jax.Array:
+        """Unpacked bool [N, R] view of the word-packed infection bitmap —
+        for host-side consumers (tests, snapshots, the oracle); the kernel
+        unpacks locally where it needs elementwise [N, R] work and keeps
+        the stored plane packed (ops/bitplane.py layout)."""
+        return bitplane.unpack_bits(self.infected, self.rumor_slots)
+
+    @property
+    def pending_inf_bool(self) -> jax.Array:
+        """Unpacked bool [D, N, R] view of the pending-infection ring."""
+        return bitplane.unpack_bits(self.pending_inf, self.rumor_slots)
 
     @property
     def view_status(self) -> jax.Array:
@@ -307,6 +341,9 @@ def init_state(
     """
     n = params.capacity
     r = params.rumor_slots
+    kd = key_np_dtype(params.key_dtype)  # validates the spelling too
+    noc = no_candidate(kd)
+    wr = bitplane.words_for(r)
     up = jnp.arange(n) < n_initial
     if namespaces is not None:
         ids_np, rel_np = build_namespace_tables(list(namespaces))
@@ -321,10 +358,10 @@ def init_state(
         known = up[:, None] & up[None, :]
         if related is not None:
             known = known & (related | jnp.eye(n, dtype=bool))
-        view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
+        view_key = jnp.where(known, ALIVE0_KEY, UNKNOWN_KEY).astype(kd)
     else:
         diag = jnp.eye(n, dtype=bool) & up[:, None]
-        view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(jnp.int32)
+        view_key = jnp.where(diag, ALIVE0_KEY, UNKNOWN_KEY).astype(kd)
     loss = (
         jnp.full((n, n), uniform_loss, jnp.float32)
         if dense_links
@@ -346,7 +383,9 @@ def init_state(
         up=up,
         epoch=jnp.zeros((n,), jnp.int32),
         view_key=view_key,
-        changed_at=jnp.full((n, n), NEVER, jnp.int32),
+        # tick stamps are semantically i32 (absolute tick numbers compared
+        # against unbounded windows) — not a packable mask, not a key
+        changed_at=jnp.full((n, n), NEVER, jnp.int32),  # lint: allow-wide-plane
         force_sync=jnp.zeros((n,), bool),
         leaving=jnp.zeros((n,), bool),
         ns_id=ns_id,
@@ -354,14 +393,14 @@ def init_state(
         rumor_active=jnp.zeros((r,), bool),
         rumor_origin=jnp.zeros((r,), jnp.int32),
         rumor_created=jnp.zeros((r,), jnp.int32),
-        infected=jnp.zeros((n, r), bool),
+        infected=jnp.zeros((n, wr), jnp.uint32),
         infected_at=jnp.zeros((n, r), jnp.int32),
         infected_from=jnp.full((n, r), -1, jnp.int32),
         loss=loss,
         fetch_rt=_roundtrip(loss),
         delay_q=delay_q,
-        pending_key=jnp.full((d, n, n), NO_CANDIDATE_I32, jnp.int32),
-        pending_inf=jnp.zeros((d, n, r), bool),
+        pending_key=jnp.full((d, n, n), noc, kd),
+        pending_inf=jnp.zeros((d, n, wr), jnp.uint32),
         pending_src=jnp.full((d, n, r), -1, jnp.int32),
     )
 
@@ -396,10 +435,11 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
     prefer rows no live peer remembers, so near-wrap aliasing never has a
     stale record to collide with.
     """
+    kd = state.view_key.dtype
     seed_rows = jnp.asarray(seed_rows, jnp.int32)
     was_used = state.view_key[row, row] >= 0  # row had a previous occupant
     new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
-    self_key = precedence_key(jnp.int32(ALIVE), jnp.int32(0), new_epoch)
+    self_key = precedence_key(jnp.int32(ALIVE), jnp.int32(0), new_epoch, dtype=kd)
     # Seed placeholders carry the seeds' CURRENT epochs — an epoch-0
     # placeholder for a seed that has itself restarted would read as a
     # phantom old identity (and emit a bogus REMOVED+ADDED pair at any
@@ -408,9 +448,10 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
         jnp.full(seed_rows.shape, ALIVE, jnp.int32),
         jnp.int32(0),
         state.epoch[seed_rows],
+        dtype=kd,
     )
     row_key = (
-        jnp.full((state.capacity,), UNKNOWN_KEY, jnp.int32)
+        jnp.full((state.capacity,), UNKNOWN_KEY, kd)
         .at[seed_rows]
         .set(seed_keys)
         .at[row]
@@ -423,13 +464,13 @@ def join_row(state: SimState, row: int, seed_rows: jax.Array | list[int]) -> Sim
         changed_at=state.changed_at.at[row].set(NEVER).at[row, row].set(state.tick),
         force_sync=state.force_sync.at[row].set(True),
         leaving=state.leaving.at[row].set(False),
-        infected=state.infected.at[row].set(False),
+        infected=state.infected.at[row].set(0),
         infected_from=state.infected_from.at[row].set(-1),
         # messages still in flight TO this row were addressed to the dead
         # previous occupant (the reference loses them with the connection);
         # the fresh identity must not receive them
-        pending_key=state.pending_key.at[:, row].set(NO_CANDIDATE_I32),
-        pending_inf=state.pending_inf.at[:, row].set(False),
+        pending_key=state.pending_key.at[:, row].set(no_candidate(kd)),
+        pending_inf=state.pending_inf.at[:, row].set(0),
         pending_src=state.pending_src.at[:, row].set(-1),
     )
 
@@ -444,13 +485,15 @@ def join_rows(state: SimState, rows, seed_rows) -> SimState:
     measured ~25 s un-jitted vs milliseconds jitted+donated). Jit me with
     ``donate_argnums=0``; ``rows``/``seed_rows`` may be traced arrays of
     static shape."""
+    kd = state.view_key.dtype
     rows = jnp.asarray(rows, jnp.int32)  # [K]
     seed_rows = jnp.asarray(seed_rows, jnp.int32)  # [S]
     k = rows.shape[0]
     was_used = state.view_key[rows, rows] >= 0
     new_epoch = jnp.where(was_used, (state.epoch[rows] + 1) & 0xFF, state.epoch[rows])
     self_keys = precedence_key(
-        jnp.full((k,), ALIVE, jnp.int32), jnp.zeros((k,), jnp.int32), new_epoch
+        jnp.full((k,), ALIVE, jnp.int32), jnp.zeros((k,), jnp.int32), new_epoch,
+        dtype=kd,
     )
     # Seed placeholders use POST-burst epochs: if a seed row is itself being
     # rejoined in this burst, the other joiners must record it at its NEW
@@ -461,9 +504,10 @@ def join_rows(state: SimState, rows, seed_rows) -> SimState:
         jnp.full(seed_rows.shape, ALIVE, jnp.int32),
         jnp.zeros(seed_rows.shape, jnp.int32),
         epoch_after[seed_rows],
+        dtype=kd,
     )
     row_key = (
-        jnp.full((k, state.capacity), UNKNOWN_KEY, jnp.int32)
+        jnp.full((k, state.capacity), UNKNOWN_KEY, kd)
         .at[:, seed_rows]
         .set(seed_keys[None, :])
         .at[jnp.arange(k), rows]
@@ -479,10 +523,10 @@ def join_rows(state: SimState, rows, seed_rows) -> SimState:
         .set(state.tick),
         force_sync=state.force_sync.at[rows].set(True),
         leaving=state.leaving.at[rows].set(False),
-        infected=state.infected.at[rows].set(False),
+        infected=state.infected.at[rows].set(0),
         infected_from=state.infected_from.at[rows].set(-1),
-        pending_key=state.pending_key.at[:, rows].set(NO_CANDIDATE_I32),
-        pending_inf=state.pending_inf.at[:, rows].set(False),
+        pending_key=state.pending_key.at[:, rows].set(no_candidate(kd)),
+        pending_inf=state.pending_inf.at[:, rows].set(0),
         pending_src=state.pending_src.at[:, rows].set(-1),
     )
 
@@ -514,19 +558,27 @@ def update_metadata(state: SimState, row: int) -> SimState:
     accept the higher-incarnation ALIVE → refetch metadata → UPDATED events,
     ``ClusterImpl.java:497-501``). Peers' UPDATED events are host-side diffs
     of ``view_inc`` increases at ALIVE status; blob versions live on host."""
+    own = state.view_key[row, row]
+    # +1 incarnation, same rank — through the layout-aware saturating bump
+    # (identical to the historical ``.add(4)`` below the narrow cap)
     return state.replace(
-        view_key=state.view_key.at[row, row].add(4),  # +1 incarnation, same rank
+        view_key=state.view_key.at[row, row].set(bump_inc(own, own & 3)),
         changed_at=state.changed_at.at[row, row].set(state.tick),
     )
 
 
 def spread_rumor(state: SimState, slot: int, origin: int) -> SimState:
-    """Start a user rumor from ``origin`` in ``slot`` (Cluster.spreadGossip)."""
+    """Start a user rumor from ``origin`` in ``slot`` (Cluster.spreadGossip).
+    The infection bitmap is word-packed: clear the slot's bit column, then
+    set the origin's bit (single-word edits, not an [N, R] rewrite)."""
+    infected = bitplane.set_bit(
+        bitplane.clear_col(state.infected, slot), origin, slot
+    )
     return state.replace(
         rumor_active=state.rumor_active.at[slot].set(True),
         rumor_origin=state.rumor_origin.at[slot].set(origin),
         rumor_created=state.rumor_created.at[slot].set(state.tick),
-        infected=state.infected.at[:, slot].set(False).at[origin, slot].set(True),
+        infected=infected,
         infected_at=state.infected_at.at[origin, slot].set(state.tick),
         infected_from=state.infected_from.at[:, slot].set(-1),
     )
@@ -611,6 +663,16 @@ def snapshot(state: SimState) -> dict[str, np.ndarray]:
 
 
 def restore(arrays: dict[str, np.ndarray]) -> SimState:
+    # Pre-r9 (checkpoint schema <= 2) archives stored the infection planes
+    # as bool [N, R] / [D, N, R]; the r9 state packs them into uint32 words.
+    # Pack on load — dtype-sniffed rather than schema-gated, so the restore
+    # is self-healing for any caller that hands us legacy planes.
+    arrays = dict(arrays)
+    for name in ("infected", "pending_inf"):
+        if name in arrays and arrays[name].dtype != np.uint32:
+            arrays[name] = bitplane.pack_bits(
+                np.asarray(arrays[name], bool), xp=np
+            )
     # copy=True is load-bearing: jnp.asarray ZERO-COPIES a 64-byte-aligned
     # numpy array on CPU, so the restored leaves would alias npz-loaded
     # buffers — which the driver then DONATES into the tick window. The

@@ -16,7 +16,7 @@ from typing import List, Optional
 from ..models.events import MembershipEvent
 from ..models.member import Member, MemberStatus
 from ..utils.streams import EventStream
-from ..ops.lattice import ALIVE, INC_MASK, LEAVING, SUSPECT, UNKNOWN
+from ..ops.lattice import ALIVE, LEAVING, SUSPECT, UNKNOWN
 from .driver import SimDriver, row_address
 
 
@@ -66,7 +66,9 @@ class SimNode:
     def incarnation_of(self, other: "SimNode | int") -> int:
         row = other.row if isinstance(other, SimNode) else other
         key = int(self._d.state.view_key[self.row, row])
-        return (key >> 2) & INC_MASK if key >= 0 else 0
+        # layout follows the driver's key dtype (narrow i16 keys decode
+        # with the narrow incarnation mask — r9)
+        return (key >> 2) & self._d._lay.inc_mask if key >= 0 else 0
 
     # -- gossip -------------------------------------------------------------
     def spread_gossip(self, payload: object) -> int:

@@ -49,12 +49,11 @@ from ..ops import state as _state
 from ..ops.lattice import (
     ALIVE,
     DEAD,
-    EPOCH_MASK,
-    EPOCH_SHIFT,
-    INC_MASK,
     LEAVING,
     SUSPECT,
     UNKNOWN,
+    key_np_dtype,
+    layout_for,
 )
 from ..ops.state import SimParams, SimState
 
@@ -70,8 +69,12 @@ class CheckpointError(RuntimeError):
 
 
 #: Checkpoint schema: 1 = the implicit pre-r7 layout (no version stamp),
-#: 2 = r7 crash-safe layout (tmp+rename, _schema + _crc32 + _engine fields).
-CHECKPOINT_SCHEMA = 2
+#: 2 = r7 crash-safe layout (tmp+rename, _schema + _crc32 + _engine fields),
+#: 3 = r9 bit-plane layout (dense ``infected`` / ``pending_inf`` stored as
+#: word-packed uint32; ``view_key`` carries its dtype — i16 under
+#: ``plane_dtype="i16"``). Restore accepts schema <= 2 archives by packing
+#: the legacy bool planes on load (``ops.state.restore`` sniffs dtypes).
+CHECKPOINT_SCHEMA = 3
 
 
 _RANK_TO_STATUS_NP = np.array([ALIVE, LEAVING, SUSPECT, DEAD], dtype=np.int8)
@@ -201,6 +204,9 @@ class SimDriver:
             )
         else:
             self.state = init
+        # key-plane bit layout (wide i32 / narrow i16 — r9): every host-side
+        # decode (event diffs, view_of) must use the state's actual layout
+        self._lay = layout_for(init.view_key.dtype)
         self._step_cache: Dict[tuple, Callable] = {}
         # per-program dispatch stats for jit_cache_audit(): calls + first
         # dispatch wall time (first dispatch includes the jit compile, or
@@ -620,8 +626,9 @@ class SimDriver:
             old_k, new_k = int(w.prev_key[j]), int(key[j])
             old_s, new_s = _status_of_key(old_k), _status_of_key(new_k)
             evs: List[MembershipEvent] = []
-            old_e = (old_k >> EPOCH_SHIFT) & EPOCH_MASK if old_k >= 0 else -1
-            new_e = (new_k >> EPOCH_SHIFT) & EPOCH_MASK if new_k >= 0 else -1
+            lay = self._lay
+            old_e = (old_k >> lay.epoch_shift) & lay.epoch_mask if old_k >= 0 else -1
+            new_e = (new_k >> lay.epoch_shift) & lay.epoch_mask if new_k >= 0 else -1
             if old_k >= 0 and new_k >= 0 and old_e != new_e:
                 # Identity epoch flip: the row was re-occupied by a FRESH
                 # member (restart = new member id). The old identity is gone
@@ -657,7 +664,7 @@ class SimDriver:
             elif (
                 new_s == ALIVE
                 and old_s in (ALIVE, SUSPECT)
-                and ((new_k >> 2) & INC_MASK) > ((old_k >> 2) & INC_MASK)
+                and ((new_k >> 2) & lay.inc_mask) > ((old_k >> 2) & lay.inc_mask)
             ):
                 # incarnation bump while alive = metadata/refutation update
                 evs.append(
@@ -793,8 +800,15 @@ class SimDriver:
                 if not hasattr(self, "_cov_fn"):
                     def _cov(state):
                         up = state.up
+                        # dense stores the bitmap word-packed (r9); sparse
+                        # still carries bools — branch at trace time
+                        inf = (
+                            state.infected_bool
+                            if hasattr(state, "infected_bool")
+                            else state.infected
+                        )
                         return (
-                            (state.infected & up[:, None]).sum(0).astype(jnp.float32)
+                            (inf & up[:, None]).sum(0).astype(jnp.float32)
                             / jnp.maximum(up.sum(), 1)
                         )
 
@@ -837,7 +851,7 @@ class SimDriver:
         with self._lock:
             key = np.asarray(self.state.view_key[row])
         status = np.where(key < 0, np.int8(UNKNOWN), _RANK_TO_STATUS_NP[key & 3])
-        inc = np.where(key < 0, 0, (key >> 2) & INC_MASK).astype(np.int32)
+        inc = np.where(key < 0, 0, (key >> 2) & self._lay.inc_mask).astype(np.int32)
         return status, inc
 
     def status_of(self, observer: int, subject: int) -> MemberStatus | None:
@@ -1200,6 +1214,17 @@ class SimDriver:
             raise CheckpointError(
                 f"checkpoint {path!r} state planes do not match this engine: {exc}"
             ) from exc
+        if not self.sparse:
+            # a key-dtype mismatch would silently retrace every window
+            # program against foreign-layout keys (i16 decode rules applied
+            # to i32 bits, or vice versa) — refuse up front instead
+            want = np.dtype(key_np_dtype(self.params.key_dtype))
+            if np.dtype(state.view_key.dtype) != want:
+                raise CheckpointError(
+                    f"checkpoint {path!r} stores {state.view_key.dtype} keys "
+                    f"but this driver runs plane_dtype={self.params.key_dtype!r}"
+                    " — restore into a driver configured for the stored layout"
+                )
         if self.mesh is not None:
             from ..ops.sharding import shard_sparse_state, shard_state
 

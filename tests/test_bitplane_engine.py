@@ -1,0 +1,360 @@
+"""r9 bit-plane compaction: packed-engine equivalence + integration.
+
+The contract the tentpole must keep (ISSUE 4 acceptance):
+
+1. The packed dense engine (``plane_dtype="i16"``: narrow keys + word-
+   parallel sweeps) is LOCKSTEP with the scalar oracle tick-for-tick, and
+   its decoded (status, incarnation, epoch) trajectories are bit-identical
+   to the wide (i32) engine's — including N not divisible by 32 (tail
+   words) and the delay rings.
+2. The packed driver keeps the r6 discipline: zero per-window
+   device→host transfers under the numpy-asarray spy.
+3. A chaos scenario (Partition + Crash + heal/restart) runs through the
+   packed planes with every sentinel green and a transfer-free stepping
+   loop.
+4. The narrow-key saturation rule (incarnation cap + epoch fold) holds
+   exactly as documented in ``lattice.KeyLayout``.
+5. Checkpoint back-compat: a pre-r9 (schema-2, bool-plane) archive
+   restores by packing on load and continues the identical trajectory.
+6. The packed mesh path enforces the 32*mesh.size word-alignment rule and
+   agrees with the single-device packed engine.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.ops import bitplane as bp
+from scalecube_cluster_tpu.ops.lattice import (
+    LAYOUT_I16,
+    RANK_ALIVE,
+    bump_inc,
+    key_epoch,
+    key_inc,
+    key_status,
+    precedence_key,
+)
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.sim.driver import CheckpointError
+
+
+def _params(n, kd, **kw):
+    base = dict(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0,),
+        key_dtype=kd,
+    )
+    base.update(kw)
+    return S.SimParams(**base)
+
+
+def _busy_state(params, n):
+    """A state with every code path live: loss, a crash (suspicion +
+    tombstones), an active rumor, a cold joiner."""
+    st = S.init_state(params, n - 1, warm=True, uniform_loss=0.15)
+    st = S.spread_rumor(st, 1, origin=2)
+    st = S.crash_row(st, 3)
+    st = S.join_row(st, n - 1, seed_rows=[0])
+    return st
+
+
+# -- 1. lockstep ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,ticks", [(33, 14), (256, 3)])
+def test_packed_kernel_is_lockstep_with_oracle(n, ticks):
+    """i16 kernel vs the scalar oracle, bit-for-bit, including an N with a
+    partial tail word (33 = 32 + 1)."""
+    params = _params(n, "i16")
+    st = _busy_state(params, n)
+    assert st.view_key.dtype == jnp.int16
+    key = jax.random.PRNGKey(9)
+    step = jax.jit(lambda s, k: K.tick(s, k, params))
+    for _ in range(ticks):
+        key, k = jax.random.split(key)
+        o = O.oracle_tick(st, k, params)
+        st, _ = step(st, k)
+        O.assert_equivalent(st, o)
+
+
+@pytest.mark.parametrize("n", [33, 256])
+def test_packed_vs_wide_decoded_trajectories_identical(n):
+    """run_ticks under i16 vs i32: decoded status/incarnation/epoch planes,
+    stamps, packed rumor bitmaps, and every metric agree exactly."""
+    outs = {}
+    for kd in ("i32", "i16"):
+        params = _params(n, kd)
+        st = _busy_state(params, n)
+        st, _, ms, _ = K.run_ticks(st, jax.random.PRNGKey(4), 30, params)
+        outs[kd] = (st, ms)
+    a, ma = outs["i32"]
+    b, mb = outs["i16"]
+    for dec in (key_status, key_inc, key_epoch):
+        assert (np.asarray(dec(a.view_key)) == np.asarray(dec(b.view_key))).all()
+    assert (np.asarray(a.changed_at) == np.asarray(b.changed_at)).all()
+    assert (np.asarray(a.infected) == np.asarray(b.infected)).all()  # packed words
+    assert (np.asarray(a.rumor_active) == np.asarray(b.rumor_active)).all()
+    for name in ma:
+        assert (np.asarray(ma[name]) == np.asarray(mb[name])).all(), name
+
+
+def test_packed_delay_rings_lockstep_with_oracle():
+    """The packed pending-infection ring (delay model) stays oracle-exact."""
+    params = _params(10, "i16", delay_slots=3, fd_every=3)
+    st = S.init_state(params, 10, warm=True, uniform_loss=0.1, uniform_delay=1.0)
+    st = S.spread_rumor(st, 0, origin=1)
+    key = jax.random.PRNGKey(2)
+    step = jax.jit(lambda s, k: K.tick(s, k, params))
+    for _ in range(12):
+        key, k = jax.random.split(key)
+        o = O.oracle_tick(st, k, params)
+        st, _ = step(st, k)
+        O.assert_equivalent(st, o)
+
+
+# -- 2. transfer discipline -------------------------------------------------
+
+
+def test_packed_driver_step_is_transfer_free(monkeypatch):
+    """The r6 zero-per-window-readback proof holds for the packed engine."""
+    d = SimDriver(_params(64, "i16", sync_every=8), 64, warm=True, seed=0)
+    d.spread_rumor(3, "payload")
+    d.step(2)
+    d.sync()
+    real_asarray = np.asarray
+    transfers = []
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"packed step() read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == 0
+
+
+# -- 3. chaos through the packed planes -------------------------------------
+
+
+def test_packed_chaos_partition_crash_heal_sentinels_green():
+    """Partition + Crash + heal + restart driven through the packed engine:
+    every sentinel green (no false-DEAD, bounded detection, re-convergence
+    after heal AND restart, key monotonicity through the narrow layout)."""
+    from scalecube_cluster_tpu.chaos import Crash, Partition, Restart, Scenario
+
+    n = 12
+    params = _params(n, "i16", rumor_slots=2)
+    d = SimDriver(params, n, warm=True, seed=0)
+    scn = Scenario(
+        name="packed-mixed",
+        events=[
+            Crash(rows=[4], at=3),
+            Partition(groups=[range(0, 6), range(6, 12)], at=30, heal_at=90),
+            Restart(rows=[4], at=120, seed_rows=(0,)),
+        ],
+        horizon=400,
+        check_interval=8,
+    )
+    rep = d.run_scenario(scn)
+    assert rep["ok"], rep
+    sent = rep["sentinels"]
+    assert rep["violations"] == 0
+    assert sent["false_dead_members_max"] == 0
+    assert sent["key_regressions"] == 0
+    assert all(x["ok"] for x in sent["detections"])
+    assert all(x["ok"] for x in sent["convergence"])
+    assert all(
+        x["converged_at"] is not None for x in sent["convergence"]
+    )
+
+
+def test_packed_armed_chaos_stepping_is_transfer_free(monkeypatch):
+    """The armed packed stepping loop (windows + sampled sentinel checks)
+    performs zero device→host transfers — the r7 proof, on the packed
+    engine. (Event APPLICATION at scenario boundaries is host mutation and
+    may read; the per-window loop must not.)"""
+    from scalecube_cluster_tpu.chaos import Crash, Scenario
+    from scalecube_cluster_tpu.chaos.engine import DriverChaosRunner
+
+    n = 12
+    d = SimDriver(_params(n, "i16", rumor_slots=2), n, warm=True, seed=0)
+    scn = Scenario(
+        name="far-future", events=[Crash(rows=[4], at=5000)], horizon=6000,
+        check_interval=4,
+    )
+    runner = DriverChaosRunner(d, scn)
+    d.step(2)
+    d.sync()
+    base = d.dispatch_stats["readbacks"]
+    real_asarray = np.asarray
+    transfers = []
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+            runner._run_check()
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"packed armed loop read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == base
+    rep = runner.report()  # the sync point; idle run is violation-free
+    assert rep["violations"] == 0
+
+
+# -- 4. narrow-key saturation rule ------------------------------------------
+
+
+def test_i16_incarnation_bump_saturates_without_epoch_carry():
+    cap = LAYOUT_I16.inc_mask  # 511
+    at_cap = precedence_key(
+        jnp.int32(0), jnp.int32(cap), epoch=3, dtype=jnp.int16
+    )
+    bumped = bump_inc(at_cap, RANK_ALIVE)
+    assert int(key_inc(bumped)) == cap  # clamped, not wrapped
+    assert int(key_epoch(bumped)) == 3  # NO carry into the epoch bits
+    assert int(bumped) >= int(at_cap)  # monotone even at the cap
+    # below the cap the bump is the historical +1
+    below = precedence_key(jnp.int32(0), jnp.int32(7), epoch=3, dtype=jnp.int16)
+    assert int(key_inc(bump_inc(below, RANK_ALIVE))) == 8
+
+
+def test_i16_epoch_folds_and_incarnation_clamps_at_pack_time():
+    fold = LAYOUT_I16.epoch_mask + 1  # 16
+    k = precedence_key(jnp.int32(0), jnp.int32(5), epoch=fold + 2, dtype=jnp.int16)
+    assert int(key_epoch(k)) == 2  # folded mod 16
+    k2 = precedence_key(
+        jnp.int32(0), jnp.int32(LAYOUT_I16.inc_mask + 100), epoch=0,
+        dtype=jnp.int16,
+    )
+    assert int(key_inc(k2)) == LAYOUT_I16.inc_mask  # clamped
+    # the wide layout is untouched by the clamp/fold for in-range values
+    k3 = precedence_key(jnp.int32(0), jnp.int32(5), epoch=200, dtype=jnp.int32)
+    assert int(key_epoch(k3)) == 200 and int(key_inc(k3)) == 5
+
+
+def test_i16_update_metadata_saturates():
+    params = _params(8, "i16")
+    st = S.init_state(params, 8, warm=True)
+    for _ in range(3):
+        st = S.update_metadata(st, 2)
+    assert int(key_inc(st.view_key[2, 2])) == 3
+    # force the diagonal to the cap; further bumps must clamp in place
+    cap_key = precedence_key(
+        jnp.int32(0), jnp.int32(LAYOUT_I16.inc_mask), epoch=0, dtype=jnp.int16
+    )
+    st = st.replace(view_key=st.view_key.at[2, 2].set(cap_key))
+    st = S.update_metadata(st, 2)
+    assert int(key_inc(st.view_key[2, 2])) == LAYOUT_I16.inc_mask
+    assert int(key_epoch(st.view_key[2, 2])) == 0
+
+
+# -- 5. checkpoint back-compat ----------------------------------------------
+
+
+def _legacy_archive(path_in: str, path_out: str, rumor_slots: int) -> None:
+    """Rewrite a current checkpoint as the r8 (schema-2) format: bool
+    infection planes, pre-bump schema stamp — byte-layout-faithful to what
+    the pre-r9 code wrote for an i32 driver."""
+    with np.load(path_in) as npz:
+        data = dict(npz)
+    assert int(data["_schema"]) == 3
+    data["_schema"] = np.int32(2)
+    data["infected"] = bp.unpack_bits(data["infected"], rumor_slots, xp=np)
+    data["pending_inf"] = bp.unpack_bits(data["pending_inf"], rumor_slots, xp=np)
+    with open(path_out, "wb") as fh:
+        np.savez_compressed(fh, **data)
+
+
+def test_r8_format_checkpoint_restores_and_continues(tmp_path):
+    """The restore path detects the pre-r9 unpacked planes and packs on
+    load instead of raising — and the restored driver's trajectory is
+    identical to the uninterrupted one."""
+    params = _params(16, "i32", sync_every=8)
+    d = SimDriver(params, 12, warm=True, seed=0)
+    slot = d.spread_rumor(3, "x")
+    d.step(5)
+    current = str(tmp_path / "now.npz")
+    legacy = str(tmp_path / "r8.npz")
+    d.checkpoint(current)
+    _legacy_archive(current, legacy, params.rumor_slots)
+
+    d.step(7)  # the uninterrupted timeline
+
+    d2 = SimDriver(params, 12, warm=True, seed=1)
+    d2.restore(legacy)
+    assert d2.state.infected.dtype == jnp.uint32  # packed on load
+    assert d2.state.pending_inf.dtype == jnp.uint32
+    d2.step(7)
+    assert (np.asarray(d.state.view_key) == np.asarray(d2.state.view_key)).all()
+    assert (np.asarray(d.state.infected) == np.asarray(d2.state.infected)).all()
+    assert d2.rumor_coverage(slot) == d.rumor_coverage(slot)
+
+
+def test_restore_refuses_key_dtype_mismatch(tmp_path):
+    params32 = _params(16, "i32")
+    d = SimDriver(params32, 12, warm=True, seed=0)
+    p = str(tmp_path / "wide.npz")
+    d.checkpoint(p)
+    d16 = SimDriver(_params(16, "i16"), 12, warm=True, seed=0)
+    with pytest.raises(CheckpointError, match="plane_dtype"):
+        d16.restore(p)
+
+
+# -- 6. packed mesh path ----------------------------------------------------
+
+
+def test_packed_mesh_requires_word_alignment():
+    import scalecube_cluster_tpu.ops.sharding as SH
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = SH.make_mesh(jax.devices()[:8])
+    bad = _params(64, "i16")  # 64 % (32*8) != 0
+    with pytest.raises(ValueError, match="32"):
+        SH.make_sharded_run(mesh, bad, n_ticks=1)
+    with pytest.raises(ValueError, match="32"):
+        SH.make_sharded_tick(mesh, bad)
+
+
+def test_packed_sharded_run_matches_single_device():
+    import scalecube_cluster_tpu.ops.sharding as SH
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = SH.make_mesh(jax.devices()[:8])
+    params = _params(256, "i16", sync_every=8)
+    st0 = _busy_state(params, 256)
+    key = jax.random.PRNGKey(6)
+
+    single, _, _, _ = K.run_ticks(st0, key, 4, params)
+
+    sharded_state = SH.shard_state(_busy_state(params, 256), mesh)
+    run = SH.make_sharded_run(mesh, params, n_ticks=4)
+    sharded, _, _, _ = run(sharded_state, key, watch_rows=None)
+    assert (np.asarray(single.view_key) == np.asarray(sharded.view_key)).all()
+    assert (np.asarray(single.infected) == np.asarray(sharded.infected)).all()

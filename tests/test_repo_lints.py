@@ -7,6 +7,9 @@
    lint can't report a false clean.
 2. Pytest-marker audit: every soak/slow test is reachable from a marker
    expression (``-m slow``) and every custom marker is registered.
+3. Plane-dtype lint (r9): no new full-width [N, N] bool/i32 plane
+   allocation in ops/ bypassing ops/bitplane.py, and no float64 promotion
+   in the packed reductions. Falsifiability-tested like the others.
 """
 
 from __future__ import annotations
@@ -20,6 +23,8 @@ sys.path.insert(0, REPO)
 
 from tools.audit_pytest_markers import audit, registered_markers
 from tools.lint_donation_safety import lint_file, lint_tree
+from tools.lint_plane_dtypes import lint_file as lint_planes_file
+from tools.lint_plane_dtypes import lint_tree as lint_planes_tree
 
 
 def test_package_is_donation_safe():
@@ -59,6 +64,42 @@ def test_lint_catches_the_r6_bug_class(tmp_path):
     assert {f.function for f in findings} == {
         "restore", "_restore_locked", "load_checkpoint"
     }
+
+
+def test_ops_plane_dtypes_are_packed():
+    """No ops/ allocation reintroduces a full-width [N, N] bool/i32 plane
+    outside ops/bitplane.py, and no float64 sneaks into ops/."""
+    findings = lint_planes_tree(
+        os.path.join(REPO, "scalecube_cluster_tpu", "ops")
+    )
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_plane_lint_catches_the_bypass_class(tmp_path):
+    """Falsifiability: an [N, N] bool plane, an [N, N] i32 plane, and a
+    float64 promotion must all be flagged; [N, R] planes, key-dtype
+    allocations, and suppressed lines must pass."""
+    bad = tmp_path / "bad_ops.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def alloc(n, r, kd):
+            a = jnp.zeros((n, n), bool)                 # flagged: bool plane
+            b = jnp.full((n, n), -1, jnp.int32)         # flagged: i32 plane
+            c = jnp.zeros((n, r), bool)                 # fine: not square
+            d = jnp.full((n, n), -1, kd)                # fine: key dtype var
+            e = jnp.zeros((n, n), bool)  # lint: allow-wide-plane
+            return a, b, c, d, e
+
+        def reduce_bad(w):
+            return w.sum(dtype=jnp.float64)             # flagged: float64
+
+        def reduce_ok(w):
+            return w.sum(dtype=jnp.int32)
+    """))
+    findings = lint_planes_file(str(bad))
+    assert len(findings) == 3, "\n".join(str(f) for f in findings)
+    assert {f.function for f in findings} == {"alloc", "reduce_bad"}
 
 
 def test_marker_audit_is_clean():

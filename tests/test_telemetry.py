@@ -192,7 +192,13 @@ def test_rumor_coverage_rides_the_deferred_accumulators():
     assert snap["rumors"]["stale"] is False
 
     # oracle check: deferred value == direct recompute from the state
-    inf = np.asarray(d.state.infected[:, slot])
+    # dense stores the infection bitmap word-packed (r9); sparse keeps bools
+    inf_plane = (
+        d.state.infected_bool
+        if hasattr(d.state, "infected_bool")
+        else d.state.infected
+    )
+    inf = np.asarray(inf_plane[:, slot])
     up = np.asarray(d.state.up)
     assert d.rumor_coverage(slot) == pytest.approx(
         float(inf[up].sum()) / max(int(up.sum()), 1)

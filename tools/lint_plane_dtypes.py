@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Plane-dtype lint: keep the r9 bit-plane compaction from eroding.
+
+ISSUE 4's tentpole moved the dense engine's boolean edge planes into
+word-packed uint32 bitmaps (``ops/bitplane.py``) and the precedence keys
+onto a configurable narrow dtype. Two regressions are easy to reintroduce
+and hard to spot in review:
+
+1. A new full-width ``[N, N]`` plane allocation in ``ops/`` — someone adds
+   a bool mask or an i32 side table as a stored plane, and the engine is
+   quietly back to one byte (or four) per edge on its hottest axis.
+2. Float64 promotion inside the packed reductions — a ``popcount``-style
+   integer reduce that touches ``float64`` anywhere silently runs the
+   whole [N, W] plane through doubles under x64 mode.
+
+Rules (AST-based, no imports of the linted code; ops/ only):
+
+1. ``jnp.zeros/ones/full/empty`` with a member-square shape — a literal
+   shape tuple containing two ADJACENT identical dims (``(n, n)``,
+   ``(d, n, n)``) — and dtype bool / jnp.bool_ / jnp.int32 / np.int32 is
+   flagged: edge-proportional planes go through ``ops/bitplane.py`` packed
+   words (bool) or the configured key dtype (keys). Non-square planes
+   ([N, R] rumor planes, [N] vectors) pass.
+2. Any ``jnp.float64`` / ``np.float64`` / ``numpy.float64`` reference in
+   ``ops/`` is flagged — packed reductions are integer end-to-end
+   (``bitplane.popcount`` contract).
+
+A line may opt out with ``# lint: allow-wide-plane`` (rule 1 — e.g. the
+``changed_at`` timestamp plane, which is semantically i32) or
+``# lint: allow-float64`` (rule 2), stating its reason inline.
+
+Run directly (``python tools/lint_plane_dtypes.py [root]``, exit 1 on
+findings) or through the tier-1 test ``tests/test_repo_lints.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import List, Optional
+
+SUPPRESS_PLANE = "lint: allow-wide-plane"
+SUPPRESS_F64 = "lint: allow-float64"
+
+_ALLOC_CHAINS = {
+    ("jnp", "zeros"), ("jnp", "ones"), ("jnp", "full"), ("jnp", "empty"),
+    ("jax", "numpy", "zeros"), ("jax", "numpy", "ones"),
+    ("jax", "numpy", "full"), ("jax", "numpy", "empty"),
+}
+_BOOL_DTYPES = {("bool",), ("jnp", "bool_"), ("np", "bool_"), ("numpy", "bool_")}
+_I32_DTYPES = {("jnp", "int32"), ("np", "int32"), ("numpy", "int32")}
+_F64_CHAINS = {("jnp", "float64"), ("np", "float64"), ("numpy", "float64"),
+               ("jax", "numpy", "float64")}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    function: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
+
+
+def _attr_chain(node: ast.AST) -> Optional[tuple]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _dim_token(node: ast.AST) -> Optional[str]:
+    """A comparable spelling of one shape dim (name, attribute chain, or
+    int literal); None for computed dims."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return str(node.value)
+    chain = _attr_chain(node)
+    return ".".join(chain) if chain else None
+
+
+def _member_square(shape: ast.AST) -> bool:
+    """True for a literal shape tuple with two ADJACENT identical dims —
+    the [N, N] / [D, N, N] edge-plane signature."""
+    if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+        return False
+    toks = [_dim_token(e) for e in shape.elts]
+    return any(
+        a is not None and a == b and not a.isdigit()
+        for a, b in zip(toks, toks[1:])
+    )
+
+
+def _dtype_of(call: ast.Call, chain: tuple) -> Optional[tuple]:
+    """The dtype argument's chain, positional or keyword, if spelled
+    statically. zeros/ones/empty: (shape, dtype); full: (shape, fill, dtype)."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            c = _attr_chain(kw.value)
+            return c if c else None
+    pos = 2 if chain[-1] == "full" else 1
+    if len(call.args) > pos:
+        c = _attr_chain(call.args[pos])
+        return c if c else None
+    return None
+
+
+def _suppressed(lines: List[str], lineno: int, marker: str) -> bool:
+    line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+    return marker in line
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, "<module>",
+                        f"unparseable: {exc.msg}")]
+    lines = source.splitlines()
+    findings: List[Finding] = []
+
+    # enclosing-function names for readable findings
+    parents: dict = {}
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for child in ast.walk(fn):
+                parents.setdefault(id(child), fn.name)
+
+    skip_f64 = os.path.basename(path) == "dcn.py"  # multi-host glue, no planes
+    for node in ast.walk(tree):
+        where = parents.get(id(node), "<module>")
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in _ALLOC_CHAINS and node.args and _member_square(node.args[0]):
+                if _suppressed(lines, node.lineno, SUPPRESS_PLANE):
+                    continue
+                dt = _dtype_of(node, chain)
+                if dt in _BOOL_DTYPES:
+                    findings.append(Finding(
+                        path, node.lineno, where,
+                        "full-width [N, N] bool plane allocation — pack it "
+                        "into uint32 words via ops/bitplane.py (or justify "
+                        f"with `# {SUPPRESS_PLANE}`)",
+                    ))
+                elif dt in _I32_DTYPES:
+                    findings.append(Finding(
+                        path, node.lineno, where,
+                        "full-width [N, N] int32 plane allocation — key "
+                        "planes take the configured key dtype "
+                        "(SimParams.key_dtype); other planes justify with "
+                        f"`# {SUPPRESS_PLANE}`",
+                    ))
+        elif isinstance(node, ast.Attribute) and not skip_f64:
+            chain = _attr_chain(node)
+            if chain in _F64_CHAINS and not _suppressed(
+                lines, node.lineno, SUPPRESS_F64
+            ):
+                findings.append(Finding(
+                    path, node.lineno, where,
+                    "float64 in ops/ — packed reductions are integer "
+                    "end-to-end (bitplane.popcount contract); justify with "
+                    f"`# {SUPPRESS_F64}`",
+                ))
+    return findings
+
+
+def lint_tree(root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in ("__pycache__", ".git", ".pytest_cache")
+        ]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, name)))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scalecube_cluster_tpu", "ops",
+    )
+    findings = lint_tree(root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} plane-dtype finding(s)")
+        return 1
+    print("plane-dtype lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
