@@ -243,6 +243,28 @@ def _headline_rounds_sparse():
 
 
 def main() -> None:
+    # r10: --profile records the trace-plane overhead headline + the
+    # phase-split tick breakdown into TRACE_BENCH_r10.json (the config10
+    # artifact shape) and prints its JSON line — the observability twin of
+    # --plane-dtype/--scaling: same interleaved median-of-5 protocol.
+    if "--profile" in sys.argv:
+        import os
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [
+            sys.executable,
+            os.path.join(here, "benchmarks", "config10_trace.py"),
+            "--out", os.path.join(here, "TRACE_BENCH_r10.json"),
+        ]
+        for flag in ("--n", "--windows", "--window-ticks", "--reps",
+                     "--profile-ticks"):
+            if flag in sys.argv:
+                i = sys.argv.index(flag)
+                if i + 1 < len(sys.argv):
+                    cmd += [flag, sys.argv[i + 1]]
+        raise SystemExit(subprocess.call(cmd))
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

@@ -82,11 +82,22 @@ def _r9(rec):
     )
 
 
+def _r10(rec):
+    prof = rec.get("profile", {})
+    top = max(prof.get("phases_pct", {}).items(), key=lambda kv: kv[1],
+              default=(None, 0))
+    return rec["trace_armed_ticks_per_s"], (
+        f"trace-armed (within noise of pipelined "
+        f"{rec['pipelined_ticks_per_s']}; top phase {top[0]} {top[1]}%)"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
     (8, "TELEM_BENCH_r08.json", _r8),
     (9, "BITPLANE_BENCH_r09.json", _r9),
+    (10, "TRACE_BENCH_r10.json", _r10),
 ]
 
 
@@ -175,6 +186,10 @@ def main() -> None:
     # multi-GiB states and belong to the dedicated r9 artifact run
     results += run([py, "benchmarks/config9_bitplane.py", "--no-verify"],
                    timeout=3000)
+    # r10 trace-plane overhead + phase breakdown (refreshes the loose
+    # TRACE_BENCH artifact so the trajectory fold sees current numbers)
+    results += run([py, "benchmarks/config10_trace.py",
+                    "--out", "TRACE_BENCH_r10.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)

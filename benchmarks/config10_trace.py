@@ -1,0 +1,164 @@
+"""Driver config #10: trace-plane overhead + tick-phase breakdown.
+
+The r10 acceptance gate, two measurements in one artifact:
+
+* **trace overhead** — arming the causal trace plane (per-tick [K, F]
+  record appends into the donated device ring, threaded through the
+  window jit) on the plain pipelined driver must cost within noise
+  (<= 2%) of the unarmed r6 loop, on the SAME config as configs 6-9
+  (dense N=4096, 24 one-tick windows per span), and must stay
+  transfer-free per window (asserted via the driver's readback counter).
+  Interleaved variants, median-of-``--reps`` spans — the r7/r8 protocol.
+* **phase breakdown** — the window re-run as phase-split jits
+  (``trace/profile.py``): per-phase wall shares of the split window, with
+  the split-vs-fused cost made explicit, and the profiler's coverage
+  invariant (phase times sum to within 20% of the split window's wall
+  time) asserted here as well as in the tier-1 test.
+
+    python benchmarks/config10_trace.py [--n 4096] [--windows 24]
+        [--window-ticks 1] [--reps 5] [--profile-ticks 24]
+        [--out TRACE_BENCH_r10.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+
+from common import emit, log
+
+
+def _params(n: int):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
+    )
+
+
+class Loop:
+    """config6's pipelined variant; ``armed=True`` adds the trace plane
+    (4 tracer rows + 1 traced rumor slot) — nothing else differs."""
+
+    def __init__(self, n: int, windows: int, window_ticks: int, armed: bool):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.armed = armed
+        self.d = SimDriver(_params(n), n, warm=True, seed=0)
+        if armed:
+            self.plane = self.d.arm_trace(
+                tracer_rows=(0, 1, 2, 3), rumor_slots=(0,)
+            )
+        self.d.step(window_ticks)  # compile + warm (incl. the ring append)
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        if self.armed:
+            assert self.d.dispatch_stats["readbacks"] == base, (
+                "armed trace performed a device->host readback"
+            )
+        return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--profile-ticks", type=int, default=24)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the artifact JSON to this path")
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"warming 2 variants: N={args.n}, {args.reps} x {args.windows} "
+        f"windows of {args.window_ticks} tick(s)")
+    plain_loop = Loop(args.n, args.windows, args.window_ticks, armed=False)
+    armed_loop = Loop(args.n, args.windows, args.window_ticks, armed=True)
+
+    plain_spans, armed_spans = [], []
+    for rep in range(args.reps):  # interleaved: drift hits both alike
+        plain_spans.append(plain_loop.span())
+        armed_spans.append(armed_loop.span())
+        log(f"rep {rep}: pipelined {plain_spans[-1]:.3f}s, "
+            f"trace-armed {armed_spans[-1]:.3f}s")
+
+    total = args.windows * args.window_ticks
+    plain = statistics.median(plain_spans)
+    armed = statistics.median(armed_spans)
+    overhead_pct = round((armed / plain - 1.0) * 100.0, 2)
+
+    # phase breakdown: the split-jit window on the armed loop's config
+    log(f"phase-split profile: {args.profile_ticks} ticks")
+    from scalecube_cluster_tpu.trace.profile import profile_driver
+
+    prof = profile_driver(armed_loop.d, n_ticks=args.profile_ticks)
+    prof.pop("timeline", None)  # per-event list is for Perfetto, not JSON stats
+    fused_ticks_per_s = total / plain
+
+    result = {
+        "config": 10,
+        "variant": "trace_overhead",
+        "n": args.n,
+        "engine": "dense",
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "ring_len": armed_loop.plane.spec.ring_len,
+        "trace_fields": armed_loop.plane.spec.n_fields,
+        "tracer_rows": list(armed_loop.plane.spec.tracer_rows),
+        "pipelined_ticks_per_s": round(total / plain, 1),
+        "trace_armed_ticks_per_s": round(total / armed, 1),
+        "armed_overhead_pct": overhead_pct,
+        "within_budget": overhead_pct <= 2.0,
+        "armed_dispatch": armed_loop.d.dispatch_snapshot(),
+        "trace_records_appended": armed_loop.plane.ring.records,
+        "profile": prof,
+        "profile_vs_fused": {
+            "fused_ticks_per_s": round(fused_ticks_per_s, 2),
+            "split_ticks_per_s": prof["split_ticks_per_s"],
+            "split_cost_x": round(
+                fused_ticks_per_s / prof["split_ticks_per_s"], 2
+            ) if prof["split_ticks_per_s"] else None,
+        },
+        "phase_coverage_ok": abs(prof["phase_coverage"] - 1.0) <= 0.2,
+        "spans_s": {
+            "pipelined": [round(s, 4) for s in plain_spans],
+            "trace_armed": [round(s, 4) for s in armed_spans],
+        },
+    }
+    emit(result)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(result, fh)
+        log(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

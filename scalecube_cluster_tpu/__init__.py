@@ -23,6 +23,7 @@ from .config import (
     MembershipConfig,
     SimConfig,
     TelemetryConfig,
+    TraceConfig,
     TransportConfig,
 )
 from .models.events import FailureDetectorEvent, MembershipEvent, MembershipEventType
@@ -40,6 +41,7 @@ __all__ = [
     "TransportConfig",
     "SimConfig",
     "TelemetryConfig",
+    "TraceConfig",
     "Member",
     "MemberStatus",
     "MembershipRecord",

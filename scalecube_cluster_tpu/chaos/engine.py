@@ -270,7 +270,7 @@ class DriverChaosRunner:
     final report (or whenever a monitor poll explicitly asks)."""
 
     def __init__(self, driver, scenario: Scenario, config=None,
-                 sentinels: bool = True):
+                 sentinels: bool = True, trace: bool = False):
         import jax
 
         from ..ops import kernel as _kernel
@@ -278,6 +278,37 @@ class DriverChaosRunner:
 
         self.driver = driver
         self.scenario = scenario
+        self._untraced_crash_rows: List[int] = []
+        if trace:
+            crash_rows = []
+            for ev in scenario.events:
+                if isinstance(ev, Crash):
+                    crash_rows.extend(int(r) for r in ev.rows)
+            uniq = tuple(dict.fromkeys(crash_rows))
+            if driver._trace is None:
+                # auto-attach (r10): the scenario's crashed rows are the
+                # members whose causal story the report will need —
+                # sample them as tracers (up to the configured
+                # TraceConfig.tracers budget) so sentinel outcomes
+                # resolve to span trees. On a mesh driver this raises
+                # (arm_trace's own rule) — an explicit trace=True must
+                # not silently degrade to an untraced report.
+                from ..config import ClusterConfig, TraceConfig
+
+                tcfg = config if isinstance(
+                    config, (ClusterConfig, TraceConfig)
+                ) else None
+                trace_cfg = tcfg.trace if isinstance(tcfg, ClusterConfig) \
+                    else (tcfg or TraceConfig())
+                driver.arm_trace(
+                    config=tcfg, tracer_rows=uniq[:trace_cfg.tracers] or None
+                )
+            # no silent caps: crashed rows the (auto- OR pre-) armed spec
+            # does not trace are named in the report — a missing span
+            # tree must read as "untraced", never "no detection activity"
+            self._untraced_crash_rows = [
+                r for r in uniq if r not in driver._trace.spec.tracer_rows
+            ]
         with driver._lock:
             self.t0 = int(driver.state.tick)  # the one arm-time readback
             view_key = driver.state.view_key
@@ -366,6 +397,7 @@ class DriverChaosRunner:
             self.rel_tick = t
         self.done = True
         report = self.report()  # THE sync point: one coalesced readback
+        self._attach_trace(report)
         self.last_report = report
         plane = getattr(d, "_telemetry", None)
         if plane is not None:
@@ -375,6 +407,31 @@ class DriverChaosRunner:
             if dump is not None:
                 report["flight_dump"] = dump
         return report
+
+    def _attach_trace(self, report: dict) -> None:
+        """Resolve sentinel outcomes to sewn span trees (r10): every traced
+        crash subject gets its probe-miss → suspect → DEAD lineage attached
+        to its detection entry (violating or not — a PASSING detection's
+        tree is how its latency is explained), and the report carries the
+        full map under ``trace_spans``. One ring readback — this runs at
+        the final-report sync point only."""
+        tplane = getattr(self.driver, "_trace", None)
+        if tplane is None:
+            return
+        from ..trace import spans as _spans
+
+        events = tplane.events()
+        trees = {}
+        for det in (report.get("sentinels") or {}).get("detections", ()):
+            row = det["row"]
+            if row in tplane.spec.tracer_rows:
+                tree = _spans.detection_tree(events, row)
+                if tree is not None:
+                    det["span_tree"] = tree
+                    trees[int(row)] = tree
+        report["trace_spans"] = trees
+        if self._untraced_crash_rows:
+            report["untraced_crash_rows"] = list(self._untraced_crash_rows)
 
     def _run_check(self) -> None:
         d = self.driver
@@ -423,10 +480,14 @@ def run_driver_scenario(
     config=None,
     sentinels: bool = True,
     max_window: int = 32,
+    trace: bool = False,
 ) -> dict:
     """Arm ``scenario`` on ``driver`` and run it to the horizon (the
-    function behind ``SimDriver.run_scenario``)."""
-    runner = DriverChaosRunner(driver, scenario, config=config, sentinels=sentinels)
+    function behind ``SimDriver.run_scenario``). ``trace=True``
+    auto-attaches the causal trace plane on the crashed rows (r10)."""
+    runner = DriverChaosRunner(
+        driver, scenario, config=config, sentinels=sentinels, trace=trace
+    )
     return runner.run(max_window=max_window)
 
 

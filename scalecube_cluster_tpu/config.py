@@ -224,6 +224,33 @@ class TelemetryConfig:
         return replace(self, **kw)
 
 
+@dataclass(frozen=True)
+class TraceConfig:
+    """Causal-trace-plane knobs (new; no reference analogue — the r10
+    on-device protocol span capture, see ``trace/``). The reference gets
+    causal traces for free from per-message DEBUG logs; the lockstep
+    tensor engine samples K "tracer" members + T traced rumor slots into a
+    fixed-shape device ring instead.
+
+    ``tracers`` — how many tracer members to sample when no explicit
+    ``tracer_rows`` are given (the first K rows). ``tracer_rows`` —
+    explicit tracer rows (wins over ``tracers``). ``rumor_slots`` — the
+    traced user-rumor slots (their infection trees are sewable).
+    ``ring_len`` — device trace-ring rows retained ([ring_len, n_fields]
+    int32; K rows append per tick, so ring_len/K ticks of history).
+    ``tick_us`` — microseconds one tick maps to in Perfetto exports
+    (display scaling only; never touches the engine)."""
+
+    tracers: int = 4
+    tracer_rows: Sequence[int] = ()
+    rumor_slots: Sequence[int] = ()
+    ring_len: int = 8192
+    tick_us: float = 1000.0
+
+    def replace(self, **kw) -> "TraceConfig":
+        return replace(self, **kw)
+
+
 Lens = Callable
 
 
@@ -239,6 +266,7 @@ class ClusterConfig:
     sim: SimConfig = field(default_factory=SimConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
     member_alias: Optional[str] = None
     external_host: Optional[str] = None  # container NAT mapping (ClusterConfig.java:236-300)
@@ -297,6 +325,9 @@ class ClusterConfig:
     def with_telemetry(self, op: Lens) -> "ClusterConfig":
         return replace(self, telemetry=op(self.telemetry))
 
+    def with_trace(self, op: Lens) -> "ClusterConfig":
+        return replace(self, trace=op(self.trace))
+
     def replace(self, **kw) -> "ClusterConfig":
         return replace(self, **kw)
 
@@ -343,6 +374,18 @@ class ClusterConfig:
             raise ValueError(
                 "telemetry.latency_buckets must be positive and ascending"
             )
+        if self.trace.ring_len <= 0:
+            raise ValueError("trace.ring_len must be > 0")
+        if self.trace.tracers <= 0 and not self.trace.tracer_rows:
+            raise ValueError(
+                "trace.tracers must be > 0 (or set explicit trace.tracer_rows)"
+            )
+        if any(r < 0 for r in self.trace.tracer_rows):
+            raise ValueError("trace.tracer_rows must be non-negative")
+        if any(s < 0 for s in self.trace.rumor_slots):
+            raise ValueError("trace.rumor_slots must be non-negative")
+        if self.trace.tick_us <= 0:
+            raise ValueError("trace.tick_us must be > 0")
         return self
 
 

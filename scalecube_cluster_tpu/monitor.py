@@ -127,6 +127,10 @@ class MonitorServer:
         self._metric_providers: List[Callable[[], List[Dict[str, Any]]]] = []
         # unified event-bus tail provider for /events
         self._events: Optional[Callable[[], List[Dict[str, Any]]]] = None
+        # causal trace providers (r10): GET /trace (decoded events + sewn
+        # span trees) and GET /trace/perfetto (Chrome-trace JSON)
+        self._trace: Optional[Callable[[], Dict[str, Any]]] = None
+        self._trace_perfetto: Optional[Callable[[], Dict[str, Any]]] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
@@ -182,6 +186,44 @@ class MonitorServer:
         self._metric_providers.append(plane.families)
         bus = plane.bus
         self._events = lambda: [r.as_dict() for r in bus.tail(256)]
+        # the trace routes ride along (a telemetry consumer wants the why
+        # as much as the how-much); late-bound so a plane armed AFTER
+        # registration (e.g. run_scenario(trace=True) auto-attach) is
+        # served without re-registering
+        self.register_trace(driver, required=False)
+
+    def register_trace(self, driver, plane=None, required: bool = True) -> None:
+        """Serve the r10 causal trace plane: ``GET /trace`` (ring stats +
+        decoded protocol events + sewn detection span trees, JSON) and
+        ``GET /trace/perfetto`` (a Chrome-trace/Perfetto document of the
+        span trees + rumor infection trees). The plane is resolved at
+        REQUEST time, so arming after registration (the chaos runner's
+        auto-attach) just works; ``required=True`` (the explicit-call
+        default) still fails fast on a driver nobody armed — the monitor
+        must never arm one itself (arming swaps compiled window programs,
+        which cannot happen behind the sim thread's back). Every poll is a
+        trace-ring sync point — poll cadence, never window cadence."""
+        if required and plane is None and getattr(driver, "_trace", None) is None:
+            raise ValueError(
+                "driver has no armed trace plane — call arm_trace() first"
+            )
+
+        def _resolve():
+            return plane if plane is not None else getattr(driver, "_trace", None)
+
+        def _snapshot():
+            p = _resolve()
+            return p.trace_snapshot() if p is not None else {"armed": False}
+
+        def _perfetto():
+            p = _resolve()
+            if p is not None:
+                return p.perfetto()
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "metadata": {"armed": False}}
+
+        self._trace = _snapshot
+        self._trace_perfetto = _perfetto
 
     def register_cluster_metrics(self, cluster, bus=None) -> None:
         """Serve OpenMetrics for one scalar-engine Cluster node at
@@ -241,6 +283,7 @@ class MonitorServer:
                 "chaos": self._chaos is not None,
                 "metrics": bool(self._metric_providers),
                 "events": self._events is not None,
+                "trace": self._trace is not None,
             }
         if path == "/metrics":
             if not self._metric_providers:
@@ -253,6 +296,14 @@ class MonitorServer:
             if self._events is None:
                 return b"404 Not Found", {"error": "no event bus registered"}
             return b"200 OK", {"events": self._events()}
+        if path == "/trace":
+            if self._trace is None:
+                return b"404 Not Found", {"error": "no trace provider registered"}
+            return b"200 OK", self._trace()
+        if path == "/trace/perfetto":
+            if self._trace_perfetto is None:
+                return b"404 Not Found", {"error": "no trace provider registered"}
+            return b"200 OK", self._trace_perfetto()
         if path == "/chaos":
             if self._chaos is None:
                 return b"404 Not Found", {"error": "no chaos provider registered"}

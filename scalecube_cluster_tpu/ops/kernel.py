@@ -323,7 +323,7 @@ def _fetch_gate(
 
 
 def _fd_phase(
-    state: SimState, r: FdRandoms, params: SimParams
+    state: SimState, r: FdRandoms, params: SimParams, trace: bool = False
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     rows = jnp.arange(n)
@@ -403,14 +403,35 @@ def _fd_phase(
         "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (accept & ~ack).sum(),
     }
+    if trace:
+        # trace-plane export (r10): the probe internals the causal trace
+        # ring records — values this phase already computed, so an armed
+        # trace changes NOTHING about the state math (lockstep-tested).
+        # ``suspect`` marks rows whose verdict RAISED a suspicion (the
+        # detection lineage's origin events).
+        metrics["trace_fd"] = {
+            "tgt": tgt.astype(jnp.int32),
+            "has_tgt": has_tgt,
+            "ack": ack,
+            "direct_ok": direct_ok,
+            "suspect": accept & ~ack,
+            "relays": relays.astype(jnp.int32),
+            "relay_valid": relay_valid,
+            "relay_ok": relay_ok,
+        }
     return st, metrics
 
 
-def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
+def _suspicion_phase(state: SimState, params: SimParams, trace=None):
     """SUSPECT cells whose suspicion window expired become DEAD at the same
     incarnation (rank 2 -> 3 is key+1). ``changed_at`` is the suspicion
     start: every accepted change that leaves a cell SUSPECT also (re)stamps
-    it, so a separate suspect_since plane would always equal it."""
+    it, so a separate suspect_since plane would always equal it.
+
+    ``trace`` (a TraceSpec) switches the return to ``(state, sus_tr)`` with
+    the tracers' expiry transitions exported from the sweep branch's own
+    ``expired`` temp (r10 — reading a branch temp is free; reading the
+    carried plane post-hoc is a full extra materialization per tick)."""
     recompute = _packed(params)
     # Packed mode recomputes the suspect mask INSIDE the rare sweep branch:
     # a mask captured by the lax.cond closure is a cond operand, so the
@@ -420,7 +441,7 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
     # one pass over view_key; the sweep branch (rare) pays the recompute.
     suspect = None if recompute else (state.view_key & 3) == RANK_SUSPECT
 
-    def _sweep(state: SimState) -> SimState:
+    def _sweep(state: SimState):
         sus = (
             (state.view_key & 3) == RANK_SUSPECT if recompute else suspect
         )
@@ -432,17 +453,29 @@ def _suspicion_phase(state: SimState, params: SimParams) -> SimState:
             & (state.tick - state.changed_at >= timeout[:, None])
             & state.up[:, None]
         )
-        return state.replace(
+        st = state.replace(
             view_key=jnp.where(expired, state.view_key + 1, state.view_key),
             changed_at=jnp.where(expired, state.tick, state.changed_at),
         )
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            return st, _tc.expiry_trace(expired, trace)
+        return st
+
+    def _skip(st: SimState):
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            return st, _tc.zero_sus_trace(trace)
+        return st
 
     # No SUSPECT cell anywhere (the healthy steady state) -> nothing can
     # expire; skip the timer compare + both plane writes.
     has_suspect = (
         ((state.view_key & 3) == RANK_SUSPECT).any() if recompute else suspect.any()
     )
-    return jax.lax.cond(has_suspect, _sweep, lambda st: st, state)
+    return jax.lax.cond(has_suspect, _sweep, _skip, state)
 
 
 def _gossip_phase(
@@ -635,7 +668,7 @@ def _gossip_phase(
 
 
 def _sync_phase(
-    state: SimState, r: RoundRandoms, params: SimParams
+    state: SimState, r: RoundRandoms, params: SimParams, trace: bool = False
 ) -> tuple[SimState, dict[str, jax.Array]]:
     """Anti-entropy full-table exchange for this tick's due callers.
 
@@ -767,10 +800,22 @@ def _sync_phase(
     # start phase, MembershipProtocolImpl.start0:250-291).
     ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
     st = st.replace(force_sync=st.force_sync & ~ok_full)
-    return st, {"sync_roundtrips": ok.sum()}
+    metrics = {"sync_roundtrips": ok.sum()}
+    if trace:
+        # trace-plane export (r10): this tick's caller compaction + merge
+        # outcomes (SYNC initiated/merged spans) — read-only internals
+        metrics["trace_sync"] = {
+            "caller": caller.astype(jnp.int32),
+            "valid": valid_c,
+            "peer": peer.astype(jnp.int32),
+            "ok": ok,
+            "req_acc": acc.sum(axis=1).astype(jnp.int32),
+            "ack_acc": accept.sum(axis=1).astype(jnp.int32),
+        }
+    return st, metrics
 
 
-def _refute_phase(state: SimState) -> SimState:
+def _refute_phase(state: SimState, trace=None):
     """A running node that finds itself SUSPECT — or even DEAD (a lingering
     cross-partition death rumor can land after a heal) — re-announces ALIVE
     with a bumped incarnation. The reference refutes ANY overriding record
@@ -778,7 +823,10 @@ def _refute_phase(state: SimState) -> SimState:
     rumor's incarnation (``onSelfMemberDetected:686-708``: r2 =
     (self, status, max(inc)+1)); without the DEAD case a node declared dead
     by others becomes a permanent zombie — up, but invisible forever.
-    Deliberate LEAVING (self-initiated) is not refuted."""
+    Deliberate LEAVING (self-initiated) is not refuted.
+
+    ``trace`` switches the return to ``(state, refuted_tr)`` — the tracers'
+    [K] self-refutation mask, read off the phase's own ``need`` vector."""
     n = state.capacity
     rows = jnp.arange(n)
     diag = state.view_key[rows, rows]
@@ -807,7 +855,10 @@ def _refute_phase(state: SimState) -> SimState:
 
     # In a healthy cluster nobody is refuting; skip the diagonal writes
     # (which force a copy-on-write of both [N, N] planes) entirely then.
-    return jax.lax.cond(need.any(), _apply, lambda st: st, state)
+    st = jax.lax.cond(need.any(), _apply, lambda st: st, state)
+    if trace is not None:
+        return st, need[jnp.asarray(trace.tracer_rows, jnp.int32)]
+    return st
 
 
 def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
@@ -844,9 +895,18 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
 
 
 def tick(
-    state: SimState, key: jax.Array, params: SimParams
+    state: SimState, key: jax.Array, params: SimParams, trace=None
 ) -> tuple[SimState, dict[str, Any]]:
-    """Advance the whole cluster by one gossip period. Pure; jit/shard me."""
+    """Advance the whole cluster by one gossip period. Pure; jit/shard me.
+
+    ``trace`` (a :class:`..trace.schema.TraceSpec`, static) arms the causal
+    trace plane (r10): the metrics dict gains a ``_trace_rows`` [K, F] i32
+    block built from phase internals — pure reads of [N]-sized values the
+    tick computes anyway (never a read of the carried [N, N] planes, which
+    would cost a full extra materialization per tick), so the state
+    trajectory is BIT-IDENTICAL armed vs unarmed and the armed tick stays
+    within noise (the lockstep + overhead gates pin both, for both
+    engines)."""
     state = state.replace(tick=state.tick + 1)
     fd_key, round_key = split_tick_key(key)
     r = draw_round_randoms(round_key, state.capacity, params.fanout)
@@ -857,24 +917,61 @@ def tick(
     # gossip/SYNC stream).
     def _fd_on(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
         fd_r = draw_fd_randoms(fd_key, st.capacity, params.ping_req_k)
-        return _fd_phase(st, fd_r, params)
+        return _fd_phase(st, fd_r, params, trace=trace is not None)
 
     def _fd_off(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
-        return st, {
+        m = {
             "fd_probes": jnp.int32(0),
             "fd_failed_probes": jnp.int32(0),
             "fd_new_suspects": jnp.int32(0),
         }
+        if trace is not None:
+            from ..trace import capture as _tc
 
-    state, fd_m = jax.lax.cond(
-        (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state
-    )
-    state = _suspicion_phase(state, params)
+            m["trace_fd"] = _tc.zero_fd_trace(st.capacity, params.ping_req_k)
+        return st, m
+
+    fd_ran = (state.tick % params.fd_every) == 0
+    state, fd_m = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
+    if trace is not None:
+        state, trace_sus = _suspicion_phase(state, params, trace=trace)
+    else:
+        state = _suspicion_phase(state, params)
     state, g_m = _gossip_phase(state, r, params)
-    state, s_m = _sync_phase(state, r, params)
-    state = _refute_phase(state)
+    state, s_m = _sync_phase(state, r, params, trace=trace is not None)
+    if trace is not None:
+        state, trace_ref = _refute_phase(state, trace=trace)
+    else:
+        state = _refute_phase(state)
     state = _rumor_sweep(state, params)
 
+    trace_fd = fd_m.pop("trace_fd", None)
+    trace_sync = s_m.pop("trace_sync", None)
+    metrics = {**fd_m, **g_m, **s_m, **state_metrics(state, params)}
+    if trace is not None:
+        from ..trace import capture as _tc
+
+        metrics["_trace_rows"] = _tc.build_trace_rows(
+            trace,
+            tick=state.tick,
+            up=state.up,
+            fd_ran=fd_ran,
+            trace_fd=trace_fd,
+            trace_sus=trace_sus,
+            trace_ref=trace_ref,
+            trace_sync=trace_sync,
+            # XLA CSEs this against state_metrics' unpack of the same plane
+            infected_b=bp.unpack_bits(state.infected, params.rumor_slots),
+            infected_at=state.infected_at,
+            infected_from=state.infected_from,
+        )
+    return state, metrics
+
+
+def state_metrics(state: SimState, params: SimParams) -> dict[str, Any]:
+    """The tick's state-derived health metrics — factored out (r10) so the
+    phase-split profiler's "telemetry" phase runs the EXACT spelling the
+    fused tick uses (one source, no drift)."""
     if params.full_metrics:
         up2 = state.up[:, None] & state.up[None, :]
         off_diag = ~jnp.eye(state.capacity, dtype=bool)
@@ -922,17 +1019,13 @@ def tick(
         .sum(axis=1)
         .max()
     )
-    metrics = {
-        **fd_m,
-        **g_m,
-        **s_m,
+    return {
         "n_up": state.up.sum(),
         "alive_view_fraction": alive_frac,
         "false_suspect_pairs": false_suspects,
         "rumor_coverage": coverage,  # [R]
         "gossip_segmentation": seg,
     }
-    return state, metrics
 
 
 def run_ticks(
@@ -1119,6 +1212,56 @@ def sentinel_core(
 def sentinel_reduce(state: SimState, sent: dict, spec: dict) -> dict:
     """Dense-engine chaos sentinel check (see :func:`sentinel_core`)."""
     return sentinel_core(state.view_key, state.up, state.tick, sent, spec)
+
+
+def run_ticks_traced(
+    state: SimState,
+    key: jax.Array,
+    trace_buf: jax.Array,
+    trace_cursor: jax.Array,
+    n_ticks: int,
+    params: SimParams,
+    trace,
+    watch_rows: jax.Array | None = None,
+) -> tuple[SimState, jax.Array, dict[str, Any], jax.Array | None, jax.Array]:
+    """Trace-armed :func:`run_ticks` (r10): the same window scan with the
+    causal trace ring threaded through the carry — each tick appends its
+    [K, F] record block in place at the device-carried cursor. The key
+    chain and every state op are IDENTICAL to the unarmed window, so the
+    trajectory stays bit-identical; the ring buffer is donated by
+    :func:`make_traced_run` so the append never copies it. ``trace_cursor``
+    comes from the host mirror (appends are a static K·n_ticks per window,
+    so the host cursor never needs a device read)."""
+    from ..trace import capture as _tc
+
+    def body(carry, _):
+        st, k, buf, cur = carry
+        k, tick_key = jax.random.split(k)
+        st, m = tick(st, tick_key, params, trace=trace)
+        buf, cur = _tc.append_rows(
+            buf, cur, m.pop("_trace_rows"), trace.ring_len
+        )
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, k, buf, cur), m
+
+    (state, key, trace_buf, _cur), ms = jax.lax.scan(
+        body, (state, key, trace_buf, trace_cursor), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched, trace_buf
+
+
+def make_traced_run(params: SimParams, n_ticks: int, trace, donate: bool = True):
+    """Jitted :func:`run_ticks_traced` window: state AND trace ring donated
+    (argnums 0, 2) — the armed driver's per-window path stays in-place and
+    transfer-free exactly like :func:`make_run`'s."""
+    from functools import partial
+
+    return jax.jit(
+        partial(run_ticks_traced, n_ticks=n_ticks, params=params, trace=trace),
+        donate_argnums=(0, 2) if donate else (),
+    )
 
 
 def make_run(params: SimParams, n_ticks: int, donate: bool = True):

@@ -1016,7 +1016,7 @@ from .bitplane import pack_bits as _pack_bits, unpack_bits as _unpack_bits
 # ---------------------------------------------------------------------------
 
 
-def _fd_phase(state: SparseState, r, params: SparseParams):
+def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
     """Vectorized FD round (``FailureDetectorImpl`` semantics, as the dense
     kernel's ``_fd_phase``) with rejection-sampled target/relay selection.
     Returns (state, proposals, metrics)."""
@@ -1099,14 +1099,29 @@ def _fd_phase(state: SparseState, r, params: SparseParams):
         "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (eff & ~ack).sum(),
     }
+    if trace:
+        # trace-plane export (r10, same contract as kernel._fd_phase):
+        # already-computed probe internals — zero effect on the state math
+        metrics["trace_fd"] = {
+            "tgt": tgt.astype(jnp.int32),
+            "has_tgt": has_tgt,
+            "ack": ack,
+            "direct_ok": direct_ok,
+            "suspect": eff & ~ack,
+            "relays": relays.astype(jnp.int32),
+            "relay_valid": relay_valid,
+            "relay_ok": relay_ok,
+        }
     return st, proposals, metrics
 
 
-def _suspicion_sweep(state: SparseState, params: SparseParams):
+def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None):
     """Dense expiry pass, every ``sweep_every`` ticks: SUSPECT cells whose
     subject's episode stamp is older than the observer's suspicion timeout
     become DEAD at the same incarnation (rank +1). O(N²/B) amortized.
-    Returns (state, proposals)."""
+    Returns (state, proposals) — plus the tracers' expiry export when
+    ``trace`` (a TraceSpec) is set (r10; read off the sweep branch's own
+    ``expired`` temp, see ``trace.capture.expiry_trace``)."""
     n = state.capacity
     rows = jnp.arange(n)
     no_props = (
@@ -1145,15 +1160,24 @@ def _suspicion_sweep(state: SparseState, params: SparseParams):
         any_exp = mine.any(axis=1)
         col = jnp.argmax(mine, axis=1).astype(jnp.int32)
         key = new_key[rows, col]
-        return (
+        out = (
             st.replace(
                 view_key=new_key, n_live=n_live, sus_key=sus_key,
                 sus_since=sus_since,
             ),
             (col, key, rows, any_exp),
         )
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            return out + (_tc.expiry_trace(expired, trace),)
+        return out
 
     def _skip(st: SparseState):
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            return st, no_props, _tc.zero_sus_trace(trace)
         return st, no_props
 
     # cheap gate: no registered episode young enough to matter -> skip scan
@@ -1554,7 +1578,7 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
     return jax.lax.cond(work, _deliver, _quiet, state)
 
 
-def _sync_phase(state: SparseState, r, params: SparseParams):
+def _sync_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
     """Anti-entropy full-table exchange — the dense kernel's compacted-K
     design (O(K·N)), minus ``changed_at``, plus liveness-delta upkeep,
     episode registration, and capped re-gossip proposals (deviation 3;
@@ -1753,7 +1777,18 @@ def _sync_phase(state: SparseState, r, params: SparseParams):
     proposals = tuple(
         jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
     )
-    return st, proposals, {"sync_roundtrips": ok.sum()}
+    metrics = {"sync_roundtrips": ok.sum()}
+    if trace:
+        # trace-plane export (r10, same contract as kernel._sync_phase)
+        metrics["trace_sync"] = {
+            "caller": caller.astype(jnp.int32),
+            "valid": valid_c,
+            "peer": peer.astype(jnp.int32),
+            "ok": ok,
+            "req_acc": acc.sum(axis=1).astype(jnp.int32),
+            "ack_acc": accept.sum(axis=1).astype(jnp.int32),
+        }
+    return st, proposals, metrics
 
 
 def _refute_phase(state: SparseState, params: SparseParams):
@@ -1959,8 +1994,14 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
 # ---------------------------------------------------------------------------
 
 
-def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
-    """One gossip period for all N members, sparse mode. Pure; jit/shard me."""
+def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=None):
+    """One gossip period for all N members, sparse mode. Pure; jit/shard me.
+
+    ``trace`` (a :class:`..trace.schema.TraceSpec`, static) arms the causal
+    trace plane — same contract as ``kernel.tick``: the metrics dict gains
+    a ``_trace_rows`` [K, F] block built from read-only [N]-sized phase
+    internals (never a read of the carried [N, N] planes); the state
+    trajectory is bit-identical armed vs unarmed."""
     state = state.replace(tick=state.tick + 1)
     fd_key, round_key = split_tick_key(key)
     r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
@@ -1976,21 +2017,28 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
 
     def _fd_on(st: SparseState):
         fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
-        return _fd_phase(st, fd_r, params)
+        return _fd_phase(st, fd_r, params, trace=trace is not None)
 
     def _fd_off(st: SparseState):
-        return st, no_props, {
+        m = {
             "fd_probes": jnp.int32(0),
             "fd_failed_probes": jnp.int32(0),
             "fd_new_suspects": jnp.int32(0),
         }
+        if trace is not None:
+            from ..trace import capture as _tc
 
-    state, props_fd, fd_m = jax.lax.cond(
-        (state.tick % params.fd_every) == 0, _fd_on, _fd_off, state
-    )
-    state, props_exp = _suspicion_sweep(state, params)
+            m["trace_fd"] = _tc.zero_fd_trace(n, params.ping_req_k)
+        return st, no_props, m
+
+    fd_ran = (state.tick % params.fd_every) == 0
+    state, props_fd, fd_m = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
+    if trace is not None:
+        state, props_exp, trace_sus = _suspicion_sweep(state, params, trace=trace)
+    else:
+        state, props_exp = _suspicion_sweep(state, params)
     state, g_m = _gossip_phase(state, r, params)
-    state, props_sync, s_m = _sync_phase(state, r, params)
+    state, props_sync, s_m = _sync_phase(state, r, params, trace=trace is not None)
     state, props_ref = _refute_phase(state, params)
     state = _rumor_sweeps(state, params)
     # allocation compaction takes the first E valid proposals in this order:
@@ -2000,6 +2048,36 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
         state, (props_fd, props_exp, props_ref, props_sync), params
     )
 
+    trace_fd = fd_m.pop("trace_fd", None)
+    trace_sync = s_m.pop("trace_sync", None)
+    metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    if trace is not None:
+        from ..trace import capture as _tc
+
+        # self-refutations ride the refute phase's own proposal mask (the
+        # eff throttle — exactly the rows whose diagonal re-announced)
+        trace_ref = props_ref[3][jnp.asarray(trace.tracer_rows, jnp.int32)]
+        metrics["_trace_rows"] = _tc.build_trace_rows(
+            trace,
+            tick=state.tick,
+            up=state.up,
+            fd_ran=fd_ran,
+            trace_fd=trace_fd,
+            trace_sus=trace_sus,
+            trace_ref=trace_ref,
+            trace_sync=trace_sync,
+            infected_b=state.infected,
+            infected_at=state.infected_at,
+            infected_from=state.infected_from,
+        )
+    return state, metrics
+
+
+def state_metrics(state: SparseState, params: SparseParams) -> dict:
+    """The sparse tick's state-derived health metrics — factored out (r10)
+    so the phase-split profiler's "telemetry" phase runs the EXACT spelling
+    the fused tick uses (see ``kernel.state_metrics``)."""
+    n = state.capacity
     coverage = (
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
         / jnp.maximum(state.up.sum(), 1)
@@ -2037,10 +2115,6 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
         state,
     )
     metrics = {
-        **fd_m,
-        **g_m,
-        **s_m,
-        **a_m,
         "n_up": state.up.sum(),
         "mr_active_count": state.mr_active.sum(),
         "rumor_coverage": coverage,
@@ -2058,7 +2132,7 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams):
     else:
         metrics["alive_view_fraction"] = jnp.float32(0.0)
         metrics["false_suspect_pairs"] = jnp.int32(0)
-    return state, metrics
+    return metrics
 
 
 def run_sparse_ticks(
@@ -2083,6 +2157,54 @@ def run_sparse_ticks(
     (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
     watched = ms.pop("_watched_keys") if watch_rows is not None else None
     return state, key, ms, watched
+
+
+def run_sparse_ticks_traced(
+    state: SparseState,
+    key: jax.Array,
+    trace_buf: jax.Array,
+    trace_cursor: jax.Array,
+    n_ticks: int,
+    params: SparseParams,
+    trace,
+    watch_rows: jax.Array | None = None,
+):
+    """Trace-armed window scan — the sparse twin of
+    ``kernel.run_ticks_traced`` (same carry-threaded ring append, same
+    bit-identical-trajectory contract)."""
+    from ..trace import capture as _tc
+
+    def body(carry, _):
+        st, k, buf, cur = carry
+        k, tick_key = jax.random.split(k)
+        st, m = sparse_tick(st, tick_key, params, trace=trace)
+        buf, cur = _tc.append_rows(
+            buf, cur, m.pop("_trace_rows"), trace.ring_len
+        )
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, k, buf, cur), m
+
+    (state, key, trace_buf, _cur), ms = jax.lax.scan(
+        body, (state, key, trace_buf, trace_cursor), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched, trace_buf
+
+
+def make_sparse_traced_run(
+    params: SparseParams, n_ticks: int, trace, donate: bool = True
+):
+    """Jitted :func:`run_sparse_ticks_traced` with state + trace ring
+    donated (argnums 0, 2) — see ``kernel.make_traced_run``."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            run_sparse_ticks_traced, n_ticks=n_ticks, params=params, trace=trace
+        ),
+        donate_argnums=(0, 2) if donate else (),
+    )
 
 
 def make_sparse_run(params: SparseParams, n_ticks: int, donate: bool = True):

@@ -286,6 +286,11 @@ class SimDriver:
         # None = unarmed (the plane is a pure consumer — arming must never
         # perturb the trajectory or add per-window transfers)
         self._telemetry = None
+        # armed causal trace plane (r10, trace.TracePlane): protocol span
+        # capture into a donated device ring, threaded through the traced
+        # window programs; None = unarmed. Same neutrality contract as
+        # telemetry: bit-identical trajectory, zero per-window readbacks.
+        self._trace = None
         # host-side tick shadow: lets bus records and flight dumps stamp the
         # current tick WITHOUT a device read (step() advances it; restore
         # re-seeds it from the checkpoint's host-visible tick plane)
@@ -322,10 +327,24 @@ class SimDriver:
         host dispatch costs a device round trip each, which on a tunneled
         TPU dwarfs the tick itself. Watched rows' view keys come back
         stacked per tick so membership events for the window are diffed
-        from a single transfer."""
-        cache_key = (n_ticks, n_watch)
+        from a single transfer. An armed trace plane (r10) keys separate
+        TRACED window programs — same trajectory, ring threaded through."""
+        traced = self._trace is not None
+        cache_key = (n_ticks, n_watch, traced)
         if cache_key not in self._step_cache:
-            if self.mesh is not None:
+            if traced:
+                spec = self._trace.spec
+                if self.sparse:
+                    from ..ops import sparse as _sparse
+
+                    self._step_cache[cache_key] = _sparse.make_sparse_traced_run(
+                        self.params, n_ticks, spec
+                    )
+                else:
+                    self._step_cache[cache_key] = _kernel.make_traced_run(
+                        self.params, n_ticks, spec
+                    )
+            elif self.mesh is not None:
                 from ..ops.sharding import make_sharded_run, make_sharded_sparse_run
 
                 self._step_cache[cache_key] = (
@@ -368,11 +387,27 @@ class SimDriver:
         rows = sorted(self._watches)
         watch_arr = jnp.asarray(rows, dtype=jnp.int32) if rows else None
         step = self._get_step(n_ticks, len(rows))
-        stats = self._step_stats[(n_ticks, len(rows))]
+        stats = self._step_stats[(n_ticks, len(rows), self._trace is not None)]
         t0 = time.perf_counter()
-        self.state, self._key, ms, watched = step(
-            self.state, self._key, watch_rows=watch_arr
-        )
+        if self._trace is not None:
+            # traced window: the trace ring rides the donated carry; the
+            # cursor upload is host→device (never a readback) and the host
+            # mirror advances by the static K·n_ticks append count
+            ring = self._trace.ring
+            self.state, self._key, ms, watched, ring.buf = step(
+                self.state, self._key, ring.buf, ring.device_cursor(),
+                watch_rows=watch_arr,
+            )
+            ring.advance(self._trace.spec.n_tracers * n_ticks)
+            # window-boundary summary: the view-column dissemination diff,
+            # appended as FLAG_SUMMARY records (pure device ops — the r8
+            # on_window pattern; the diff must NOT live inside the window
+            # jit, see trace/capture.py)
+            self._trace.on_window(self.state)
+        else:
+            self.state, self._key, ms, watched = step(
+                self.state, self._key, watch_rows=watch_arr
+            )
         dispatch_s = time.perf_counter() - t0
         if stats["calls"] == 0:
             # first dispatch = trace + compile (or persistent-cache load)
@@ -552,7 +587,8 @@ class SimDriver:
         with self._lock:  # _step_stats mutates under the lock in step()
             programs = [
                 {
-                    "n_ticks": k[0], "n_watch": k[1], "calls": v["calls"],
+                    "n_ticks": k[0], "n_watch": k[1], "traced": k[2],
+                    "calls": v["calls"],
                     "first_dispatch_s": v["first_dispatch_s"],
                 }
                 for k, v in sorted(self._step_stats.items())
@@ -961,6 +997,10 @@ class SimDriver:
             }
         if self._chaos is not None:
             out["chaos"] = self._chaos.snapshot()
+        if self._trace is not None:
+            # host-only counters (cursor arithmetic) — the ring itself is
+            # NOT read here; /trace is the ring's sync point
+            out["trace"] = self._trace.stats()
         return out
 
     def enable_health_probes(self) -> None:
@@ -1003,6 +1043,50 @@ class SimDriver:
         """The armed :class:`..telemetry.TelemetryPlane`, or None."""
         return self._telemetry
 
+    # -- causal trace plane (r10: span capture + Perfetto export) -------------
+    def arm_trace(self, config=None, tracer_rows=None, rumor_slots=None):
+        """Arm the causal trace plane; returns the
+        :class:`..trace.TracePlane`. ``config`` is a
+        :class:`..config.ClusterConfig` or :class:`..config.TraceConfig`
+        (None = defaults: the first ``TraceConfig.tracers`` rows);
+        ``tracer_rows`` / ``rumor_slots`` override the config's sampling.
+
+        Arming swaps the window programs for the traced builders: every
+        tick appends one [K, n_fields] int32 record block to the donated
+        device trace ring INSIDE the window jit. The trajectory stays
+        bit-identical to an unarmed driver and steady-state ``step()``
+        stays transfer-free (tests/test_trace.py holds both); ring reads
+        happen only at sync points (``/trace`` scrape, flight dump,
+        :meth:`..trace.TracePlane.snapshot`)."""
+        from ..config import ClusterConfig
+        from ..trace.plane import TracePlane
+
+        with self._lock:
+            if self._trace is not None:
+                return self._trace
+            if self.mesh is not None:
+                raise ValueError(
+                    "trace capture is single-device for now — arm on an "
+                    "unsharded driver (the ring append is row-global)"
+                )
+            if isinstance(config, ClusterConfig):
+                config = config.trace
+            self._trace = TracePlane(
+                self, config=config, tracer_rows=tracer_rows,
+                rumor_slots=rumor_slots,
+            )
+            self._publish(
+                "driver", "trace_armed",
+                tracers=list(self._trace.spec.tracer_rows),
+                rumor_slots=list(self._trace.spec.rumor_slots),
+            )
+            return self._trace
+
+    @property
+    def trace(self):
+        """The armed :class:`..trace.TracePlane`, or None."""
+        return self._trace
+
     def _publish(self, source: str, kind: str, **fields) -> None:
         """Emit one host-side lifecycle record onto the armed telemetry bus
         (no-op when unarmed; never touches the device)."""
@@ -1019,6 +1103,7 @@ class SimDriver:
         config=None,
         sentinels: bool = True,
         max_window: int = 32,
+        trace: bool = False,
     ) -> dict:
         """Run a :class:`..chaos.Scenario` against this driver: scripted
         fault events applied between windows (partitions, loss storms, link
@@ -1028,12 +1113,18 @@ class SimDriver:
         ops); the returned structured report is the one sync point. The
         same scenario object runs unmodified on the dense, sparse, and
         mesh-sharded drivers, and on the scalar engine via
-        :class:`..chaos.EmulatorChaosRunner`."""
+        :class:`..chaos.EmulatorChaosRunner`.
+
+        ``trace=True`` auto-attaches the causal trace plane (r10) before
+        arming: the scenario's crashed rows become tracer members (an
+        already-armed plane is reused as-is), so sentinel violations — and
+        successful detections — resolve to sewn probe-miss → suspect →
+        DEAD span trees in the report."""
         from ..chaos.engine import run_driver_scenario
 
         return run_driver_scenario(
             self, scenario, config=config, sentinels=sentinels,
-            max_window=max_window,
+            max_window=max_window, trace=trace,
         )
 
     def chaos_snapshot(self) -> dict:
@@ -1234,6 +1325,12 @@ class SimDriver:
                 else shard_state(state, self.mesh)
             )
         self.state = state
+        # reset the trace plane: clear the ring (decode orders records by
+        # tick, so records from the abandoned timeline would sew into the
+        # restored one as phantom lineage) and re-baseline the
+        # window-boundary column mirror
+        if self._trace is not None:
+            self._trace.on_restore(state)
         # re-baseline watches so restore doesn't emit phantom events
         for w in self._watches.values():
             w.prev_key = np.asarray(self.state.view_key[w.row])

@@ -36,12 +36,19 @@ def write_flight_dump(
     ring_snapshot: dict,
     bus_tail: List[dict],
     context: Optional[dict] = None,
+    trace: Optional[dict] = None,
 ) -> str:
     """Atomically write one flight artifact; returns the final path.
 
     Crash-safe exactly like ``SimDriver.checkpoint``: mkstemp in the target
     directory (concurrent dumps never truncate each other), fsync, then one
-    ``os.replace`` — the artifact either fully exists or not at all."""
+    ``os.replace`` — the artifact either fully exists or not at all.
+
+    ``trace`` (r10) is the causal-trace section an armed trace plane
+    contributes (``TracePlane.flight_section``): the trace-ring tail plus
+    the sewn span tree for each violating member — post-mortems carry
+    causality, not just the how-much series. Optional, so pre-r10 dumps
+    and unarmed drivers keep the schema (readers treat it as absent)."""
     rows = ring_snapshot["rows"]
     doc = {
         "_schema": FLIGHT_SCHEMA,
@@ -56,6 +63,8 @@ def write_flight_dump(
         "events": list(bus_tail),
         "context": context or {},
     }
+    if trace is not None:
+        doc["trace"] = trace
     target = os.path.abspath(path)
     fd, tmp = tempfile.mkstemp(
         prefix=os.path.basename(target) + ".tmp-",
@@ -136,6 +145,12 @@ def replay_timeline(dump: dict) -> List[str]:
         f"ring: {len(dump['ring']['rows'])} window(s) of "
         f"{len(names)} series; {len(dump['events'])} bus event(s)",
     ]
+    if dump.get("trace"):
+        tr = dump["trace"]
+        header.append(
+            f"trace: {len(tr.get('rows', []))} ring record(s), span trees "
+            f"for {sorted(tr.get('span_trees', {}))}"
+        )
     if dump.get("context"):
         header.append(f"context: {json.dumps(dump['context'], sort_keys=True)}")
     return header + [line for _, _, line in sorted(entries, key=lambda e: (e[0], e[1]))]

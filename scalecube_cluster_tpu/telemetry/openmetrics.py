@@ -125,6 +125,18 @@ def _bus_families(bus) -> List[dict]:
             "Telemetry-bus records evicted by the bounded retention.",
             [(f"{PREFIX}_bus_evicted_total", {}, stats["evicted"])],
         ),
+        # r10 satellite: the bus's bounded-retention state as GAUGES — the
+        # eviction counter alone can't tell "about to drop" from "idle"
+        family(
+            f"{PREFIX}_bus_retained", "gauge",
+            "Telemetry-bus records currently retained.",
+            [(f"{PREFIX}_bus_retained", {}, stats["retained"])],
+        ),
+        family(
+            f"{PREFIX}_bus_capacity", "gauge",
+            "Telemetry-bus bounded retention capacity.",
+            [(f"{PREFIX}_bus_capacity", {}, stats["capacity"])],
+        ),
     ]
 
 
@@ -210,6 +222,53 @@ def driver_families(driver, plane) -> List[dict]:
             [(f"{PREFIX}_ring_windows_total", base, plane.ring.windows)],
         )
     )
+    # r10 satellite: device-ring cursor position + wrap count as gauges
+    # (host-side cursor arithmetic — the scrape does not touch the device
+    # for these; how much retained history a flight dump would carry)
+    fams.append(
+        family(
+            f"{PREFIX}_ring_cursor", "gauge",
+            "Device metric-ring write cursor (next row index).",
+            [(f"{PREFIX}_ring_cursor", base,
+              plane.ring.windows % plane.ring.ring_len)],
+        )
+    )
+    fams.append(
+        family(
+            f"{PREFIX}_ring_wraps_total", "counter",
+            "Times the device metric ring lapped itself (history overwritten).",
+            [(f"{PREFIX}_ring_wraps_total", base,
+              plane.ring.windows // plane.ring.ring_len)],
+        )
+    )
+    tplane = getattr(driver, "_trace", None)
+    if tplane is not None:
+        # counters use the LIFETIME totals (monotone across the
+        # restore-path ring clear — a decreasing counter corrupts
+        # Prometheus rate()/increase() over the restore boundary)
+        fams.append(
+            family(
+                f"{PREFIX}_trace_records_total", "counter",
+                "Records appended to the device trace ring (lifetime).",
+                [(f"{PREFIX}_trace_records_total", base,
+                  tplane.ring.records_total)],
+            )
+        )
+        fams.append(
+            family(
+                f"{PREFIX}_trace_ring_cursor", "gauge",
+                "Device trace-ring write cursor (next record index).",
+                [(f"{PREFIX}_trace_ring_cursor", base, tplane.ring.cursor)],
+            )
+        )
+        fams.append(
+            family(
+                f"{PREFIX}_trace_ring_wraps_total", "counter",
+                "Times the device trace ring lapped itself (lifetime).",
+                [(f"{PREFIX}_trace_ring_wraps_total", base,
+                  tplane.ring.wraps_total)],
+            )
+        )
     for hname, hist, help_ in (
         ("window_dispatch_seconds", plane.hist_dispatch,
          "Host wall time to enqueue one jitted window."),

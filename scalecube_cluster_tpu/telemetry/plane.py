@@ -156,6 +156,18 @@ class TelemetryPlane:
         with self.driver._lock:
             snap = self.ring.snapshot(self.config.flight_windows)
         self.driver._note_readback(1)
+        # r10: an armed trace plane contributes the causal section — the
+        # trace-ring tail + sewn span trees for the violating members (rows
+        # of failed detection obligations in the context, when present)
+        trace_doc = None
+        tplane = getattr(self.driver, "_trace", None)
+        if tplane is not None:
+            sent = (context or {}).get("sentinels") or {}
+            bad = [
+                det["row"] for det in sent.get("detections", ())
+                if not det.get("ok", True)
+            ] or list(tplane.spec.tracer_rows)
+            trace_doc = tplane.flight_section(bad)
         target = path or default_dump_path(self.config.flight_dir, reason)
         out = write_flight_dump(
             target,
@@ -164,6 +176,7 @@ class TelemetryPlane:
             ring_snapshot=snap,
             bus_tail=[r.as_dict() for r in self.bus.tail()],
             context=context,
+            trace=trace_doc,
         )
         self.flight_dumps.append(out)
         return out

@@ -25,6 +25,25 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 
+def ring_tail(buf, appended: int, ring_len: int, k: Optional[int] = None):
+    """Oldest-first unroll of a circular buffer's newest ``k`` rows — THE
+    wrap-ordering spelling, shared by the metric ring and the r10 trace
+    ring (``trace/rings.py``) so the two cannot drift. ``appended`` is the
+    total rows ever written (cursor = appended % ring_len). Reading ``buf``
+    is the caller's sync point; callers hold the driver lock."""
+    have = min(appended, ring_len)
+    k = have if k is None else min(int(k), have)
+    if k <= 0:  # empty read: no device transfer
+        return np.zeros((0,) + tuple(buf.shape[1:]), buf.dtype)
+    host = np.asarray(buf)
+    if appended >= ring_len:  # wrapped: unroll from the cursor
+        cursor = appended % ring_len
+        ordered = np.concatenate([host[cursor:], host[:cursor]], axis=0)
+    else:
+        ordered = host[:have]
+    return ordered[-k:]
+
+
 class MetricRing:
     """Circular [ring_len, n_metrics] f32 device buffer of per-window rows.
 
@@ -71,17 +90,7 @@ class MetricRing:
     def last(self, k: Optional[int] = None) -> np.ndarray:
         """The most recent ``k`` rows (default: all retained), OLDEST first —
         one coalesced device→host transfer (the sync point)."""
-        have = min(self._windows, self.ring_len)
-        k = have if k is None else min(int(k), have)
-        if k <= 0:
-            return np.zeros((0, len(self.names)), np.float32)
-        buf = np.asarray(self._buf)
-        if self._windows >= self.ring_len:  # wrapped: unroll from the cursor
-            cursor = self._windows % self.ring_len
-            ordered = np.concatenate([buf[cursor:], buf[:cursor]], axis=0)
-        else:
-            ordered = buf[:have]
-        return ordered[-k:]
+        return ring_tail(self._buf, self._windows, self.ring_len, k)
 
     def snapshot(self, k: Optional[int] = None) -> Dict[str, object]:
         """Host view of the ring: column names + the last ``k`` rows in

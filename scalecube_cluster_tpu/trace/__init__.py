@@ -1,0 +1,33 @@
+"""Causal trace plane (r10): on-device protocol span capture, tick-phase
+profiling, and Perfetto/OTel export for the lockstep tensor engines.
+
+Import surface is kept LIGHT on purpose: the tick kernels import
+:mod:`.capture` from inside jitted code paths, so this ``__init__`` must
+not drag in the driver-facing modules (plane/profile) — those load lazily.
+
+* :mod:`.schema`  — ``TraceSpec`` + the ring record layout + host decode.
+* :mod:`.capture` — the device-side [K, F] record builder both engines call.
+* :mod:`.rings`   — the donated device trace ring (host cursor).
+* :mod:`.spans`   — sew records into detection lineages + rumor trees.
+* :mod:`.export`  — Chrome-trace/Perfetto JSON + OTel-style span dicts.
+* :mod:`.plane`   — ``TracePlane``: the armed state of one driver.
+* :mod:`.profile` — phase-split window profiler (FD/gossip/SYNC/... wall
+  timings + ``jax.profiler`` annotations).
+"""
+
+from .schema import TraceSpec, decode_record, decode_records
+
+__all__ = [
+    "TraceSpec",
+    "decode_record",
+    "decode_records",
+    "TracePlane",
+]
+
+
+def __getattr__(name):
+    if name == "TracePlane":
+        from .plane import TracePlane
+
+        return TracePlane
+    raise AttributeError(name)

@@ -1,0 +1,301 @@
+"""Device-side trace-record builders, shared by BOTH tick engines.
+
+Two record paths, split by COST STRUCTURE (measured, not aesthetic):
+
+* **Per-tick rows** (:func:`build_trace_rows`, called inside the window
+  jit): everything derivable from [N]-sized phase internals the tick
+  already computed — FD probe picks/outcomes and verdict suspicions, the
+  suspicion sweep's expiry transitions (exported from the sweep branch's
+  own temp), self-refutations, SYNC caller outcomes, and per-slot rumor
+  first-infection exemplars. These add no measurable cost: no new
+  full-plane work, no extra consumers of the carried [N, N] planes.
+* **Per-window summary rows** (:func:`build_summary_rows`, run by the
+  driver OUTSIDE the window jit at the window boundary): the
+  window-over-window diff of the tracers' view-key COLUMNS — suspicion /
+  death dissemination across observers, observed refutations, running
+  totals. The diff lives outside the window program because ANY in-scan
+  consumer of the donated view plane (a column gather, even behind a
+  lax.cond) statically forces an extra full-plane materialization per
+  tick — measured at ~18% of the N=4096 CPU tick. At the window boundary
+  the read is the r8 telemetry-plane pattern (``on_window`` consuming the
+  post-window state), which config8/config10 measure as free.
+
+Everything is pure jnp on values the tick already computed: capture reads
+the trajectory, never feeds back into it, which is what makes the
+armed-vs-unarmed bit-identical lockstep provable rather than hoped
+(tests/test_trace.py pins it for both engines).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.lattice import RANK_ALIVE, RANK_DEAD, RANK_SUSPECT
+from .schema import (
+    FLAG_FD_ROUND,
+    FLAG_PROBE_ACK,
+    FLAG_PROBE_DIRECT,
+    FLAG_PROBE_SENT,
+    FLAG_SELF_REFUTED,
+    FLAG_SUMMARY,
+    FLAG_SYNC_DUE,
+    FLAG_SYNC_OK,
+    NO_ROW,
+    TraceSpec,
+)
+
+
+def zero_fd_trace(n: int, k: int) -> dict:
+    """Structure-matched zeros for the FD phase's off-tick ``lax.cond``
+    branch (no probes happened; every derived event decodes to nothing)."""
+    return {
+        "tgt": jnp.zeros((n,), jnp.int32),
+        "has_tgt": jnp.zeros((n,), bool),
+        "ack": jnp.zeros((n,), bool),
+        "direct_ok": jnp.zeros((n,), bool),
+        "suspect": jnp.zeros((n,), bool),
+        "relays": jnp.zeros((n, k), jnp.int32),
+        "relay_valid": jnp.zeros((n, k), bool),
+        "relay_ok": jnp.zeros((n, k), bool),
+    }
+
+
+def zero_sus_trace(spec: TraceSpec) -> dict:
+    """Zeros for the suspicion sweep's skip branch: no expiries."""
+    k = spec.n_tracers
+    return {
+        "count": jnp.zeros((k,), jnp.int32),
+        "by": jnp.full((k,), NO_ROW, jnp.int32),
+    }
+
+
+def expiry_trace(expired: jax.Array, spec: TraceSpec) -> dict:
+    """Per-tracer expiry export, computed INSIDE the sweep branch from its
+    already-materialized ``expired`` [N, N] temp (reading a branch temp is
+    free; reading the carried view plane is not — see the module note)."""
+    tr = jnp.asarray(spec.tracer_rows, jnp.int32)
+    cols = expired[:, tr]  # [N, K]
+    return {
+        "count": cols.sum(axis=0).astype(jnp.int32),
+        "by": _exemplar(cols),
+    }
+
+
+def gather_tracer_cols(view_key: jax.Array, spec: TraceSpec) -> jax.Array:
+    """The tracers' [N, K] view-key columns as i32 (narrow i16 keys are
+    widened so the diff math is layout-independent). Window-boundary use
+    ONLY — never call this inside the window jit (the cost note above)."""
+    tr = jnp.asarray(spec.tracer_rows, jnp.int32)
+    return view_key[:, tr].astype(jnp.int32)
+
+
+def _exemplar(mask: jax.Array) -> jax.Array:
+    """Lowest set row per column of an [N, K] mask (NO_ROW when empty) —
+    the deterministic exemplar the wide-row schema records when an event
+    class bursts past one observer per tick."""
+    n = mask.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    ex = jnp.where(mask, rows[:, None], n).min(axis=0)
+    return jnp.where(ex >= n, NO_ROW, ex).astype(jnp.int32)
+
+
+def build_trace_rows(
+    spec: TraceSpec,
+    *,
+    tick: jax.Array,
+    up: jax.Array,
+    fd_ran: jax.Array,
+    trace_fd: dict,
+    trace_sus: dict,
+    trace_ref: jax.Array,
+    trace_sync: dict,
+    infected_b: jax.Array,
+    infected_at: jax.Array,
+    infected_from: jax.Array,
+) -> jax.Array:
+    """One tick's [K, n_fields] int32 record block (see :mod:`.schema`).
+
+    ``trace_fd`` / ``trace_sus`` / ``trace_sync`` are the engines'
+    phase-internal exports; ``trace_ref`` the [K] self-refuted mask;
+    ``infected_*`` the post-tick rumor planes ([N, R] bool/i32).
+    """
+    k = spec.ping_req_k
+    tr = jnp.asarray(spec.tracer_rows, jnp.int32)  # [K]
+    K = spec.n_tracers
+    i32 = jnp.int32
+    zero_k = jnp.zeros((K,), i32)
+
+    # -- tracer as observer: the FD probe ------------------------------------
+    tgt = trace_fd["tgt"].astype(i32)
+    has_tgt = trace_fd["has_tgt"] & fd_ran
+    ack = trace_fd["ack"]
+    probe_sent = has_tgt[tr]
+    probe_tgt = jnp.where(probe_sent, tgt[tr], NO_ROW)
+    probe_ack = probe_sent & ack[tr]
+    probe_direct = probe_sent & trace_fd["direct_ok"][tr]
+    # vouch requests fire only when the direct ping failed (the reference's
+    # doPingReq path); a direct ack's relays were never asked
+    indirect = probe_sent & ~probe_direct
+    relay_rows = jnp.where(
+        indirect[:, None] & trace_fd["relay_valid"][tr],
+        trace_fd["relays"][tr].astype(i32),
+        NO_ROW,
+    )  # [K, k]
+    vouch_mask = jnp.where(
+        indirect[:, None] & trace_fd["relay_ok"][tr],
+        1 << jnp.arange(k, dtype=i32)[None, :],
+        0,
+    ).sum(axis=1).astype(i32)
+
+    # -- tracer as subject: probes + FD suspect verdicts about it ------------
+    probed = has_tgt[:, None] & (tgt[:, None] == tr[None, :])  # [N, K]
+    probed_by = probed.sum(axis=0).astype(i32)
+    miss = probed & ~ack[:, None]
+    probed_miss = miss.sum(axis=0).astype(i32)
+    probed_miss_by = _exemplar(miss)
+    sus_verdict = probed & trace_fd["suspect"][:, None]
+    new_suspect = sus_verdict.sum(axis=0).astype(i32)
+    new_suspect_by = _exemplar(sus_verdict)
+
+    # -- tracer as SYNC caller ------------------------------------------------
+    caller = trace_sync["caller"].astype(i32)
+    sync_valid = trace_sync["valid"]
+    m = (caller[None, :] == tr[:, None]) & sync_valid[None, :]  # [K, Ks]
+    sync_due = m.any(axis=1)
+    slot = jnp.argmax(m, axis=1)
+    sync_ok = sync_due & trace_sync["ok"][slot]
+    sync_peer = jnp.where(sync_ok, trace_sync["peer"].astype(i32)[slot], NO_ROW)
+    sync_req_acc = jnp.where(sync_ok, trace_sync["req_acc"].astype(i32)[slot], 0)
+    sync_ack_acc = jnp.where(sync_ok, trace_sync["ack_acc"].astype(i32)[slot], 0)
+
+    # -- header flags ---------------------------------------------------------
+    def _bit(cond, bit):
+        return jnp.where(cond, i32(bit), i32(0))
+
+    flags = (
+        _bit(fd_ran, FLAG_FD_ROUND)
+        + _bit(probe_sent, FLAG_PROBE_SENT)
+        + _bit(probe_ack, FLAG_PROBE_ACK)
+        + _bit(probe_direct, FLAG_PROBE_DIRECT)
+        + _bit(trace_ref & up[tr], FLAG_SELF_REFUTED)
+        + _bit(sync_due, FLAG_SYNC_DUE)
+        + _bit(sync_ok, FLAG_SYNC_OK)
+    )
+
+    fields = [
+        jnp.broadcast_to(tick.astype(i32), (K,)),
+        tr,
+        flags,
+        probe_tgt,
+        vouch_mask,
+    ]
+    fields += [relay_rows[:, s] for s in range(k)]
+    fields += [
+        probed_by,
+        probed_miss,
+        probed_miss_by,
+        new_suspect,
+        new_suspect_by,
+        zero_k,  # suspect_total: summary rows only
+        trace_sus["count"],
+        trace_sus["by"],
+        zero_k,  # dead_total: summary rows only
+        zero_k,  # refute_seen: summary rows only
+        sync_peer,
+        sync_req_acc,
+        sync_ack_acc,
+    ]
+
+    # -- traced rumor slots (slot-scoped; replicated across tracer rows) -----
+    for slot_id in spec.rumor_slots:
+        newly = infected_b[:, slot_id] & (infected_at[:, slot_id] == tick) & up
+        count = newly.sum().astype(i32)
+        node = _exemplar(newly[:, None])[0]
+        src = jnp.where(
+            node >= 0, infected_from[jnp.maximum(node, 0), slot_id], NO_ROW
+        ).astype(i32)
+        fields += [
+            jnp.broadcast_to(count, (K,)),
+            jnp.broadcast_to(node, (K,)),
+            jnp.broadcast_to(src, (K,)),
+        ]
+
+    assert len(fields) == spec.n_fields, (len(fields), spec.n_fields)
+    return jnp.stack(fields, axis=1)
+
+
+def build_summary_rows(
+    spec: TraceSpec,
+    tick: jax.Array,
+    up: jax.Array,
+    prev_cols: jax.Array,
+    now_cols: jax.Array,
+) -> jax.Array:
+    """One window-boundary [K, n_fields] summary block (FLAG_SUMMARY): the
+    view-column diff since the previous boundary — dissemination counts,
+    exemplars, and running totals. Runs OUTSIDE the window jit (driver
+    ``TracePlane.on_window``); transitions are captured no matter which
+    phase caused them, at window granularity."""
+    K = spec.n_tracers
+    i32 = jnp.int32
+    tr = jnp.asarray(spec.tracer_rows, i32)
+    zero_k = jnp.zeros((K,), i32)
+    no_row = jnp.full((K,), NO_ROW, i32)
+    up_col = up[:, None]
+
+    known_prev = prev_cols >= 0
+    known_now = now_cols >= 0
+    sus_prev = known_prev & ((prev_cols & 3) == RANK_SUSPECT)
+    sus_now = known_now & ((now_cols & 3) == RANK_SUSPECT)
+    dead_prev = known_prev & ((prev_cols & 3) == RANK_DEAD)
+    dead_now = known_now & ((now_cols & 3) == RANK_DEAD)
+    new_suspect = up_col & sus_now & ~sus_prev
+    new_dead = up_col & dead_now & ~dead_prev
+    refute_seen = (
+        up_col
+        & sus_prev
+        & known_now
+        & ((now_cols & 3) == RANK_ALIVE)
+        & (now_cols > prev_cols)
+    )
+
+    fields = [
+        jnp.broadcast_to(tick.astype(i32), (K,)),
+        tr,
+        jnp.full((K,), FLAG_SUMMARY, i32),
+        no_row,  # probe_tgt
+        zero_k,  # vouch_mask
+    ]
+    fields += [no_row for _ in range(spec.ping_req_k)]
+    fields += [
+        zero_k,  # probed_by
+        zero_k,  # probed_miss
+        no_row,  # probed_miss_by
+        new_suspect.sum(axis=0).astype(i32),
+        _exemplar(new_suspect),
+        (up_col & sus_now).sum(axis=0).astype(i32),
+        new_dead.sum(axis=0).astype(i32),
+        _exemplar(new_dead),
+        (up_col & dead_now).sum(axis=0).astype(i32),
+        refute_seen.sum(axis=0).astype(i32),
+        no_row,  # sync_peer
+        zero_k,  # sync_req_accepts
+        zero_k,  # sync_ack_accepts
+    ]
+    fields += [zero_k] * (3 * len(spec.rumor_slots))
+    assert len(fields) == spec.n_fields, (len(fields), spec.n_fields)
+    return jnp.stack(fields, axis=1)
+
+
+def append_rows(
+    buf: jax.Array, cursor: jax.Array, rows: jax.Array, ring_len: int
+) -> tuple[jax.Array, jax.Array]:
+    """Circular append of one [K, F] block at the cursor; returns (buf,
+    advanced cursor). Used both inside the window scan (device-carried
+    cursor) and by the driver's window-boundary summary append (host
+    cursor uploaded) — the HOST mirrors the count either way, so reading
+    the ring never needs a device round trip to find it."""
+    K = rows.shape[0]
+    idx = (cursor + jnp.arange(K, dtype=jnp.int32)) % ring_len
+    return buf.at[idx].set(rows), (cursor + K) % ring_len

@@ -1,0 +1,251 @@
+"""TracePlane: the armed causal-trace state of one :class:`..sim.SimDriver`.
+
+Arming (``SimDriver.arm_trace``) swaps the driver's window programs for the
+traced builders (``kernel.make_traced_run`` / ``sparse.make_sparse_traced_
+run``) — the state trajectory stays bit-identical and the steady-state
+``step()`` stays transfer-free (the ring is donated through the window;
+tests/test_trace.py holds both). Everything host-facing happens at SYNC
+POINTS under the driver lock, the r8 discipline: the per-window append
+donates the ring buffer, so an unsynchronized monitor-thread read would
+race into "Array has been deleted".
+
+Host surfaces:
+
+* :meth:`snapshot` / :meth:`events` / :meth:`sew` — ring readback, decode,
+  span sewing (``GET /trace``).
+* :meth:`detection_tree` — one subject's probe-miss → suspect → DEAD
+  lineage (what chaos sentinel violations resolve to).
+* :meth:`rumor_provenance` / :meth:`rumor_trees` — the full per-rumor
+  infection trees from the persistent ``infected_at`` / ``infected_from``
+  planes (one gather at the sync point — the ring carries per-tick
+  exemplars, the planes carry the complete tree).
+* :meth:`perfetto` — the Chrome-trace/Perfetto document (``GET
+  /trace/perfetto``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import TraceConfig
+from . import export as _export
+from . import spans as _spans
+from .rings import TraceRing
+from .schema import TraceSpec, decode_records
+
+
+class TracePlane:
+    """The armed trace state of one driver (``driver._trace``)."""
+
+    def __init__(
+        self,
+        driver,
+        config: Optional[TraceConfig] = None,
+        tracer_rows: Optional[Sequence[int]] = None,
+        rumor_slots: Optional[Sequence[int]] = None,
+    ):
+        cfg = config or TraceConfig()
+        cap = driver.params.capacity
+        if tracer_rows is None:
+            tracer_rows = tuple(cfg.tracer_rows) or tuple(
+                range(min(cfg.tracers, cap))
+            )
+        if rumor_slots is None:
+            rumor_slots = tuple(cfg.rumor_slots)
+        tracer_rows = tuple(int(r) for r in tracer_rows)
+        rumor_slots = tuple(int(s) for s in rumor_slots)
+        if any(not 0 <= r < cap for r in tracer_rows):
+            raise ValueError(f"tracer_rows out of range [0, {cap})")
+        if any(not 0 <= s < driver.params.rumor_slots for s in rumor_slots):
+            raise ValueError(
+                f"rumor_slots out of range [0, {driver.params.rumor_slots})"
+            )
+        self.config = cfg
+        self.driver = driver
+        self.spec = TraceSpec(
+            tracer_rows=tracer_rows,
+            rumor_slots=rumor_slots,
+            ring_len=cfg.ring_len,
+            ping_req_k=driver.params.ping_req_k,
+        )
+        self.ring = TraceRing(self.spec)
+        # window-boundary view-column mirror + summary programs (r10): the
+        # dissemination diff runs OUTSIDE the window jit — an in-scan read
+        # of the donated view plane costs a full extra materialization per
+        # tick (capture.py's module note), while this post-window read is
+        # the r8 on_window pattern, measured free.
+        import jax
+
+        from . import capture as _capture
+
+        spec = self.spec
+
+        def _summary(view_key, up, tick, prev_cols):
+            now = _capture.gather_tracer_cols(view_key, spec)
+            rows = _capture.build_summary_rows(spec, tick, up, prev_cols, now)
+            return rows, now
+
+        self._summary_fn = jax.jit(_summary)
+        self._append_fn = jax.jit(
+            lambda buf, rows, cur: _capture.append_rows(
+                buf, cur, rows, spec.ring_len
+            )[0],
+            donate_argnums=0,
+        )
+        self._cols = _capture.gather_tracer_cols(driver.state.view_key, spec)
+
+    # -- the per-window device path (called under the driver lock) -----------
+    def on_window(self, state) -> None:
+        """Fold one window boundary into the ring: the view-column diff
+        since the previous boundary as a FLAG_SUMMARY record block. Pure
+        device ops — zero device→host transfers."""
+        rows, self._cols = self._summary_fn(
+            state.view_key, state.up, state.tick, self._cols
+        )
+        self.ring.buf = self._append_fn(
+            self.ring.buf, rows, self.ring.device_cursor()
+        )
+        self.ring.advance(self.spec.n_tracers)
+
+    def reset_cols(self, state) -> None:
+        """Re-baseline the window-boundary mirror (driver restore: the old
+        columns belong to the abandoned timeline)."""
+        from . import capture as _capture
+
+        self._cols = _capture.gather_tracer_cols(state.view_key, self.spec)
+
+    def on_restore(self, state) -> None:
+        """Driver restore: clear the ring AND re-baseline the mirror — a
+        restored driver's tick counter rewinds, and decode orders records
+        by tick, so retained records from the abandoned timeline would sew
+        into the restored one as phantom lineage (the same class the
+        driver's watch re-baseline prevents)."""
+        self.ring.clear()
+        self.reset_cols(state)
+
+    # -- stats (host-only; no device touch) -----------------------------------
+    def stats(self) -> Dict:
+        return {
+            "tracer_rows": list(self.spec.tracer_rows),
+            "rumor_slots": list(self.spec.rumor_slots),
+            "ring_len": self.spec.ring_len,
+            "n_fields": self.spec.n_fields,
+            "records": self.ring.records,
+            "records_total": self.ring.records_total,
+            "cursor": self.ring.cursor,
+            "wraps": self.ring.wraps,
+            "ticks_retained": self.spec.ring_len // self.spec.n_tracers,
+        }
+
+    # -- sync points (driver lock + readback bookkeeping) ---------------------
+    def snapshot(self, k: Optional[int] = None) -> Dict:
+        """Raw ring readback, oldest first — THE trace-ring sync point."""
+        with self.driver._lock:
+            snap = self.ring.snapshot(k)
+        self.driver._note_readback(1)
+        return snap
+
+    def events(self, k: Optional[int] = None) -> List[Dict]:
+        """Decoded protocol events from the newest ``k`` records."""
+        return decode_records(self.snapshot(k)["rows"], self.spec)
+
+    def sew(self, k: Optional[int] = None) -> Dict:
+        """Events + every detection lineage the ring substantiates."""
+        return _spans.sew_trees(self.snapshot(k)["rows"], self.spec)
+
+    def detection_tree(self, subject: int, k: Optional[int] = None):
+        """The probe-miss → suspect → DEAD span tree of one tracer subject
+        (None when the ring holds no detection activity about it)."""
+        return _spans.detection_tree(self.events(k), subject)
+
+    # -- rumor provenance (persistent planes, one gather) ---------------------
+    def rumor_provenance(self, slot: int) -> Dict:
+        """The complete infection record of one traced slot from the
+        persistent planes: rows, arrival ticks, infecting edges."""
+        if slot not in self.spec.rumor_slots:
+            raise ValueError(f"slot {slot} is not traced ({self.spec.rumor_slots})")
+        d = self.driver
+        with d._lock:
+            st = d.state
+            inf_plane = getattr(st, "infected_bool", st.infected)
+            inf = np.asarray(inf_plane[:, slot])
+            at = np.asarray(st.infected_at[:, slot])
+            frm = np.asarray(st.infected_from[:, slot])
+            origin = int(np.asarray(st.rumor_origin[slot]))
+        d._note_readback(1)
+        rows = np.nonzero(inf)[0]
+        return {
+            "slot": int(slot),
+            "origin": origin,
+            "rows": [int(r) for r in rows],
+            "at": [int(a) for a in at[rows]],
+            "from": [int(f) for f in frm[rows]],
+        }
+
+    def rumor_trees(self) -> List[Dict]:
+        """Infection trees for every traced slot (empty slots excluded)."""
+        trees = []
+        for slot in self.spec.rumor_slots:
+            prov = self.rumor_provenance(slot)
+            if prov["rows"]:
+                trees.append(_spans.rumor_tree(
+                    prov["slot"], prov["origin"], prov["rows"], prov["at"],
+                    prov["from"],
+                ))
+        return trees
+
+    # -- monitor surfaces ------------------------------------------------------
+    def trace_snapshot(self, k: int = 256) -> Dict:
+        """``GET /trace``: stats + the newest ``k`` records decoded + sewn
+        detection lineages (JSON-ready)."""
+        sewn = self.sew(k)
+        return {
+            "armed": True,
+            **self.stats(),
+            "engine": "sparse" if self.driver.sparse else "dense",
+            "events": sewn["events"],
+            "detections": sewn["detections"],
+        }
+
+    def perfetto(self, k: Optional[int] = None, profile: Optional[Dict] = None) -> Dict:
+        """``GET /trace/perfetto``: the combined Chrome-trace document —
+        protocol span trees + rumor infection trees (+ an optional
+        phase-profiler timeline when the caller ran one)."""
+        sewn = self.sew(k)
+        return _export.chrome_trace(
+            span_trees=list(sewn["detections"].values()),
+            rumor_trees=self.rumor_trees(),
+            profile=profile,
+            tick_us=self.config.tick_us,
+        )
+
+    def otel_spans(self, k: Optional[int] = None) -> List[Dict]:
+        """OpenTelemetry-style span dicts for every sewn lineage."""
+        sewn = self.sew(k)
+        return _export.to_otel_spans(list(sewn["detections"].values()))
+
+    # -- flight-recorder section ----------------------------------------------
+    def flight_section(self, violating_rows: Sequence[int] = (),
+                       tail: int = 256) -> Dict:
+        """What a flight dump carries (r10 satellite): the trace-ring tail
+        (raw rows — replayable through :func:`..trace.schema
+        .decode_records`) plus the sewn span tree for each violating member
+        that is a tracer, so post-mortems carry causality."""
+        snap = self.snapshot(tail)
+        events = decode_records(snap["rows"], self.spec)
+        trees = {}
+        for row in violating_rows:
+            if row in self.spec.tracer_rows:
+                tree = _spans.detection_tree(events, int(row))
+                if tree is not None:
+                    trees[int(row)] = tree
+        return {
+            "fields": snap["fields"],
+            "records_total": snap["records"],
+            "rows": [[int(v) for v in r] for r in snap["rows"]],
+            "tracer_rows": list(self.spec.tracer_rows),
+            "rumor_slots": list(self.spec.rumor_slots),
+            "span_trees": trees,
+        }

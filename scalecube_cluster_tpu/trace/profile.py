@@ -1,0 +1,292 @@
+"""Tick-phase profiler: the window re-run as PHASE-SPLIT jits.
+
+The production window is one fused XLA program (``lax.scan`` over the whole
+tick) — maximally fast, observably opaque: when a window is slow there is
+no way to say WHICH protocol phase (FD selection, the gossip merge, SYNC's
+compacted exchange, the suspicion sweep, the telemetry reductions) paid
+for it. This module rebuilds the tick as a sequence of individually jitted
+phase programs — same helpers, same key chain, same op spellings (the
+metric tails are shared via ``kernel.state_metrics`` /
+``sparse.state_metrics``) — so the final state is BIT-IDENTICAL to the
+fused window while every phase gets:
+
+* a host wall-clock measurement (``block_until_ready`` per phase), and
+* a ``jax.profiler.TraceAnnotation`` scope, so a surrounding
+  ``jax.profiler.trace(...)`` capture shows the phases on the device
+  timeline under their protocol names.
+
+The split run is slower than the fused one (per-phase dispatch + lost
+cross-phase fusion — that is the price of the microscope and exactly why
+it is a MODE, not the production path); its per-phase shares are the
+honest decomposition of the split window, recorded as
+``TRACE_BENCH_r10.json``'s phase breakdown and renderable as a Perfetto
+timeline via :func:`..trace.export.profile_to_events`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, List, Tuple
+
+#: phase names in execution order, per engine (the sparse tick has the
+#: allocation-compaction "merge" phase the dense tick lacks)
+DENSE_PHASES = (
+    "rand", "fd", "suspicion", "gossip", "sync", "refute", "sweep",
+    "telemetry",
+)
+SPARSE_PHASES = (
+    "rand", "fd", "suspicion", "gossip", "sync", "refute", "sweep", "alloc",
+    "telemetry",
+)
+
+
+def _annotation(name: str):
+    try:
+        from jax.profiler import TraceAnnotation
+
+        return TraceAnnotation(f"scalecube/{name}")
+    except Exception:  # pragma: no cover - profiler API unavailable
+        return contextlib.nullcontext()
+
+
+class _Timer:
+    """Accumulates per-phase wall time + the flat event timeline."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.timeline: List[Dict] = []
+        self.t0 = time.perf_counter()
+
+    @contextlib.contextmanager
+    def phase(self, name: str, tick: int):
+        import jax
+
+        start = time.perf_counter()
+        with _annotation(name):
+            out = {}
+            yield out
+            jax.block_until_ready(out.get("v"))
+        dur = time.perf_counter() - start
+        self.totals[name] = self.totals.get(name, 0.0) + dur
+        self.timeline.append({
+            "phase": name, "tick": tick,
+            "start_s": round(start - self.t0, 7), "dur_s": round(dur, 7),
+        })
+
+
+def _dense_phase_fns(params) -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import kernel as K
+    from ..ops.rand import (
+        draw_fd_randoms,
+        draw_round_randoms,
+        split_tick_key,
+    )
+
+    def _rand(st, key):
+        key, tick_key = jax.random.split(key)
+        fd_key, round_key = split_tick_key(tick_key)
+        r = draw_round_randoms(round_key, st.capacity, params.fanout)
+        return st.replace(tick=st.tick + 1), key, fd_key, r
+
+    def _fd(st, fd_key):
+        def on(s):
+            fd_r = draw_fd_randoms(fd_key, s.capacity, params.ping_req_k)
+            return K._fd_phase(s, fd_r, params)
+
+        def off(s):
+            return s, {
+                "fd_probes": jnp.int32(0),
+                "fd_failed_probes": jnp.int32(0),
+                "fd_new_suspects": jnp.int32(0),
+            }
+
+        return jax.lax.cond((st.tick % params.fd_every) == 0, on, off, st)
+
+    return {
+        "rand": jax.jit(_rand),
+        "fd": jax.jit(_fd),
+        "suspicion": jax.jit(lambda st: K._suspicion_phase(st, params)),
+        "gossip": jax.jit(lambda st, r: K._gossip_phase(st, r, params)),
+        "sync": jax.jit(lambda st, r: K._sync_phase(st, r, params)),
+        "refute": jax.jit(K._refute_phase),
+        "sweep": jax.jit(lambda st: K._rumor_sweep(st, params)),
+        "telemetry": jax.jit(lambda st: K.state_metrics(st, params)),
+    }
+
+
+def _run_dense_tick(fns, timer: _Timer, state, key, t: int):
+    with timer.phase("rand", t) as o:
+        state, key, fd_key, r = fns["rand"](state, key)
+        o["v"] = (state, key, fd_key, r)
+    with timer.phase("fd", t) as o:
+        state, _fd_m = fns["fd"](state, fd_key)
+        o["v"] = state
+    with timer.phase("suspicion", t) as o:
+        state = fns["suspicion"](state)
+        o["v"] = state
+    with timer.phase("gossip", t) as o:
+        state, _g_m = fns["gossip"](state, r)
+        o["v"] = state
+    with timer.phase("sync", t) as o:
+        state, _s_m = fns["sync"](state, r)
+        o["v"] = state
+    with timer.phase("refute", t) as o:
+        state = fns["refute"](state)
+        o["v"] = state
+    with timer.phase("sweep", t) as o:
+        state = fns["sweep"](state)
+        o["v"] = state
+    with timer.phase("telemetry", t) as o:
+        metrics = fns["telemetry"](state)
+        o["v"] = metrics
+    return state, key
+
+
+def _sparse_phase_fns(params) -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import sparse as SP
+    from ..ops.rand import draw_sparse_fd, draw_sparse_round, split_tick_key
+
+    n = params.capacity
+
+    def _rand(st, key):
+        key, tick_key = jax.random.split(key)
+        fd_key, round_key = split_tick_key(tick_key)
+        r = draw_sparse_round(round_key, n, params.fanout, params.sample_tries)
+        return st.replace(tick=st.tick + 1), key, fd_key, r
+
+    def _fd(st, fd_key):
+        rows = jnp.arange(n)
+        no_props = (
+            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+            rows, jnp.zeros((n,), bool),
+        )
+
+        def on(s):
+            fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
+            return SP._fd_phase(s, fd_r, params)
+
+        def off(s):
+            return s, no_props, {
+                "fd_probes": jnp.int32(0),
+                "fd_failed_probes": jnp.int32(0),
+                "fd_new_suspects": jnp.int32(0),
+            }
+
+        return jax.lax.cond((st.tick % params.fd_every) == 0, on, off, st)
+
+    return {
+        "rand": jax.jit(_rand),
+        "fd": jax.jit(_fd),
+        "suspicion": jax.jit(lambda st: SP._suspicion_sweep(st, params)),
+        "gossip": jax.jit(lambda st, r: SP._gossip_phase(st, r, params)),
+        "sync": jax.jit(lambda st, r: SP._sync_phase(st, r, params)),
+        "refute": jax.jit(lambda st: SP._refute_phase(st, params)),
+        "sweep": jax.jit(lambda st: SP._rumor_sweeps(st, params)),
+        "alloc": jax.jit(lambda st, props: SP._alloc_phase(st, props, params)),
+        "telemetry": jax.jit(lambda st: SP.state_metrics(st, params)),
+    }
+
+
+def _run_sparse_tick(fns, timer: _Timer, state, key, t: int):
+    with timer.phase("rand", t) as o:
+        state, key, fd_key, r = fns["rand"](state, key)
+        o["v"] = (state, key, fd_key, r)
+    with timer.phase("fd", t) as o:
+        state, props_fd, _m = fns["fd"](state, fd_key)
+        o["v"] = (state, props_fd)
+    with timer.phase("suspicion", t) as o:
+        state, props_exp = fns["suspicion"](state)
+        o["v"] = (state, props_exp)
+    with timer.phase("gossip", t) as o:
+        state, _g_m = fns["gossip"](state, r)
+        o["v"] = state
+    with timer.phase("sync", t) as o:
+        state, props_sync, _s_m = fns["sync"](state, r)
+        o["v"] = (state, props_sync)
+    with timer.phase("refute", t) as o:
+        state, props_ref = fns["refute"](state)
+        o["v"] = (state, props_ref)
+    with timer.phase("sweep", t) as o:
+        state = fns["sweep"](state)
+        o["v"] = state
+    with timer.phase("alloc", t) as o:
+        state, _a_m = fns["alloc"](
+            state, (props_fd, props_exp, props_ref, props_sync)
+        )
+        o["v"] = state
+    with timer.phase("telemetry", t) as o:
+        metrics = fns["telemetry"](state)
+        o["v"] = metrics
+    return state, key
+
+
+def profile_ticks(
+    params, state, key, n_ticks: int, warmup_ticks: int = 1
+) -> Tuple[object, object, Dict]:
+    """Run ``n_ticks`` as phase-split jits; returns (state, key, result).
+
+    The phase sequence reproduces ``tick()`` / ``sparse_tick()`` exactly
+    (same helper functions, same key chain), so the returned state matches
+    the fused window's bit-for-bit — tests/test_trace.py pins it. The first
+    ``warmup_ticks`` compile every phase program and are EXCLUDED from the
+    per-phase totals and the wall measurement."""
+    from ..ops.sparse import SparseParams
+
+    sparse = isinstance(params, SparseParams)
+    fns = _sparse_phase_fns(params) if sparse else _dense_phase_fns(params)
+    run = _run_sparse_tick if sparse else _run_dense_tick
+    for t in range(warmup_ticks):
+        state, key = run(fns, _Timer(), state, key, t)
+    timer = _Timer()
+    wall0 = time.perf_counter()
+    for t in range(n_ticks):
+        state, key = run(fns, timer, state, key, t)
+    wall = time.perf_counter() - wall0
+    phase_sum = sum(timer.totals.values())
+    result = {
+        "engine": "sparse" if sparse else "dense",
+        "n": params.capacity,
+        "ticks": n_ticks,
+        "warmup_ticks": warmup_ticks,
+        "wall_s": round(wall, 6),
+        "phase_sum_s": round(phase_sum, 6),
+        # phase coverage of the measured window wall time — the acceptance
+        # gate holds this within 20% of 1.0 (the loop is phases + epsilon)
+        "phase_coverage": round(phase_sum / wall, 4) if wall else None,
+        "split_ticks_per_s": round(n_ticks / wall, 2) if wall else None,
+        "phases_s": {k: round(v, 6) for k, v in sorted(timer.totals.items())},
+        "phases_pct": {
+            k: round(100.0 * v / phase_sum, 2)
+            for k, v in sorted(timer.totals.items())
+        } if phase_sum else {},
+        "timeline": timer.timeline,
+    }
+    return state, key, result
+
+
+def profile_driver(driver, n_ticks: int = 32, warmup_ticks: int = 1) -> Dict:
+    """Profile one driver's window WITHOUT touching its live state: the
+    state and key are deep-copied (jax-owned copies — donation-safe) and
+    the phase-split run happens on the copies. Returns the result dict
+    (``timeline`` renders via :func:`.export.profile_to_events`)."""
+    import jax
+    import jax.numpy as jnp
+
+    if driver.mesh is not None:
+        raise ValueError("phase profiling is single-device (mesh unsupported)")
+    with driver._lock:
+        state = jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True), driver.state
+        )
+        key = jnp.array(driver._key, copy=True)
+    _st, _k, result = profile_ticks(
+        driver.params, state, key, n_ticks, warmup_ticks=warmup_ticks
+    )
+    return result

@@ -1,0 +1,94 @@
+"""The donated device trace ring: fixed-shape [ring_len, n_fields] int32.
+
+Same discipline as the r8 metric ring (``telemetry/rings.py``), scaled to
+K rows per TICK instead of one row per window: the buffer lives on device
+and is threaded THROUGH the traced window program (the scan body appends
+each tick's [K, F] block in place; the driver donates the buffer alongside
+the state), so an armed trace plane adds zero per-window device→host
+transfers. The cursor is HOST state — appends per window are a static
+``K * n_ticks``, so the host always knows where the ring stands without a
+device read; :meth:`last` / :meth:`snapshot` are the sync points.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .schema import TraceSpec
+
+
+class TraceRing:
+    """Host handle of the device trace buffer + its cursor arithmetic."""
+
+    def __init__(self, spec: TraceSpec):
+        import jax.numpy as jnp
+
+        self.spec = spec
+        self.buf = jnp.zeros((spec.ring_len, spec.n_fields), jnp.int32)
+        # records in the CURRENT timeline (cursor = records % ring_len);
+        # host state — advanced by the driver after each traced window
+        self.records = 0
+        # lifetime append count: MONOTONE (survives the restore-path
+        # clear()) — the /metrics counter source; a Prometheus counter
+        # must never decrease short of a process restart
+        self.records_total = 0
+
+    @property
+    def cursor(self) -> int:
+        return self.records % self.spec.ring_len
+
+    @property
+    def wraps(self) -> int:
+        """Times the current timeline's ring lapped itself."""
+        return self.records // self.spec.ring_len
+
+    @property
+    def wraps_total(self) -> int:
+        """Lifetime lap count (monotone — the /metrics counter source)."""
+        return self.records_total // self.spec.ring_len
+
+    def clear(self) -> None:
+        """Drop every retained record (fresh zeroed buffer, cursor 0) —
+        the restore path: records from an abandoned timeline must not sew
+        into the restored one. The lifetime totals keep counting."""
+        import jax.numpy as jnp
+
+        self.buf = jnp.zeros((self.spec.ring_len, self.spec.n_fields),
+                             jnp.int32)
+        self.records = 0
+
+    def device_cursor(self):
+        """The cursor as a device scalar for the next window's append
+        chain (an upload, never a readback)."""
+        import jax.numpy as jnp
+
+        return jnp.int32(self.cursor)
+
+    def advance(self, n_records: int) -> None:
+        self.records += int(n_records)
+        self.records_total += int(n_records)
+
+    def last(self, k: Optional[int] = None) -> np.ndarray:
+        """The newest ``k`` records (default: all retained), OLDEST first —
+        one coalesced device→host transfer through the shared
+        ``telemetry.rings.ring_tail`` unroll. Callers must hold the driver
+        lock (the per-window append donates this buffer)."""
+        from ..telemetry.rings import ring_tail
+
+        return np.asarray(
+            ring_tail(self.buf, self.records, self.spec.ring_len, k),
+            np.int32,
+        )
+
+    def snapshot(self, k: Optional[int] = None) -> Dict[str, object]:
+        return {
+            "fields": self.spec.field_names(),
+            "ring_len": self.spec.ring_len,
+            "records": self.records,
+            "records_total": self.records_total,
+            "cursor": self.cursor,
+            "wraps": self.wraps,
+            "rows": self.last(k),
+        }

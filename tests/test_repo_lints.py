@@ -10,6 +10,10 @@
 3. Plane-dtype lint (r9): no new full-width [N, N] bool/i32 plane
    allocation in ops/ bypassing ops/bitplane.py, and no float64 promotion
    in the packed reductions. Falsifiability-tested like the others.
+4. Host-callback lint (r10): no ``jax.debug.print`` / ``io_callback`` /
+   ``pure_callback`` / ``device_get`` inside ops/ tick paths — the
+   zero-transfer discipline made static instead of resting on the
+   transfer-spy tests alone. Falsifiability-tested like the others.
 """
 
 from __future__ import annotations
@@ -23,6 +27,8 @@ sys.path.insert(0, REPO)
 
 from tools.audit_pytest_markers import audit, registered_markers
 from tools.lint_donation_safety import lint_file, lint_tree
+from tools.lint_host_callbacks import lint_file as lint_callbacks_file
+from tools.lint_host_callbacks import lint_tree as lint_callbacks_tree
 from tools.lint_plane_dtypes import lint_file as lint_planes_file
 from tools.lint_plane_dtypes import lint_tree as lint_planes_tree
 
@@ -100,6 +106,45 @@ def test_plane_lint_catches_the_bypass_class(tmp_path):
     findings = lint_planes_file(str(bad))
     assert len(findings) == 3, "\n".join(str(f) for f in findings)
     assert {f.function for f in findings} == {"alloc", "reduce_bad"}
+
+
+def test_ops_tick_paths_have_no_host_callbacks():
+    """The zero-transfer discipline, statically: nothing in ops/ calls a
+    host-callback escape hatch (jax.debug.print / io_callback /
+    pure_callback / device_get) — the transfer-spy tests would miss these
+    because they transfer without touching np.asarray."""
+    findings = lint_callbacks_tree(
+        os.path.join(REPO, "scalecube_cluster_tpu", "ops")
+    )
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_host_callback_lint_catches_the_escape_hatches(tmp_path):
+    """Falsifiability: every spelled escape hatch is flagged (qualified and
+    from-imported), the suppression comment works, and plain jnp calls
+    pass clean."""
+    bad = tmp_path / "bad_tick.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import io_callback
+        from jax import pure_callback
+
+        def _phase(state):
+            jax.debug.print("tick {}", state.tick)          # flagged
+            io_callback(print, None, state.tick)            # flagged
+            pure_callback(lambda x: x, state.tick, state.tick)  # flagged
+            v = jax.device_get(state.tick)                  # flagged
+            return state, v
+
+        def _fine(state):
+            x = jnp.where(state.up, 1, 0)
+            jax.debug.print("ok {}", x)  # lint: allow-host-callback
+            return x.sum()
+    """))
+    findings = lint_callbacks_file(str(bad))
+    assert len(findings) == 4, "\n".join(str(f) for f in findings)
+    assert {f.function for f in findings} == {"_phase"}
 
 
 def test_marker_audit_is_clean():

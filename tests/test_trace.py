@@ -1,0 +1,613 @@
+"""Causal trace plane (r10) acceptance suite.
+
+1. NEUTRALITY — a trace-armed driver is BIT-IDENTICAL in state lockstep
+   with an unarmed one (dense AND sparse): capture reads phase internals
+   and column diffs, never feeds back into the tick.
+2. ZERO ADDED TRANSFERS — the r6/r8 transfer-spy proof extended: an armed
+   trace plane's step() performs no device→host transfers; the /trace
+   scrape, span sewing, and flight dumps are the sync points.
+3. CAUSAL SEWING — a chaos Crash scenario yields the probe-miss →
+   suspect → DEAD detection-lineage span tree for the crashed tracer, and
+   a traced rumor's full infection tree sews from the provenance planes.
+4. PERFETTO EXPORT — the Chrome-trace JSON loads under ``json.load`` with
+   well-formed ph/ts/dur fields.
+5. PHASE PROFILER — the phase-split window reproduces the fused window's
+   final state bit-for-bit, and per-phase times sum to within 20% of the
+   measured (split) window wall time.
+6. Satellites — /trace + /trace/perfetto endpoints, bus/ring gauges on
+   /metrics with grammar coverage, concurrent scrape-while-ticking
+   stress, and trace-carrying flight dumps on forced violations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import scalecube_cluster_tpu.ops.sparse as SP
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.chaos import Crash, Scenario
+from scalecube_cluster_tpu.config import ClusterConfig, TraceConfig
+from scalecube_cluster_tpu.ops import kernel as K
+from scalecube_cluster_tpu.sim.driver import SimDriver
+from scalecube_cluster_tpu.telemetry.flight import load_flight_dump, replay_timeline
+from scalecube_cluster_tpu.trace.rings import TraceRing
+from scalecube_cluster_tpu.trace.schema import (
+    FLAG_PROBE_SENT,
+    TraceSpec,
+    decode_records,
+)
+from scalecube_cluster_tpu.trace import spans as trace_spans
+
+from test_telemetry import _assert_valid_exposition
+
+
+def _dense_params(n=32, **kw):
+    kw.setdefault("fd_every", 2)
+    kw.setdefault("sync_every", 10)
+    kw.setdefault("suspicion_mult", 2)
+    kw.setdefault("repeat_mult", 2)
+    kw.setdefault("rumor_slots", 4)
+    kw.setdefault("seed_rows", (0,))
+    return S.SimParams(capacity=n, **kw)
+
+
+def _sparse_params(n=32, **kw):
+    kw.setdefault("fd_every", 2)
+    kw.setdefault("sync_every", 10)
+    kw.setdefault("suspicion_mult", 2)
+    kw.setdefault("repeat_mult", 2)
+    kw.setdefault("rumor_slots", 4)
+    kw.setdefault("sweep_every", 4)
+    kw.setdefault("seed_rows", (0,))
+    return SP.SparseParams(capacity=n, **kw)
+
+
+def _assert_states_equal(a, b):
+    for f in dataclasses.fields(type(a)):
+        va = np.asarray(getattr(a, f.name))
+        vb = np.asarray(getattr(b, f.name))
+        assert np.array_equal(va, vb), f"state field {f.name} diverged"
+
+
+# ---------------------------------------------------------------------------
+# 0. schema + config
+# ---------------------------------------------------------------------------
+
+
+def test_trace_spec_schema_is_consistent():
+    spec = TraceSpec(tracer_rows=(3, 9), rumor_slots=(0, 2), ring_len=64,
+                     ping_req_k=3)
+    names = spec.field_names()
+    assert len(names) == spec.n_fields == len(set(names))
+    assert names[spec.relay_field(1)] == "vouch_relay1"
+    assert names[spec.subject_field("new_dead")] == "new_dead"
+    assert names[spec.sync_field("sync_peer")] == "sync_peer"
+    assert names[spec.rumor_field(1, "rumor_new_inf")] == "rumor_new_inf_s2"
+    with pytest.raises(ValueError):
+        TraceSpec(tracer_rows=())
+    with pytest.raises(ValueError):
+        TraceSpec(tracer_rows=(1, 1))
+    with pytest.raises(ValueError):
+        TraceSpec(tracer_rows=(0, 1, 2), ring_len=2)
+
+
+def test_trace_config_validation():
+    ClusterConfig().validate()  # defaults are valid
+    with pytest.raises(ValueError):
+        ClusterConfig().with_trace(lambda t: t.replace(ring_len=0)).validate()
+    with pytest.raises(ValueError):
+        ClusterConfig().with_trace(
+            lambda t: t.replace(tracers=0, tracer_rows=())
+        ).validate()
+    with pytest.raises(ValueError):
+        ClusterConfig().with_trace(lambda t: t.replace(tick_us=0)).validate()
+    d = SimDriver(_dense_params(), 32, warm=True, seed=0)
+    with pytest.raises(ValueError):
+        d.arm_trace(tracer_rows=(99,))  # out of range
+    with pytest.raises(ValueError):
+        d.arm_trace(rumor_slots=(99,))
+
+
+# ---------------------------------------------------------------------------
+# 1. neutrality: armed == unarmed, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _lockstep(make_driver):
+    plain = make_driver(seed=7)
+    armed = make_driver(seed=7)
+    armed.arm_trace(tracer_rows=(1, 5), rumor_slots=(0,))
+    for d in (plain, armed):
+        d.spread_rumor(origin=2, payload="x")
+    for d in (plain, armed):
+        d.step(5)
+    for d in (plain, armed):
+        d.crash(5)
+    for w in (3, 7, 11):
+        for d in (plain, armed):
+            d.step(w)
+        _assert_states_equal(plain.state, armed.state)
+    assert np.array_equal(np.asarray(plain._key), np.asarray(armed._key))
+
+
+def test_trace_armed_driver_is_bit_identical_dense():
+    _lockstep(lambda seed: SimDriver(_dense_params(), 32, warm=True, seed=seed))
+
+
+def test_trace_armed_driver_is_bit_identical_sparse():
+    _lockstep(lambda seed: SimDriver(_sparse_params(), 32, warm=True, seed=seed))
+
+
+def test_trace_armed_packed_i16_driver_is_bit_identical():
+    """The r9 packed engine traces too: the capture path widens i16 keys
+    to i32 before diffing, so the same spec serves both layouts."""
+    _lockstep(lambda seed: SimDriver(
+        _dense_params(key_dtype="i16"), 32, warm=True, seed=seed
+    ))
+
+
+def test_trace_armed_step_is_transfer_free(monkeypatch):
+    """r10 extension of the r6/r8 transfer-spy proof: with trace AND
+    telemetry armed, the no-consumer step() path performs ZERO
+    device→host transfers — /trace and /metrics are the sync points."""
+    d = SimDriver(_dense_params(), 24, warm=True, seed=1)
+    d.arm_trace(tracer_rows=(0, 3))
+    d.arm_telemetry()
+    d.spread_rumor(origin=2, payload="x")
+    d.step(2)  # compile + warm both traced programs
+    jax.block_until_ready(d.state)
+
+    transfers = []
+    real_asarray = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == []
+    assert d.dispatch_stats["readbacks"] == 0
+    # ...and the scrape IS a (counted) sync point
+    assert d.trace.events() is not None
+    assert d.dispatch_stats["readbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. event capture + span sewing
+# ---------------------------------------------------------------------------
+
+
+def test_probe_sync_and_refute_events_decode():
+    d = SimDriver(_dense_params(48), 48, warm=True, seed=2)
+    plane = d.arm_trace(tracer_rows=(3, 11), rumor_slots=(0,))
+    d.spread_rumor(origin=1, payload="r")
+    d.step(6)
+    d.crash(11)
+    d.step(40)
+    events = plane.events()
+    kinds = {e["kind"] for e in events}
+    assert {"probe", "probed", "suspect_raised", "rumor_infection"} <= kinds
+    for e in events:
+        if e["kind"] == "probe":
+            assert e["observer"] in (3, 11)
+            assert 0 <= e["subject"] < 48
+            if not e["direct"] and e["ack"]:
+                assert e["vouch_mask"] > 0  # the ack came from a voucher
+        if e["kind"] == "probed":
+            assert e["subject"] in (3, 11)
+            assert e["missed"] <= e["probes"]
+    # SYNC rounds fire every sync_every=10 ticks per row; at least one
+    # tracer sync should have landed and merged
+    syncs = [e for e in events if e["kind"] == "sync"]
+    assert syncs and all(e["observer"] in (3, 11) for e in syncs)
+    # raw-row sanity: a probe flag implies a recorded target
+    rows = plane.snapshot()["rows"]
+    spec = plane.spec
+    for row in rows:
+        if int(row[2]) & FLAG_PROBE_SENT:
+            assert int(row[3]) >= 0
+
+
+def test_crash_scenario_sews_detection_lineage_and_perfetto(tmp_path):
+    """THE acceptance path: a chaos Crash scenario on a trace-auto-attached
+    driver yields a sewn probe-miss → suspect → DEAD span tree for the
+    crashed tracer and a valid Chrome-trace/Perfetto JSON document."""
+    d = SimDriver(_dense_params(24), 24, warm=True, seed=3)
+    scenario = Scenario("crash-lineage", [Crash(rows=(7,), at=4)])
+    report = d.run_scenario(scenario, trace=True)
+    assert d.trace is not None
+    assert 7 in d.trace.spec.tracer_rows  # auto-attach sampled the crash row
+    assert report["ok"], report
+    det = report["sentinels"]["detections"][0]
+    assert det["row"] == 7 and det["detected_at"] is not None
+
+    # the sewn lineage rides the report, chained probe_miss -> suspicion -> dead
+    tree = report["trace_spans"][7]
+    assert det["span_tree"] == tree
+    assert tree["name"] == "detection(subject=7)"
+    pm = tree["children"][0]
+    assert pm["name"].startswith("probe_miss")
+    sus = pm["children"][0]
+    assert sus["name"].startswith("suspicion")
+    dead = sus["children"][0]
+    assert dead["name"].startswith("dead")
+    # causality is ordered: misses start before suspicion, suspicion
+    # before expiry; every up observer ended at DEAD
+    assert pm["start_tick"] <= sus["start_tick"] <= dead["start_tick"]
+    assert dead["attributes"]["final_dead_total"] == 23
+    # detection latency from the span extent matches the sentinel stamp
+    # (sentinels sample every check_interval ticks, spans are per tick)
+    assert dead["start_tick"] <= det["detected_at"] + report["t0"]
+
+    # OTel flattening keeps parent links resolvable
+    flat = d.trace.otel_spans()
+    ids = {s["span_id"] for s in flat}
+    assert all(s["parent_span_id"] in ids
+               for s in flat if s["parent_span_id"] is not None)
+
+    # Perfetto export: loads under json.load, ph/ts/dur well-formed
+    doc = d.trace.perfetto()
+    path = tmp_path / "trace.json"
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    with open(path) as fh:
+        loaded = json.load(fh)
+    events = loaded["traceEvents"]
+    assert events, "empty perfetto document"
+    assert any(ev.get("name", "").startswith("detection") for ev in events)
+    for ev in events:
+        assert ev["ph"] in ("X", "i", "M")
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] > 0
+        if ev["ph"] == "i":
+            assert ev["s"] in ("t", "p", "g")
+
+
+def test_rumor_infection_tree_is_complete_and_parented():
+    d = SimDriver(_dense_params(40), 40, warm=True, seed=4)
+    plane = d.arm_trace(tracer_rows=(0,), rumor_slots=(0, 1))
+    slot = d.spread_rumor(origin=6, payload="x")
+    d.step(30)
+    assert d.rumor_coverage(slot) == 1.0
+    tree = [t for t in plane.rumor_trees() if t["slot"] == slot][0]
+    assert tree["origin"] == 6 and tree["n_infected"] == 40
+    assert tree["depth"] >= 1
+    # walk: every node reachable from the root exactly once, edges sane
+    seen = []
+
+    def walk(node, parent):
+        seen.append(node["row"])
+        if node["row"] != 6 and not node.get("orphan_edge"):
+            assert node["from"] == parent
+            assert node["at"] >= 1
+        for c in node["children"]:
+            walk(c, node["row"])
+
+    walk(tree["root"], None)
+    assert sorted(seen) == list(range(40))
+    # ring exemplars agree with the plane-sewn tree: every first-infection
+    # event names a (node, src) edge the provenance tree contains
+    edges = {}
+
+    def collect(node):
+        for c in node["children"]:
+            edges[c["row"]] = node["row"]
+            collect(c)
+
+    collect(tree["root"])
+    for e in plane.events():
+        if e["kind"] == "rumor_infection" and e["slot"] == slot:
+            assert e["count"] >= 1
+            if not edges.get(e["node"]) is None:
+                assert edges[e["node"]] == e["src"]
+
+
+def test_trace_ring_wraps_and_orders():
+    spec = TraceSpec(tracer_rows=(0, 1), rumor_slots=(), ring_len=8,
+                     ping_req_k=2)
+    ring = TraceRing(spec)
+    # simulate 3 windows of 2 ticks: 12 records through an 8-slot ring
+    for w in range(3):
+        buf = ring.buf
+        for t in range(2):
+            rows = jnp.full((2, spec.n_fields), 10 * w + t, jnp.int32)
+            idx = (jnp.int32(ring.cursor + 2 * t)
+                   + jnp.arange(2, dtype=jnp.int32)) % spec.ring_len
+            buf = buf.at[idx].set(rows)
+        ring.buf = buf
+        ring.advance(4)
+    assert ring.records == 12 and ring.cursor == 4 and ring.wraps == 1
+    rows = ring.last()
+    assert rows.shape == (8, spec.n_fields)
+    # oldest retained first: window 1 tick 0 .. window 2 tick 1
+    assert [int(v) for v in rows[:, 0]] == [10, 10, 11, 11, 20, 20, 21, 21]
+
+
+def test_driver_ring_cursor_mirrors_device_appends():
+    d = SimDriver(_dense_params(), 24, warm=True, seed=5)
+    plane = d.arm_trace(tracer_rows=(0, 1, 2))
+    d.step(4)
+    d.step(3)
+    # K rows per tick + K summary rows per window boundary
+    assert plane.ring.records == 3 * (4 + 1) + 3 * (3 + 1)
+    snap = plane.snapshot()
+    ticks = snap["rows"][:, 0]
+    assert list(ticks) == sorted(ticks)  # oldest first, tick-ordered
+    assert set(snap["rows"][:, 1]) == {0, 1, 2}
+    # the two window boundaries appended FLAG_SUMMARY records at the
+    # window-end ticks
+    from scalecube_cluster_tpu.trace.schema import F_FLAGS, FLAG_SUMMARY
+
+    summaries = snap["rows"][(snap["rows"][:, F_FLAGS] & FLAG_SUMMARY) != 0]
+    assert len(summaries) == 6
+    assert set(summaries[:, 0]) == {4, 7}
+    stats = d.health_snapshot()["trace"]
+    assert stats["records"] == 27 and stats["wraps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. phase profiler
+# ---------------------------------------------------------------------------
+
+
+def test_phase_profiler_matches_fused_and_covers_wall():
+    """Acceptance: the split window reproduces the fused trajectory
+    bit-for-bit AND per-phase times sum to within 20% of the measured
+    (split) window wall time."""
+    from scalecube_cluster_tpu.trace.profile import DENSE_PHASES, profile_ticks
+
+    params = _dense_params(48)
+    st = S.spread_rumor(S.init_state(params, 48, warm=True), 0, origin=2)
+    key = jax.random.PRNGKey(11)
+    n_ticks = 24
+    fused = K.make_run(params, n_ticks + 1, donate=False)
+    ref_state, ref_key, _ms, _w = fused(st, key)
+    st2 = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), st)
+    out_state, out_key, res = profile_ticks(
+        params, st2, key, n_ticks, warmup_ticks=1
+    )
+    _assert_states_equal(ref_state, out_state)
+    assert np.array_equal(np.asarray(ref_key), np.asarray(out_key))
+    assert set(res["phases_s"]) == set(DENSE_PHASES)
+    assert 0.8 <= res["phase_coverage"] <= 1.2, res
+    assert len(res["timeline"]) == n_ticks * len(DENSE_PHASES)
+
+
+def test_phase_profiler_sparse_and_driver_entry():
+    from scalecube_cluster_tpu.trace.profile import SPARSE_PHASES, profile_driver
+
+    d = SimDriver(_sparse_params(), 24, warm=True, seed=6)
+    d.spread_rumor(origin=3, payload="x")
+    d.step(4)
+    before = np.asarray(d.state.view_key).copy()
+    res = profile_driver(d, n_ticks=8)
+    assert set(res["phases_s"]) == set(SPARSE_PHASES)
+    assert 0.8 <= res["phase_coverage"] <= 1.2
+    # the profiler ran on COPIES: the live driver state is untouched
+    assert np.array_equal(before, np.asarray(d.state.view_key))
+    # the timeline renders into the combined Perfetto doc
+    from scalecube_cluster_tpu.trace.export import chrome_trace
+
+    doc = chrome_trace(profile=res)
+    assert any(ev["ph"] == "X" for ev in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# 4. monitor endpoints + exposition gauges
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_trace_endpoints_and_gauges():
+    d = SimDriver(_dense_params(), 24, warm=True, seed=7)
+    d.arm_trace(tracer_rows=(0, 5), rumor_slots=(0,))
+    d.arm_telemetry()
+    d.spread_rumor(origin=1, payload="x")
+    d.step(6)
+    d.crash(5)
+    d.step(12)
+
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    server = MonitorServer()
+    server.register_telemetry(d)  # auto-registers the armed trace plane
+    status, index = server._route("/")
+    assert status.startswith(b"200") and index["trace"] is True
+
+    status, doc = server._route("/trace")
+    assert status.startswith(b"200")
+    json.dumps(doc)  # JSON-ready
+    assert doc["armed"] and doc["tracer_rows"] == [0, 5]
+    assert doc["records"] == d.trace.ring.records
+    assert any(e["kind"] == "probed" for e in doc["events"])
+
+    status, perf = server._route("/trace/perfetto")
+    assert status.startswith(b"200")
+    assert all("ph" in ev for ev in json.loads(json.dumps(perf))["traceEvents"])
+
+    # satellite: bus retention + ring cursor/wrap gauges on /metrics,
+    # grammar-checked like the r8 exposition tests
+    status, body = server._route("/metrics")
+    assert status.startswith(b"200")
+    values = _assert_valid_exposition(body.decode())
+    for name in (
+        'scalecube_bus_retained', 'scalecube_bus_capacity',
+        'scalecube_ring_cursor{engine="dense"}',
+        'scalecube_ring_wraps_total{engine="dense"}',
+        'scalecube_trace_records_total{engine="dense"}',
+        'scalecube_trace_ring_cursor{engine="dense"}',
+        'scalecube_trace_ring_wraps_total{engine="dense"}',
+    ):
+        assert any(k.startswith(name) for k in values), name
+    assert values['scalecube_trace_records_total{engine="dense"}'] == str(
+        d.trace.ring.records_total
+    )
+
+    # unarmed server refuses to register a trace provider
+    d2 = SimDriver(_dense_params(), 24, warm=True, seed=8)
+    with pytest.raises(ValueError):
+        MonitorServer().register_trace(d2)
+
+
+def test_concurrent_scrape_while_ticking_stress():
+    """r10 satellite: monitor threads hammering /metrics + /trace against a
+    donating, stepping driver — the armed rings' donated buffers must stay
+    behind the driver lock (the r8 "Array has been deleted" class extended
+    to the trace ring)."""
+    d = SimDriver(_dense_params(), 24, warm=True, seed=9)
+    d.arm_trace(tracer_rows=(0, 1), rumor_slots=(0,))
+    d.arm_telemetry()
+    d.spread_rumor(origin=2, payload="x")
+    d.step(1)
+
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    server = MonitorServer()
+    server.register_telemetry(d)
+    errors = []
+    stop = threading.Event()
+
+    def hammer(path):
+        while not stop.is_set():
+            try:
+                status, _body = server._route(path)
+                assert status.startswith(b"200")
+            except Exception as exc:  # noqa: BLE001 — the test's whole point
+                errors.append((path, repr(exc)))
+                return
+
+    threads = [
+        threading.Thread(target=hammer, args=(p,))
+        for p in ("/metrics", "/trace", "/trace/perfetto", "/health")
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(40):
+            d.step(2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert errors == []
+    assert not any(t.is_alive() for t in threads)
+
+
+# ---------------------------------------------------------------------------
+# 5. flight dumps carry causality
+# ---------------------------------------------------------------------------
+
+
+def test_forced_violation_flight_dump_carries_trace(tmp_path):
+    """r10 satellite: a forced detection-budget violation writes a flight
+    dump whose trace section holds the ring tail AND the sewn span tree
+    for the violating member — post-mortems carry causality."""
+    from scalecube_cluster_tpu.config import TelemetryConfig
+
+    d = SimDriver(_dense_params(24), 24, warm=True, seed=10)
+    d.arm_telemetry(TelemetryConfig(flight_dir=str(tmp_path), ring_len=64))
+    # detect_budget=8 is below the suspicion window: the obligation MUST
+    # fail, but the horizon lets the real detection complete so the tree
+    # carries the whole probe-miss -> suspect -> dead chain
+    scenario = Scenario(
+        "impossible-deadline", [Crash(rows=(5,), at=2)],
+        detect_budget=8, horizon=120,
+    )
+    report = d.run_scenario(scenario, trace=True)
+    assert report["violations"] >= 1
+    assert "flight_dump" in report
+
+    dump = load_flight_dump(report["flight_dump"])
+    assert dump["reason"] == "sentinel_violation"
+    tr = dump["trace"]
+    assert tr["tracer_rows"] == [5]
+    assert len(tr["rows"]) > 0 and len(tr["rows"][0]) == len(tr["fields"])
+    tree = tr["span_trees"]["5"] if "5" in tr["span_trees"] else tr["span_trees"][5]
+    assert tree["name"] == "detection(subject=5)"
+    # the ring tail in the dump replays through the host decoder
+    events = decode_records(np.asarray(tr["rows"], np.int64), d.trace.spec)
+    assert any(e["kind"] == "dead" for e in events)
+    # and the human-readable replay mentions the trace section
+    text = "\n".join(replay_timeline(dump))
+    assert "trace:" in text and "span trees" in text
+
+
+def test_detection_tree_requires_activity():
+    assert trace_spans.detection_tree([], subject=3) is None
+
+
+def test_pre_armed_plane_names_untraced_crash_rows():
+    """No silent caps: with a PRE-armed plane whose tracers miss a crashed
+    row, the report must say "untraced", not read as no detection
+    activity. The auto-attach budget honors TraceConfig.tracers."""
+    d = SimDriver(_dense_params(24), 24, warm=True, seed=14)
+    d.arm_trace(tracer_rows=(0,))
+    report = d.run_scenario(
+        Scenario("untraced-crash", [Crash(rows=(7,), at=2)],
+                 detect_budget=400, horizon=30),
+        trace=True,
+    )
+    assert report["untraced_crash_rows"] == [7]
+    assert report["trace_spans"] == {}
+
+
+def test_restore_clears_the_trace_ring(tmp_path):
+    """A restored driver's tick counter rewinds; records from the
+    abandoned timeline must not sew into the restored one (decode orders
+    by tick — stale records would fabricate merged lineages)."""
+    d = SimDriver(_dense_params(), 24, warm=True, seed=12)
+    plane = d.arm_trace(tracer_rows=(0, 3))
+    d.step(4)
+    path = str(tmp_path / "ck.npz")
+    d.checkpoint(path)
+    d.crash(3)
+    d.step(20)
+    assert plane.ring.records > 8
+    total_before = plane.ring.records_total
+    d.restore(path)
+    assert plane.ring.records == 0  # abandoned-timeline records dropped
+    # ...but the /metrics counter source stays monotone across the clear
+    assert plane.ring.records_total == total_before
+    d.step(3)
+    # only the restored timeline's records exist: 2 tracers x (3 ticks + 1
+    # window summary), ticks picking up from the checkpoint
+    assert plane.ring.records == 2 * 4
+    assert all(5 <= t <= 7 for t in plane.snapshot()["rows"][:, 0])
+
+
+def test_trace_provider_binds_late_after_auto_attach():
+    """register_telemetry on an UNARMED driver still serves /trace once a
+    later run_scenario(trace=True) auto-attaches the plane (the provider
+    resolves at request time, never at registration time)."""
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    d = SimDriver(_dense_params(24), 24, warm=True, seed=13)
+    d.arm_telemetry()
+    server = MonitorServer()
+    server.register_telemetry(d)
+    status, doc = server._route("/trace")
+    assert status.startswith(b"200") and doc == {"armed": False}
+    status, perf = server._route("/trace/perfetto")
+    assert status.startswith(b"200") and perf["traceEvents"] == []
+
+    report = d.run_scenario(
+        Scenario("late-arm", [Crash(rows=(5,), at=2)]), trace=True
+    )
+    assert report["trace_spans"]
+    status, doc = server._route("/trace")
+    assert status.startswith(b"200") and doc["armed"] is True
+    assert doc["detections"]
