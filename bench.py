@@ -242,6 +242,44 @@ def _headline_rounds_sparse():
     return convergence_ticks, ROUNDS * budget / dt
 
 
+def _headline_rounds_pview():
+    """Pview-engine duty-cycle measurement (r11) — same rounds/budget
+    contract; the O(N·k) engine's sampled fanout still converges the rumor
+    inside the sweep budget (benchmarks/config11_pview.py is the
+    pview-vs-dense A/B + the 16 GiB max-N ladder; this records the pview
+    headline number)."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = PV.PviewParams(
+        capacity=N, view_slots=24, active_slots=8, fanout=3, repeat_mult=3,
+        ping_req_k=3, fd_every=5, sync_every=150, suspicion_mult=5,
+        rumor_slots=8, seed_rows=(0,), key_dtype="i16",
+    )
+    budget = gossip_periods_to_sweep(params.repeat_mult, N)
+    state = PV.init_pview_state(params, N, warm=True)
+    step = PV.make_pview_run(params, budget)
+    key = jax.random.PRNGKey(0)
+    state = PV.spread_rumor(state, 0, origin=0)
+    state, key, ms, _w = step(state, key)
+    warm_cov = np.asarray(ms["rumor_coverage"])[:, 0]
+    jax.block_until_ready(state)
+
+    convergence_ticks = []
+    t0 = time.perf_counter()
+    for r in range(ROUNDS):
+        state = PV.spread_rumor(state, 0, origin=(r * 97) % N)
+        state, key, ms, _w = step(state, key)
+        cov = np.asarray(ms["rumor_coverage"])[:, 0]
+        hit = np.nonzero(cov >= 1.0)[0]
+        convergence_ticks.append(int(hit[0]) + 1 if hit.size else None)
+    dt = time.perf_counter() - t0
+    log(
+        f"pview: {ROUNDS} rounds x {budget} ticks, convergence at "
+        f"{convergence_ticks} (warm: {int(np.argmax(warm_cov >= 1.0)) + 1})"
+    )
+    return convergence_ticks, ROUNDS * budget / dt
+
+
 def main() -> None:
     # r10: --profile records the trace-plane overhead headline + the
     # phase-split tick breakdown into TRACE_BENCH_r10.json (the config10
@@ -268,8 +306,8 @@ def main() -> None:
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")
-        if i + 1 < len(sys.argv) and sys.argv[i + 1] == "dense":
-            engine = "dense"
+        if i + 1 < len(sys.argv) and sys.argv[i + 1] in ("dense", "pview"):
+            engine = sys.argv[i + 1]
     # r9: --plane-dtype i16 runs the dense side on the bit-plane-packed
     # engine (config9's record shape; trajectories are decode-identical)
     plane_dtype = "i32"
@@ -315,6 +353,9 @@ def main() -> None:
     try:
         if engine == "sparse":
             conv, ticks_per_s = _measure_with_retry(_headline_rounds_sparse, "sparse")
+            conv_d, ticks_per_s_dense = _measure_with_retry(_dense, "dense")
+        elif engine == "pview":
+            conv, ticks_per_s = _measure_with_retry(_headline_rounds_pview, "pview")
             conv_d, ticks_per_s_dense = _measure_with_retry(_dense, "dense")
         else:
             conv, ticks_per_s = _measure_with_retry(_dense, "dense")

@@ -92,12 +92,24 @@ def _r10(rec):
     )
 
 
+def _r11(rec):
+    ladder = rec.get("max_n_ladder", {})
+    return rec["dense_ticks_per_s"], (
+        f"dense arm of the pview A/B (pview {rec['pview_ticks_per_s']} "
+        f"ticks/s = {rec['pview_vs_dense']}x dense at N=4096; pview-alone "
+        f"N={rec.get('big_n')} {rec.get('big_n_ticks_per_s')} ticks/s; "
+        f"16 GiB ceiling {ladder.get('claimed_ceiling_n')} vs dense packed "
+        f"{(ladder.get('dense_reference') or {}).get('packed_lean_max_n')})"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
     (8, "TELEM_BENCH_r08.json", _r8),
     (9, "BITPLANE_BENCH_r09.json", _r9),
     (10, "TRACE_BENCH_r10.json", _r10),
+    (11, "PVIEW_BENCH_r11.json", _r11),
 ]
 
 
@@ -190,6 +202,14 @@ def main() -> None:
     # TRACE_BENCH artifact so the trajectory fold sees current numbers)
     results += run([py, "benchmarks/config10_trace.py",
                     "--out", "TRACE_BENCH_r10.json"], timeout=3000)
+    # r11 partial-view engine: pview-vs-dense A/B + the pview-alone 65536
+    # point; the max-N ladder is a chain of ~2-min XLA compiles and the
+    # ceiling verify allocates a multi-GiB state, so the matrix run caps
+    # the ladder at the 100k+ gate step and skips the verify (the full
+    # ladder + verified ceiling belong to the dedicated r11 artifact run)
+    results += run([py, "benchmarks/config11_pview.py", "--no-verify",
+                    "--probe-base", "131072", "--probe-cap", "131072"],
+                   timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)

@@ -1,0 +1,348 @@
+"""Driver config #11: the O(N·k) partial-view engine vs the N×N wall.
+
+Two sections, one JSON artifact (``PVIEW_BENCH_r11.json``):
+
+1. **Throughput**: pview vs dense ticks/s at N=4096 on the config6-10
+   workload (warm cluster, 24 one-tick windows per span, interleaved
+   median-of-``--reps`` spans so host drift hits both alike), plus the
+   pview-ALONE large-N point at N=``--big-n`` (default 65536 — a size NO
+   full-plane engine can even allocate under the budget). Every loop must
+   stay transfer-free per window (readback counter assert).
+
+2. **Max-N ladder** (the r11 acceptance gate): the largest pview N whose
+   one donated 1-tick window the COMPILER plans within a fixed budget
+   (default 16 GiB — one v5e chip's HBM), measured from
+   ``compiled.memory_analysis()`` exactly like config9's probe
+   (arguments + temps + un-aliased outputs). Ladder steps double from
+   ``--probe-base``; each step is a full XLA compile (~2 min at these
+   sizes on CPU), so the ladder is the expensive half of this config.
+   Gates:
+
+   * pview fits >= 100_000 members (the SNIPPETS.md 100k-node target);
+   * the claimed ceiling is VERIFIED by a real allocated + ticked window
+     (``--verify-n``, default = the probed ceiling) — an existence proof,
+     not just compiler arithmetic;
+   * the dense comparison point is read from BITPLANE_BENCH_r09.json
+     (packed-lean ceiling 24576 under the same budget/method) rather than
+     re-probed — pass ``--probe-dense`` to recompute it here.
+
+    python benchmarks/config11_pview.py [--n 4096] [--big-n 65536]
+        [--windows 24] [--reps 5] [--budget-gib 16]
+        [--probe-base 65536] [--probe-cap 2097152] [--verify-n N]
+        [--no-verify] [--probe-dense]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+from functools import partial
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+import jax.numpy as jnp
+
+from common import emit, log
+
+REPO = _p.Path(__file__).parent.parent
+
+
+def _pview_params(n: int, kd: str = "i16"):
+    from scalecube_cluster_tpu.ops.pview import PviewParams
+
+    return PviewParams(
+        capacity=n, view_slots=24, active_slots=8, fanout=3, repeat_mult=3,
+        ping_req_k=3, fd_every=5, sync_every=150, suspicion_mult=5,
+        rumor_slots=8, seed_rows=(0,), key_dtype=kd,
+    )
+
+
+def _dense_params(n: int):
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    return SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+    )
+
+
+class Loop:
+    """config6-10's pipelined SimDriver loop; the params object selects the
+    engine (ops/engine_api.resolve)."""
+
+    def __init__(self, params, n: int, windows: int, window_ticks: int):
+        from scalecube_cluster_tpu.sim import SimDriver
+
+        self.windows = windows
+        self.window_ticks = window_ticks
+        self.d = SimDriver(params, n, warm=True, seed=0)
+        self.d.step(window_ticks)  # compile + warm
+        self.d.sync()
+
+    def span(self) -> float:
+        base = self.d.dispatch_stats["readbacks"]
+        t0 = time.perf_counter()
+        for _ in range(self.windows):
+            self.d.step(self.window_ticks)
+        self.d.sync()
+        dt = time.perf_counter() - t0
+        assert self.d.dispatch_stats["readbacks"] == base, (
+            "bench loop performed a device->host readback"
+        )
+        return dt
+
+
+# -- max-N ladder ------------------------------------------------------------
+
+
+def _window_bytes(n: int, kd: str) -> dict:
+    """Compiler-reported bytes of one donated 1-tick pview window at
+    capacity n — config9's methodology; the abstract state comes from
+    jax.eval_shape (pool/table dims scale non-linearly with capacity, so
+    the tiny-state dim-substitution trick does not apply)."""
+    from scalecube_cluster_tpu.ops.pview import init_pview_state, run_pview_ticks
+
+    params = _pview_params(n, kd)
+    absstate = jax.eval_shape(partial(init_pview_state, params, n, warm=True))
+    fn = jax.jit(
+        partial(run_pview_ticks, n_ticks=1, params=params), donate_argnums=0
+    )
+    c = fn.lower(absstate, jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+    ma = c.memory_analysis()
+    peak = (
+        ma.argument_size_in_bytes
+        + ma.temp_size_in_bytes
+        + max(ma.output_size_in_bytes - ma.alias_size_in_bytes, 0)
+    )
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "peak_bytes": int(peak),
+    }
+
+
+def probe_max_n(budget_bytes: int, base_n: int, cap_n: int, kd: str) -> dict:
+    """Doubling sweep: largest pview N whose one-window program the
+    compiler plans within the budget; honest about the cap (a capped
+    ladder records capped=True instead of implying a measured ceiling)."""
+    n = base_n
+    ceiling, detail, steps = 0, None, []
+    capped = False
+    while True:
+        stats = _window_bytes(n, kd)
+        fits = stats["peak_bytes"] <= budget_bytes
+        log(
+            f"probe pview N={n}: peak {stats['peak_bytes'] / 2**30:.2f} GiB "
+            f"({'fits' if fits else 'over budget'})"
+        )
+        steps.append({"n": n, **stats, "fits": fits})
+        if not fits:
+            break
+        ceiling, detail = n, stats
+        if n >= cap_n:
+            capped = True
+            break
+        n *= 2
+    return {
+        "max_n": ceiling,
+        "key_dtype": kd,
+        "window_bytes_at_max_n": detail,
+        "first_infeasible_n": None if capped else n,
+        "capped": capped,
+        "ladder": steps,
+    }
+
+
+def verify_ceiling(n: int, kd: str) -> dict:
+    """Existence proof: allocate the pview state and run one donated
+    window at the claimed ceiling, for real, on this host."""
+    from scalecube_cluster_tpu.ops.pview import init_pview_state, make_pview_run
+
+    params = _pview_params(n, kd)
+    t0 = time.perf_counter()
+    st = init_pview_state(params, n, warm=True)
+    jax.block_until_ready(st)
+    alloc_s = time.perf_counter() - t0
+    run = make_pview_run(params, n_ticks=1)
+    key = jax.random.PRNGKey(0)
+    t0 = time.perf_counter()
+    st, key, ms, _ = run(st, key, watch_rows=None)
+    jax.block_until_ready(st)
+    first_s = time.perf_counter() - t0  # includes compile
+    t0 = time.perf_counter()
+    st, key, ms, _ = run(st, key, watch_rows=None)
+    jax.block_until_ready(st)
+    warm_s = time.perf_counter() - t0
+    n_up = int(ms["n_up"][-1])
+    del st, ms
+    return {
+        "n": n, "key_dtype": kd, "alloc_s": round(alloc_s, 3),
+        "first_window_s": round(first_s, 3), "warm_tick_s": round(warm_s, 3),
+        "n_up_after_tick": n_up, "ok": n_up == n,
+    }
+
+
+def _dense_reference(budget_gib: float) -> dict:
+    """The dense packed-lean ceiling under the same budget/method — read
+    from the r9 artifact (same memory_analysis probe) when present."""
+    path = REPO / "BITPLANE_BENCH_r09.json"
+    try:
+        with open(path) as fh:
+            r9 = json.load(fh)
+        probe = r9["max_n_probe"]
+        if probe["budget_gib"] == budget_gib:
+            return {
+                "source": "BITPLANE_BENCH_r09.json",
+                "packed_lean_max_n": probe["profiles"]["packed_lean"]["max_n"],
+                "unpacked_fidelity_max_n": (
+                    probe["profiles"]["unpacked_fidelity"]["max_n"]
+                ),
+            }
+        return {"source": str(path), "note": f"budget mismatch ({probe['budget_gib']} GiB)"}
+    except (OSError, KeyError, json.JSONDecodeError) as exc:
+        return {"source": str(path), "note": f"unreadable: {exc}"}
+
+
+def _probe_dense_here(budget_bytes: int) -> dict:
+    """--probe-dense: recompute the dense packed-lean ceiling with
+    config9's probe instead of trusting the r9 artifact."""
+    import importlib
+
+    c9 = importlib.import_module("config9_bitplane")
+    n, ceiling = 4096, 0
+    while True:
+        stats = c9._window_bytes(n, "i16", False)
+        if stats["peak_bytes"] > budget_bytes:
+            break
+        ceiling = n
+        n *= 2
+    return {"source": "probed here (config9 methodology)", "packed_lean_max_n": ceiling}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--big-n", type=int, default=65536)
+    ap.add_argument("--windows", type=int, default=24)
+    ap.add_argument("--big-windows", type=int, default=4)
+    ap.add_argument("--window-ticks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--budget-gib", type=float, default=16.0)
+    ap.add_argument("--probe-base", type=int, default=65536)
+    ap.add_argument("--probe-cap", type=int, default=2 ** 21)
+    ap.add_argument("--key-dtype", default="i16")
+    ap.add_argument("--verify-n", type=int, default=0)  # 0 = the ceiling
+    ap.add_argument("--no-verify", action="store_true")
+    ap.add_argument("--probe-dense", action="store_true")
+    args = ap.parse_args()
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    log(f"throughput: N={args.n}, {args.reps} x {args.windows} windows of "
+        f"{args.window_ticks} tick(s), interleaved dense/pview")
+    dense = Loop(_dense_params(args.n), args.n, args.windows, args.window_ticks)
+    pview = Loop(
+        _pview_params(args.n, args.key_dtype), args.n, args.windows,
+        args.window_ticks,
+    )
+    d_spans, p_spans = [], []
+    for rep in range(args.reps):  # interleaved: drift hits both alike
+        d_spans.append(dense.span())
+        p_spans.append(pview.span())
+        log(f"rep {rep}: dense {d_spans[-1]:.3f}s, pview {p_spans[-1]:.3f}s")
+    total = args.windows * args.window_ticks
+    d_med = statistics.median(d_spans)
+    p_med = statistics.median(p_spans)
+    del dense, pview
+
+    log(f"large-N pview point: N={args.big_n}, {args.reps} x "
+        f"{args.big_windows} windows")
+    big = Loop(
+        _pview_params(args.big_n, args.key_dtype), args.big_n,
+        args.big_windows, args.window_ticks,
+    )
+    big_spans = [big.span() for _ in range(args.reps)]
+    big_med = statistics.median(big_spans)
+    big_total = args.big_windows * args.window_ticks
+    del big
+
+    budget = int(args.budget_gib * 2 ** 30)
+    log(f"max-N ladder: budget {args.budget_gib} GiB, doubling from "
+        f"{args.probe_base} (cap {args.probe_cap})")
+    probe = probe_max_n(budget, args.probe_base, args.probe_cap, args.key_dtype)
+    if probe["max_n"] == 0:
+        raise SystemExit(
+            f"max-N ladder degenerate: probe base {args.probe_base} does not "
+            f"fit the {args.budget_gib} GiB budget — lower --probe-base"
+        )
+
+    verify = None
+    claimed = probe["max_n"]
+    if not args.no_verify:
+        claimed = args.verify_n or probe["max_n"]
+        log(f"verifying claimed ceiling N={claimed} end-to-end ...")
+        verify = verify_ceiling(claimed, args.key_dtype)
+        if not verify["ok"]:
+            raise SystemExit(f"ceiling verify failed: {verify}")
+
+    dense_ref = (
+        _probe_dense_here(budget) if args.probe_dense
+        else _dense_reference(args.budget_gib)
+    )
+    dense_ceiling = dense_ref.get("packed_lean_max_n")
+
+    result = {
+        "config": 11,
+        "variant": "pview_partial_view",
+        "n": args.n,
+        "engine": "pview",
+        "key_dtype": args.key_dtype,
+        "backend": jax.default_backend(),
+        "windows": args.windows,
+        "window_ticks": args.window_ticks,
+        "reps": args.reps,
+        "dense_ticks_per_s": round(total / d_med, 1),
+        "pview_ticks_per_s": round(total / p_med, 1),
+        "pview_vs_dense": round(d_med / p_med, 3),
+        "big_n": args.big_n,
+        "big_n_ticks_per_s": round(big_total / big_med, 2),
+        "max_n_ladder": {
+            "budget_gib": args.budget_gib,
+            "method": "compiled.memory_analysis() peak (args+temps+"
+                      "unaliased outputs) of one donated 1-tick pview "
+                      "window, doubling ladder (abstract state via "
+                      "jax.eval_shape; each step is a full XLA compile)",
+            "probe": probe,
+            "pview_ceiling_n": probe["max_n"],
+            "claimed_ceiling_n": claimed,
+            "meets_100k_gate": claimed >= 100_000,
+            "dense_reference": dense_ref,
+            "ceiling_vs_dense_packed": (
+                round(claimed / dense_ceiling, 1) if dense_ceiling else None
+            ),
+            "verified": verify,
+        },
+        "spans_s": {
+            "dense": [round(s, 4) for s in d_spans],
+            "pview": [round(s, 4) for s in p_spans],
+            "pview_big": [round(s, 4) for s in big_spans],
+        },
+    }
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

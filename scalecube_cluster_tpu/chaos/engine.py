@@ -33,7 +33,7 @@ from .events import (
     Scenario,
     ScenarioError,
 )
-from .sentinels import build_spec, init_sentinel_state, sentinel_report
+from .sentinels import build_spec, sentinel_report
 
 
 @dataclass(frozen=True)
@@ -125,14 +125,22 @@ class StateTimeline:
         self._storm_stash = None  # pre-storm loss plane (independent copy)
         self._storm_pct = 0.0  # active storm's floor, as a probability
         self._storm_replay: List[Callable] = []
+        # group-partition-capable engines (pview's part_id/part_loss model)
+        # run Partition events without an [N, N] link plane; per-PAIR flaps
+        # still need one
+        group_parts = getattr(ops, "GROUP_PARTITIONS", False)
         if not dense_links:
             for s in self._steps:
-                if s.kind in ("partition_block", "partition_heal",
-                              "flap_down", "flap_up"):
+                if s.kind in ("partition_block", "partition_heal") and not group_parts:
                     raise ScenarioError(
                         f"{s.kind} needs per-link (dense) links; this engine "
                         "runs scalar uniform loss — construct the driver "
                         "with dense_links=True"
+                    )
+                if s.kind in ("flap_down", "flap_up"):
+                    raise ScenarioError(
+                        f"{s.kind} needs per-link (dense) links; this engine "
+                        "has no per-pair link plane"
                     )
 
     def next_tick(self) -> Optional[int]:
@@ -273,9 +281,6 @@ class DriverChaosRunner:
                  sentinels: bool = True, trace: bool = False):
         import jax
 
-        from ..ops import kernel as _kernel
-        from ..ops import sparse as _sparse
-
         self.driver = driver
         self.scenario = scenario
         self._untraced_crash_rows: List[int] = []
@@ -311,7 +316,7 @@ class DriverChaosRunner:
             ]
         with driver._lock:
             self.t0 = int(driver.state.tick)  # the one arm-time readback
-            view_key = driver.state.view_key
+            arm_state = driver.state
         self.spec = build_spec(scenario, driver.params, config=config)
         self.timeline = StateTimeline(
             scenario,
@@ -320,16 +325,14 @@ class DriverChaosRunner:
             on_restart=self._restart,
             horizon=self.spec.horizon,
         )
-        self._sent = (
-            init_sentinel_state(view_key, self.spec, sparse=driver.sparse)
-            if sentinels
-            else None
-        )
+        # sentinel init + reduce through the engine interface (r11): dense/
+        # sparse run the shared view-plane core, pview its table-edge twin
+        from ..ops import engine_api
+
+        eng = engine_api.of_driver(driver)
+        self._sent = eng.sentinel_init(arm_state, self.spec) if sentinels else None
         self._spec_dev = self.spec.device_arrays(self.t0)
-        reduce_fn = (
-            _sparse.sentinel_reduce if driver.sparse else _kernel.sentinel_reduce
-        )
-        self._check = jax.jit(reduce_fn)
+        self._check = jax.jit(eng.sentinel_reduce)
         self.events_applied: List[Tuple[int, str]] = []
         self.rel_tick = 0
         self.done = False

@@ -240,10 +240,14 @@ def sentinel_report(sent_host: Dict[str, np.ndarray], spec: SentinelSpec,
     false_dead = int(sent_host["false_dead_max"])
     regress = int(sent_host["key_regressions"])
     n_live_drift = int(sent_host.get("n_live_drift", 0))
+    # pview's internal-consistency sentinel (duplicate/self table entries —
+    # the partial-view analogue of the sparse n_live drift)
+    view_breaks = int(sent_host.get("view_invariant_breaks", 0))
     violations = (
         (1 if false_dead else 0)
         + (1 if regress else 0)
         + (1 if n_live_drift else 0)
+        + (1 if view_breaks else 0)
         + sum(1 for d in detections if not d["ok"])
         + sum(1 for c in convergence if not c["ok"])
     )
@@ -261,4 +265,6 @@ def sentinel_report(sent_host: Dict[str, np.ndarray], spec: SentinelSpec,
     }
     if "n_live_drift" in sent_host:
         report["n_live_drift"] = n_live_drift
+    if "view_invariant_breaks" in sent_host:
+        report["view_invariant_breaks"] = view_breaks
     return report

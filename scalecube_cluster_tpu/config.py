@@ -160,6 +160,13 @@ class SimConfig:
     # sweeps (ops/bitplane.py), under the narrow saturation rule
     # (incarnation cap 511 + epoch fold 16 — lattice.KeyLayout).
     plane_dtype: str = "i32"
+    # Partial-view engine knobs (r11, ops/pview.py): ``view_slots`` is k,
+    # the per-member neighbor-table width ([N, k] — the O(N·k) memory
+    # budget); ``active_slots`` the HyParView-style active-view prefix
+    # sampled for FD probes / gossip fanout / SYNC peers (the remainder is
+    # the passive healing reservoir refreshed by the SYNC-folded shuffle).
+    view_slots: int = 24
+    active_slots: int = 8
     seed: int = 0
     # Persistent XLA compilation-cache directory (None = disabled; the
     # SCALECUBE_COMPILE_CACHE_DIR env var is the non-config fallback).
@@ -358,6 +365,11 @@ class ClusterConfig:
             raise ValueError("reconnect_base_delay must be >= 0")
         if self.sim.plane_dtype not in ("i32", "i16"):
             raise ValueError("sim.plane_dtype must be 'i32' or 'i16'")
+        if not (0 < self.sim.active_slots < self.sim.view_slots):
+            raise ValueError(
+                "need 0 < sim.active_slots < sim.view_slots (the pview "
+                "passive reservoir must be non-empty)"
+            )
         if self.chaos.check_interval_ticks <= 0:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):

@@ -24,6 +24,14 @@ Modules:
 * :mod:`oracle`   — scalar NumPy reimplementation of identical tick
   semantics, used by equivalence tests.
 * :mod:`sharding` — mesh construction + sharded jit of the tick.
+* :mod:`sparse`   — the record-queue engine (bounded rumor pools; r3) with
+  its own oracle (:mod:`sparse_oracle`).
+* :mod:`pview`    — the O(N·k) partial-view engine (r11: [N, k] neighbor
+  tables, no [N, N] plane anywhere) with its own oracle
+  (:mod:`pview_oracle`).
+* :mod:`engine_api` — the ONE engine-interface spelling: every consumer
+  (driver, telemetry, trace, chaos, monitor) resolves dense/sparse/pview
+  through one :class:`~.engine_api.EngineOps` descriptor (r11).
 """
 
 from .lattice import UNKNOWN, decode_key, precedence_key

@@ -70,12 +70,49 @@ def initialize(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    _select_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         **kwargs,
     )
+
+
+def cpu_collectives_available() -> bool:
+    """True when this jaxlib can run MULTIPROCESS computations on the CPU
+    backend (a built-in gloo collectives implementation). The stock XLA
+    CPU client refuses cross-process programs outright ("Multiprocess
+    computations aren't implemented on the CPU backend") unless a CPU
+    collectives implementation is selected — :func:`initialize` selects
+    gloo when available; this probe is the test-gating spelling."""
+    try:
+        from jax._src import xla_bridge as _xb
+        import jaxlib.xla_extension as _xe
+
+        # the flag must accept "gloo" AND this jaxlib must actually ship
+        # the gloo collectives (CPU_COLLECTIVES_IMPLEMENTATIONS only
+        # enumerates the flag's legal spellings, not what was compiled in)
+        return "gloo" in tuple(_xb.CPU_COLLECTIVES_IMPLEMENTATIONS) and hasattr(
+            _xe, "make_gloo_tcp_collectives"
+        )
+    except Exception:
+        return False
+
+
+def _select_cpu_collectives() -> None:
+    """On the CPU backend, select the gloo collectives implementation (the
+    flag defaults to "none", under which a multi-process CPU computation
+    fails at dispatch). Harmless on TPU/GPU: the flag only affects the CPU
+    client, and we leave any explicit user setting alone."""
+    if not cpu_collectives_available():
+        return
+    from jax._src import xla_bridge as _xb
+
+    # the flag object, not jax.config.<name> — the jax.config attribute
+    # is not materialized for this Flag on current jax
+    if _xb.CPU_COLLECTIVES_IMPLEMENTATION.value in (None, "none"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def process_info() -> tuple[int, int]:

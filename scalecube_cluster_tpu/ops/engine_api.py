@@ -1,0 +1,258 @@
+"""The ONE engine-interface spelling (r11).
+
+Before this module, every engine consumer — SimDriver's window dispatch,
+the telemetry plane's ring vector, the trace plane's window-boundary diff,
+the chaos runner's sentinel check, the monitor's health snapshot — picked
+between the dense and sparse engines with its own ``driver.sparse``
+branch, and adding a third engine meant touching them all. Now each engine
+registers one :class:`EngineOps` descriptor and every consumer resolves
+through :func:`resolve` / :func:`of_driver`:
+
+* **window builders** — ``make_run`` / ``make_traced_run`` /
+  ``make_sharded_run`` (None when the engine is single-device), all jit
+  with the state (and trace ring) DONATED: the r6 double-buffered
+  dispatch discipline is part of the interface, not per-engine folklore.
+* **telemetry seam** — ``telemetry_series`` + ``telemetry_window_vector``
+  (the r8 metric-ring row).
+* **trace seam** — ``tracer_view_cols`` (the r10 window-boundary
+  dissemination diff's input: observer-by-tracer key columns, synthesized
+  for table engines that hold no [N, N] plane).
+* **chaos seam** — ``sentinel_init`` / ``sentinel_reduce`` (the r7
+  invariant sentinels).
+* **host-view seams** — ``view_row`` (one observer's full-width key row,
+  for event diffs / ``view_of``), ``remembered_rows`` (the driver's
+  prefer-forgotten-rows join policy), ``staleness`` (the health
+  snapshot's identity-dissemination reduce), ``key_plane`` (the narrow-
+  layout checkpoint guard), ``pool_slots`` (bounded-pool sizing).
+
+Engines: ``dense`` (:mod:`.kernel` / :mod:`.state`), ``sparse``
+(:mod:`.sparse`), ``pview`` (:mod:`.pview` — the r11 O(N·k) partial-view
+engine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineOps:
+    """One engine's plug surface (see the module docstring)."""
+
+    name: str
+    ops: object  # host-mutator module (join/crash/leave/links/snapshot/...)
+    init_state: Callable  # (params, n_initial, warm, dense_links) -> state
+    make_run: Callable  # (params, n_ticks) -> jitted donated window
+    make_traced_run: Callable  # (params, n_ticks, trace) -> jitted window
+    make_sharded_run: Optional[Callable]  # (mesh, params, n_ticks, dense) or None
+    shard_state: Optional[Callable]  # (state, mesh) -> state, or None
+    telemetry_series: tuple
+    telemetry_window_vector: Callable
+    sentinel_init: Callable  # (state, spec) -> accumulator dict
+    sentinel_reduce: Callable  # (state, sent, spec) -> sent
+    view_row: Callable  # (state, row) -> [N] i32 device keys
+    tracer_view_cols: Callable  # (state, tracer_rows) -> [N, K] i32
+    remembered_rows: Callable  # (state) -> [N] bool
+    staleness: Callable  # (state) -> (stale [N] i32, n_up)
+    key_plane: Optional[Callable]  # (state) -> narrow-capable key array
+    pool_slots: Optional[Callable]  # (params) -> bounded-pool size
+    dense_links_default: bool
+    supports_mesh: bool
+    has_pool: bool
+
+
+# -- shared seams for the two full-view-plane engines (dense + sparse both
+# hold the same [N, N] view_key / [N] up state shape) ------------------------
+
+
+def _plane_view_row(state, row):
+    return state.view_key[row].astype(jnp.int32)
+
+
+def _plane_tracer_view_cols(state, rows):
+    return state.view_key[:, jnp.asarray(rows, jnp.int32)].astype(jnp.int32)
+
+
+def _plane_remembered_rows(state):
+    return ((state.view_key >= 0) & state.up[:, None]).any(axis=0)
+
+
+def _plane_staleness(state):
+    up = state.up
+    vk = state.view_key
+    diag = jnp.diagonal(vk)
+    stale = (
+        jnp.where(
+            up[:, None] & up[None, :] & ((vk >> 2) < (diag >> 2)[None, :]),
+            1, 0,
+        ).sum(axis=0).astype(jnp.int32)
+    )
+    return stale, up.sum()
+
+
+def _plane_sentinel_init(sparse):
+    from ..chaos.sentinels import init_sentinel_state
+
+    return lambda state, spec: init_sentinel_state(
+        state.view_key, spec, sparse=sparse
+    )
+
+
+def _dense_engine() -> EngineOps:
+    from . import kernel as K
+    from . import state as S
+
+    def _sharded(mesh, params, n_ticks, dense_links):
+        from .sharding import make_sharded_run
+
+        return make_sharded_run(mesh, params, n_ticks, dense_links)
+
+    def _shard_state(state, mesh):
+        from .sharding import shard_state
+
+        return shard_state(state, mesh)
+
+    return EngineOps(
+        name="dense",
+        ops=S,
+        init_state=lambda p, n, warm, dense_links: S.init_state(
+            p, n, warm=warm, dense_links=dense_links
+        ),
+        make_run=K.make_run,
+        make_traced_run=K.make_traced_run,
+        make_sharded_run=_sharded,
+        shard_state=_shard_state,
+        telemetry_series=tuple(K.TELEMETRY_SERIES),
+        telemetry_window_vector=K.telemetry_window_vector,
+        sentinel_init=_plane_sentinel_init(sparse=False),
+        sentinel_reduce=K.sentinel_reduce,
+        view_row=_plane_view_row,
+        tracer_view_cols=_plane_tracer_view_cols,
+        remembered_rows=_plane_remembered_rows,
+        staleness=_plane_staleness,
+        key_plane=lambda state: state.view_key,
+        pool_slots=None,
+        dense_links_default=True,
+        supports_mesh=True,
+        has_pool=False,
+    )
+
+
+def _sparse_engine() -> EngineOps:
+    from . import sparse as SP
+
+    def _sharded(mesh, params, n_ticks, dense_links):
+        from .sharding import make_sharded_sparse_run
+
+        return make_sharded_sparse_run(mesh, params, n_ticks)
+
+    def _shard_state(state, mesh):
+        from .sharding import shard_sparse_state
+
+        return shard_sparse_state(state, mesh)
+
+    return EngineOps(
+        name="sparse",
+        ops=SP,
+        init_state=lambda p, n, warm, dense_links: SP.init_sparse_state(
+            p, n, warm=warm, dense_links=dense_links
+        ),
+        make_run=SP.make_sparse_run,
+        make_traced_run=SP.make_sparse_traced_run,
+        make_sharded_run=_sharded,
+        shard_state=_shard_state,
+        telemetry_series=tuple(SP.TELEMETRY_SERIES),
+        telemetry_window_vector=SP.telemetry_window_vector,
+        sentinel_init=_plane_sentinel_init(sparse=True),
+        sentinel_reduce=SP.sentinel_reduce,
+        view_row=_plane_view_row,
+        tracer_view_cols=_plane_tracer_view_cols,
+        remembered_rows=_plane_remembered_rows,
+        staleness=_plane_staleness,
+        key_plane=None,  # sparse keys are i32-only; no narrow checkpoint guard
+        pool_slots=lambda params: params.mr_slots,
+        dense_links_default=False,
+        supports_mesh=True,
+        has_pool=True,
+    )
+
+
+def _pview_engine() -> EngineOps:
+    from . import pview as PV
+
+    def _init(p, n, warm, dense_links):
+        if dense_links:
+            raise ValueError(
+                "the pview engine has no [N, N] link plane — partitions use "
+                "the group model (dense_links must be False/None)"
+            )
+        return PV.init_pview_state(p, n, warm=warm)
+
+    return EngineOps(
+        name="pview",
+        ops=PV,
+        init_state=_init,
+        make_run=PV.make_pview_run,
+        make_traced_run=PV.make_pview_traced_run,
+        make_sharded_run=None,
+        shard_state=None,
+        telemetry_series=tuple(PV.TELEMETRY_SERIES),
+        telemetry_window_vector=PV.telemetry_window_vector,
+        sentinel_init=PV.sentinel_init,
+        sentinel_reduce=PV.sentinel_reduce,
+        view_row=lambda state, row: PV.view_rows(state, [row])[0],
+        tracer_view_cols=PV.tracer_view_cols,
+        remembered_rows=PV.remembered_rows,
+        staleness=PV.staleness,
+        key_plane=lambda state: state.nbr_key,
+        pool_slots=lambda params: params.mr_pool,
+        dense_links_default=False,
+        supports_mesh=False,
+        has_pool=True,
+    )
+
+
+_BUILDERS = {
+    "dense": _dense_engine,
+    "sparse": _sparse_engine,
+    "pview": _pview_engine,
+}
+_CACHE: dict = {}
+
+
+def engine(name: str) -> EngineOps:
+    """The registered :class:`EngineOps` by name ("dense"/"sparse"/"pview")."""
+    if name not in _BUILDERS:
+        raise ValueError(f"unknown engine {name!r}; one of {sorted(_BUILDERS)}")
+    if name not in _CACHE:
+        _CACHE[name] = _BUILDERS[name]()
+    return _CACHE[name]
+
+
+def resolve(params) -> EngineOps:
+    """The engine a params object selects (by type — the historical driver
+    contract: SimParams → dense, SparseParams → sparse, PviewParams →
+    pview)."""
+    from .pview import PviewParams
+    from .sparse import SparseParams
+    from .state import SimParams
+
+    if isinstance(params, PviewParams):
+        return engine("pview")
+    if isinstance(params, SparseParams):
+        return engine("sparse")
+    if isinstance(params, SimParams):
+        return engine("dense")
+    raise TypeError(
+        f"params {type(params).__name__} selects no engine (expected "
+        "SimParams, SparseParams, or PviewParams)"
+    )
+
+
+def of_driver(driver) -> EngineOps:
+    """The driver's engine (drivers cache it as ``driver._eng``)."""
+    eng = getattr(driver, "_eng", None)
+    return eng if eng is not None else resolve(driver.params)

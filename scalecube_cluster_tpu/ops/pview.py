@@ -1,0 +1,1923 @@
+"""The partial-view ("pview") SWIM tick: O(N·k) memory, no [N, N] plane.
+
+Every engine before this one materializes at least one full [N, N] plane
+(``view_key``), so even the r9 bit-plane compaction caps one 16 GiB window
+at N=24576 (BITPLANE_BENCH_r09.json). SWIM itself never needs full views:
+gossip with bounded, sampled fanout keeps its O(log N)-round spread and
+fault tolerance (Haeupler–Malkhi, arXiv:1311.2839; Censor-Hillel et al.,
+arXiv:1209.6158), so a member's protocol-visible world can be k sampled
+neighbors plus a bounded rumor pool. This module is that third engine:
+
+* ``nbr_id`` / ``nbr_key`` — the [N, k] neighbor table: slot s of row i
+  holds a member id (or -1 empty) and that member's packed precedence key
+  (:mod:`.lattice`, i32 wide or saturating-i16 narrow layout via
+  ``key_dtype``). Slots ``[0, ka)`` are the ACTIVE view (FD probe targets,
+  gossip fanout peers, SYNC peers are sampled here); ``[ka, k)`` the
+  PASSIVE view (the HyParView-style healing reservoir, refreshed by the
+  SYNC-folded shuffle and promoted into the active view by the
+  maintenance sweep).
+* ``self_key`` — each row's record about itself ([N] i32): the dense
+  engine's diagonal, kept separate so refutation/identity logic stays O(N).
+* membership + user rumor pools — the sparse engine's bounded-pool design
+  verbatim (``mr_*`` [M], ``minf_age`` [N, M] u8, ``rumor_*``/[N, R]);
+  the allocation / priority-eviction / backpressure machinery is IMPORTED
+  from :mod:`.sparse` (one spelling).
+* delivery — gather/scatter over neighbor index tables (per-fanout-slot
+  inverse sender indexes + row gathers, the sparse deviation-6 design):
+  per-tick work is O(N·(f·T + M + A·k)), memory O(N·(k + M)) — no [N, N]
+  or [N, ceil(N/32)] allocation anywhere in this module (statically
+  enforced by tools/lint_plane_dtypes.py rule 3).
+
+Randomness: the tick consumes the EXACT sparse-engine draw stream
+(:func:`.rand.draw_sparse_fd` / :func:`.rand.draw_sparse_round` under the
+same two-subkey split) — uniforms are interpreted as ACTIVE-SLOT indexes
+instead of column indexes. The scalar oracle (:mod:`.pview_oracle`) replays
+the identical draws and is bit-exact in lockstep.
+
+Deliberate deviations from the reference (beyond the sparse engine's six,
+which this engine inherits where the machinery is shared):
+
+P1. **Partial views.** A member holds records about at most k neighbors
+    (+ itself); the reference holds the full member table. SWIM's
+    guarantees survive: FD only ever probes a bounded random subset per
+    round, gossip only needs fanout-many live peers, and the bounded-
+    fanout rumor spread keeps its O(log N) rounds and fault tolerance
+    under sampled views (arXiv:1311.2839 §1, arXiv:1209.6158 §1) —
+    docs/PARTIAL_VIEW.md carries the full argument.
+P2. **Static log-size knobs.** A partial view cannot count the cluster,
+    so every ``ceilLog2(cluster size)`` knob (suspicion timeout, spread
+    window, sweep lifetime) uses the static capacity N — an upper bound
+    that only adds dissemination redundancy / suspicion patience (real
+    deployments gossip a size estimate; the sim's capacity is exact).
+P3. **Insertion/eviction.** A record about an unknown subject inserts at
+    the first empty slot, else evicts the minimum-key PASSIVE entry
+    (newest facts get residency — the pool-eviction philosophy of sparse
+    deviation 3). An evicted record is forgotten, not refuted; it heals
+    via SYNC/shuffle exactly like an evicted pool rumor.
+P4. **Symmetric SYNC exchange of pre-state.** A SYNC round trip merges
+    the two parties' PRE-exchange tables into each other (k + 1 records
+    each way, the self record included); the reference's ACK carries the
+    peer's post-merge table. Anti-entropy still converges — the combined
+    information flows on the next exchange — and the regather-free form
+    keeps the phase O(K·k).
+P5. **Per-receiver apply cap.** A receiver applies at most ``apply_slots``
+    newly-arriving membership rumors per tick (lowest pool slots first);
+    the rest are NOT marked infected, so their senders keep forwarding
+    while the spread window lasts (the same retry-on-drop shape as sparse
+    deviation 6). Steady-state change rates sit far below the cap.
+P6. **SYNC receiver collision drop.** When several SYNC callers pick the
+    same peer in one tick, the peer merges only the highest-slot caller's
+    table that tick (the losers' round trips still count for their own
+    ACK merge) — the sparse deviation-6 collision rule applied to
+    anti-entropy.
+P7. **Self-expiry does not announce.** A row whose own record expires
+    SUSPECT→DEAD in its table refutes next tick anyway (the refutation is
+    the announcement); other observers run their own timers.
+P8. **Bounded tombstones.** DEAD table entries are purged (forgotten, not
+    refuted) every ``tombstone_ticks`` — the reference removes DEAD
+    members from its table immediately; a partial view keeps them one
+    pool-rumor lifetime as re-admission guards. The purge is globally
+    synchronous, which makes post-heal re-convergence DETERMINISTICALLY
+    bounded: no table can re-infect another through a SYNC merge, and
+    stale pool rumors age out on their own sweep.
+
+Partition model (no [N, N] loss plane): ``part_id`` [N] + ``part_loss``
+[G, G] — chaos partitions assign the faulted row groups to partition
+cells and block/heal the cell pairs; uniform loss/delay stay scalars.
+Loss(i, j) = max(uniform, part_loss[part_id[i], part_id[j]]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .lattice import (
+    ALIVE,
+    RANK_ALIVE,
+    RANK_DEAD,
+    RANK_LEAVING,
+    RANK_SUSPECT,
+    UNKNOWN_KEY,
+    bump_inc,
+    key_np_dtype,
+    layout_of,
+    precedence_key,
+)
+from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
+    draw_sparse_fd,
+    draw_sparse_round,
+    fetch_uniform,
+    split_tick_key,
+)
+from .sparse import TELEMETRY_SERIES as _SPARSE_TELEMETRY_SERIES, _alloc_phase, _allocate
+from .state import NEVER, NO_CANDIDATE_I32, delay_mean_to_q
+
+NO_CANDIDATE = NO_CANDIDATE_I32
+
+#: chaos StateTimeline capability flag: Partition events run on the group
+#: model (part_id/part_loss) without an [N, N] link plane
+GROUP_PARTITIONS = True
+
+
+def _ceil_log2_static(n: int) -> int:
+    return int(n).bit_length() if n > 0 else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PviewParams:
+    """Static parameters of the partial-view tick (hashable; close over in
+    jit). Shared protocol knobs mirror :class:`.sparse.SparseParams` (same
+    reference anchors); the pview-only knobs size the neighbor table:
+    ``view_slots`` (k, total slots/row), ``active_slots`` (ka, the sampled
+    prefix), ``apply_slots`` (A, per-receiver rumor applies/tick —
+    deviation P5), ``partition_groups`` (G, chaos partition cells)."""
+
+    capacity: int
+    view_slots: int = 24
+    active_slots: int = 8
+    fanout: int = 3
+    repeat_mult: int = 3
+    ping_req_k: int = 3
+    fd_every: int = 5
+    sync_every: int = 150
+    sync_stagger: int = 1
+    suspicion_mult: int = 5
+    sweep_every: int = 8
+    sample_tries: int = 4
+    rumor_slots: int = 16
+    mr_slots: int = 0  # 0 = auto: min(2048, max(256, capacity // 32))
+    announce_slots: int = 256
+    sync_slots: int = 0
+    sync_announce: int = 2
+    # Every Q-th periodic SYNC round of a row goes DETERMINISTICALLY to a
+    # seed (round-robin over seeds) instead of the sampled table draw.
+    # This bounds the partial-view RE-BRIDGING latency: after a partition's
+    # mutual kill each side's tombstones make the other side unsampleable,
+    # so without a deterministic seed visit the halves reconnect only
+    # through the probabilistic union-pool seed draw — a
+    # (1 - S/(ka+S))^rounds tail that can outlive any budget.
+    seed_sync_every: int = 4
+    # DEAD tombstones are PURGED from the tables every ``tombstone_ticks``
+    # (0 = auto: sweep_ticks, the pool-rumor lifetime — the death rumor has
+    # finished spreading by then). The reference removes DEAD members from
+    # its table outright; a partial view keeps them one dissemination
+    # window as re-admission guards and then forgets (deviation P8). The
+    # purge is GLOBALLY SYNCHRONOUS (same tick on every row), so tables
+    # cannot re-infect each other through SYNC merges, and pool rumors
+    # age out on their own sweep — post-heal convergence is therefore
+    # bounded by purge period + sweep_ticks, deterministically.
+    tombstone_ticks: int = 0
+    apply_slots: int = 8
+    partition_groups: int = 4
+    fd_accept_slots: int = 0
+    refute_slots: int = 0
+    delay_slots: int = 0
+    fd_direct_timeout_ticks: int = 2
+    fd_leg_timeout_ticks: int = 1
+    sync_timeout_ticks: int = 15
+    seed_rows: tuple = ()
+    early_free: bool = True
+    full_metrics: bool = False
+    key_dtype: str = "i32"
+
+    def __post_init__(self):
+        if not (0 < self.active_slots < self.view_slots):
+            raise ValueError(
+                "need 0 < active_slots < view_slots (the passive reservoir "
+                f"must be non-empty): got ka={self.active_slots}, "
+                f"k={self.view_slots}"
+            )
+        key_np_dtype(self.key_dtype)  # validates the spelling
+        if self.partition_groups < 3:
+            raise ValueError(
+                "partition_groups must be >= 3 (cell 0 is the unpartitioned "
+                "cell and a partition needs two DISTINCT cells): got "
+                f"G={self.partition_groups}"
+            )
+
+    @property
+    def mr_pool(self) -> int:
+        return self.mr_slots or min(2048, max(256, self.capacity // 32))
+
+    @property
+    def log2n(self) -> int:
+        """Static ceil-log2 of capacity — every cluster-size knob
+        (deviation P2)."""
+        return _ceil_log2_static(self.capacity)
+
+    @property
+    def spread_ticks(self) -> int:
+        return self.repeat_mult * self.log2n
+
+    @property
+    def sweep_ticks(self) -> int:
+        return 2 * (self.repeat_mult * self.log2n + 1)
+
+    @property
+    def suspicion_timeout_ticks(self) -> int:
+        return self.suspicion_mult * self.log2n * self.fd_every
+
+    @property
+    def purge_sweeps(self) -> int:
+        """Tombstone purge cadence in SWEEPS (ceil of tombstone_ticks /
+        sweep_every) — the purge rides the maintenance sweep."""
+        tt = self.tombstone_ticks or self.sweep_ticks
+        return max(1, -(-tt // self.sweep_every))
+
+    @staticmethod
+    def from_config(
+        config,
+        capacity: int | None = None,
+        initial_size: int | None = None,
+        seed_rows: tuple = (0,),
+        mr_slots: int | None = None,
+        view_slots: int | None = None,
+    ) -> "PviewParams":
+        """Derive pview params from a ClusterConfig — the sparse tick-unit
+        mapping (one tick = one gossip period) plus the table sizing."""
+        sim = config.sim
+        cap = capacity or sim.capacity or (initial_size or 0)
+        if cap <= 1:
+            raise ValueError(
+                "sim capacity must be > 1 (set config.sim.capacity, or pass "
+                "capacity= / initial_size=)"
+            )
+        dt = sim.tick_interval
+        return PviewParams(
+            capacity=cap,
+            view_slots=view_slots or sim.view_slots,
+            active_slots=sim.active_slots,
+            fanout=config.gossip.gossip_fanout,
+            repeat_mult=config.gossip.gossip_repeat_mult,
+            ping_req_k=config.failure_detector.ping_req_members,
+            fd_every=max(1, round(config.failure_detector.ping_interval / dt)),
+            sync_every=max(1, round(config.membership.sync_interval / dt)),
+            suspicion_mult=config.membership.suspicion_mult,
+            rumor_slots=sim.rumor_slots,
+            mr_slots=mr_slots or 0,
+            seed_rows=tuple(seed_rows),
+            delay_slots=sim.delay_slots,
+            key_dtype=sim.plane_dtype,
+            fd_direct_timeout_ticks=max(
+                0, int(config.failure_detector.ping_timeout / dt)
+            ),
+            fd_leg_timeout_ticks=max(
+                0,
+                int(
+                    (config.failure_detector.ping_interval
+                     - config.failure_detector.ping_timeout) / dt / 2
+                ),
+            ),
+            sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
+        )
+
+
+class PviewState(struct.PyTreeNode):
+    """Lean partial-view simulation state — O(N·(k + M)) total.
+
+    Key-value convention: ``nbr_key`` is stored in the configured
+    ``key_dtype`` plane (i32 wide / i16 narrow); every OTHER key carrier
+    (``self_key``, ``sus_key``, ``mr_key``, proposals) is an i32 holding a
+    value packed under the SAME layout (narrow values sign-extend
+    losslessly), so all comparison logic runs in i32 and only the [N, k]
+    plane pays the narrow footprint."""
+
+    tick: jax.Array
+    up: jax.Array  # bool [N]
+    epoch: jax.Array  # i32 [N]
+    joined_at: jax.Array  # i32 [N]
+    self_key: jax.Array  # i32 [N] — own record (the dense diagonal)
+    nbr_id: jax.Array  # i32 [N, k] — neighbor member ids, -1 empty
+    nbr_key: jax.Array  # kdt [N, k] — neighbor precedence keys
+    sus_key: jax.Array  # i32 [N]
+    sus_since: jax.Array  # i32 [N]
+    force_sync: jax.Array  # bool [N]
+    leaving: jax.Array  # bool [N]
+    mr_active: jax.Array  # bool [M]
+    mr_subject: jax.Array  # i32 [M]
+    mr_key: jax.Array  # i32 [M]
+    mr_created: jax.Array  # i32 [M]
+    mr_origin: jax.Array  # i32 [M]
+    minf_age: jax.Array  # u8 [N, M]
+    rumor_active: jax.Array  # bool [R]
+    rumor_origin: jax.Array  # i32 [R]
+    rumor_created: jax.Array  # i32 [R]
+    infected: jax.Array  # bool [N, R]
+    infected_at: jax.Array  # i32 [N, R]
+    infected_from: jax.Array  # i32 [N, R]
+    loss: jax.Array  # f32 scalar — uniform loss floor
+    delay_q: jax.Array  # f32 scalar — uniform geometric delay parameter
+    part_id: jax.Array  # i32 [N] — partition cell per row (0 = default)
+    part_loss: jax.Array  # f32 [G, G] — partition cell-pair loss
+    pending_minf: jax.Array  # bool [D, N, M]
+    pending_inf: jax.Array  # bool [D, N, R]
+    pending_src: jax.Array  # i32 [D, N, R]
+
+    @property
+    def capacity(self) -> int:
+        return self.up.shape[0]
+
+    @property
+    def view_key(self):  # pragma: no cover - guard, not a code path
+        raise AttributeError(
+            "PviewState has no [N, N] view plane — use engine_api.view_row / "
+            "tracer_view_cols to synthesize row/column views"
+        )
+
+
+# ---------------------------------------------------------------------------
+# construction + host mutators
+# ---------------------------------------------------------------------------
+
+
+def init_pview_state(
+    params: PviewParams,
+    n_initial: int,
+    warm: bool = True,
+    uniform_loss: float = 0.0,
+    uniform_delay: float = 0.0,
+) -> PviewState:
+    """Fresh partial-view sim; rows ``0..n_initial-1`` up.
+
+    Warm start fills each row's table with a deterministic SCATTERED
+    sample of the initial membership: the ACTIVE slots get geometric
+    long-range chords (offsets n/2, n/4, ...), the passive tail the small
+    offsets — a binary-dissemination overlay. The scatter matters: the
+    reference's gossip draw is uniform over the FULL member table, and a
+    k-sample only preserves the O(log N)-round epidemic bound if it spans
+    the cluster (arXiv:1311.2839's direct-addressing reach). A ring
+    neighborhood (the obvious i+1..i+k fill) degenerates infection into a
+    LINEAR wavefront — ~ka members/tick — whenever the SYNC-folded
+    shuffle is slow relative to the rumor's spread window, which is
+    exactly the reference cadence (sync_every >> spread_ticks). Cold
+    start knows only the configured seeds."""
+    n, k, m, r = params.capacity, params.view_slots, params.mr_pool, params.rumor_slots
+    g = params.partition_groups
+    kdt = key_np_dtype(params.key_dtype)
+    up = jnp.arange(n) < n_initial
+    self_key = jnp.where(up, jnp.int32(0), UNKNOWN_KEY)  # ALIVE@0@0 packed == 0
+    rows = np.arange(n)
+    if warm and n_initial > 1:
+        # distinct offsets, largest scales first (active prefix), then the
+        # small-offset fill; padded with out-of-range values (-> empty
+        # slots) when n_initial - 1 < k
+        offs: list = []
+        step = n_initial // 2
+        while len(offs) < k and step > 1:
+            # odd chords (step | 1): a set of even offsets can only ever
+            # reach its own residue class — the parity trap
+            c = step | 1
+            if c < n_initial and c not in offs:
+                offs.append(c)
+            step //= 2
+        d = 1
+        while len(offs) < k and len(offs) < n_initial - 1:
+            c = d % n_initial
+            if c and c not in offs:
+                offs.append(c)
+            d += 1
+        while len(offs) < k:
+            offs.append(n_initial + len(offs))  # invalid -> empty slot
+        offs_a = np.asarray(offs, np.int64)
+        ids = (rows[:, None] + offs_a[None, :]) % max(n_initial, 1)
+        valid = (rows[:, None] < n_initial) & (offs_a[None, :] < n_initial)
+        ids = np.where(valid, ids, -1).astype(np.int32)
+    else:
+        ids = np.full((n, k), -1, np.int32)
+        seeds = [s for s in params.seed_rows if s < n_initial]
+        for i in range(n_initial):
+            s_i = [s for s in seeds if s != i][: k]
+            ids[i, : len(s_i)] = s_i
+    nbr_id = jnp.asarray(ids)
+    nbr_key = jnp.where(nbr_id >= 0, 0, UNKNOWN_KEY).astype(kdt)
+    if uniform_delay > 0 and params.delay_slots <= 0:
+        raise ValueError("uniform_delay > 0 requires params.delay_slots > 0")
+    d = max(0, params.delay_slots)
+    return PviewState(
+        tick=jnp.int32(0),
+        up=up,
+        epoch=jnp.zeros((n,), jnp.int32),
+        joined_at=jnp.zeros((n,), jnp.int32),
+        self_key=self_key.astype(jnp.int32),
+        nbr_id=nbr_id,
+        nbr_key=nbr_key,
+        sus_key=jnp.full((n,), NO_CANDIDATE, jnp.int32),
+        sus_since=jnp.full((n,), NEVER, jnp.int32),
+        force_sync=jnp.zeros((n,), bool),
+        leaving=jnp.zeros((n,), bool),
+        mr_active=jnp.zeros((m,), bool),
+        mr_subject=jnp.full((m,), -1, jnp.int32),
+        mr_key=jnp.zeros((m,), jnp.int32),
+        mr_created=jnp.zeros((m,), jnp.int32),
+        mr_origin=jnp.zeros((m,), jnp.int32),
+        minf_age=jnp.zeros((n, m), jnp.uint8),
+        rumor_active=jnp.zeros((r,), bool),
+        rumor_origin=jnp.zeros((r,), jnp.int32),
+        rumor_created=jnp.zeros((r,), jnp.int32),
+        infected=jnp.zeros((n, r), bool),
+        infected_at=jnp.zeros((n, r), jnp.int32),
+        infected_from=jnp.full((n, r), -1, jnp.int32),
+        loss=jnp.float32(uniform_loss),
+        delay_q=jnp.float32(delay_mean_to_q(uniform_delay)),
+        part_id=jnp.zeros((n,), jnp.int32),
+        part_loss=jnp.zeros((g, g), jnp.float32),
+        pending_minf=jnp.zeros((d, n, m), bool),
+        pending_inf=jnp.zeros((d, n, r), bool),
+        pending_src=jnp.full((d, n, r), -1, jnp.int32),
+    )
+
+
+def _kdt(state: PviewState):
+    return state.nbr_key.dtype
+
+
+def _keys_i32(state: PviewState) -> jax.Array:
+    """The neighbor-key plane widened to i32 (sign-extension preserves the
+    narrow layout's values, -1 included)."""
+    return state.nbr_key.astype(jnp.int32)
+
+
+def _pack_self(state_or_dtype, status, inc, epoch) -> jax.Array:
+    """Pack under the configured layout, carried as i32 (see the state's
+    key-value convention)."""
+    kdt = _kdt(state_or_dtype) if isinstance(state_or_dtype, PviewState) else state_or_dtype
+    return precedence_key(
+        jnp.asarray(status, jnp.int32), jnp.asarray(inc, jnp.int32),
+        jnp.asarray(epoch, jnp.int32), dtype=kdt,
+    ).astype(jnp.int32)
+
+
+def announce(state: PviewState, subject, key, origin) -> PviewState:
+    """Host-side membership-rumor allocation — the sparse engine's pool
+    machinery verbatim (:func:`.sparse._allocate` is imported; see its
+    priority-eviction account)."""
+    st, _a, _d, _e = _allocate(
+        state,
+        jnp.asarray([subject], jnp.int32),
+        jnp.asarray([key], jnp.int32),
+        jnp.asarray([origin], jnp.int32),
+        jnp.ones((1,), bool),
+        prio=jnp.ones((1,), bool),
+    )
+    return st
+
+
+def _insert_rows_table(state: PviewState, rows, seed_rows):
+    """Fresh table for joining ``rows``: seeds in ascending slots."""
+    k = state.nbr_id.shape[1]
+    kdt = _kdt(state)
+    rows = jnp.asarray(rows, jnp.int32)
+    seed_rows = jnp.asarray(seed_rows, jnp.int32)[: k]
+    nk = rows.shape[0]
+    slots = jnp.arange(k)
+    s_cnt = seed_rows.shape[0]
+    ids = jnp.where(
+        slots[None, :] < s_cnt,
+        seed_rows[jnp.minimum(slots, s_cnt - 1)][None, :],
+        -1,
+    )
+    ids = jnp.broadcast_to(ids, (nk, k))
+    # a joiner never tables itself
+    ids = jnp.where(ids == rows[:, None], -1, ids)
+    seed_keys = _pack_self(
+        kdt,
+        jnp.full((nk, k), ALIVE),
+        jnp.zeros((nk, k)),
+        state.epoch[jnp.maximum(ids, 0)],
+    )
+    keys = jnp.where(ids >= 0, seed_keys, UNKNOWN_KEY).astype(kdt)
+    return ids, keys
+
+
+def join_row(state: PviewState, row: int, seed_rows) -> PviewState:
+    """Activate ``row`` as a fresh member knowing the seeds; identical
+    identity-epoch semantics to ``sparse.join_row`` (restart = new member
+    id via the epoch bits) + self-announce rumor."""
+    was_used = state.self_key[row] >= 0
+    new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
+    self_key = _pack_self(state, ALIVE, 0, new_epoch)
+    ids, keys = _insert_rows_table(state, [row], seed_rows)
+    state = state.replace(
+        up=state.up.at[row].set(True),
+        epoch=state.epoch.at[row].set(new_epoch),
+        joined_at=state.joined_at.at[row].set(state.tick),
+        self_key=state.self_key.at[row].set(self_key),
+        nbr_id=state.nbr_id.at[row].set(ids[0]),
+        nbr_key=state.nbr_key.at[row].set(keys[0]),
+        force_sync=state.force_sync.at[row].set(True),
+        leaving=state.leaving.at[row].set(False),
+        minf_age=state.minf_age.at[row].set(0),
+        infected=state.infected.at[row].set(False),
+        infected_from=state.infected_from.at[row].set(-1),
+        pending_minf=state.pending_minf.at[:, row].set(False)
+        if state.pending_minf.shape[0]
+        else state.pending_minf,
+        pending_inf=state.pending_inf.at[:, row].set(False)
+        if state.pending_inf.shape[0]
+        else state.pending_inf,
+        pending_src=state.pending_src.at[:, row].set(-1)
+        if state.pending_src.shape[0]
+        else state.pending_src,
+    )
+    return announce(state, row, self_key, row)
+
+
+def join_rows(state: PviewState, rows, seed_rows) -> PviewState:
+    """Vectorized churn-burst join (distinct ``rows``)."""
+    rows = jnp.asarray(rows, jnp.int32)
+    nk = rows.shape[0]
+    was_used = state.self_key[rows] >= 0
+    new_epoch = jnp.where(was_used, (state.epoch[rows] + 1) & 0xFF, state.epoch[rows])
+    epoch_after = state.epoch.at[rows].set(new_epoch)
+    self_keys = _pack_self(state, jnp.full((nk,), ALIVE), jnp.zeros((nk,)), new_epoch)
+    st = state.replace(epoch=epoch_after)
+    ids, keys = _insert_rows_table(st, rows, seed_rows)
+    state = st.replace(
+        up=state.up.at[rows].set(True),
+        joined_at=state.joined_at.at[rows].set(state.tick),
+        self_key=state.self_key.at[rows].set(self_keys),
+        nbr_id=state.nbr_id.at[rows].set(ids),
+        nbr_key=state.nbr_key.at[rows].set(keys),
+        force_sync=state.force_sync.at[rows].set(True),
+        leaving=state.leaving.at[rows].set(False),
+        minf_age=state.minf_age.at[rows].set(0),
+        infected=state.infected.at[rows].set(False),
+        infected_from=state.infected_from.at[rows].set(-1),
+        pending_minf=state.pending_minf.at[:, rows].set(False)
+        if state.pending_minf.shape[0]
+        else state.pending_minf,
+        pending_inf=state.pending_inf.at[:, rows].set(False)
+        if state.pending_inf.shape[0]
+        else state.pending_inf,
+        pending_src=state.pending_src.at[:, rows].set(-1)
+        if state.pending_src.shape[0]
+        else state.pending_src,
+    )
+    state, _a, _d, _e = _allocate(
+        state, rows, self_keys, rows, jnp.ones((nk,), bool),
+        prio=jnp.ones((nk,), bool),
+    )
+    return state
+
+
+def crash_row(state: PviewState, row: int) -> PviewState:
+    return state.replace(up=state.up.at[row].set(False))
+
+
+def crash_rows(state: PviewState, rows) -> PviewState:
+    return state.replace(up=state.up.at[jnp.asarray(rows, jnp.int32)].set(False))
+
+
+def begin_leave(state: PviewState, row: int) -> PviewState:
+    own = state.self_key[row]
+    leaving_key = ((own >> 2) << 2) | RANK_LEAVING
+    state = state.replace(
+        self_key=state.self_key.at[row].set(leaving_key),
+        leaving=state.leaving.at[row].set(True),
+    )
+    return announce(state, row, leaving_key, row)
+
+
+def update_metadata(state: PviewState, row: int) -> PviewState:
+    """Metadata update = own-incarnation bump re-announced ALIVE; routed
+    through :func:`.lattice.bump_inc` so the narrow layout saturates."""
+    kdt = _kdt(state)
+    new_key = bump_inc(
+        state.self_key[row].astype(kdt), RANK_ALIVE
+    ).astype(jnp.int32)
+    state = state.replace(self_key=state.self_key.at[row].set(new_key))
+    return announce(state, row, new_key, row)
+
+
+def spread_rumor(state: PviewState, slot: int, origin: int) -> PviewState:
+    return state.replace(
+        rumor_active=state.rumor_active.at[slot].set(True),
+        rumor_origin=state.rumor_origin.at[slot].set(origin),
+        rumor_created=state.rumor_created.at[slot].set(state.tick),
+        infected=state.infected.at[:, slot].set(False).at[origin, slot].set(True),
+        infected_at=state.infected_at.at[origin, slot].set(state.tick),
+        infected_from=state.infected_from.at[:, slot].set(-1),
+    )
+
+
+def set_uniform_loss(state: PviewState, loss, floor: bool = False) -> PviewState:
+    new = jnp.maximum(state.loss, loss) if floor else jnp.asarray(loss, jnp.float32)
+    return state.replace(loss=jnp.float32(new))
+
+
+def _part_cell(rows) -> int:
+    """Deterministic partition-cell id for a host-side row group: cells are
+    hashed from the group's minimum row into [1, G). Two simultaneous
+    partitions whose groups hash to the same cell merge (documented bound;
+    G is ``PviewParams.partition_groups``)."""
+    return int(min(int(r) for r in rows))
+
+
+def _cells_for(state: PviewState, group_a, group_b) -> tuple[int, int]:
+    g = state.part_loss.shape[0]
+    ra, rb = _part_cell(group_a), _part_cell(group_b)
+    ca = 1 + (ra % (g - 1))
+    cb = 1 + (rb % (g - 1))
+    if ca == cb:
+        # Order-independent collision remap: bump the group with the LARGER
+        # raw min row, so (a, b) and (b, a) resolve to the same cell pair.
+        # ("Always bump the second" left the heal path one-directional:
+        # both set_link_loss(a, b, 0) and set_link_loss(b, a, 0) landed on
+        # the same ordered cell and part_loss[cb, ca] stayed 1.0 forever.)
+        if ra <= rb:
+            cb = 1 + (cb % (g - 1))
+        else:
+            ca = 1 + (ca % (g - 1))
+    return ca, cb
+
+
+def block_partition(state: PviewState, group_a, group_b) -> PviewState:
+    ca, cb = _cells_for(state, group_a, group_b)
+    part = (
+        state.part_id.at[jnp.asarray(list(group_a), jnp.int32)].set(ca)
+        .at[jnp.asarray(list(group_b), jnp.int32)].set(cb)
+    )
+    pl = state.part_loss.at[ca, cb].set(1.0).at[cb, ca].set(1.0)
+    return state.replace(part_id=part, part_loss=pl)
+
+
+def set_link_loss(state: PviewState, src, dst, loss) -> PviewState:
+    """Group-pair loss only (the chaos partition heal path): ``src``/``dst``
+    must be the row groups of an earlier :func:`block_partition`. Arbitrary
+    per-link loss needs an [N, N] plane — exactly what this engine bans."""
+    src = list(np.atleast_1d(np.asarray(src)))
+    dst = list(np.atleast_1d(np.asarray(dst)))
+    ca, cb = _cells_for(state, src, dst)
+    pl = state.part_loss.at[ca, cb].set(jnp.float32(loss))
+    return state.replace(part_loss=pl)
+
+
+def heal_partition(state: PviewState, group_a, group_b) -> PviewState:
+    s = set_link_loss(state, group_a, group_b, 0.0)
+    return set_link_loss(s, group_b, group_a, 0.0)
+
+
+def set_link_delay(state: PviewState, src, dst, mean_delay_ticks: float):
+    raise ValueError(
+        "per-link delay needs an [N, N] plane; the pview engine supports "
+        "uniform delay only (init_pview_state(uniform_delay=...))"
+    )
+
+
+def sentinel_reduce(state: PviewState, sent: dict, spec: dict) -> dict:
+    """Pview chaos-sentinel check over the [N, k] tables + self records
+    (the partial-view analogue of :func:`.kernel.sentinel_core`):
+
+    * false-DEAD — never-faulted up subjects tombstoned by any up observer
+      (table edges only; a subject nobody tables cannot be falsely dead).
+    * detection — a crashed row is detected once NO up observer holds a
+      non-DEAD record about it (unknown counts as detected, matching the
+      reference's removal semantics).
+    * convergence — no up observer holds a non-ALIVE record about any up
+      subject (partial-view re-convergence: every live edge agrees ALIVE).
+    * key regressions — self records never regress (lattice monotonicity).
+    * view invariant — no duplicate subjects and no self-entry within any
+      row's table (the pview analogue of sparse's n_live drift: corruption
+      no protocol-level check would see).
+    """
+    n = state.capacity
+    keys = _keys_i32(state)
+    sid = state.nbr_id
+    sidc = jnp.maximum(sid, 0)
+    valid = sid >= 0
+    rank = keys & 3
+    rel = state.tick - spec["t0"]
+
+    sent = dict(sent)
+    sent["key_regressions"] = sent["key_regressions"] + (
+        state.self_key < sent["prev_diag"]
+    ).sum().astype(jnp.int32)
+    sent["prev_diag"] = state.self_key
+
+    nf_up = spec["never_faulted"] & state.up
+    fd_edge = valid & state.up[:, None] & (rank == RANK_DEAD) & nf_up[sidc]
+    false_dead = (
+        jnp.zeros((n + 1,), bool)
+        .at[jnp.where(fd_edge, sid, n)]
+        .max(fd_edge, mode="drop")[:n]
+        .sum()
+        .astype(jnp.int32)
+    )
+    sent["false_dead_max"] = jnp.maximum(sent["false_dead_max"], false_dead)
+
+    crash_rows_ = spec["crash_rows"]
+    if crash_rows_.shape[0]:
+        holds = (
+            valid[:, :, None]
+            & state.up[:, None, None]
+            & (sid[:, :, None] == crash_rows_[None, None, :])
+            & (rank[:, :, None] != RANK_DEAD)
+        )
+        detected = ~holds.any(axis=(0, 1))
+        active = (
+            (rel >= spec["crash_at"])
+            & (rel <= spec["crash_until"])
+            & (sent["detect_tick"] < 0)
+        )
+        sent["detect_tick"] = jnp.where(active & detected, rel, sent["detect_tick"])
+
+    if spec["conv_from"].shape[0]:
+        bad_edge = (
+            valid & state.up[:, None] & state.up[sidc] & (rank != RANK_ALIVE)
+        )
+        converged = ~bad_edge.any()
+        active = (rel >= spec["conv_from"]) & (sent["conv_tick"] < 0)
+        sent["conv_tick"] = jnp.where(active & converged, rel, sent["conv_tick"])
+
+    dup = (
+        valid[:, :, None]
+        & valid[:, None, :]
+        & (sid[:, :, None] == sid[:, None, :])
+        & ~jnp.eye(sid.shape[1], dtype=bool)[None]
+    ).any(axis=(1, 2))
+    self_entry = (valid & (sid == jnp.arange(n)[:, None])).any(axis=1)
+    breaks = (dup | self_entry).sum().astype(jnp.int32)
+    sent["view_invariant_breaks"] = (
+        sent.get("view_invariant_breaks", jnp.int32(0)) + breaks
+    )
+    return sent
+
+
+def sentinel_init(state: PviewState, spec) -> dict:
+    """Fresh sentinel accumulators baselined on the current self records.
+
+    ``prev_diag`` must be an independent COPY: the live ``self_key`` leaf
+    is donated away by the next window, and an aliased baseline would read
+    "Array has been deleted" at the first sentinel check (dense gets this
+    for free from its diag gather)."""
+    sent = {
+        "prev_diag": jnp.array(state.self_key, copy=True),
+        "key_regressions": jnp.int32(0),
+        "false_dead_max": jnp.int32(0),
+        "detect_tick": jnp.full((len(spec.crash_rows),), -1, jnp.int32),
+        "conv_tick": jnp.full((len(spec.conv_from),), -1, jnp.int32),
+        "view_invariant_breaks": jnp.int32(0),
+    }
+    return sent
+
+
+# pview telemetry ring layout: the sparse series verbatim (shared core +
+# pool backpressure — the pool machinery IS the sparse pool).
+TELEMETRY_SERIES = _SPARSE_TELEMETRY_SERIES
+
+
+def telemetry_window_vector(ms: dict, state: PviewState) -> jax.Array:
+    from .kernel import telemetry_window_core
+
+    f32 = jnp.float32
+    vec = telemetry_window_core(ms, state)
+    vec.extend(
+        [
+            ms["announced"].sum().astype(f32),
+            ms["announce_dropped"].sum().astype(f32),
+            ms["pool_evicted"].sum().astype(f32),
+            ms["mr_active_count"].max().astype(f32),
+        ]
+    )
+    return jnp.stack(vec)
+
+
+def snapshot(state: PviewState) -> dict:
+    return {
+        f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(PviewState)
+    }
+
+
+def restore(arrays: dict) -> PviewState:
+    # copy=True: see the dense state.restore use-after-free account (r6)
+    return PviewState(**{k: jnp.array(v, copy=True) for k, v in arrays.items()})
+
+
+# ---------------------------------------------------------------------------
+# in-tick helpers
+# ---------------------------------------------------------------------------
+
+
+def _loss_at(state: PviewState, i, j):
+    base = jnp.broadcast_to(state.loss, jnp.shape(i))
+    part = state.part_loss[state.part_id[i], state.part_id[j]]
+    return jnp.maximum(base, part)
+
+
+def _rt_at(state: PviewState, i, j):
+    return (1.0 - _loss_at(state, i, j)) * (1.0 - _loss_at(state, j, i))
+
+
+def _timely_rt(q1, q2, t: int):
+    h = jnp.ones_like(q1)
+    acc = h
+    q2p = jnp.ones_like(q2)
+    for _ in range(t):
+        q2p = q2p * q2
+        h = q1 * h + q2p
+        acc = acc + h
+    return (1.0 - q1) * (1.0 - q2) * acc
+
+
+def _rt_timely(state: PviewState, i, j, t: int):
+    p = _rt_at(state, i, j)
+    if state.pending_inf.shape[0]:
+        q = jnp.broadcast_to(state.delay_q, jnp.shape(i))
+        p = p * _timely_rt(q, q, t)
+    return p
+
+
+def _sample_slots(state: PviewState, rows, u, n_picks: int, tries: int, ka: int):
+    """Per-row ``n_picks`` distinct ACTIVE-SLOT draws by bounded rejection —
+    the slot-space mirror of :func:`.sparse._sample_rejection`: each pick
+    takes the first of ``tries`` uniform slot draws that holds a non-DEAD
+    neighbor and differs from earlier picks. Slot distinctness IS member
+    distinctness (table rows hold unique subjects).
+
+    Returns (slot [R, P] clamped, member [R, P] clamped, valid [R, P])."""
+    slots = jnp.minimum((u * np.float32(ka)).astype(jnp.int32), ka - 1)
+    sid = state.nbr_id[rows[:, None], slots]
+    skey = state.nbr_key[rows[:, None], slots].astype(jnp.int32)
+    ok_base = (sid >= 0) & ((skey & 3) != RANK_DEAD)
+    picks = []
+    for p in range(n_picks):
+        sel = jnp.full(rows.shape, -1, jnp.int32)
+        for t in range(tries):
+            c = slots[:, p * tries + t]
+            ok = ok_base[:, p * tries + t]
+            for q in picks:
+                ok = ok & (c != q)
+            sel = jnp.where((sel < 0) & ok, c, sel)
+        picks.append(sel)
+    slot = jnp.stack(picks, 1)
+    valid = slot >= 0
+    slot_c = jnp.maximum(slot, 0)
+    member = state.nbr_id[rows[:, None], slot_c]
+    return slot_c, jnp.maximum(member, 0), valid
+
+
+def _apply_records(
+    state: PviewState, subj, cand, valid, salt: int, ka: int
+):
+    """Merge one record per row into the row's world: ``subj``/``cand``
+    [N] i32 (layout-valued), ``valid`` [N]. The ONE accept-and-place
+    spelling every delivery path shares (gossip rumor apply, SYNC merge):
+
+    * accept gates — identical to sparse: ``cand > own``; unknown subjects
+      admit ALIVE/LEAVING only; ALIVE candidates pass the metadata-fetch
+      gate (same salt-keyed stateless hash draw).
+    * placement — subject == row routes to ``self_key``; a tabled subject
+      updates in place; an unknown subject inserts at the first empty
+      slot, else evicts the minimum-key passive entry (deviation P3).
+
+    Returns (state, accepted [N] bool, sus_cand [N] i32 scatter-max input
+    folded by the caller)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    kdt = _kdt(state)
+    k = state.nbr_id.shape[1]
+    keys = _keys_i32(state)
+    subj_c = jnp.clip(subj, 0, n - 1)
+
+    to_self = valid & (subj == rows)
+    to_tab = valid & ~to_self & (subj >= 0)
+
+    match = state.nbr_id == subj[:, None]
+    present = (match & to_tab[:, None]).any(axis=1)
+    slot_p = jnp.argmax(match, axis=1).astype(jnp.int32)
+    own_tab = jnp.where(present, keys[rows, slot_p], UNKNOWN_KEY)
+    own = jnp.where(to_self, state.self_key, own_tab)
+
+    needs_fetch = (cand & 3) == RANK_ALIVE
+    u = fetch_uniform(state.tick, salt, rows, subj_c)
+    fetch_ok = ~needs_fetch | (state.up[subj_c] & (u < _rt_at(state, rows, subj_c)))
+    accept = (
+        (to_self | to_tab)
+        & (cand > own)
+        & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+        & fetch_ok
+    )
+
+    new_self = jnp.where(accept & to_self, cand, state.self_key)
+
+    acc_t = accept & to_tab
+    empty = state.nbr_id < 0
+    has_empty = empty.any(axis=1)
+    slot_e = jnp.argmax(empty, axis=1).astype(jnp.int32)
+    p_keys = keys[:, ka:]
+    slot_v = (ka + jnp.argmin(p_keys, axis=1)).astype(jnp.int32)
+    slot_w = jnp.where(present, slot_p, jnp.where(has_empty, slot_e, slot_v))
+    onehot = acc_t[:, None] & (jnp.arange(k)[None, :] == slot_w[:, None])
+    new_id = jnp.where(onehot, subj[:, None], state.nbr_id)
+    new_key = jnp.where(onehot, cand[:, None].astype(kdt), state.nbr_key)
+
+    sus_in = jnp.where(accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE)
+    sus_cand = (
+        jnp.full((n + 1,), NO_CANDIDATE, jnp.int32)
+        .at[jnp.where(accept, subj_c, n)]
+        .max(sus_in, mode="drop")[:n]
+    )
+    state = state.replace(self_key=new_self, nbr_id=new_id, nbr_key=new_key)
+    return state, accept, sus_cand
+
+
+def _register_sus(state: PviewState, sus_cand) -> PviewState:
+    new_sus = jnp.maximum(state.sus_key, sus_cand)
+    return state.replace(
+        sus_key=new_sus,
+        sus_since=jnp.where(new_sus > state.sus_key, state.tick, state.sus_since),
+    )
+
+
+# ---------------------------------------------------------------------------
+# phases
+# ---------------------------------------------------------------------------
+
+
+def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
+    """Vectorized FD round over the active view — the sparse ``_fd_phase``
+    with slot-space target/relay selection and the self-record ACK."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    ka = params.active_slots
+    kdt = _kdt(state)
+    keys = _keys_i32(state)
+    tgt_slot_all, tgt_all, valid = _sample_slots(
+        state, rows, r.fd_try, 1 + params.ping_req_k, params.sample_tries, ka
+    )
+    tgt_slot = tgt_slot_all[:, 0]
+    tgt = tgt_all[:, 0]
+    has_tgt = valid[:, 0] & state.up
+
+    p_direct = _rt_timely(state, rows, tgt, params.fd_direct_timeout_ticks) \
+        if params.delay_slots else _rt_at(state, rows, tgt)
+    direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
+
+    relays = tgt_all[:, 1:]
+    relay_valid = valid[:, 1:]
+    tgt_b = tgt[:, None]
+    p_relay = _rt_at(state, rows[:, None], relays) * _rt_at(state, relays, tgt_b)
+    if params.delay_slots:
+        q = jnp.broadcast_to(state.delay_q, relays.shape)
+        p_relay = p_relay * _timely_rt(q, q, params.fd_leg_timeout_ticks)
+        p_relay = p_relay * _timely_rt(q, q, params.fd_leg_timeout_ticks)
+    relay_ok = relay_valid & state.up[relays] & state.up[tgt_b] & (r.fd_relay < p_relay)
+    ack = direct_ok | relay_ok.any(axis=1)
+
+    own_key = keys[rows, tgt_slot]
+    alive_key = (state.self_key[tgt] >> 2) << 2
+    suspect_key = ((own_key >> 2) << 2) | RANK_SUSPECT
+    cand = jnp.where(ack, alive_key, suspect_key)
+    accept = has_tgt & (cand > own_key)
+    V = min(n, params.fd_accept_slots or max(64, n // 16))
+    eff = accept & (jnp.cumsum(accept.astype(jnp.int32)) - 1 < V)
+
+    onehot = eff[:, None] & (jnp.arange(state.nbr_id.shape[1])[None, :] == tgt_slot[:, None])
+    st = state.replace(
+        nbr_key=jnp.where(onehot, cand[:, None].astype(kdt), state.nbr_key)
+    )
+    sus_cand = (
+        jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        .at[tgt]
+        .max(jnp.where(eff & ~ack, cand, NO_CANDIDATE))
+    )
+    st = _register_sus(st, sus_cand)
+    proposals = (tgt, cand, rows, eff)
+    metrics = {
+        "fd_probes": has_tgt.sum(),
+        "fd_failed_probes": (has_tgt & ~ack).sum(),
+        "fd_new_suspects": (eff & ~ack).sum(),
+    }
+    if trace:
+        metrics["trace_fd"] = {
+            "tgt": tgt.astype(jnp.int32),
+            "has_tgt": has_tgt,
+            "ack": ack,
+            "direct_ok": direct_ok,
+            "suspect": eff & ~ack,
+            "relays": relays.astype(jnp.int32),
+            "relay_valid": relay_valid,
+            "relay_ok": relay_ok,
+        }
+    return st, proposals, metrics
+
+
+def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None):
+    """Every ``sweep_every`` ticks: (1) suspicion-episode expiry over the
+    [N, k] tables + the self records (sparse deviation 1 semantics, static
+    timeout — deviation P2), with per-subject announcer election; (2) the
+    TOMBSTONE PURGE (deviation P8) every ``purge_sweeps``-th sweep; (3)
+    the ACTIVE-VIEW PROMOTION sweep — each empty/DEAD active slot swaps in
+    the best (max-key) live passive entry, ascending active slots first.
+    The promotion is the HyParView active-view repair, made deterministic."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    k = state.nbr_id.shape[1]
+    ka = params.active_slots
+    timeout = params.suspicion_timeout_ticks
+    no_props = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        rows,
+        jnp.zeros((n,), bool),
+    )
+
+    def _expire(st: PviewState):
+        keys = _keys_i32(st)
+        sid = st.nbr_id
+        sidc = jnp.maximum(sid, 0)
+        is_sus = (keys & 3) == RANK_SUSPECT
+        expired = (
+            is_sus
+            & st.up[:, None]
+            & ((st.tick - st.sus_since[sidc]) >= timeout)
+            & (keys <= st.sus_key[sidc])
+        )
+        new_keys = jnp.where(expired, keys + 1, keys)
+        self_expired = (
+            st.up
+            & ((st.self_key & 3) == RANK_SUSPECT)
+            & ((st.tick - st.sus_since) >= timeout)
+            & (st.self_key <= st.sus_key)
+        )
+        new_self = jnp.where(self_expired, st.self_key + 1, st.self_key)
+        any_suspect_left = (
+            ((new_keys & 3) == RANK_SUSPECT) & st.up[:, None] & (sid >= 0)
+        ).any() | (((new_self & 3) == RANK_SUSPECT) & st.up).any()
+        sus_key = jnp.where(any_suspect_left, st.sus_key, NO_CANDIDATE)
+        sus_since = jnp.where(any_suspect_left, st.sus_since, NEVER)
+        # per-subject announcer election (sparse deviation 3): the lowest
+        # expiring observer row announces; self-expiry never does (P7)
+        first_row = (
+            jnp.full((n + 1,), n, jnp.int32)
+            .at[jnp.where(expired, sid, n)]
+            .min(jnp.broadcast_to(rows[:, None], expired.shape), mode="drop")[:n]
+        )
+        mine = expired & (first_row[sidc] == rows[:, None])
+        any_exp = mine.any(axis=1)
+        col = jnp.argmax(mine, axis=1).astype(jnp.int32)
+        subj = sid[rows, col]
+        key = new_keys[rows, col]
+        st = st.replace(
+            nbr_key=new_keys.astype(_kdt(st)),
+            self_key=new_self,
+            sus_key=sus_key,
+            sus_since=sus_since,
+        )
+        props = (jnp.maximum(subj, 0), key, rows, any_exp)
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            # tracer-subject expiry counts: [N, K] mask of expiring cells
+            tr = jnp.asarray(trace.tracer_rows, jnp.int32)
+            exp_cols = (
+                (sid[:, :, None] == tr[None, None, :]) & expired[:, :, None]
+            ).any(axis=1)
+            return st, props, {
+                "count": exp_cols.sum(axis=0).astype(jnp.int32),
+                "by": _tc._exemplar(exp_cols),
+            }
+        return st, props
+
+    def _skip_exp(st: PviewState):
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            return st, no_props, _tc.zero_sus_trace(trace)
+        return st, no_props
+
+    def _purge(st: PviewState):
+        # tombstone purge (deviation P8): every purge_sweeps-th sweep,
+        # forget every DEAD table entry — masked where, no cond (the
+        # cadence test is on the traced tick)
+        do = ((st.tick // params.sweep_every) % params.purge_sweeps) == 0
+        keys = _keys_i32(st)
+        drop = do & (st.nbr_id >= 0) & ((keys & 3) == RANK_DEAD)
+        return st.replace(
+            nbr_id=jnp.where(drop, -1, st.nbr_id),
+            nbr_key=jnp.where(drop, UNKNOWN_KEY, keys).astype(_kdt(st)),
+        )
+
+    def _promote(st: PviewState):
+        nbr_id, nbr_key = st.nbr_id, st.nbr_key
+        for a in range(ka):
+            keys = nbr_key.astype(jnp.int32)
+            a_id = nbr_id[:, a]
+            a_key = keys[:, a]
+            bad = (a_id < 0) | ((a_key & 3) == RANK_DEAD)
+            p_ids = nbr_id[:, ka:]
+            p_keys = keys[:, ka:]
+            ok_p = (p_ids >= 0) & ((p_keys & 3) != RANK_DEAD)
+            score = jnp.where(ok_p, p_keys, NO_CANDIDATE)
+            j = jnp.argmax(score, axis=1).astype(jnp.int32)
+            has = score[rows, j] > NO_CANDIDATE
+            do = bad & has
+            src = ka + j
+            sel_a = jnp.arange(k)[None, :] == a
+            sel_p = jnp.arange(k)[None, :] == src[:, None]
+            id_a = nbr_id[rows, src]
+            key_a = nbr_key[rows, src]
+            nbr_id = jnp.where(
+                do[:, None] & sel_a, id_a[:, None],
+                jnp.where(do[:, None] & sel_p, a_id[:, None], nbr_id),
+            )
+            nbr_key = jnp.where(
+                do[:, None] & sel_a, key_a[:, None],
+                jnp.where(do[:, None] & sel_p, nbr_key[:, a][:, None], nbr_key),
+            )
+        return st.replace(nbr_id=nbr_id, nbr_key=nbr_key)
+
+    def _sweep(st: PviewState):
+        has_suspects = (st.sus_since > NEVER).any()
+        out = jax.lax.cond(has_suspects, _expire, _skip_exp, st)
+        st2 = _promote(_purge(out[0]))
+        return (st2,) + tuple(out[1:])
+
+    def _skip(st: PviewState):
+        return _skip_exp(st)
+
+    on_tick = (state.tick % params.sweep_every) == 0
+    return jax.lax.cond(on_tick, _sweep, _skip, state)
+
+
+def _gossip_phase(state: PviewState, r, params: PviewParams):
+    """Infection-style dissemination — the sparse ``_gossip_phase`` with
+    active-view peer sampling and the per-receiver A-pass record apply
+    (deviation P5). Quiescent clusters skip the whole phase."""
+    n = state.capacity
+    m = params.mr_pool
+    rows = jnp.arange(n)
+    D = params.delay_slots
+    F = params.fanout
+    R = params.rumor_slots
+    spread = params.spread_ticks
+    from .bitplane import pack_bits as _pack_bits, unpack_bits as _unpack_bits
+
+    work = state.rumor_active.any() | state.mr_active.any()
+    if D:
+        slot_now = state.tick % D
+        work = (
+            work
+            | state.pending_inf[slot_now].any()
+            | state.pending_minf[slot_now].any()
+        )
+
+    def _deliver(state: PviewState):
+        mr_any = state.mr_active.any()
+        if D:
+            mr_any = mr_any | state.pending_minf[slot_now].any()
+        young_u = (
+            state.infected
+            & state.rumor_active[None, :]
+            & (state.tick - state.infected_at < spread)
+        )
+
+        def _mr_pre(st: PviewState):
+            age = st.minf_age
+            age = jnp.where(
+                age > 0, jnp.minimum(age, jnp.uint8(254)) + jnp.uint8(1), age
+            )
+            young_m = (
+                (age > 0)
+                & st.mr_active[None, :]
+                & (age.astype(jnp.int32) <= spread)
+            )
+            return age, _pack_bits(young_m)
+
+        def _mr_pre_skip(st: PviewState):
+            return st.minf_age, jnp.zeros((n, (m + 31) // 32), jnp.uint32)
+
+        age, ym_p = jax.lax.cond(mr_any, _mr_pre, _mr_pre_skip, state)
+        state = state.replace(minf_age=age)
+        _slots, peers, peer_valid = _sample_slots(
+            state, rows, r.gossip_try, F, params.sample_tries,
+            params.active_slots,
+        )
+
+        yu_p = _pack_bits(young_u)
+        Wm, Wu = ym_p.shape[1], yu_p.shape[1]
+        payload = jnp.concatenate(
+            [ym_p, yu_p, state.infected_from.astype(jnp.uint32)], axis=1
+        )
+        if D:
+            recv_u = state.pending_inf[slot_now]
+            recv_src = state.pending_src[slot_now]
+            recv_m_p = _pack_bits(state.pending_minf[slot_now])
+            pend_u = state.pending_inf
+            pend_src = state.pending_src
+            pend_m = state.pending_minf
+        else:
+            recv_u = jnp.zeros_like(state.infected)
+            recv_src = jnp.full_like(state.infected_from, -1)
+            recv_m_p = jnp.zeros_like(ym_p)
+
+        sender_has = young_u.any(axis=1) | (ym_p != 0).any(axis=1)
+        p_all = peers.T  # [F, N]
+        rows_b = jnp.broadcast_to(rows, (F, n))
+        ok_all = (
+            peer_valid.T
+            & sender_has[None, :]
+            & state.up[None, :]
+            & state.up[p_all]
+            & (r.gossip_edge.T < (1.0 - _loss_at(state, rows_b, p_all)))
+        )
+        sent = ok_all.sum()
+        if D:
+            qd = jnp.broadcast_to(state.delay_q, (F, n))
+            d_all = jnp.zeros((F, n), jnp.int32)
+            qpow = qd
+            for _ in range(1, D):
+                d_all = d_all + (r.gossip_delay.T < qpow)
+                qpow = qpow * qd
+            ok_now_all = ok_all & (d_all == 0)
+        else:
+            ok_now_all = ok_all
+        inv = (
+            jnp.full((F, n), -1, jnp.int32)
+            .at[jnp.arange(F)[:, None], p_all]
+            .max(jnp.where(ok_now_all, rows[None, :], -1))
+        )
+        j_all = jnp.maximum(inv, 0)
+        has_all = (inv >= 0)[:, :, None]
+        pl_all = payload[j_all]
+        yu_all = _unpack_bits(pl_all[:, :, Wm : Wm + Wu], R)
+        from_all = pl_all[:, :, Wm + Wu :].astype(jnp.int32)
+        deliver_u_all = (
+            yu_all
+            & has_all
+            & (from_all != rows[None, :, None])
+            & (state.rumor_origin[None, None, :] != rows[None, :, None])
+        )
+        recv_u = recv_u | deliver_u_all.any(axis=0)
+        recv_src = jnp.maximum(
+            recv_src,
+            jnp.where(deliver_u_all, j_all[:, :, None], -1).max(axis=0),
+        )
+        recv_m_p = functools.reduce(
+            jnp.bitwise_or,
+            [jnp.where(has_all[s], pl_all[s, :, :Wm], jnp.uint32(0)) for s in range(F)],
+            recv_m_p,
+        )
+        rumor_sent = deliver_u_all.sum()
+        if D:
+            no_sender = jnp.full((n,), -1, jnp.int32)
+            for s in range(F):
+                ok_late = ok_all[s] & (d_all[s] > 0)
+                inv_l = no_sender.at[p_all[s]].max(jnp.where(ok_late, rows, -1))
+                jl = jnp.maximum(inv_l, 0)
+                hasl = (inv_l >= 0)[:, None]
+                pll = payload[jl]
+                young_u_l = _unpack_bits(pll[:, Wm : Wm + Wu], R)
+                lfrom = pll[:, Wm + Wu :].astype(jnp.int32)
+                slot_d = (state.tick + d_all[s][jl]) % D
+                late_u = (
+                    young_u_l
+                    & hasl
+                    & (lfrom != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                pend_u = pend_u.at[slot_d, rows].max(late_u)
+                pend_src = pend_src.at[slot_d, rows].max(
+                    jnp.where(late_u, jl[:, None], -1)
+                )
+                pend_m = pend_m.at[slot_d, rows].max(
+                    _unpack_bits(pll[:, :Wm], m)
+                    & hasl
+                    & (state.mr_origin[None, :] != rows[:, None])
+                )
+
+        newly_u = recv_u & ~state.infected & state.up[:, None] & state.rumor_active[None, :]
+        state = state.replace(
+            infected=state.infected | newly_u,
+            infected_at=jnp.where(newly_u, state.tick, state.infected_at),
+            infected_from=jnp.where(newly_u, recv_src, state.infected_from),
+        )
+
+        # membership-rumor infection + record application, capped at A per
+        # receiver per tick (deviation P5): pass a picks each row's lowest
+        # still-eligible pool slot, marks it delivered (minf_age = 1), and
+        # routes the record through the shared accept-and-place spelling.
+        def _mr_apply(state: PviewState):
+            recv_m = _unpack_bits(recv_m_p, m) & (
+                state.mr_origin[None, :] != rows[:, None]
+            )
+            remaining = (
+                recv_m
+                & (state.minf_age == 0)
+                & state.up[:, None]
+                & state.mr_active[None, :]
+            )
+
+            # A sequential apply passes as a lax.scan (the unrolled form
+            # inlines A copies of the accept-and-place graph — compile
+            # time, not semantics; pass order is identical)
+            def apply_pass(carry, _):
+                st, minf, remaining, sus_acc, delivered, accepts = carry
+                col = jnp.argmax(remaining, axis=1).astype(jnp.int32)
+                got = remaining[rows, col]
+                subj = st.mr_subject[col]
+                cand = st.mr_key[col]
+                onehot = got[:, None] & (jnp.arange(m)[None, :] == col[:, None])
+                minf = jnp.where(onehot, jnp.uint8(1), minf)
+                remaining = remaining & ~onehot
+                st, acc, sus_cand = _apply_records(
+                    st, subj, cand, got, SALT_GOSSIP, params.active_slots
+                )
+                sus_acc = jnp.maximum(sus_acc, sus_cand)
+                return (
+                    st, minf, remaining, sus_acc,
+                    delivered + got.sum(), accepts + acc.sum(),
+                ), None
+
+            carry0 = (
+                state, state.minf_age, remaining,
+                jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                jnp.int32(0), jnp.int32(0),
+            )
+            (state, minf, _rem, sus_acc, delivered, accepts), _ = jax.lax.scan(
+                apply_pass, carry0, None, length=params.apply_slots
+            )
+            state = _register_sus(state.replace(minf_age=minf), sus_acc)
+            return state, delivered, accepts
+
+        state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
+            mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)), state
+        )
+        if D:
+            state = state.replace(
+                pending_inf=pend_u.at[slot_now].set(False),
+                pending_src=pend_src.at[slot_now].set(-1),
+                pending_minf=pend_m.at[slot_now].set(False),
+            )
+        return state, {
+            "gossip_msgs": sent,
+            "rumor_sends": rumor_sent,
+            "rumor_deliveries": newly_u.sum(),
+            "mr_deliveries": n_mr_deliveries,
+            "mr_accepts": n_mr_accepts,
+        }
+
+    def _quiet(state: PviewState):
+        return state, {
+            "gossip_msgs": jnp.int32(0),
+            "rumor_sends": jnp.int32(0),
+            "rumor_deliveries": jnp.int32(0),
+            "mr_deliveries": jnp.int32(0),
+            "mr_accepts": jnp.int32(0),
+        }
+
+    return jax.lax.cond(work, _deliver, _quiet, state)
+
+
+def _merge_entries(
+    state: PviewState,
+    src_rows,
+    pre_id,
+    pre_key_i32,
+    pre_self,
+    salt: int,
+    params: PviewParams,
+):
+    """Merge each row's source's PRE-exchange table (k entries + the self
+    record) into the row, sequentially by slot (deviation P4) — a
+    lax.scan over the k + 1 record steps (an unrolled loop inlines k + 1
+    copies of the accept-and-place graph and dominates the whole tick's
+    XLA compile time). Returns (state, accept_count [N], top-P
+    subjects/keys [N, P])."""
+    n = state.capacity
+    k = pre_id.shape[1]
+    P = params.sync_announce
+    has = src_rows >= 0
+    src = jnp.maximum(src_rows, 0)
+    # [k + 1, N] per-step record streams; step k is the self record
+    subj_steps = jnp.concatenate([pre_id[src].T, src[None, :]], axis=0)
+    cand_steps = jnp.concatenate(
+        [pre_key_i32[src].T, pre_self[src][None, :]], axis=0
+    )
+
+    def body(carry, xs):
+        st, acc_cnt, best_key, best_subj, sus_acc = carry
+        subj, cand = xs
+        valid = has & (subj >= 0)
+        st, acc, sus_cand = _apply_records(
+            st, subj, cand, valid, salt, params.active_slots
+        )
+        sus_acc = jnp.maximum(sus_acc, sus_cand)
+        acc_cnt = acc_cnt + acc.astype(jnp.int32)
+        # running top-P accepted keys (largest first; earlier step wins
+        # ties — the re-gossip proposals, sparse deviation 3's cap)
+        ins_k = jnp.where(acc, cand, NO_CANDIDATE)
+        ins_s = subj
+        for p in range(P):
+            take = ins_k > best_key[:, p]
+            old_k, old_s = best_key[:, p], best_subj[:, p]
+            best_key = best_key.at[:, p].set(jnp.where(take, ins_k, old_k))
+            best_subj = best_subj.at[:, p].set(jnp.where(take, ins_s, old_s))
+            ins_k = jnp.where(take, old_k, ins_k)
+            ins_s = jnp.where(take, old_s, ins_s)
+        return (st, acc_cnt, best_key, best_subj, sus_acc), None
+
+    carry0 = (
+        state,
+        jnp.zeros((n,), jnp.int32),
+        jnp.full((n, P), NO_CANDIDATE, jnp.int32),
+        jnp.zeros((n, P), jnp.int32),
+        jnp.full((n,), NO_CANDIDATE, jnp.int32),
+    )
+    (state, acc_cnt, best_key, best_subj, sus_acc), _ = jax.lax.scan(
+        body, carry0, (subj_steps, cand_steps)
+    )
+    state = _register_sus(state, sus_acc)
+    return state, acc_cnt, best_subj, best_key
+
+
+def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
+    """Anti-entropy + shuffle: a due caller exchanges its table (plus self
+    record) with one sampled active peer — both directions merge the
+    other's PRE-exchange entries (deviation P4); multiple callers on one
+    peer collapse to the highest slot (deviation P6). The passive-slot
+    insertions this merge performs ARE the HyParView shuffle refresh."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    P = params.sync_announce
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
+    due_p = ((state.tick + rows * params.sync_stagger) % params.sync_every) == 0
+    due_f = state.force_sync & state.up
+    due_p = due_p & state.up & ~due_f
+    (cf,) = jnp.nonzero(due_f, size=K, fill_value=n)
+    nf = (cf < n).sum()
+    (cp,) = jnp.nonzero(due_p, size=K, fill_value=n)
+    caller = cf.at[jnp.arange(K) + nf].set(cp, mode="drop")
+    valid_c = caller < n
+    caller = jnp.minimum(caller, n - 1)
+
+    # SYNC peer draw over the UNION pool ``active slots ∪ seeds`` — the
+    # reference's selectSyncAddress draws from seedMembers ∪ members
+    # (MembershipProtocolImpl.java:461-472); with a full view the seed
+    # share is vanishing, with a k-slot table it is S/(ka+S). This is the
+    # partial-view re-bridging mechanism: after a partition's mutual kill
+    # each side's table marks the other DEAD (unsampleable), and ONLY an
+    # always-contactable seed re-connects the halves (the sparse engine's
+    # extra_mask plays this role over its full-width column draw).
+    ka = params.active_slots
+    S = len(params.seed_rows)
+    pool = ka + S
+    u_try = r.sync_try[caller]  # [K, T]
+    tries = jnp.minimum((u_try * np.float32(pool)).astype(jnp.int32), pool - 1)
+    if S:
+        seeds_arr = jnp.asarray(params.seed_rows, jnp.int32)
+        seed_pick = seeds_arr[jnp.clip(tries - ka, 0, S - 1)]
+    is_seed = tries >= ka
+    slot_c = jnp.minimum(tries, ka - 1)
+    sid = state.nbr_id[caller[:, None], slot_c]
+    skey = state.nbr_key[caller[:, None], slot_c].astype(jnp.int32)
+    tab_ok = ~is_seed & (sid >= 0) & ((skey & 3) != RANK_DEAD)
+    if S:
+        member_try = jnp.where(is_seed, seed_pick, jnp.maximum(sid, 0))
+        ok_try = tab_ok | (is_seed & (seed_pick != caller[:, None]))
+    else:
+        member_try = jnp.maximum(sid, 0)
+        ok_try = tab_ok
+    peer = jnp.full(caller.shape, -1, jnp.int32)
+    for t_i in range(params.sample_tries):
+        peer = jnp.where(
+            (peer < 0) & ok_try[:, t_i], member_try[:, t_i], peer
+        )
+    valid_pick = peer >= 0
+    peer = jnp.maximum(peer, 0)
+    if params.seed_rows:
+        fb = seeds_arr[
+            jnp.minimum((r.sync_fb[caller] * np.float32(S)).astype(jnp.int32), S - 1)
+        ]
+        use_fb = ~valid_pick & (fb != caller)
+        peer = jnp.where(use_fb, fb, peer)
+        valid_pick = valid_pick | use_fb
+        # deterministic seed cadence (see PviewParams.seed_sync_every):
+        # periodic callers only — forced (joiner) syncs keep the draw
+        Q = params.seed_sync_every
+        round_ = (state.tick + caller * params.sync_stagger) // params.sync_every
+        sidx = (caller + round_ // Q) % S
+        sp = seeds_arr[sidx]
+        sp = jnp.where(sp == caller, seeds_arr[(sidx + 1) % S], sp)
+        is_periodic = jnp.arange(K) >= nf
+        use_seed = ((round_ % Q) == 0) & (sp != caller) & is_periodic & valid_c
+        peer = jnp.where(use_seed, sp, peer)
+        valid_pick = valid_pick | use_seed
+    p_rt = _rt_timely(state, caller, peer, params.sync_timeout_ticks) \
+        if params.delay_slots else _rt_at(state, caller, peer)
+    ok = valid_c & valid_pick & state.up[peer] & (r.sync_edge[caller] < p_rt)
+
+    # pre-exchange snapshot: both directions merge from these
+    pre_id = state.nbr_id
+    pre_key = _keys_i32(state)
+    pre_self = state.self_key
+
+    # REQ direction: winner caller per peer (deviation P6)
+    inv_slot = (
+        jnp.full((n,), -1, jnp.int32)
+        .at[peer]
+        .max(jnp.where(ok, jnp.arange(K, dtype=jnp.int32), -1))
+    )
+    req_src = jnp.where(inv_slot >= 0, caller[jnp.maximum(inv_slot, 0)], -1)
+    st, req_acc_n, req_subj, req_key = _merge_entries(
+        state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params
+    )
+    # ACK direction: distinct callers each merge their peer's pre-entries
+    ack_src = (
+        jnp.full((n,), -1, jnp.int32)
+        .at[caller]
+        .max(jnp.where(ok, peer, -1))
+    )
+    st, ack_acc_n, ack_subj, ack_key = _merge_entries(
+        st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params
+    )
+
+    ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
+    st = st.replace(force_sync=st.force_sync & ~ok_full)
+
+    # re-gossip proposals: top-P accepted per participant, REQ receivers
+    # (peers) first then ACK receivers (callers) — [N·P] each direction
+    def _props(subj2, key2, part_mask):
+        subs = jnp.concatenate([subj2[:, p] for p in range(P)])
+        keys_ = jnp.concatenate([key2[:, p] for p in range(P)])
+        origs = jnp.concatenate([rows] * P)
+        vals = jnp.concatenate(
+            [part_mask & (key2[:, p] > NO_CANDIDATE) for p in range(P)]
+        )
+        return subs, keys_, origs, vals
+
+    props_p = _props(req_subj, req_key, req_src >= 0)
+    props_c = _props(ack_subj, ack_key, ack_src >= 0)
+    proposals = tuple(
+        jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
+    )
+    metrics = {"sync_roundtrips": ok.sum()}
+    if trace:
+        winner = ok & (inv_slot[peer] == jnp.arange(K))
+        metrics["trace_sync"] = {
+            "caller": caller.astype(jnp.int32),
+            "valid": valid_c,
+            "peer": peer.astype(jnp.int32),
+            "ok": ok,
+            "req_acc": jnp.where(winner, req_acc_n[peer], 0).astype(jnp.int32),
+            "ack_acc": jnp.where(ok, ack_acc_n[caller], 0).astype(jnp.int32),
+        }
+    return st, proposals, metrics
+
+
+def _refute_phase(state: PviewState, params: PviewParams):
+    """Self-record refutation — row-local over ``self_key``; bumps route
+    through :func:`.lattice.bump_inc` (narrow saturation)."""
+    n = state.capacity
+    rows = jnp.arange(n)
+    kdt = _kdt(state)
+    diag = state.self_key
+    rank = diag & 3
+    need = state.up & (
+        (rank == RANK_SUSPECT)
+        | (rank == RANK_DEAD)
+        | (state.leaving & (rank != RANK_LEAVING))
+    )
+    V = min(n, params.refute_slots or max(64, n // 16))
+    eff = need & (jnp.cumsum(need.astype(jnp.int32)) - 1 < V)
+    announce_rank = jnp.where(state.leaving, RANK_LEAVING, RANK_ALIVE)
+    bumped = bump_inc(diag.astype(kdt), announce_rank.astype(kdt)).astype(jnp.int32)
+    new_diag = jnp.where(eff, bumped, diag)
+    st = state.replace(self_key=new_diag)
+    return st, (rows, new_diag, rows, eff)
+
+
+def _rumor_sweeps(state: PviewState, params: PviewParams) -> PviewState:
+    """Slot reclamation — sparse semantics with the static windows (P2)."""
+    sweep = params.sweep_ticks
+    spread = params.spread_ticks
+
+    keep_u = state.tick - state.rumor_created <= sweep
+    forwarding_u = (
+        state.infected
+        & state.up[:, None]
+        & (state.tick - state.infected_at < spread)
+    ).any(axis=0)
+    keep_u = keep_u | forwarding_u
+    if params.delay_slots:
+        keep_u = keep_u | state.pending_inf.any(axis=(0, 1))
+    state = state.replace(rumor_active=state.rumor_active & keep_u)
+
+    def _sweep_m(state: PviewState):
+        age = state.minf_age.astype(jnp.int32)
+        forwarding_m = (
+            (age > 0) & (age <= spread) & state.up[:, None]
+        ).any(axis=0)
+        keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
+        pending_m = (
+            state.pending_minf.any(axis=(0, 1))
+            if params.delay_slots
+            else jnp.zeros_like(keep_m)
+        )
+        keep_m = keep_m | pending_m
+        if params.early_free:
+            covered = (
+                (state.minf_age > 0)
+                | ~state.up[:, None]
+                | (state.joined_at[:, None] > state.mr_created[None, :])
+            ).all(axis=0)
+            keep_m = keep_m & ~(covered & ~pending_m)
+        keep_m = keep_m & state.mr_active
+        freed = state.mr_active & ~keep_m
+        state = state.replace(
+            mr_active=keep_m,
+            mr_subject=jnp.where(freed, -1, state.mr_subject),
+            minf_age=jnp.where(freed[None, :], jnp.uint8(0), state.minf_age),
+        )
+        if params.delay_slots:
+            state = state.replace(
+                pending_minf=state.pending_minf & keep_m[None, None, :]
+            )
+        return state
+
+    return jax.lax.cond(state.mr_active.any(), _sweep_m, lambda st: st, state)
+
+
+# ---------------------------------------------------------------------------
+# tick
+# ---------------------------------------------------------------------------
+
+
+def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=None):
+    """One gossip period for all N members, partial-view mode. Pure;
+    jit me. Same two-subkey draw split and trace contract as the sparse
+    tick (``trace`` arms the r10 capture; trajectory bit-identical)."""
+    state = state.replace(tick=state.tick + 1)
+    fd_key, round_key = split_tick_key(key)
+    r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
+
+    n = state.capacity
+    rows = jnp.arange(n)
+    no_props = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        rows,
+        jnp.zeros((n,), bool),
+    )
+
+    def _fd_on(st: PviewState):
+        fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
+        return _fd_phase(st, fd_r, params, trace=trace is not None)
+
+    def _fd_off(st: PviewState):
+        m = {
+            "fd_probes": jnp.int32(0),
+            "fd_failed_probes": jnp.int32(0),
+            "fd_new_suspects": jnp.int32(0),
+        }
+        if trace is not None:
+            from ..trace import capture as _tc
+
+            m["trace_fd"] = _tc.zero_fd_trace(n, params.ping_req_k)
+        return st, no_props, m
+
+    fd_ran = (state.tick % params.fd_every) == 0
+    state, props_fd, fd_m = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
+    if trace is not None:
+        state, props_exp, trace_sus = _maintenance_sweep(state, params, trace=trace)
+    else:
+        state, props_exp = _maintenance_sweep(state, params)
+    state, g_m = _gossip_phase(state, r, params)
+    state, props_sync, s_m = _sync_phase(state, r, params, trace=trace is not None)
+    state, props_ref = _refute_phase(state, params)
+    state = _rumor_sweeps(state, params)
+    state, a_m = _alloc_phase(
+        state, (props_fd, props_exp, props_ref, props_sync), params
+    )
+
+    trace_fd = fd_m.pop("trace_fd", None)
+    trace_sync = s_m.pop("trace_sync", None)
+    metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    if trace is not None:
+        from ..trace import capture as _tc
+
+        trace_ref = props_ref[3][jnp.asarray(trace.tracer_rows, jnp.int32)]
+        metrics["_trace_rows"] = _tc.build_trace_rows(
+            trace,
+            tick=state.tick,
+            up=state.up,
+            fd_ran=fd_ran,
+            trace_fd=trace_fd,
+            trace_sus=trace_sus,
+            trace_ref=trace_ref,
+            trace_sync=trace_sync,
+            infected_b=state.infected,
+            infected_at=state.infected_at,
+            infected_from=state.infected_from,
+        )
+    return state, metrics
+
+
+def state_metrics(state: PviewState, params: PviewParams) -> dict:
+    """State-derived health metrics — the shared telemetry names, computed
+    over the table EDGES (up observer + tabled subject) instead of full
+    pairs: ``alive_view_fraction`` is live-edge agreement, the partial-view
+    convergence measure the sentinels also use."""
+    coverage = (
+        (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
+        / jnp.maximum(state.up.sum(), 1)
+    )
+    newest_u = jnp.where(
+        state.infected, state.rumor_created[None, :], NEVER
+    ).max(axis=1)
+    seg_u = (
+        state.rumor_active[None, :]
+        & ~state.infected
+        & (state.rumor_created[None, :] < newest_u[:, None])
+        & state.up[:, None]
+    ).sum(axis=1)
+
+    def _seg_m(st: PviewState):
+        newest_m = jnp.where(
+            st.minf_age > 0, st.mr_created[None, :], NEVER
+        ).max(axis=1)
+        return (
+            st.mr_active[None, :]
+            & (st.minf_age == 0)
+            & (st.mr_created[None, :] < newest_m[:, None])
+            & st.up[:, None]
+        ).sum(axis=1)
+
+    seg_m = jax.lax.cond(
+        state.mr_active.any() & ((state.tick % params.sweep_every) == 0),
+        _seg_m,
+        lambda st: jnp.zeros((state.capacity,), jnp.int32),
+        state,
+    )
+    metrics = {
+        "n_up": state.up.sum(),
+        "mr_active_count": state.mr_active.sum(),
+        "rumor_coverage": coverage,
+        "gossip_segmentation": (seg_u + seg_m).max(),
+    }
+    if params.full_metrics:
+        keys = _keys_i32(state)
+        sid = state.nbr_id
+        sidc = jnp.maximum(sid, 0)
+        rank = keys & 3
+        edges = (sid >= 0) & state.up[:, None] & state.up[sidc]
+        n_edges = jnp.maximum(edges.sum(), 1)
+        metrics["alive_view_fraction"] = (
+            (edges & (rank == RANK_ALIVE)).sum().astype(jnp.float32) / n_edges
+        )
+        metrics["false_suspect_pairs"] = (edges & (rank == RANK_SUSPECT)).sum()
+    else:
+        metrics["alive_view_fraction"] = jnp.float32(0.0)
+        metrics["false_suspect_pairs"] = jnp.int32(0)
+    return metrics
+
+
+def run_pview_ticks(
+    state: PviewState,
+    key: jax.Array,
+    n_ticks: int,
+    params: PviewParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Batched scan window — same contract as ``sparse.run_sparse_ticks``;
+    watched rows return their SYNTHESIZED full-width key rows per tick
+    ([n_ticks, W, N], -1 where untabled) so the driver's event diff works
+    unchanged."""
+
+    def body(carry, _):
+        st, k = carry
+        k, tick_key = jax.random.split(k)
+        st, m = pview_tick(st, tick_key, params)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=view_rows(st, watch_rows))
+        return (st, k), m
+
+    (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched
+
+
+def run_pview_ticks_traced(
+    state: PviewState,
+    key: jax.Array,
+    trace_buf: jax.Array,
+    trace_cursor: jax.Array,
+    n_ticks: int,
+    params: PviewParams,
+    trace,
+    watch_rows: jax.Array | None = None,
+):
+    from ..trace import capture as _tc
+
+    def body(carry, _):
+        st, k, buf, cur = carry
+        k, tick_key = jax.random.split(k)
+        st, m = pview_tick(st, tick_key, params, trace=trace)
+        buf, cur = _tc.append_rows(buf, cur, m.pop("_trace_rows"), trace.ring_len)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=view_rows(st, watch_rows))
+        return (st, k, buf, cur), m
+
+    (state, key, trace_buf, _cur), ms = jax.lax.scan(
+        body, (state, key, trace_buf, trace_cursor), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched, trace_buf
+
+
+def make_pview_run(params: PviewParams, n_ticks: int, donate: bool = True):
+    """Jitted window with the state DONATED — the pview twin of
+    ``sparse.make_sparse_run`` (the one spelling the driver and every
+    bench loop use)."""
+    return jax.jit(
+        functools.partial(run_pview_ticks, n_ticks=n_ticks, params=params),
+        donate_argnums=0 if donate else (),
+    )
+
+
+def make_pview_traced_run(params: PviewParams, n_ticks: int, trace, donate: bool = True):
+    return jax.jit(
+        functools.partial(
+            run_pview_ticks_traced, n_ticks=n_ticks, params=params, trace=trace
+        ),
+        donate_argnums=(0, 2) if donate else (),
+    )
+
+
+# ---------------------------------------------------------------------------
+# synthesized views (host/driver seams — see ops.engine_api)
+# ---------------------------------------------------------------------------
+
+
+def view_rows(state: PviewState, rows) -> jax.Array:
+    """Synthesize full-width [W, N] i32 key rows for ``rows``: each row's
+    table scattered by subject (-1 where untabled) + its self record on
+    the diagonal. O(W·(k + N)) — host-seam cost, never in the tick."""
+    rows = jnp.asarray(rows, jnp.int32)
+    n = state.capacity
+    ids = state.nbr_id[rows]  # [W, k]
+    keys = _keys_i32(state)[rows]
+    full = (
+        jnp.full((rows.shape[0], n + 1), UNKNOWN_KEY, jnp.int32)
+        .at[jnp.arange(rows.shape[0])[:, None], jnp.where(ids >= 0, ids, n)]
+        .max(keys, mode="drop")[:, :n]
+    )
+    return full.at[jnp.arange(rows.shape[0]), rows].set(state.self_key[rows])
+
+
+def tracer_view_cols(state: PviewState, tracer_rows) -> jax.Array:
+    """The tracers' [N, K] synthesized view-key COLUMNS: observer i's
+    record about tracer t (-1 unknown; the tracer's own row carries its
+    self record) — the pview feed for the trace plane's window-boundary
+    dissemination diff."""
+    tr = jnp.asarray(tracer_rows, jnp.int32)
+    keys = _keys_i32(state)
+    match = state.nbr_id[:, :, None] == tr[None, None, :]  # [N, k, K]
+    cols = jnp.where(
+        match & (state.nbr_id[:, :, None] >= 0), keys[:, :, None], UNKNOWN_KEY
+    ).max(axis=1)
+    return cols.at[tr, jnp.arange(tr.shape[0])].set(state.self_key[tr])
+
+
+def remembered_rows(state: PviewState) -> jax.Array:
+    """[N] bool — rows some up member still holds a record about (tables
+    only; the driver's prefer-forgotten-rows join policy)."""
+    n = state.capacity
+    held = state.up[:, None] & (state.nbr_id >= 0)
+    return (
+        jnp.zeros((n + 1,), bool)
+        .at[jnp.where(held, state.nbr_id, n)]
+        .max(held, mode="drop")[:n]
+    )
+
+
+def staleness(state: PviewState):
+    """Per-subject count of up observers holding a STALE record (identity/
+    incarnation below the subject's own) — table edges only (unknown
+    observers are not counted stale: a partial view is not staleness)."""
+    n = state.capacity
+    keys = _keys_i32(state)
+    sid = state.nbr_id
+    sidc = jnp.maximum(sid, 0)
+    stale_edge = (
+        (sid >= 0)
+        & state.up[:, None]
+        & state.up[sidc]
+        & ((keys >> 2) < (state.self_key[sidc] >> 2))
+    )
+    stale = (
+        jnp.zeros((n + 1,), jnp.int32)
+        .at[jnp.where(stale_edge, sid, n)]
+        .add(stale_edge.astype(jnp.int32), mode="drop")[:n]
+    )
+    return stale, state.up.sum()

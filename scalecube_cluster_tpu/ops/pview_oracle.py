@@ -1,0 +1,772 @@
+"""Scalar (per-node-loop NumPy) oracle of the PARTIAL-VIEW tick semantics.
+
+Mirror of :mod:`.pview` the way :mod:`.sparse_oracle` mirrors :mod:`.sparse`
+(SURVEY.md §4's lockstep-equivalence strategy): per-node Python loops
+consuming byte-identical draws from :func:`.rand.draw_sparse_randoms` —
+the pview engine deliberately consumes the sparse draw layout, interpreted
+as active-slot indexes — and the equivalence suite steps both and compares
+the full state every tick. All float comparisons replay the kernel's
+float32 op order; all tie-breaking (first rejection try, lowest slot,
+lowest pool column, step-order top-P insertion, highest-row/slot collision
+winner) is mirrored exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .lattice import RANK_ALIVE, RANK_DEAD, RANK_LEAVING, RANK_SUSPECT
+from .rand import (
+    SALT_GOSSIP,
+    SALT_SYNC_ACK,
+    SALT_SYNC_REQ,
+    draw_sparse_randoms,
+    fetch_uniform,
+)
+from .pview import PviewParams, PviewState
+
+NO_CAND = np.iinfo(np.int32).min
+NEVER = -(1 << 30)
+
+_FIELDS = (
+    "up", "epoch", "joined_at", "self_key", "nbr_id", "nbr_key", "sus_key",
+    "sus_since", "force_sync", "leaving", "mr_active", "mr_subject", "mr_key",
+    "mr_created", "mr_origin", "minf_age", "rumor_active", "rumor_origin",
+    "rumor_created", "infected", "infected_at", "infected_from", "loss",
+    "delay_q", "part_id", "part_loss", "pending_minf", "pending_inf",
+    "pending_src",
+)
+
+
+class _PO:
+    """Mutable numpy mirror of PviewState."""
+
+    def __init__(self, state: PviewState):
+        self.tick = int(state.tick)
+        for name in _FIELDS:
+            setattr(self, name, np.asarray(getattr(state, name)).copy())
+
+    def snap(self):
+        import copy
+
+        return copy.deepcopy(self)
+
+    def key_i32(self, i: int, s: int) -> int:
+        return int(np.int32(self.nbr_key[i, s]))
+
+
+def _loss(o, i, j):
+    base = np.float32(o.loss)
+    part = np.float32(o.part_loss[int(o.part_id[i]), int(o.part_id[j])])
+    return max(base, part)
+
+
+def _rt(o, i, j):
+    return np.float32(
+        (np.float32(1.0) - _loss(o, i, j)) * (np.float32(1.0) - _loss(o, j, i))
+    )
+
+
+def _timely(q1, q2, t: int) -> np.float32:
+    q1, q2 = np.float32(q1), np.float32(q2)
+    h = np.float32(1.0)
+    acc = np.float32(1.0)
+    q2p = np.float32(1.0)
+    for _ in range(t):
+        q2p = np.float32(q2p * q2)
+        h = np.float32(np.float32(q1 * h) + q2p)
+        acc = np.float32(acc + h)
+    return np.float32(np.float32((np.float32(1.0) - q1) * (np.float32(1.0) - q2)) * acc)
+
+
+def _rt_timely(o, i, j, t, D):
+    p = _rt(o, i, j)
+    if D:
+        q = np.float32(o.delay_q)
+        p = np.float32(p * _timely(q, q, t))
+    return p
+
+
+def _pick_slots(o, row: int, u: np.ndarray, n_picks: int, tries: int, ka: int):
+    """Mirror of ``pview._sample_slots`` for one row: first valid try wins;
+    slot distinctness (== member distinctness by the table invariant)."""
+    sels: list[int] = []
+    for p in range(n_picks):
+        sel = -1
+        for t in range(tries):
+            c = min(int(np.float32(np.float32(u[p * tries + t]) * np.float32(ka))), ka - 1)
+            ok = int(o.nbr_id[row, c]) >= 0 and (o.key_i32(row, c) & 3) != RANK_DEAD
+            ok = ok and all(c != q for q in sels)
+            if sel < 0 and ok:
+                sel = c
+        sels.append(sel)
+    slots = [max(s, 0) for s in sels]
+    members = [max(int(o.nbr_id[row, s]), 0) for s in slots]
+    valid = [s >= 0 for s in sels]
+    return slots, members, valid
+
+
+def _fetch_ok(o, salt: int, i: int, j: int) -> bool:
+    u = np.float32(fetch_uniform(o.tick, salt, i, j, xp=np))
+    return bool(o.up[j]) and bool(u < _rt(o, i, j))
+
+
+class _SusBatch:
+    """The kernel registers suspicion episodes per phase as ONE scatter-max
+    against the pre-phase ``sus_key`` (stamps move only when the max rises).
+    This mirrors that batch semantics."""
+
+    def __init__(self, n: int):
+        self.cand = np.full(n, NO_CAND, np.int64)
+
+    def add(self, subj: int, key: int) -> None:
+        self.cand[subj] = max(self.cand[subj], key)
+
+    def commit(self, o) -> None:
+        for j in range(len(self.cand)):
+            if self.cand[j] > int(o.sus_key[j]):
+                o.sus_key[j] = self.cand[j]
+                o.sus_since[j] = o.tick
+
+
+def _apply_record_b(o, i, subj, cand, salt, ka, sus: _SusBatch):
+    k = o.nbr_id.shape[1]
+    if subj < 0:
+        return False
+    if subj == i:
+        own = int(o.self_key[i])
+        slot_kind = "self"
+    else:
+        slot_p = next((s for s in range(k) if int(o.nbr_id[i, s]) == subj), None)
+        own = o.key_i32(i, slot_p) if slot_p is not None else -1
+        slot_kind = slot_p
+    if cand <= own:
+        return False
+    if own < 0 and (cand & 3) > RANK_LEAVING:
+        return False
+    if (cand & 3) == RANK_ALIVE and not _fetch_ok(o, salt, i, subj):
+        return False
+    if slot_kind == "self":
+        o.self_key[i] = cand
+    else:
+        if slot_kind is not None:
+            w = slot_kind
+        else:
+            empties = [s for s in range(k) if int(o.nbr_id[i, s]) < 0]
+            if empties:
+                w = empties[0]
+            else:
+                p_keys = [o.key_i32(i, s) for s in range(ka, k)]
+                w = ka + int(np.argmin(np.asarray(p_keys, np.int64)))
+            o.nbr_id[i, w] = subj
+        o.nbr_key[i, w] = o.nbr_key.dtype.type(cand)
+    if (cand & 3) == RANK_SUSPECT:
+        sus.add(subj, cand)
+    return True
+
+
+def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
+    n = params.capacity
+    f, k_req, T = params.fanout, params.ping_req_k, params.sample_tries
+    M, R = params.mr_pool, params.rumor_slots
+    D = params.delay_slots
+    k = params.view_slots
+    ka = params.active_slots
+    P = params.sync_announce
+    spread = params.spread_ticks
+    o = _PO(state)
+    o.tick += 1
+    t = o.tick
+    r = draw_sparse_randoms(key, n, f, k_req, T)
+    r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    proposals: list[tuple[list, list, list, list]] = []
+
+    # ---- FD phase ----
+    fd_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    if (t % params.fd_every) == 0:
+        pre = o.snap()
+        sus = _SusBatch(n)
+        V_fd = min(n, params.fd_accept_slots or max(64, n // 16))
+        accepted_so_far = 0
+        for i in range(n):
+            slots, members, valid = _pick_slots(pre, i, r["fd_try"][i], 1 + k_req, T, ka)
+            if not (valid[0] and pre.up[i]):
+                continue
+            tgt_slot, tgt = slots[0], members[0]
+            p_direct = _rt_timely(pre, i, tgt, params.fd_direct_timeout_ticks, D)
+            ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
+            for s in range(k_req):
+                if ack:
+                    break
+                if not valid[1 + s]:
+                    continue
+                rl = members[1 + s]
+                p4 = np.float32(_rt(pre, i, rl) * _rt(pre, rl, tgt))
+                if D:
+                    q = np.float32(pre.delay_q)
+                    p4 = np.float32(p4 * _timely(q, q, params.fd_leg_timeout_ticks))
+                    p4 = np.float32(p4 * _timely(q, q, params.fd_leg_timeout_ticks))
+                if pre.up[rl] and pre.up[tgt] and r["fd_relay"][i, s] < p4:
+                    ack = True
+            own = pre.key_i32(i, tgt_slot)
+            if ack:
+                cand = (int(pre.self_key[tgt]) >> 2) << 2
+            else:
+                cand = ((own >> 2) << 2) | RANK_SUSPECT
+            if cand > own:
+                accepted_so_far += 1
+                if accepted_so_far > V_fd:
+                    continue
+                o.nbr_key[i, tgt_slot] = o.nbr_key.dtype.type(cand)
+                fd_props[0][i] = tgt
+                fd_props[1][i] = cand
+                fd_props[3][i] = True
+                if not ack:
+                    sus.add(tgt, cand)
+        sus.commit(o)
+    proposals.append(fd_props)
+
+    # ---- maintenance sweep: suspicion expiry + active-view promotion ----
+    exp_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    if (t % params.sweep_every) == 0:
+        if bool((o.sus_since > NEVER).any()):
+            timeout = params.suspicion_timeout_ticks
+            expired = np.zeros((n, k), bool)
+            for i in range(n):
+                if not o.up[i]:
+                    continue
+                for s in range(k):
+                    subj = int(o.nbr_id[i, s])
+                    if subj < 0:
+                        continue
+                    kij = o.key_i32(i, s)
+                    if (
+                        (kij & 3) == RANK_SUSPECT
+                        and t - int(o.sus_since[subj]) >= timeout
+                        and kij <= int(o.sus_key[subj])
+                    ):
+                        expired[i, s] = True
+            # per-subject announcer election: lowest expiring observer row
+            first_row: dict[int, int] = {}
+            for i in range(n):
+                for s in range(k):
+                    if expired[i, s]:
+                        subj = int(o.nbr_id[i, s])
+                        first_row.setdefault(subj, i)
+            for i in range(n):
+                for s in range(k):
+                    if not expired[i, s]:
+                        continue
+                    subj = int(o.nbr_id[i, s])
+                    o.nbr_key[i, s] = o.nbr_key.dtype.type(o.key_i32(i, s) + 1)
+                    if not exp_props[3][i] and first_row.get(subj) == i:
+                        exp_props[0][i] = subj
+                        exp_props[1][i] = o.key_i32(i, s)
+                        exp_props[3][i] = True
+            # self expiry (never announces — deviation P7)
+            for i in range(n):
+                sk = int(o.self_key[i])
+                if (
+                    o.up[i]
+                    and (sk & 3) == RANK_SUSPECT
+                    and t - int(o.sus_since[i]) >= timeout
+                    and sk <= int(o.sus_key[i])
+                ):
+                    o.self_key[i] = sk + 1
+            any_suspect_left = any(
+                o.up[i]
+                and (
+                    (int(o.self_key[i]) & 3) == RANK_SUSPECT
+                    or any(
+                        int(o.nbr_id[i, s]) >= 0
+                        and (o.key_i32(i, s) & 3) == RANK_SUSPECT
+                        for s in range(k)
+                    )
+                )
+                for i in range(n)
+            )
+            if not any_suspect_left:
+                o.sus_key[:] = NO_CAND
+                o.sus_since[:] = NEVER
+        # tombstone purge (deviation P8): every purge_sweeps-th sweep,
+        # forget every DEAD table entry (engine order: expire → purge →
+        # promote, so a same-sweep expiry is purged too — its announcement
+        # proposal was already captured)
+        if ((t // params.sweep_every) % params.purge_sweeps) == 0:
+            for i in range(n):
+                for s in range(k):
+                    if int(o.nbr_id[i, s]) >= 0 and (o.key_i32(i, s) & 3) == RANK_DEAD:
+                        o.nbr_id[i, s] = -1
+                        o.nbr_key[i, s] = o.nbr_key.dtype.type(-1)
+        # promotion sweep: ascending active slots swap in the best live
+        # passive entry when empty/DEAD
+        for i in range(n):
+            for a in range(ka):
+                a_id = int(o.nbr_id[i, a])
+                a_key = o.key_i32(i, a)
+                bad = a_id < 0 or (a_key & 3) == RANK_DEAD
+                if not bad:
+                    continue
+                best, best_key = None, NO_CAND
+                for s in range(ka, k):
+                    if int(o.nbr_id[i, s]) < 0:
+                        continue
+                    skey = o.key_i32(i, s)
+                    if (skey & 3) == RANK_DEAD:
+                        continue
+                    if skey > best_key:
+                        best, best_key = s, skey
+                if best is None:
+                    continue
+                o.nbr_id[i, a], o.nbr_id[i, best] = o.nbr_id[i, best], o.nbr_id[i, a]
+                o.nbr_key[i, a], o.nbr_key[i, best] = (
+                    o.nbr_key[i, best], o.nbr_key[i, a],
+                )
+    proposals.append(exp_props)
+
+    # ---- gossip phase ----
+    slot_now = t % D if D else 0
+    work = bool(o.rumor_active.any()) or bool(o.mr_active.any())
+    if D:
+        work = work or bool(o.pending_inf[slot_now].any()) or bool(
+            o.pending_minf[slot_now].any()
+        )
+    if work:
+        age = o.minf_age
+        mr_any = bool(o.mr_active.any()) or (
+            D and bool(o.pending_minf[slot_now].any())
+        )
+        if mr_any:
+            o.minf_age = np.where(
+                age > 0, np.minimum(age, np.uint8(254)) + np.uint8(1), age
+            ).astype(np.uint8)
+        pre = o.snap()
+        recv_u = pre.pending_inf[slot_now].copy() if D else np.zeros((n, R), bool)
+        recv_src = (
+            pre.pending_src[slot_now].copy() if D else np.full((n, R), -1, np.int32)
+        )
+        recv_m = pre.pending_minf[slot_now].copy() if D else np.zeros((n, M), bool)
+        young_u = np.zeros((n, R), bool)
+        young_m = np.zeros((n, M), bool)
+        peers_all = np.zeros((n, f), np.int32)
+        valid_all = np.zeros((n, f), bool)
+        for i in range(n):
+            _s, peers_all[i], valid_all[i] = _pick_slots(
+                pre, i, r["gossip_try"][i], f, T, ka
+            )
+            for ru in range(R):
+                young_u[i, ru] = (
+                    pre.infected[i, ru]
+                    and pre.rumor_active[ru]
+                    and t - int(pre.infected_at[i, ru]) < spread
+                )
+            if mr_any:
+                for mm in range(M):
+                    young_m[i, mm] = (
+                        pre.mr_active[mm]
+                        and 0 < int(pre.minf_age[i, mm]) <= spread
+                    )
+        sender_has = young_u.any(axis=1) | young_m.any(axis=1)
+        for s in range(f):
+            inv_now = np.full(n, -1, np.int32)
+            inv_late = np.full(n, -1, np.int32)
+            d_of = np.zeros(n, np.int32)
+            for j in range(n):
+                if not (valid_all[j, s] and sender_has[j] and pre.up[j]):
+                    continue
+                p = int(peers_all[j, s])
+                if not pre.up[p]:
+                    continue
+                if not bool(
+                    r["gossip_edge"][j, s] < (np.float32(1.0) - _loss(pre, j, p))
+                ):
+                    continue
+                dd = 0
+                if D:
+                    qd = np.float32(pre.delay_q)
+                    qpow = qd
+                    for _ in range(1, D):
+                        if r["gossip_delay"][j, s] < qpow:
+                            dd += 1
+                        qpow = np.float32(qpow * qd)
+                d_of[j] = dd
+                if dd == 0:
+                    inv_now[p] = max(inv_now[p], j)
+                else:
+                    inv_late[p] = max(inv_late[p], j)
+            for i in range(n):
+                j = int(inv_now[i])
+                if j >= 0:
+                    for ru in range(R):
+                        if (
+                            young_u[j, ru]
+                            and int(pre.infected_from[j, ru]) != i
+                            and int(pre.rumor_origin[ru]) != i
+                        ):
+                            recv_u[i, ru] = True
+                            recv_src[i, ru] = max(int(recv_src[i, ru]), j)
+                    for mm in range(M):
+                        if young_m[j, mm] and int(pre.mr_origin[mm]) != i:
+                            recv_m[i, mm] = True
+                jl = int(inv_late[i])
+                if jl >= 0:
+                    sd = (t + int(d_of[jl])) % D
+                    for ru in range(R):
+                        if (
+                            young_u[jl, ru]
+                            and int(pre.infected_from[jl, ru]) != i
+                            and int(pre.rumor_origin[ru]) != i
+                        ):
+                            o.pending_inf[sd, i, ru] = True
+                            o.pending_src[sd, i, ru] = max(
+                                int(o.pending_src[sd, i, ru]), jl
+                            )
+                    for mm in range(M):
+                        if young_m[jl, mm] and int(pre.mr_origin[mm]) != i:
+                            o.pending_minf[sd, i, mm] = True
+
+        for i in range(n):
+            if not pre.up[i]:
+                continue
+            for ru in range(R):
+                if recv_u[i, ru] and pre.rumor_active[ru] and not pre.infected[i, ru]:
+                    o.infected[i, ru] = True
+                    o.infected_at[i, ru] = t
+                    o.infected_from[i, ru] = recv_src[i, ru]
+
+        # membership-rumor apply, capped at A per receiver (deviation P5):
+        # pass a takes each row's LOWEST still-eligible pool slot; the pick
+        # is marked delivered (minf_age = 1) whether or not the record is
+        # accepted; gates read the CURRENT (carry) tables.
+        if mr_any:
+            A = params.apply_slots
+            eligible = {
+                i: [
+                    mm
+                    for mm in range(M)
+                    if recv_m[i, mm]
+                    and int(pre.mr_origin[mm]) != i
+                    and int(o.minf_age[i, mm]) == 0
+                    and o.up[i]
+                    and pre.mr_active[mm]
+                ]
+                for i in range(n)
+            }
+            for a in range(A):
+                sus = _SusBatch(n)
+                for i in range(n):
+                    if a >= len(eligible[i]):
+                        continue
+                    mm = eligible[i][a]
+                    o.minf_age[i, mm] = 1
+                    _apply_record_b(
+                        o, i, int(pre.mr_subject[mm]), int(pre.mr_key[mm]),
+                        SALT_GOSSIP, ka, sus,
+                    )
+                sus.commit(o)
+        if D:
+            o.pending_inf[slot_now] = False
+            o.pending_src[slot_now] = -1
+            o.pending_minf[slot_now] = False
+
+    # ---- SYNC phase ----
+    pre = o.snap()
+    K = min(n, params.sync_slots or (n // params.sync_every + 32))
+    due_force = [i for i in range(n) if pre.up[i] and bool(pre.force_sync[i])]
+    due_periodic = [
+        i
+        for i in range(n)
+        if pre.up[i]
+        and not bool(pre.force_sync[i])
+        and ((t + i * params.sync_stagger) % params.sync_every) == 0
+    ]
+    due_rows = (due_force[:K] + due_periodic[:K])[:K]
+    pairs = []  # (slot_index_in_K, caller, peer)
+    S_seeds = len(params.seed_rows)
+    pool = ka + S_seeds
+    for slot_i, i in enumerate(due_rows):
+        # union-pool draw: active slots ∪ seeds (pview._sync_phase)
+        p, ok_pick = 0, False
+        for t_i in range(T):
+            c = min(
+                int(np.float32(np.float32(r["sync_try"][i][t_i]) * np.float32(pool))),
+                pool - 1,
+            )
+            if c >= ka:
+                cand_p = int(params.seed_rows[min(c - ka, S_seeds - 1)])
+                ok = cand_p != i
+            else:
+                cand_p = max(int(pre.nbr_id[i, c]), 0)
+                ok = int(pre.nbr_id[i, c]) >= 0 and (
+                    pre.key_i32(i, c) & 3
+                ) != RANK_DEAD
+            if ok:
+                p, ok_pick = cand_p, True
+                break
+        if not ok_pick and params.seed_rows:
+            S = len(params.seed_rows)
+            fb = params.seed_rows[
+                min(int(np.float32(np.float32(r["sync_fb"][i]) * np.float32(S))), S - 1)
+            ]
+            if fb != i:
+                p = int(fb)
+                ok_pick = True
+        if params.seed_rows and i in due_periodic:
+            # deterministic seed cadence (pview seed_sync_every)
+            Q = params.seed_sync_every
+            round_ = (t + i * params.sync_stagger) // params.sync_every
+            if (round_ % Q) == 0:
+                sidx = (i + round_ // Q) % S_seeds
+                sp = int(params.seed_rows[sidx])
+                if sp == i:
+                    sp = int(params.seed_rows[(sidx + 1) % S_seeds])
+                if sp != i:
+                    p, ok_pick = sp, True
+        if not ok_pick:
+            continue
+        p_rt = _rt_timely(pre, i, p, params.sync_timeout_ticks, D)
+        if pre.up[p] and bool(r["sync_edge"][i] < p_rt):
+            o.force_sync[i] = False
+            pairs.append((slot_i, i, p))
+
+    def _entries_of(src: int):
+        out = []
+        for s in range(k):
+            subj = int(pre.nbr_id[src, s])
+            out.append((subj, pre.key_i32(src, s)))
+        out.append((src, int(pre.self_key[src])))
+        return out
+
+    def _merge(dst_src: dict[int, int], salt: int):
+        """dst row -> src row; k+1 sequential steps, one _SusBatch per
+        step (mirrors the kernel's per-step scatter-max + commit)."""
+        acc_cnt = {i: 0 for i in dst_src}
+        best: dict[int, list] = {i: [(NO_CAND, 0)] * P for i in dst_src}
+        for s in range(k + 1):
+            sus = _SusBatch(n)
+            for i, src in dst_src.items():
+                subj, cand = _entries_of(src)[s]
+                if subj < 0:
+                    continue
+                acc = _apply_record_b(o, i, subj, cand, salt, ka, sus)
+                if acc:
+                    acc_cnt[i] += 1
+                    ins_k, ins_s = cand, subj
+                    b = best[i]
+                    for p in range(P):
+                        if ins_k > b[p][0]:
+                            b[p], (ins_k, ins_s) = (ins_k, ins_s), b[p]
+                    best[i] = b
+            sus.commit(o)
+        return acc_cnt, best
+
+    # REQ: winner caller per peer = highest K-slot (deviation P6); pairs
+    # iterate ascending slot, so the last write per peer is the winner
+    req_srcs: dict[int, int] = {}
+    for _slot_i, i, p in pairs:
+        req_srcs[p] = i
+    _req_acc, req_best = _merge(req_srcs, SALT_SYNC_REQ)
+    # ACK: every ok caller merges its peer's pre-entries
+    ack_srcs = {i: p for _si, i, p in pairs}
+    _ack_acc, ack_best = _merge(ack_srcs, SALT_SYNC_ACK)
+
+    # proposals: REQ receivers then ACK receivers, [N·P] each, p-major
+    def _props_of(best: dict[int, list]):
+        subs = [[0] * n for _ in range(P)]
+        keys_ = [[0] * n for _ in range(P)]
+        vals = [[False] * n for _ in range(P)]
+        for i, b in best.items():
+            for p in range(P):
+                kk, ss = b[p]
+                if kk > NO_CAND:
+                    subs[p][i] = ss
+                    keys_[p][i] = kk
+                    vals[p][i] = True
+        flat = lambda a: [x for chunk in a for x in chunk]
+        return (
+            flat(subs), flat(keys_), flat([list(range(n))] * P), flat(vals)
+        )
+
+    sp = _props_of(req_best)
+    sc = _props_of(ack_best)
+    sync_props = tuple(a + b for a, b in zip(sp, sc))
+
+    # ---- refutation ----
+    ref_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
+    V_ref = min(n, params.refute_slots or max(64, n // 16))
+    lay_inc_mask = {"int16": (1 << 9) - 1}.get(str(o.nbr_key.dtype), (1 << 21) - 1)
+    epoch_shift = {"int16": 11}.get(str(o.nbr_key.dtype), 23)
+    needed_so_far = 0
+    for i in range(n):
+        diag = int(o.self_key[i])
+        rank = diag & 3
+        need = bool(o.up[i]) and (
+            rank == RANK_SUSPECT
+            or rank == RANK_DEAD
+            or (bool(o.leaving[i]) and rank != RANK_LEAVING)
+        )
+        if need:
+            needed_so_far += 1
+            if needed_so_far > V_ref:
+                need = False
+        new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
+        # bump_inc with the layout's saturation (narrow keys clamp)
+        inc = min(((diag >> 2) & lay_inc_mask) + 1, lay_inc_mask)
+        epoch_bits = (diag >> epoch_shift) << epoch_shift
+        new_diag = (epoch_bits | (inc << 2) | new_rank) if need else diag
+        ref_props[0][i] = i
+        ref_props[1][i] = new_diag
+        ref_props[3][i] = need
+        if need:
+            o.self_key[i] = new_diag
+    proposals.append(ref_props)
+    proposals.append(sync_props)
+
+    # ---- rumor sweeps (static windows — deviation P2) ----
+    sweep = params.sweep_ticks
+    for ru in range(R):
+        if not o.rumor_active[ru] or t - int(o.rumor_created[ru]) <= sweep:
+            continue
+        if D and bool(o.pending_inf[:, :, ru].any()):
+            continue
+        if any(
+            o.infected[i, ru] and o.up[i] and t - int(o.infected_at[i, ru]) < spread
+            for i in range(n)
+        ):
+            continue
+        o.rumor_active[ru] = False
+    if bool(o.mr_active.any()):
+        for mm in range(M):
+            if not o.mr_active[mm]:
+                continue
+            pending = D and bool(o.pending_minf[:, :, mm].any())
+            forwarding = any(
+                o.up[i] and 0 < int(o.minf_age[i, mm]) <= spread for i in range(n)
+            )
+            keep = (t - int(o.mr_created[mm]) <= sweep) or forwarding or pending
+            if params.early_free:
+                covered = all(
+                    (not o.up[i])
+                    or int(o.minf_age[i, mm]) > 0
+                    or int(o.joined_at[i]) > int(o.mr_created[mm])
+                    for i in range(n)
+                )
+                if covered and not pending:
+                    keep = False
+            if not keep:
+                o.mr_active[mm] = False
+                o.mr_subject[mm] = -1
+                o.minf_age[:, mm] = 0
+                if D:
+                    o.pending_minf[:, :, mm] = False
+
+    # ---- announcement allocation (sparse._alloc_phase mirror) ----
+    E = params.announce_slots
+    subject = [x for p in proposals for x in p[0]]
+    key_l = [x for p in proposals for x in p[1]]
+    origin = [x for p in proposals for x in p[2]]
+    valid = [x for p in proposals for x in p[3]]
+    pool_key_by_subject: dict[int, int] = {}
+    for mm in range(M):
+        if o.mr_active[mm]:
+            pool_key_by_subject[int(o.mr_subject[mm])] = int(o.mr_key[mm])
+    valid = [
+        v and int(key_l[ci]) > pool_key_by_subject.get(int(subject[ci]), NO_CAND)
+        for ci, v in enumerate(valid)
+    ]
+    if any(valid):
+        n_prio = sum(len(p[0]) for p in proposals[:3])
+        compact = [i for i, v in enumerate(valid) if v][:E]
+        entries = [
+            (int(subject[ci]), int(key_l[ci]), int(origin[ci]), ci < n_prio)
+            for ci in compact
+        ]
+        wins = []
+        for e, (s, kk, oo, pr) in enumerate(entries):
+            lose = any(
+                s2 == s and (k2 > kk or (k2 == kk and e2 < e))
+                for e2, (s2, k2, _o2, _p2) in enumerate(entries)
+                if e2 != e
+            )
+            if not lose:
+                wins.append((s, kk, oo, pr))
+        pool_by_subject = {
+            int(o.mr_subject[mm]): mm for mm in range(M) if o.mr_active[mm]
+        }
+        pre_mr_key = o.mr_key.copy()
+        free = [mm for mm in range(M) if not o.mr_active[mm]][:E]
+        replace_tgt = {
+            pool_by_subject[s]
+            for s, kk, _oo, _pr in wins
+            if s in pool_by_subject and kk > int(o.mr_key[pool_by_subject[s]])
+        }
+        need_m = [0] * M
+        cov_m = [0] * M
+        for mm in range(M):
+            for i in range(n):
+                if o.up[i] and not int(o.joined_at[i]) > int(o.mr_created[mm]):
+                    need_m[mm] += 1
+                    if int(o.minf_age[i, mm]) > 0:
+                        cov_m[mm] += 1
+        victims = sorted(
+            (
+                mm
+                for mm in range(M)
+                if o.mr_active[mm]
+                and mm not in replace_tgt
+                and 2 * cov_m[mm] >= need_m[mm]
+            ),
+            key=lambda mm: (need_m[mm] - cov_m[mm], mm),
+        )[: min(E, M)]
+        a0 = int(np.sum(o.mr_active))
+        cap_npr = (M * 7) // 8
+        fi = 0
+        vi = 0
+        evicted_slots: set[int] = set()
+        for s, kk, oo, pr in wins:
+            if s in pool_by_subject:
+                slot = pool_by_subject[s]
+                if kk <= int(pre_mr_key[slot]):
+                    continue
+                assert slot not in evicted_slots
+                o.minf_age[:, slot] = 0
+                if D:
+                    o.pending_minf[:, :, slot] = False
+            else:
+                rr = fi
+                fi += 1
+                if rr < len(free) and (pr or a0 + rr < cap_npr):
+                    slot = free[rr]
+                elif pr and vi < len(victims):
+                    slot = victims[vi]
+                    vi += 1
+                    evicted_slots.add(slot)
+                    o.minf_age[:, slot] = 0
+                    if D:
+                        o.pending_minf[:, :, slot] = False
+                else:
+                    continue
+            o.mr_active[slot] = True
+            o.mr_subject[slot] = s
+            o.mr_key[slot] = kk
+            o.mr_created[slot] = t
+            o.mr_origin[slot] = oo
+            o.minf_age[oo, slot] = 1
+    return o
+
+
+def assert_pview_equivalent(state: PviewState, o: _PO) -> None:
+    pairs = {"tick": (int(state.tick), o.tick)}
+    for name in _FIELDS:
+        pairs[name] = (np.asarray(getattr(state, name)), getattr(o, name))
+    for name, (a, b) in pairs.items():
+        a, b = np.asarray(a), np.asarray(b)
+        if not np.array_equal(a, b):
+            diff = np.argwhere(np.atleast_1d(a != b))
+            raise AssertionError(
+                f"pview kernel/oracle divergence in {name} at "
+                f"{diff[:10].tolist()} (kernel="
+                f"{a[tuple(diff[0])] if diff.size else a}, "
+                f"oracle={b[tuple(diff[0])] if diff.size else b})"
+            )

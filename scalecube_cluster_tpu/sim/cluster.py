@@ -65,7 +65,7 @@ class SimNode:
 
     def incarnation_of(self, other: "SimNode | int") -> int:
         row = other.row if isinstance(other, SimNode) else other
-        key = int(self._d.state.view_key[self.row, row])
+        key = int(self._d._eng.view_row(self._d.state, self.row)[row])
         # layout follows the driver's key dtype (narrow i16 keys decode
         # with the narrow incarnation mask — r9)
         return (key >> 2) & self._d._lay.inc_mask if key >= 0 else 0

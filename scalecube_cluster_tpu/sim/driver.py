@@ -136,13 +136,21 @@ def auto_params(
 
         _cc.enable_persistent_compile_cache(config=config)
     force_sparse = overrides.pop("force_sparse", False)
+    force_pview = overrides.pop("force_pview", False)
     use_dense = (per_link_fidelity or link_delay) and capacity <= dense_threshold
     if capacity <= 512:
         # tiny clusters: dense is both faster to compile and exact
         use_dense = True
     if force_sparse:
         use_dense = False
-    cls = SimParams if use_dense else _sparse.SparseParams
+    if force_pview:
+        # the r11 O(N·k) engine: the only one that fits 100k+ members in
+        # one 16 GiB window (no [N, N] plane anywhere — see ops/pview.py)
+        from ..ops import pview as _pview
+
+        cls = _pview.PviewParams
+    else:
+        cls = SimParams if use_dense else _sparse.SparseParams
     if config is not None:
         # from_config accepts only its own kwargs; remaining overrides are
         # applied to the derived params afterwards
@@ -176,37 +184,41 @@ class SimDriver:
         ``compile_cache_dir`` points the persistent XLA compilation cache
         at a directory (``ClusterConfig.sim.compile_cache_dir`` /
         ``SCALECUBE_COMPILE_CACHE_DIR`` are the config/env spellings)."""
-        from ..ops import sparse as _sparse
+        from ..ops import engine_api as _engine_api
 
         if compile_cache_dir:
             from .. import compile_cache as _cc
 
             _cc.enable_persistent_compile_cache(compile_cache_dir)
         self.params = params
-        self.sparse = isinstance(params, _sparse.SparseParams)
-        self._ops = _sparse if self.sparse else _state
+        # ONE engine-dispatch spelling (r11, ops/engine_api.py): the params
+        # type selects the EngineOps descriptor every consumer (window
+        # builders, telemetry/trace/chaos planes, monitor) resolves through
+        self._eng = _engine_api.resolve(params)
+        self.engine = self._eng.name
+        self.sparse = self.engine == "sparse"  # historical spelling, kept
+        self._ops = self._eng.ops
         self.mesh = mesh
         self.record_metrics = record_metrics
-        if dense_links is None:
-            dense_links = not self.sparse
-        if self.sparse:
-            init = _sparse.init_sparse_state(
-                params, n_initial, warm=warm, dense_links=dense_links
+        if mesh is not None and not self._eng.supports_mesh:
+            raise ValueError(
+                f"the {self.engine} engine is single-device (no sharded "
+                "window builders) — construct without mesh="
             )
-        else:
-            init = _state.init_state(params, n_initial, warm=warm, dense_links=dense_links)
+        if dense_links is None:
+            dense_links = self._eng.dense_links_default
+        init = self._eng.init_state(params, n_initial, warm, dense_links)
         self._dense_links = init.loss.ndim != 0
         if mesh is not None:
-            from ..ops.sharding import shard_sparse_state, shard_state
-
-            self.state = (
-                shard_sparse_state(init, mesh) if self.sparse else shard_state(init, mesh)
-            )
+            self.state = self._eng.shard_state(init, mesh)
         else:
             self.state = init
         # key-plane bit layout (wide i32 / narrow i16 — r9): every host-side
         # decode (event diffs, view_of) must use the state's actual layout
-        self._lay = layout_for(init.view_key.dtype)
+        key_plane = self._eng.key_plane(init) if self._eng.key_plane else None
+        self._lay = layout_for(
+            key_plane.dtype if key_plane is not None else jnp.int32
+        )
         self._step_cache: Dict[tuple, Callable] = {}
         # per-program dispatch stats for jit_cache_audit(): calls + first
         # dispatch wall time (first dispatch includes the jit compile, or
@@ -333,35 +345,15 @@ class SimDriver:
         cache_key = (n_ticks, n_watch, traced)
         if cache_key not in self._step_cache:
             if traced:
-                spec = self._trace.spec
-                if self.sparse:
-                    from ..ops import sparse as _sparse
-
-                    self._step_cache[cache_key] = _sparse.make_sparse_traced_run(
-                        self.params, n_ticks, spec
-                    )
-                else:
-                    self._step_cache[cache_key] = _kernel.make_traced_run(
-                        self.params, n_ticks, spec
-                    )
-            elif self.mesh is not None:
-                from ..ops.sharding import make_sharded_run, make_sharded_sparse_run
-
-                self._step_cache[cache_key] = (
-                    make_sharded_sparse_run(self.mesh, self.params, n_ticks)
-                    if self.sparse
-                    else make_sharded_run(
-                        self.mesh, self.params, n_ticks, self._dense_links
-                    )
+                self._step_cache[cache_key] = self._eng.make_traced_run(
+                    self.params, n_ticks, self._trace.spec
                 )
-            elif self.sparse:
-                from ..ops import sparse as _sparse
-
-                self._step_cache[cache_key] = _sparse.make_sparse_run(
-                    self.params, n_ticks
+            elif self.mesh is not None:
+                self._step_cache[cache_key] = self._eng.make_sharded_run(
+                    self.mesh, self.params, n_ticks, self._dense_links
                 )
             else:
-                self._step_cache[cache_key] = _kernel.make_run(
+                self._step_cache[cache_key] = self._eng.make_run(
                     self.params, n_ticks
                 )
             self._step_stats[cache_key] = {"calls": 0, "first_dispatch_s": None}
@@ -639,7 +631,7 @@ class SimDriver:
     def watch(self, row: int) -> EventStream:
         """Start emitting MembershipEvents as observed by node ``row``."""
         if row not in self._watches:
-            key = np.asarray(self.state.view_key[row])
+            key = np.asarray(self._eng.view_row(self.state, row))
             w = _Watch(row=row, prev_key=key)
             for j in np.nonzero(key >= 0)[0]:
                 w.known[int(j)] = self._member_handle(int(j))
@@ -729,7 +721,7 @@ class SimDriver:
         if len(free) == 0:
             raise RuntimeError("no free rows (capacity exhausted)")
         remembered = np.asarray(  # [N] — some up member still has a record
-            ((self.state.view_key >= 0) & self.state.up[:, None]).any(axis=0)
+            self._eng.remembered_rows(self.state)
         )
         forgotten = free[~remembered[free]]
         row = int(forgotten[0]) if len(forgotten) else int(free[0])
@@ -746,7 +738,7 @@ class SimDriver:
         # GATED on a registered health consumer (ADVICE r5: an unmonitored
         # interactive join must not pay a device→host sync) and even then
         # stays a DEVICE scalar, batched into the next flush() readback.
-        if self.sparse and self._health_interest:
+        if self._eng.has_pool and self._health_interest:
             in_pool = (
                 (self.state.mr_subject == row) & self.state.mr_active
             ).any()
@@ -885,14 +877,16 @@ class SimDriver:
         Lock-guarded: sim_snapshot calls this from the monitor thread, and
         the read must not interleave with a donating step."""
         with self._lock:
-            key = np.asarray(self.state.view_key[row])
+            key = np.asarray(self._eng.view_row(self.state, row))
         status = np.where(key < 0, np.int8(UNKNOWN), _RANK_TO_STATUS_NP[key & 3])
         inc = np.where(key < 0, 0, (key >> 2) & self._lay.inc_mask).astype(np.int32)
         return status, inc
 
     def status_of(self, observer: int, subject: int) -> MemberStatus | None:
         with self._lock:
-            s = _status_of_key(int(self.state.view_key[observer, subject]))
+            s = _status_of_key(
+                int(self._eng.view_row(self.state, observer)[subject])
+            )
         return None if s == UNKNOWN else MemberStatus(s)
 
     def is_up(self, row: int) -> bool:
@@ -924,20 +918,9 @@ class SimDriver:
         self._health_interest = True
         self._flush_locked()
         if not hasattr(self, "_health_fn"):
-            def _stale(state):
-                up = state.up
-                vk = state.view_key
-                diag = jnp.diagonal(vk)
-                stale = (
-                    jnp.where(
-                        up[:, None] & up[None, :]
-                        & ((vk >> 2) < (diag >> 2)[None, :]),
-                        1, 0,
-                    ).sum(axis=0).astype(jnp.int32)
-                )
-                return stale, up.sum()
-
-            self._health_fn = jax.jit(_stale)
+            # the engine's staleness reduce (engine_api seam): dense/sparse
+            # run the [N, N] identity-lag pass, pview the table-edge one
+            self._health_fn = jax.jit(self._eng.staleness)
         stale, n_up = self._health_fn(self.state)
         stale = np.asarray(stale)
         n_up = int(n_up)
@@ -957,7 +940,7 @@ class SimDriver:
             if bool(self.state.up[r])
         ]
         out = {
-            "engine": "sparse" if self.sparse else "dense",
+            "engine": self.engine,
             "tick": tick,
             "n_up": n_up,
             "announce": dict(self._health_counters),
@@ -989,9 +972,9 @@ class SimDriver:
             ),
             "stale": bool(self._rumor_cov_dirty),
         }
-        if self.sparse:
+        if self._eng.has_pool:
             out["pool"] = {
-                "mr_slots": self.params.mr_slots,
+                "mr_slots": self._eng.pool_slots(self.params),
                 "active_now": int(np.asarray(self.state.mr_active).sum()),
                 "high_water": self._pool_high_water,
             }
@@ -1033,7 +1016,7 @@ class SimDriver:
             self._telemetry = TelemetryPlane(self, config=config, bus=bus)
             self._telemetry.bus.publish(
                 "driver", "telemetry_armed", tick=self._host_tick,
-                engine="sparse" if self.sparse else "dense",
+                engine=self.engine,
                 capacity=self.params.capacity,
             )
             return self._telemetry
@@ -1204,7 +1187,7 @@ class SimDriver:
             _host=np.frombuffer(host_bytes, dtype=np.uint8),
             _schema=np.int32(CHECKPOINT_SCHEMA),
             _crc32=np.uint32(zlib.crc32(host_bytes) & 0xFFFFFFFF),
-            _engine=np.bytes_(b"sparse" if self.sparse else b"dense"),
+            _engine=np.bytes_(self.engine.encode()),
         )
 
     def restore(self, path: str) -> None:
@@ -1245,7 +1228,7 @@ class SimDriver:
         engine_raw = data.pop("_engine", None)
         if engine_raw is not None:
             engine = bytes(engine_raw.tobytes()).rstrip(b"\x00").decode()
-            mine = "sparse" if self.sparse else "dense"
+            mine = self.engine
             if engine != mine:
                 raise CheckpointError(
                     f"checkpoint {path!r} was written by the {engine} engine; "
@@ -1305,25 +1288,20 @@ class SimDriver:
             raise CheckpointError(
                 f"checkpoint {path!r} state planes do not match this engine: {exc}"
             ) from exc
-        if not self.sparse:
+        if self._eng.key_plane is not None:
             # a key-dtype mismatch would silently retrace every window
             # program against foreign-layout keys (i16 decode rules applied
             # to i32 bits, or vice versa) — refuse up front instead
             want = np.dtype(key_np_dtype(self.params.key_dtype))
-            if np.dtype(state.view_key.dtype) != want:
+            have = np.dtype(self._eng.key_plane(state).dtype)
+            if have != want:
                 raise CheckpointError(
-                    f"checkpoint {path!r} stores {state.view_key.dtype} keys "
+                    f"checkpoint {path!r} stores {have} keys "
                     f"but this driver runs plane_dtype={self.params.key_dtype!r}"
                     " — restore into a driver configured for the stored layout"
                 )
         if self.mesh is not None:
-            from ..ops.sharding import shard_sparse_state, shard_state
-
-            state = (
-                shard_sparse_state(state, self.mesh)
-                if self.sparse
-                else shard_state(state, self.mesh)
-            )
+            state = self._eng.shard_state(state, self.mesh)
         self.state = state
         # reset the trace plane: clear the ring (decode orders records by
         # tick, so records from the abandoned timeline would sew into the
@@ -1333,7 +1311,7 @@ class SimDriver:
             self._trace.on_restore(state)
         # re-baseline watches so restore doesn't emit phantom events
         for w in self._watches.values():
-            w.prev_key = np.asarray(self.state.view_key[w.row])
+            w.prev_key = np.asarray(self._eng.view_row(self.state, w.row))
             w.known = {
                 int(j): self.members.get(int(j), self._member_handle(int(j)))
                 for j in np.nonzero(w.prev_key >= 0)[0]

@@ -146,7 +146,7 @@ def driver_families(driver, plane) -> List[dict]:
     reads the metric ring's newest row back (one coalesced transfer)."""
     counters = dict(driver.health_counters)  # property read = the flush
     ds = driver.dispatch_snapshot()
-    engine = "sparse" if driver.sparse else "dense"
+    engine = driver.engine
     base = {"engine": engine}
     fams = [
         family(

@@ -41,12 +41,12 @@ class TelemetryPlane:
         cfg = config or TelemetryConfig()
         self.config = cfg
         self.driver = driver
-        if driver.sparse:
-            from ..ops import sparse as engine
-        else:
-            from ..ops import kernel as engine
-        self._engine = engine
-        self.names = tuple(engine.TELEMETRY_SERIES) + SENTINEL_SERIES
+        # ONE engine-dispatch spelling (r11): the ring's series layout and
+        # window-vector reduction come from the EngineOps descriptor
+        from ..ops import engine_api
+
+        eng = engine_api.of_driver(driver)
+        self.names = tuple(eng.telemetry_series) + SENTINEL_SERIES
         self.ring = MetricRing(self.names, cfg.ring_len, mesh=driver.mesh)
         self.bus = bus or TelemetryBus(cfg.bus_capacity)
         self.hist_dispatch = Histogram(cfg.latency_buckets)
@@ -57,7 +57,7 @@ class TelemetryPlane:
         # one cached device zero for the unarmed sentinel columns (a fresh
         # jnp scalar per window would be a per-window host→device upload)
         self._zero = jnp.int32(0)
-        vector_fn = engine.telemetry_window_vector
+        vector_fn = eng.telemetry_window_vector
 
         def _row(ms, state, false_dead, key_regr):
             return jnp.concatenate(
@@ -172,7 +172,7 @@ class TelemetryPlane:
         out = write_flight_dump(
             target,
             reason=reason,
-            engine="sparse" if self.driver.sparse else "dense",
+            engine=self.driver.engine,
             ring_snapshot=snap,
             bus_tail=[r.as_dict() for r in self.bus.tail()],
             context=context,

@@ -78,13 +78,21 @@ class TracePlane:
         # the r8 on_window pattern, measured free.
         import jax
 
+        from ..ops import engine_api
         from . import capture as _capture
 
         spec = self.spec
+        # view-column source through the engine interface (r11): dense and
+        # sparse gather real view-key columns; pview SYNTHESIZES them from
+        # its [N, k] tables (same [N, K] i32 contract either way)
+        eng = engine_api.of_driver(driver)
+        tracer_rows_arr = tuple(spec.tracer_rows)
 
-        def _summary(view_key, up, tick, prev_cols):
-            now = _capture.gather_tracer_cols(view_key, spec)
-            rows = _capture.build_summary_rows(spec, tick, up, prev_cols, now)
+        def _summary(state, prev_cols):
+            now = eng.tracer_view_cols(state, tracer_rows_arr)
+            rows = _capture.build_summary_rows(
+                spec, state.tick, state.up, prev_cols, now
+            )
             return rows, now
 
         self._summary_fn = jax.jit(_summary)
@@ -94,16 +102,17 @@ class TracePlane:
             )[0],
             donate_argnums=0,
         )
-        self._cols = _capture.gather_tracer_cols(driver.state.view_key, spec)
+        self._gather_cols = jax.jit(
+            lambda state: eng.tracer_view_cols(state, tracer_rows_arr)
+        )
+        self._cols = self._gather_cols(driver.state)
 
     # -- the per-window device path (called under the driver lock) -----------
     def on_window(self, state) -> None:
         """Fold one window boundary into the ring: the view-column diff
         since the previous boundary as a FLAG_SUMMARY record block. Pure
         device ops — zero device→host transfers."""
-        rows, self._cols = self._summary_fn(
-            state.view_key, state.up, state.tick, self._cols
-        )
+        rows, self._cols = self._summary_fn(state, self._cols)
         self.ring.buf = self._append_fn(
             self.ring.buf, rows, self.ring.device_cursor()
         )
@@ -112,9 +121,7 @@ class TracePlane:
     def reset_cols(self, state) -> None:
         """Re-baseline the window-boundary mirror (driver restore: the old
         columns belong to the abandoned timeline)."""
-        from . import capture as _capture
-
-        self._cols = _capture.gather_tracer_cols(state.view_key, self.spec)
+        self._cols = self._gather_cols(state)
 
     def on_restore(self, state) -> None:
         """Driver restore: clear the ring AND re-baseline the mirror — a
@@ -204,7 +211,7 @@ class TracePlane:
         return {
             "armed": True,
             **self.stats(),
-            "engine": "sparse" if self.driver.sparse else "dense",
+            "engine": self.driver.engine,
             "events": sewn["events"],
             "detections": sewn["detections"],
         }

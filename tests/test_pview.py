@@ -1,0 +1,509 @@
+"""r11 partial-view engine: lockstep equivalence + integration.
+
+The contract the tentpole must keep (ISSUE 6 acceptance):
+
+1. The pview engine (``ops/pview.py``) is LOCKSTEP with its scalar oracle
+   (``ops/pview_oracle.py``) tick-for-tick over the FULL state — churn,
+   loss, partitions (group model), the delay ring, and both key layouts
+   (i32 wide / i16 narrow) — at N∈{33, 256}.
+2. On seeded join/crash/partition scenarios the pview engine converges to
+   the SAME decoded steady-state membership as the dense engine (the
+   convergence oracle): identical up sets, every live edge ALIVE, every
+   crashed row detected.
+3. Driver integration keeps the r6-r10 discipline: transfer-free step
+   loop under the numpy-asarray spy, armed (telemetry + trace) drivers
+   bit-identical to unarmed, checkpoint/restore roundtrip with the
+   donation-safe ``copy=True`` rule, engine-mismatch refusal.
+4. A Partition + Crash + heal chaos scenario runs on pview with every
+   sentinel green — including the pview-only view-invariant sentinel
+   (no duplicate/self table entries).
+5. The engine interface (``ops/engine_api.py``) rejects what pview cannot
+   do ([N, N] link planes, meshes, per-link delay) loudly at arm time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from functools import partial
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+import scalecube_cluster_tpu.ops.pview as PV
+import scalecube_cluster_tpu.ops.pview_oracle as PO
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.config import TelemetryConfig
+from scalecube_cluster_tpu.ops import engine_api
+from scalecube_cluster_tpu.ops.lattice import RANK_ALIVE, RANK_DEAD, key_status
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.sim.driver import CheckpointError
+
+
+def _params(n, **kw):
+    base = dict(
+        capacity=n, view_slots=10, active_slots=4, fanout=2, repeat_mult=3,
+        ping_req_k=2, fd_every=2, sync_every=5, suspicion_mult=2,
+        sweep_every=2, sample_tries=4, rumor_slots=3, mr_slots=16,
+        announce_slots=8, sync_announce=2, seed_rows=(0, 1), apply_slots=4,
+    )
+    base.update(kw)
+    return PV.PviewParams(**base)
+
+
+def _state_fields(state):
+    return [f.name for f in dataclasses.fields(type(state))]
+
+
+def _run_lockstep(params, st, seed, n_ticks, mutate=None):
+    step = jax.jit(partial(PV.pview_tick, params=params))
+    key = jax.random.PRNGKey(seed)
+    for t in range(n_ticks):
+        if mutate is not None:
+            st = mutate(t, st)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = PO.pview_oracle_tick(st, k, params)
+        PO.assert_pview_equivalent(st_next, oracle)
+        st = st_next
+    return st
+
+
+def _churn(t, st):
+    """Every code path live: rumor, loss, crash, group partition + heal,
+    cold join, leave, metadata bump."""
+    if t == 2:
+        st = PV.spread_rumor(st, 0, origin=3)
+    if t == 4:
+        st = PV.set_uniform_loss(st, 0.25)
+    if t == 6:
+        st = PV.crash_row(st, 4)
+    if t == 14:
+        st = PV.join_row(st, st.capacity - 1, seed_rows=[0])
+    if t == 20:
+        st = PV.begin_leave(st, 5)
+    if t == 23:
+        st = PV.crash_row(st, 5)
+    if t == 26:
+        st = PV.update_metadata(st, 1)
+    if t == 30:
+        st = PV.block_partition(st, range(0, 8), range(8, 16))
+    if t == 40:
+        st = PV.heal_partition(st, range(0, 8), range(8, 16))
+    return st
+
+
+# ---------------------------------------------------------------------------
+# 1. lockstep with the scalar oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_pview_lockstep_with_churn(seed):
+    params = _params(33)
+    st = PV.init_pview_state(params, 28, warm=True)
+    _run_lockstep(params, st, seed, 48, mutate=_churn)
+
+
+def test_pview_lockstep_narrow_keys():
+    """The saturating i16 neighbor-key layout stays oracle-exact (the
+    oracle reads the layout off the state's nbr_key dtype)."""
+    params = _params(33, key_dtype="i16")
+    st = PV.init_pview_state(params, 28, warm=True)
+    assert st.nbr_key.dtype == jnp.int16
+    assert st.self_key.dtype == jnp.int32  # i32 carrier convention
+    _run_lockstep(params, st, 3, 48, mutate=_churn)
+
+
+def test_pview_lockstep_with_delay_ring():
+    """The [D, N, M]/[D, N, R] pending delivery rings + closed-form FD/SYNC
+    timeliness factors stay oracle-exact."""
+    params = _params(
+        33, delay_slots=3, fd_direct_timeout_ticks=2, fd_leg_timeout_ticks=1,
+        sync_timeout_ticks=8,
+    )
+    st = PV.init_pview_state(params, 28, warm=True, uniform_delay=1.0)
+
+    def mutate(t, st):
+        if t == 2:
+            st = PV.spread_rumor(st, 0, origin=1)
+        if t == 5:
+            st = PV.crash_row(st, 9)
+        return st
+
+    _run_lockstep(params, st, 1, 24, mutate=mutate)
+
+
+def test_pview_lockstep_larger_n():
+    """N=256 (beyond every static cap default), few ticks, busy state."""
+    params = _params(
+        256, view_slots=12, active_slots=5, mr_slots=24, fd_every=1,
+        sync_every=3,
+    )
+    st = PV.init_pview_state(params, 250, warm=True, uniform_loss=0.1)
+    st = PV.spread_rumor(st, 0, origin=2)
+    st = PV.crash_row(st, 9)
+    st = PV.join_row(st, 255, seed_rows=[0])
+    _run_lockstep(params, st, 5, 4)
+
+
+def test_pview_state_has_no_nxn_plane():
+    """The O(N·k) budget, dynamically: no state leaf is [N, N]-proportional
+    (the static twin is lint_plane_dtypes rule 3), and the view_key guard
+    raises instead of materializing."""
+    n = 64
+    params = _params(n)
+    st = PV.init_pview_state(params, n, warm=True)
+    for f in dataclasses.fields(type(st)):
+        shape = np.shape(getattr(st, f.name))
+        assert sum(1 for d in shape if d >= n) <= 1, (
+            f"{f.name} has shape {shape} — more than one capacity-scaled dim"
+        )
+    with pytest.raises(AttributeError, match="no \\[N, N\\] view plane"):
+        _ = st.view_key
+
+
+# ---------------------------------------------------------------------------
+# 2. dense engine as the convergence oracle
+# ---------------------------------------------------------------------------
+
+
+def test_pview_converges_to_same_membership_as_dense():
+    """Seeded join + crash + partition scenario on BOTH engines: each must
+    re-converge (its own sentinel) and the decoded steady-state membership
+    verdicts must agree — same up set, every up member self-decoding ALIVE,
+    every crashed row detected, every live edge ALIVE."""
+    from scalecube_cluster_tpu.chaos import Crash, Partition, Scenario
+
+    n = 64
+    scn = Scenario(
+        name="conv-oracle",
+        events=[
+            Crash(rows=[9], at=3),
+            Partition(groups=[range(0, 32), range(32, 64)], at=30, heal_at=80),
+        ],
+        horizon=420,
+        check_interval=8,
+    )
+    pv = SimDriver(
+        _params(n, view_slots=12, active_slots=5, mr_slots=32,
+                announce_slots=16, seed_rows=(0, 32), apply_slots=6),
+        n - 1, warm=True, seed=0,
+    )
+    dn = SimDriver(
+        S.SimParams(
+            capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+            sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0, 32),
+        ),
+        n - 1, warm=True, seed=0,
+    )
+    for d in (pv, dn):  # the seeded JOIN leg: a cold member on row n-1
+        d.join(seed_rows=(0,))
+    rep_pv = pv.run_scenario(scn)
+    rep_dn = dn.run_scenario(scn)
+    assert rep_pv["ok"], rep_pv["sentinels"]
+    assert rep_dn["ok"], rep_dn["sentinels"]
+
+    up_pv = np.asarray(pv.state.up)
+    up_dn = np.asarray(dn.state.up)
+    assert (up_pv == up_dn).all()
+
+    # decoded self-records: every up member says ALIVE in both engines
+    self_pv = np.asarray(pv.state.self_key)
+    diag_dn = np.asarray(jnp.diagonal(dn.state.view_key)).astype(np.int32)
+    assert ((self_pv[up_pv] & 3) == RANK_ALIVE).all()
+    assert (np.asarray(key_status(diag_dn))[up_dn] == 0).all()
+
+    # crashed row detected by both: dense holds DEAD everywhere live,
+    # pview holds NO non-DEAD record (unknown == removed, the reference's
+    # post-detection table state)
+    vk = np.asarray(dn.state.view_key).astype(np.int32)
+    assert ((vk[up_dn, 9] & 3) == RANK_DEAD).all()
+    sid = np.asarray(pv.state.nbr_id)
+    keys = np.asarray(pv.state.nbr_key).astype(np.int32)
+    holds = (sid == 9) & up_pv[:, None] & ((keys & 3) != RANK_DEAD)
+    assert not holds.any()
+
+    # every live pview table edge agrees ALIVE (the partial-view
+    # convergence measure — dense's full-plane equivalent is implied by
+    # its own convergence sentinel)
+    live_edge = (sid >= 0) & up_pv[:, None] & up_pv[np.maximum(sid, 0)]
+    assert ((keys[live_edge] & 3) == RANK_ALIVE).all()
+
+
+# ---------------------------------------------------------------------------
+# 3. driver integration: transfers, arming, checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_pview_driver_step_is_transfer_free(monkeypatch):
+    """The r6 zero-per-window-readback proof holds for the pview engine."""
+    d = SimDriver(_params(64, sync_every=8), 64, warm=True, seed=0)
+    d.spread_rumor(3, "payload")
+    d.step(2)
+    d.sync()
+    real_asarray = np.asarray
+    transfers = []
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(5):
+            d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"pview step() read back: {transfers}"
+    assert d.dispatch_stats["readbacks"] == 0
+
+
+def test_pview_armed_and_unarmed_drivers_bit_identical():
+    """Telemetry + trace planes armed on one of two same-seeded drivers:
+    every state leaf identical window for window (r8/r10 neutrality on the
+    third engine)."""
+    params = _params(24, sync_every=8)
+    a = SimDriver(params, 20, warm=True, seed=11)
+    b = SimDriver(params, 20, warm=True, seed=11)
+    b.arm_telemetry(TelemetryConfig(ring_len=8))
+    b.arm_trace(tracer_rows=(1, 5), rumor_slots=(0,))
+    for w in range(4):
+        if w == 1:
+            for d in (a, b):
+                d.crash(5)
+                d.spread_rumor(origin=3, payload="p")
+        if w == 2:
+            for d in (a, b):
+                d.join(seed_rows=(0,))
+        a.step(3)
+        b.step(3)
+        for name in _state_fields(a.state):
+            x = np.asarray(getattr(a.state, name))
+            y = np.asarray(getattr(b.state, name))
+            assert np.array_equal(x, y), (
+                f"armed/unarmed divergence in {name} at window {w}"
+            )
+    assert np.array_equal(np.asarray(a._key), np.asarray(b._key))
+    assert b.telemetry.ring.windows == 4
+    assert b.trace.stats()["records"] > 0
+
+
+def test_pview_armed_step_is_transfer_free(monkeypatch):
+    """Armed (telemetry + trace) pview stepping performs zero device→host
+    transfers — the spy proof with both planes live."""
+    d = SimDriver(_params(24, sync_every=8), 20, warm=True, seed=3)
+    d.arm_telemetry(TelemetryConfig(ring_len=8))
+    d.arm_trace(tracer_rows=(2,), rumor_slots=(0,))
+    d.spread_rumor(3, "x")
+    d.step(2)
+    d.sync()
+    real_asarray = np.asarray
+    transfers = []
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(4):
+            d.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"armed pview step() read back: {transfers}"
+
+
+def test_pview_checkpoint_roundtrip_continues_identically(tmp_path):
+    """checkpoint → restore into a fresh driver → identical continued
+    trajectory. The restore path must deep-copy (jnp.array copy=True):
+    the donated window would otherwise consume the npz's zero-copy alias
+    and diverge (the r6 use-after-free class)."""
+    params = _params(24, sync_every=8)
+    d = SimDriver(params, 20, warm=True, seed=5)
+    slot = d.spread_rumor(3, "x")
+    d.step(5)
+    p = str(tmp_path / "pv.npz")
+    d.checkpoint(p)
+
+    d.step(7)  # the uninterrupted timeline
+
+    d2 = SimDriver(params, 20, warm=True, seed=99)
+    d2.restore(p)
+    d2.step(7)  # donating windows over the restored buffers
+    for name in _state_fields(d.state):
+        x = np.asarray(getattr(d.state, name))
+        y = np.asarray(getattr(d2.state, name))
+        assert np.array_equal(x, y), f"restore divergence in {name}"
+    assert d2.rumor_coverage(slot) == d.rumor_coverage(slot)
+
+
+def test_pview_checkpoint_refuses_foreign_engine(tmp_path):
+    d = SimDriver(_params(16), 12, warm=True, seed=0)
+    p = str(tmp_path / "pv.npz")
+    d.checkpoint(p)
+    dn = SimDriver(
+        S.SimParams(capacity=16, rumor_slots=3, seed_rows=(0,)),
+        12, warm=True, seed=0,
+    )
+    with pytest.raises(CheckpointError, match="pview"):
+        dn.restore(p)
+
+
+# ---------------------------------------------------------------------------
+# 4. chaos on pview
+# ---------------------------------------------------------------------------
+
+
+def test_pview_chaos_partition_crash_heal_sentinels_green():
+    """Partition + Crash + heal + restart on the pview engine: every
+    sentinel green — detection, post-heal re-convergence (tombstone purge
+    + seed-SYNC cadence, deviations P8 + the seed_sync_every account),
+    no false-DEAD, key monotonicity, and the view invariant (no
+    duplicate/self table entries, ever)."""
+    from scalecube_cluster_tpu.chaos import Crash, Partition, Restart, Scenario
+
+    n = 48
+    params = _params(
+        n, view_slots=12, active_slots=5, fanout=3, sync_every=6,
+        mr_slots=32, announce_slots=16, rumor_slots=2, seed_rows=(0, 24),
+        apply_slots=6,
+    )
+    d = SimDriver(params, n, warm=True, seed=0)
+    scn = Scenario(
+        name="pview-mixed",
+        events=[
+            Crash(rows=[4], at=3),
+            Partition(groups=[range(0, 24), range(24, 48)], at=30, heal_at=90),
+            Restart(rows=[4], at=120, seed_rows=(0,)),
+        ],
+        horizon=500,
+        check_interval=8,
+    )
+    rep = d.run_scenario(scn)
+    assert rep["ok"], rep
+    sent = rep["sentinels"]
+    assert rep["violations"] == 0
+    assert sent["false_dead_members_max"] == 0
+    assert sent["key_regressions"] == 0
+    assert sent["view_invariant_breaks"] == 0
+    assert all(x["ok"] for x in sent["detections"])
+    assert all(x["ok"] for x in sent["convergence"])
+    assert all(x["converged_at"] is not None for x in sent["convergence"])
+
+
+# ---------------------------------------------------------------------------
+# 5. engine-interface guard rails
+# ---------------------------------------------------------------------------
+
+
+def test_pview_rejects_dense_links():
+    with pytest.raises(ValueError, match="no \\[N, N\\] link plane"):
+        SimDriver(_params(16), 12, warm=True, dense_links=True)
+
+
+def test_pview_rejects_mesh():
+    import scalecube_cluster_tpu.ops.sharding as SH
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    mesh = SH.make_mesh(jax.devices()[:2])
+    with pytest.raises(ValueError, match="single-device"):
+        SimDriver(_params(64), 32, warm=True, mesh=mesh)
+
+
+def test_pview_rejects_per_link_delay():
+    st = PV.init_pview_state(_params(16), 12, warm=True)
+    with pytest.raises(ValueError, match="per-link delay"):
+        PV.set_link_delay(st, [0], [1], 2.0)
+
+
+def test_engine_api_resolves_all_three():
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    assert engine_api.resolve(_params(16)).name == "pview"
+    assert engine_api.resolve(
+        S.SimParams(capacity=8, seed_rows=(0,))
+    ).name == "dense"
+    assert engine_api.resolve(
+        SP.SparseParams(capacity=64, seed_rows=(0,))
+    ).name == "sparse"
+    with pytest.raises(TypeError, match="selects no engine"):
+        engine_api.resolve(object())
+    with pytest.raises(ValueError, match="unknown engine"):
+        engine_api.engine("fancy")
+
+
+def test_pview_view_row_synthesis_matches_tables():
+    """engine_api.view_row / tracer_view_cols synthesize full-width rows/
+    columns that agree with the raw [N, k] tables + self records."""
+    n = 24
+    params = _params(n)
+    st = PV.init_pview_state(params, n, warm=True)
+    eng = engine_api.engine("pview")
+    row = 3
+    full = np.asarray(eng.view_row(st, row))
+    assert full.shape == (n,)
+    sid = np.asarray(st.nbr_id[row])
+    keys = np.asarray(st.nbr_key[row]).astype(np.int32)
+    for s, j in enumerate(sid):
+        if j >= 0:
+            assert full[j] == keys[s]
+    assert full[row] == int(st.self_key[row])
+    untabled = set(range(n)) - set(sid[sid >= 0].tolist()) - {row}
+    assert all(full[j] == -1 for j in untabled)
+
+    cols = np.asarray(eng.tracer_view_cols(st, (row, 7)))
+    assert cols.shape == (n, 2)
+    rows_full = np.asarray(PV.view_rows(st, np.arange(n)))
+    assert (cols[:, 0] == rows_full[:, row]).all()
+    assert (cols[:, 1] == rows_full[:, 7]).all()
+
+
+def test_pview_partition_heal_symmetric_on_cell_collision():
+    """Groups whose min rows are congruent mod G-1 hash to the SAME raw
+    partition cell (0 and 3 under the default G=4); the collision remap
+    must be order-independent so BOTH directional heal calls clear the
+    same cell pair (regression: 'always bump the second' left
+    part_loss[cb, ca] = 1.0 forever and the halves never re-converged)."""
+    n = 32
+    st = PV.init_pview_state(_params(n), n, warm=True)
+    a, b = range(0, 3), range(3, 6)
+    st = PV.block_partition(st, a, b)
+    assert float(np.asarray(st.part_loss).sum()) == 2.0  # both directions
+    healed = PV.heal_partition(st, a, b)
+    assert float(np.asarray(healed.part_loss).max()) == 0.0
+    # swapped-group spelling heals the identical cells
+    healed_swapped = PV.heal_partition(st, b, a)
+    assert float(np.asarray(healed_swapped.part_loss).max()) == 0.0
+
+
+def test_pview_partition_groups_validated():
+    """G=2 leaves one non-reserved cell: both groups collide onto it and
+    block_partition would sever intra-group traffic instead of splitting
+    the halves — refused at params construction."""
+    with pytest.raises(ValueError, match="partition_groups"):
+        _params(64, partition_groups=2)
+
+
+def test_pview_simnode_incarnation_of():
+    """SimNode.incarnation_of goes through engine_api.view_row (regression:
+    it read state.view_key directly, which the pview state does not have)."""
+    from scalecube_cluster_tpu.sim.cluster import SimNode
+
+    d = SimDriver(_params(24), 24, warm=True, seed=0)
+    node = SimNode(d, 0)
+    assert node.incarnation_of(1) == 0
+    d.update_metadata(1)
+    d.step(4)
+    d.sync()
+    assert node.incarnation_of(1) >= 1

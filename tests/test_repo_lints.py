@@ -108,6 +108,44 @@ def test_plane_lint_catches_the_bypass_class(tmp_path):
     assert {f.function for f in findings} == {"alloc", "reduce_bad"}
 
 
+def test_pview_lint_hard_bans_capacity_squared_allocs(tmp_path):
+    """Falsifiability for plane-lint rule 3: inside a file named pview.py,
+    [N, N] allocations of ANY dtype, the [D, N, N] form, the word-packed
+    [N, ceil(N/32)] form, np allocations, and capacity-attribute spellings
+    are all flagged, the suppression marker does NOT exempt them, and
+    O(N·k) / [N, R] / [G, G] shapes pass."""
+    bad = tmp_path / "pview.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def alloc(n, k, r, g, d, state):
+            a = jnp.zeros((n, n), jnp.float32)            # flagged: any dtype
+            b = jnp.zeros((d, n, n), bool)                # flagged: [D, N, N]
+            c = jnp.zeros((n, (n + 31) // 32), jnp.uint32)  # flagged: packed
+            e = np.full((n, n), -1, np.int32)             # flagged: np alloc
+            f = jnp.zeros((state.capacity, n), bool)      # flagged: capacity attr
+            s = jnp.zeros((n, n), bool)  # lint: allow-wide-plane (no exemption)
+            ok1 = jnp.zeros((n, k), jnp.int32)
+            ok2 = jnp.zeros((n, r), bool)
+            ok3 = jnp.zeros((g, g), jnp.float32)
+            ok4 = jnp.zeros((n + 1,), bool)
+            return a, b, c, e, f, s, ok1, ok2, ok3, ok4
+    """))
+    findings = lint_planes_file(str(bad))
+    assert len(findings) == 6, "\n".join(str(f) for f in findings)
+    assert all("pview" in f.message for f in findings)
+
+    # the same square alloc OUTSIDE pview.py falls back to rules 1/2 only
+    other = tmp_path / "other_ops.py"
+    other.write_text(
+        "import jax.numpy as jnp\n"
+        "def alloc(n):\n"
+        "    return jnp.zeros((n, n), jnp.float32)\n"
+    )
+    assert lint_planes_file(str(other)) == []
+
+
 def test_ops_tick_paths_have_no_host_callbacks():
     """The zero-transfer discipline, statically: nothing in ops/ calls a
     host-callback escape hatch (jax.debug.print / io_callback /

@@ -253,7 +253,15 @@ def test_delayed_rumor_exactly_once_delivery_beyond_sweep():
     mean link delay far beyond the sweep window must still deliver the rumor
     to every member EXACTLY once — late in-flight copies keep the slot live
     (per-node sweep semantics) and the infection bitmap's OR makes double
-    delivery structurally impossible."""
+    delivery structurally impossible.
+
+    Mean delay 300 (not 60): the ring truncates draws at delay_slots - 1,
+    so what matters for the "outlives the sweep" assertion is the residual
+    mass BELOW the sweep window — at mean 60 that is ~14% per in-flight
+    copy and the assertion is a seed lottery across jax PRNG-stream
+    changes (it flipped when the toolchain bumped jax); at 300 it is ~4%
+    and the property holds across seeds while staying exactly the
+    reference scenario (delay >> sweep window)."""
     from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_sweep
 
     params = S.SimParams(
@@ -261,7 +269,7 @@ def test_delayed_rumor_exactly_once_delivery_beyond_sweep():
         rumor_slots=2, seed_rows=(0,), delay_slots=24,
     )
     n_alive = 4
-    st = S.init_state(params, n_alive, warm=True, uniform_delay=60.0)
+    st = S.init_state(params, n_alive, warm=True, uniform_delay=300.0)
     st = S.spread_rumor(st, 0, 0)
     step = jax.jit(partial(K.tick, params=params))
     key = jax.random.PRNGKey(21)

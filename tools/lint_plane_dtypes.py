@@ -24,9 +24,18 @@ Rules (AST-based, no imports of the linted code; ops/ only):
 2. Any ``jnp.float64`` / ``np.float64`` / ``numpy.float64`` reference in
    ``ops/`` is flagged — packed reductions are integer end-to-end
    (``bitplane.popcount`` contract).
+3. **pview hard ban (r11).** Inside ``ops/pview.py`` — the O(N·k)
+   partial-view engine whose whole point is that NO plane scales as N² —
+   any allocation (jnp or np; any dtype) whose literal shape tuple
+   contains two or more capacity-scaled dims is flagged: ``(n, n)``,
+   ``(d, n, n)``, and the word-packed full-width form ``(n, (n + 31) //
+   32)`` all match (a dim is capacity-scaled when it references ``n`` /
+   ``n_initial`` / a ``capacity`` attribute). There is NO suppression
+   marker for this rule — an [N, N]-proportional plane in pview.py is a
+   design regression, not a style call.
 
-A line may opt out with ``# lint: allow-wide-plane`` (rule 1 — e.g. the
-``changed_at`` timestamp plane, which is semantically i32) or
+A line may opt out with ``# lint: allow-wide-plane`` (rules 1 only — e.g.
+the ``changed_at`` timestamp plane, which is semantically i32) or
 ``# lint: allow-float64`` (rule 2), stating its reason inline.
 
 Run directly (``python tools/lint_plane_dtypes.py [root]``, exit 1 on
@@ -53,6 +62,12 @@ _BOOL_DTYPES = {("bool",), ("jnp", "bool_"), ("np", "bool_"), ("numpy", "bool_")
 _I32_DTYPES = {("jnp", "int32"), ("np", "int32"), ("numpy", "int32")}
 _F64_CHAINS = {("jnp", "float64"), ("np", "float64"), ("numpy", "float64"),
                ("jax", "numpy", "float64")}
+# rule 3: np allocations count too (a host-side [N, N] staging plane blows
+# the same budget before it ever reaches the device)
+_NP_ALLOC_CHAINS = {
+    (m, f) for m in ("np", "numpy") for f in ("zeros", "ones", "full", "empty")
+}
+_CAPACITY_NAMES = {"n", "n_initial"}
 
 
 @dataclass(frozen=True)
@@ -100,6 +115,26 @@ def _member_square(shape: ast.AST) -> bool:
     )
 
 
+def _capacity_scaled(node: ast.AST) -> bool:
+    """True when a shape dim references the member capacity: the bare
+    names ``n`` / ``n_initial``, any ``*.capacity`` attribute, or an
+    expression containing one (``n + 1``, ``(n + 31) // 32``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _CAPACITY_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "capacity":
+            return True
+    return False
+
+
+def _pview_wide(shape: ast.AST) -> bool:
+    """Rule 3's trigger: a literal shape tuple with >= 2 capacity-scaled
+    dims ([N, N], [D, N, N], and the word-packed [N, ceil(N/32)])."""
+    if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+        return False
+    return sum(1 for e in shape.elts if _capacity_scaled(e)) >= 2
+
+
 def _dtype_of(call: ast.Call, chain: tuple) -> Optional[tuple]:
     """The dtype argument's chain, positional or keyword, if spelled
     statically. zeros/ones/empty: (shape, dtype); full: (shape, fill, dtype)."""
@@ -138,10 +173,27 @@ def lint_file(path: str) -> List[Finding]:
                 parents.setdefault(id(child), fn.name)
 
     skip_f64 = os.path.basename(path) == "dcn.py"  # multi-host glue, no planes
+    pview = os.path.basename(path) == "pview.py"
     for node in ast.walk(tree):
         where = parents.get(id(node), "<module>")
         if isinstance(node, ast.Call):
             chain = _attr_chain(node.func)
+            if (
+                pview
+                and chain in (_ALLOC_CHAINS | _NP_ALLOC_CHAINS)
+                and node.args
+                and _pview_wide(node.args[0])
+            ):
+                # rule 3: NOT suppressible — the O(N·k) budget is the
+                # engine's contract
+                findings.append(Finding(
+                    path, node.lineno, where,
+                    "capacity-squared allocation in ops/pview.py — the "
+                    "partial-view engine allows NO [N, N]-proportional "
+                    "plane (including word-packed [N, ceil(N/32)]); keep "
+                    "state O(N·k) or put the plane in another engine",
+                ))
+                continue
             if chain in _ALLOC_CHAINS and node.args and _member_square(node.args[0]):
                 if _suppressed(lines, node.lineno, SUPPRESS_PLANE):
                     continue
