@@ -142,6 +142,32 @@ def collect_trajectory(root: pathlib.Path) -> list:
     return out
 
 
+def collect_audit_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing AUDIT artifact (r12): overall verdict
+    plus per-program ok flags — enough for a round-over-round diff without
+    duplicating the full contract detail."""
+    path = root / "AUDIT_r12.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return {
+            "present": True,
+            "ok": data.get("ok"),
+            "n_programs": data.get("n_programs"),
+            "n_violations": data.get("n_violations"),
+            "programs": {
+                e["program"]: all(
+                    c["ok"] for c in e.get("contracts", {}).values()
+                )
+                for e in data.get("programs", [])
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, required=True)
@@ -211,6 +237,14 @@ def main() -> None:
                     "--probe-base", "131072", "--probe-cap", "131072"],
                    timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
+    # r12 static program audit: the r6-r11 contracts proved over every
+    # engine's compiled window programs (donation aliasing, transfer-
+    # freeness, no in-scan plane materialization, pview O(N·k), memory
+    # budgets). Refreshes the standing AUDIT artifact AND rides the round
+    # artifact as a config entry; a violation surfaces as ok=false here
+    # and as a nonzero exit in CI.
+    results += run([py, "tools/audit_programs.py", "--all", "--json",
+                    "--out", "AUDIT_r12.json"])
     results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)
 
@@ -223,6 +257,9 @@ def main() -> None:
         # bench artifacts (r9 satellite: no more loose, collector-invisible
         # files)
         "dense_tick_trajectory": collect_trajectory(ROOT),
+        # r12: standing static-audit verdict summary (full detail lives in
+        # AUDIT_r12.json, refreshed by the tools/audit_programs.py run above)
+        "program_audit": collect_audit_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

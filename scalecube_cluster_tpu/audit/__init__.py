@@ -1,0 +1,64 @@
+"""Static program-audit plane (r12).
+
+Proves the repo's load-bearing invariants over each engine's COMPILED
+window programs — closed jaxprs, lowered StableHLO, AOT-compiled HLO and
+its ``memory_analysis`` — instead of sampling them from runs or matching
+source text:
+
+* r6 donated-buffer aliasing (every donated leaf aliased, no stale escape),
+* r6/r8/r10 transfer-freeness (no host callback/infeed/outfeed primitive),
+* the r10 in-scan wide-plane materialization pattern (~18%/tick),
+* the r11 pview O(N·k) no-wide-value guarantee,
+* the r9/r11 per-engine window memory budgets,
+* the r6 ``restore()`` copy rule, via each engine's registered
+  ``restore_module`` (AST lint through the contract registry).
+
+Contracts are declared per engine on
+:class:`..ops.engine_api.EngineContracts`; ``tools/audit_programs.py`` is
+the CLI; ``tests/test_audit_programs.py`` runs the fast matrix in tier-1
+and falsifiability-tests every contract class on seeded violations.
+"""
+
+from .contracts import (
+    CHECKERS,
+    TRANSFER_PRIMITIVES,
+    Violation,
+    check_donation_alias,
+    check_forbid_wide_values,
+    check_memory_budget,
+    check_no_plane_materialization,
+    check_restore_seams,
+    check_transfer_free,
+    run_contracts,
+)
+from .programs import (
+    DEFAULT_CAPACITY,
+    DEFAULT_N_TICKS,
+    DEFAULT_SHARDED_CAPACITY,
+    AuditProgram,
+    build_engine_programs,
+    build_matrix,
+)
+from .report import audit_all, audit_programs, format_text
+
+__all__ = [
+    "AuditProgram",
+    "CHECKERS",
+    "DEFAULT_CAPACITY",
+    "DEFAULT_N_TICKS",
+    "DEFAULT_SHARDED_CAPACITY",
+    "TRANSFER_PRIMITIVES",
+    "Violation",
+    "audit_all",
+    "audit_programs",
+    "build_engine_programs",
+    "build_matrix",
+    "check_donation_alias",
+    "check_forbid_wide_values",
+    "check_memory_budget",
+    "check_no_plane_materialization",
+    "check_restore_seams",
+    "check_transfer_free",
+    "format_text",
+    "run_contracts",
+]

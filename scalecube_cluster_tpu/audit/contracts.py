@@ -1,0 +1,479 @@
+"""The contract checkers: prove each r6–r11 invariant over the program IR.
+
+Each checker takes one :class:`.programs.AuditProgram` and returns a list
+of :class:`Violation` — empty means PROVED over the program the compiler
+actually builds (not sampled from a run, not matched from source text):
+
+* :func:`check_donation_alias` — r6: every donated leaf is aliased into
+  the lowered program (``tf.aliasing_output`` / ``donated_invars``), and
+  no donated input escapes the program unchanged alongside its aliased
+  update (the use-after-free shape: the caller would read freed memory
+  through the returned alias).
+* :func:`check_transfer_free` — r6/r8/r10: no host-callback or
+  infeed/outfeed primitive anywhere in the closed jaxpr. This is the
+  IR-level superset of ``tools/lint_host_callbacks.py`` — a callback
+  reached through decorator indirection or a re-exported helper never
+  appears as a matchable attribute chain in source, but it is always a
+  ``*_callback`` equation in the jaxpr.
+* :func:`check_no_plane_materialization` — r10 (the measured ~18%/tick
+  lesson): no gather/dynamic-slice of a capacity²-wide plane inside the
+  window scan whose value escapes ONLY to the per-tick stacked outputs.
+  Such a consumer forces XLA to materialize an extra full-plane copy per
+  tick; window-boundary diffs (the r10 design) are free.
+* :func:`check_forbid_wide_values` — r11, pview only: NO value anywhere
+  in the closed jaxpr has two or more capacity-scaled dims. The source
+  lint (plane-dtype rule 3) bans *allocations*; this bans every
+  intermediate the compiler builds, which is the actual O(N·k) claim.
+* :func:`check_memory_budget` — r9/r11: the compiled program's
+  ``memory_analysis`` peak stays within the engine's declared budget
+  (``factor ×`` one state copy ``+ overhead``) — the max-N ladders'
+  feasibility rule as a per-engine regression gate.
+
+:func:`run_contracts` dispatches the applicable subset for one program;
+:func:`check_restore_seams` closes the loop on the r6 restore rule by
+running the AST donation lint over each engine's registered
+``restore_module`` (zero-copy host aliases enter donatable state through
+``restore()``, which no jaxpr can see — the lint is the right tool, the
+registry makes it per-engine).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import re
+from typing import Callable, Dict, List, Optional
+
+from . import jaxpr_walk as W
+from .programs import MIB, AuditProgram
+
+#: jaxpr primitives that reach the host from inside a program (the lint's
+#: attribute chains, at the IR level where indirection cannot hide them)
+TRANSFER_PRIMITIVES = {
+    "pure_callback": "pure_callback bakes a host round trip into the program",
+    "io_callback": "io_callback bakes a host round trip into the program",
+    "debug_callback": "debug callback (jax.debug.print/callback) runs on host "
+                      "per traced invocation",
+    "outside_call": "host_callback outside_call is a device->host escape",
+    "infeed": "infeed synchronizes with a host feeder thread",
+    "outfeed": "outfeed pushes device values to a host listener",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One broken contract, with an actionable pointer."""
+
+    contract: str  # "donation_alias" | "transfer_free" | ...
+    program: str  # AuditProgram.name
+    message: str
+    where: str = ""  # source provenance (file:line (fn)) when known
+
+    def __str__(self) -> str:
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.program}: {self.contract}: {self.message}{loc}"
+
+
+# -- 1. donation-alias verifier ----------------------------------------------
+
+_ARG_SPLIT_RE = re.compile(r"%arg(\d+):")
+
+
+def _mlir_donated_args(mlir_text: str) -> Dict[int, str]:
+    """arg position -> donation annotation, from the lowered module's entry
+    signature. A donated parameter carries ``tf.aliasing_output = K``
+    (single-device: jax already knows which output reuses the buffer) or
+    ``jax.buffer_donor = true`` (sharded: XLA's buffer assignment picks the
+    aliasing, and the COMPILED module's ``input_output_alias`` shows it —
+    see :func:`_compiled_aliased_params`). Parsed per argument fragment so
+    an unannotated arg can never swallow its neighbor's annotation."""
+    out: Dict[int, str] = {}
+    parts = _ARG_SPLIT_RE.split(mlir_text)
+    # parts = [prefix, argnum, fragment, argnum, fragment, ...]; each
+    # fragment runs to the NEXT %arg token, so arg attrs stay with their arg
+    for argnum, fragment in zip(parts[1::2], parts[2::2]):
+        # attrs sit in the leading {...} block before the signature's arrow
+        head = fragment.split("->", 1)[0]
+        m = re.search(r"tf\.aliasing_output\s*=\s*(\d+)", head)
+        if m:
+            out[int(argnum)] = f"tf.aliasing_output = {m.group(1)}"
+        elif re.search(r"jax\.buffer_donor\s*=\s*true", head):
+            out[int(argnum)] = "jax.buffer_donor"
+    return out
+
+
+def _compiled_aliased_params(hlo_text: str) -> Optional[set]:
+    """Parameter numbers in the compiled module's ``input_output_alias``
+    header — what XLA's buffer assignment ACCEPTED; None when the module
+    declares no alias map at all."""
+    key = "input_output_alias={"
+    i = hlo_text.find(key)
+    if i < 0:
+        return None
+    j = i + len(key)
+    depth = 1
+    while depth and j < len(hlo_text):
+        ch = hlo_text[j]
+        depth += ch == "{"
+        depth -= ch == "}"
+        j += 1
+    body = hlo_text[i + len(key):j]
+    return {int(m.group(1)) for m in re.finditer(r"\}:\s*\((\d+),", body)}
+
+
+def _kept_flat_positions(prog: AuditProgram) -> List[int]:
+    """Flat invar positions the program actually USES, in order — jit's
+    lowering DROPS unused arguments (``kept_var_idx``) and numbers MLIR
+    ``%argN`` / compiled parameters over the kept ones only, so flat leaf
+    positions must be remapped through this list before comparing against
+    either. Usedness is judged on the traced pjit's INNER jaxpr (the outer
+    wrapper trivially passes every arg through)."""
+    jaxpr = prog.closed_jaxpr.jaxpr
+    inner = jaxpr
+    if len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name == "pjit":
+        sub = jaxpr.eqns[0].params.get("jaxpr")
+        if sub is not None and len(sub.jaxpr.invars) == len(jaxpr.invars):
+            inner = sub.jaxpr
+    used = set()
+    for eqn in inner.eqns:
+        for iv in eqn.invars:
+            if W.is_var(iv):
+                used.add(id(iv))
+    for ov in inner.outvars:
+        if W.is_var(ov):
+            used.add(id(ov))
+    return [i for i, iv in enumerate(inner.invars) if id(iv) in used]
+
+
+def check_donation_alias(
+    prog: AuditProgram, use_compiled: bool = False
+) -> List[Violation]:
+    donated = prog.donated_leaf_info()
+    if not donated:
+        return []  # program donates nothing; no claim to verify
+    violations: List[Violation] = []
+
+    # flat position -> lowered/compiled argument number (unused args are
+    # dropped by lowering, shifting every later argument's number)
+    kept = _kept_flat_positions(prog)
+    arg_number = {flat: i for i, flat in enumerate(kept)}
+
+    # (a) every donated leaf must be USED and annotated in the LOWERED
+    # program — an unused donated leaf means the builder no longer threads
+    # that buffer at all (its donation is vacuous and the state it holds is
+    # dead weight), and an unannotated one means the donation was dropped
+    annotated = _mlir_donated_args(prog.mlir_text)
+    for pos, path, nbytes in donated:
+        if nbytes == 0:
+            continue
+        if pos not in arg_number:
+            violations.append(Violation(
+                "donation_alias", prog.name,
+                f"donated leaf {path} (flat arg {pos}, {nbytes} B) is "
+                "UNUSED by the program — lowering drops the argument, the "
+                "donation is vacuous, and the buffer never updates in "
+                "place (r6 discipline requires every donated leaf to be "
+                "threaded through the window)",
+            ))
+        elif arg_number[pos] not in annotated:
+            violations.append(Violation(
+                "donation_alias", prog.name,
+                f"donated leaf {path} (flat arg {pos}, {nbytes} B) carries "
+                "neither tf.aliasing_output nor jax.buffer_donor in the "
+                "lowered program — the donation was dropped and the window "
+                "silently degrades to a copying dispatch (r6)",
+            ))
+
+    # (a') when compiled, the optimized module's input_output_alias map is
+    # the ground truth: XLA's buffer assignment must have ACCEPTED an alias
+    # for every donated leaf (a may-alias hint XLA declined — e.g. a donor
+    # whose buffer is still live at output time — shows up only here)
+    if use_compiled and not violations:
+        accepted = _compiled_aliased_params(prog.compiled().as_text())
+        if accepted is None:
+            violations.append(Violation(
+                "donation_alias", prog.name,
+                "compiled module declares NO input_output_alias map despite "
+                f"{len(donated)} donated leaves — the whole donation was "
+                "dropped at compile time (r6 copying dispatch)",
+            ))
+        else:
+            for pos, path, nbytes in donated:
+                if nbytes > 0 and arg_number.get(pos) not in accepted:
+                    violations.append(Violation(
+                        "donation_alias", prog.name,
+                        f"donated leaf {path} (param {arg_number.get(pos)}, "
+                        f"{nbytes} B) is absent from the compiled "
+                        "input_output_alias map — XLA declined the alias, "
+                        "so this window copies the buffer every dispatch "
+                        "(r6)",
+                    ))
+
+    # (b) no donated input may escape unchanged alongside its aliased
+    # update — the caller would hold a stale reference into freed memory
+    closed = prog.closed_jaxpr
+    invars = closed.jaxpr.invars
+    donated_positions = {pos for pos, _, _ in donated}
+    path_by_pos = {pos: path for pos, path, _ in donated}
+    outvar_ids = {id(v) for v in closed.jaxpr.outvars if W.is_var(v)}
+    for pos, iv in enumerate(invars):
+        if pos in donated_positions and id(iv) in outvar_ids:
+            violations.append(Violation(
+                "donation_alias", prog.name,
+                f"donated leaf {path_by_pos[pos]} (flat arg {pos}) escapes "
+                "the program UNCHANGED alongside its aliased update — the "
+                "r6 use-after-free shape (the returned value aliases a "
+                "buffer the donation frees); return only the updated array",
+            ))
+    return violations
+
+
+# -- 2. transfer-freeness prover ---------------------------------------------
+
+
+def check_transfer_free(prog: AuditProgram) -> List[Violation]:
+    violations: List[Violation] = []
+    for eqn, _ in W.walk_eqns(prog.closed_jaxpr.jaxpr):
+        why = TRANSFER_PRIMITIVES.get(eqn.primitive.name)
+        if why is not None:
+            violations.append(Violation(
+                "transfer_free", prog.name,
+                f"primitive '{eqn.primitive.name}' in the closed jaxpr: "
+                f"{why} — the r6 zero-per-window-transfer discipline bans "
+                "it from every window program",
+                where=W.provenance(eqn),
+            ))
+    return violations
+
+
+# -- 3. in-scan wide-plane materialization detector ---------------------------
+
+
+def check_no_plane_materialization(prog: AuditProgram) -> List[Violation]:
+    if not prog.is_window:
+        return []
+    violations: List[Violation] = []
+    for scan_eqn in W.outer_scans(prog.closed_jaxpr.jaxpr):
+        if scan_eqn.params.get("length") != prog.n_ticks:
+            continue  # not the window loop
+        body = scan_eqn.params["jaxpr"].jaxpr
+        nc = scan_eqn.params["num_carry"]
+        carry_out = [v for v in body.outvars[:nc] if W.is_var(v)]
+        ys_out = [v for v in body.outvars[nc:] if W.is_var(v)]
+        producer: Dict[int, int] = {}
+        for i, eqn in enumerate(body.eqns):
+            for ov in eqn.outvars:
+                if W.is_var(ov):
+                    producer[id(ov)] = i
+
+        def reach(roots) -> set:
+            seen_eqns: set = set()
+            seen_vars: set = set()
+            stack = list(roots)
+            while stack:
+                v = stack.pop()
+                if id(v) in seen_vars:
+                    continue
+                seen_vars.add(id(v))
+                i = producer.get(id(v))
+                if i is None or i in seen_eqns:
+                    continue
+                seen_eqns.add(i)
+                for iv in body.eqns[i].invars:
+                    if W.is_var(iv):
+                        stack.append(iv)
+            return seen_eqns
+
+        feeds_carry = reach(carry_out)
+        feeds_ys = reach(ys_out)
+        for i, eqn in enumerate(body.eqns):
+            if i in feeds_ys and i not in feeds_carry:
+                hit = W.find_wide_gather(eqn, prog.wide_threshold)
+                if hit is not None:
+                    op = next(
+                        (v for v in hit.invars if W.is_var(v)), None
+                    )
+                    shape = tuple(op.aval.shape) if op is not None else "?"
+                    violations.append(Violation(
+                        "no_plane_materialization", prog.name,
+                        f"in-scan {hit.primitive.name} of wide plane "
+                        f"{shape} feeds ONLY the per-tick stacked outputs "
+                        "— this forces an extra full-plane materialization "
+                        "every tick (the measured r10 ~18% pattern); "
+                        "capture it as a window-boundary diff instead",
+                        where=W.provenance(hit),
+                    ))
+    return violations
+
+
+# -- 4. pview O(N·k) wide-value ban ------------------------------------------
+
+
+def check_forbid_wide_values(prog: AuditProgram) -> List[Violation]:
+    if not prog.contracts.forbid_wide_values:
+        return []
+    violations: List[Violation] = []
+    seen_shapes: set = set()
+    # program inputs and closure CONSTANTS first (a wide lookup table baked
+    # in as a closed-over const never appears as an eqn output), then every
+    # equation output at any depth
+    jaxpr = prog.closed_jaxpr.jaxpr
+    for v, kind in [(iv, "INPUT") for iv in jaxpr.invars] + [
+        (cv, "CLOSURE CONSTANT") for cv in jaxpr.constvars
+    ]:
+        if W.is_var(v) and W.is_wide(v.aval, prog.wide_threshold):
+            shape = tuple(v.aval.shape)
+            if shape not in seen_shapes:
+                seen_shapes.add(shape)
+                violations.append(Violation(
+                    "forbid_wide_values", prog.name,
+                    f"program {kind} of capacity-squared shape {shape} — "
+                    "the partial-view engine admits no [N, N]-proportional "
+                    "value anywhere (O(N·k) contract, r11)",
+                ))
+    for eqn, _ in W.walk_eqns(prog.closed_jaxpr.jaxpr):
+        candidates = [(ov, f"built by '{eqn.primitive.name}'")
+                      for ov in eqn.outvars]
+        for sj in W.sub_jaxprs(eqn):
+            candidates.extend(
+                (cv, f"closed over by a '{eqn.primitive.name}' sub-jaxpr")
+                for cv in sj.constvars
+            )
+        for ov, how in candidates:
+            if W.is_var(ov) and W.is_wide(ov.aval, prog.wide_threshold):
+                shape = tuple(ov.aval.shape)
+                if shape in seen_shapes:
+                    continue
+                seen_shapes.add(shape)
+                violations.append(Violation(
+                    "forbid_wide_values", prog.name,
+                    f"intermediate value of capacity-squared shape {shape} "
+                    f"{how} — the O(N·k) guarantee must hold for every "
+                    "value the compiler builds, not just stored state "
+                    "(r11)",
+                    where=W.provenance(eqn),
+                ))
+    return violations
+
+
+# -- 5. memory-budget gate ----------------------------------------------------
+
+
+def check_memory_budget(prog: AuditProgram) -> List[Violation]:
+    mem = prog.memory()
+    peak = mem["peak_live_bytes"]
+    budget = int(
+        prog.contracts.memory_factor * prog.budget_basis_bytes
+        + prog.contracts.memory_overhead_mib * MIB
+    )
+    if peak > budget:
+        return [Violation(
+            "memory_budget", prog.name,
+            f"compiled peak {peak} B ({peak / MIB:.2f} MiB) exceeds the "
+            f"declared budget {budget} B = {prog.contracts.memory_factor} × "
+            f"state {prog.budget_basis_bytes} B + "
+            f"{prog.contracts.memory_overhead_mib} MiB overhead "
+            f"(memory_analysis: args {mem.get('argument_size_in_bytes')}, "
+            f"out {mem.get('output_size_in_bytes')}, "
+            f"temps {mem.get('temp_size_in_bytes')}, "
+            f"aliased -{mem.get('alias_size_in_bytes')})",
+        )]
+    return []
+
+
+# -- 6. restore-seam check (AST lint through the contract registry) ----------
+
+
+def check_restore_seams(
+    engine_names=None, modules: Optional[Dict[str, str]] = None
+) -> List[Violation]:
+    """Run the donation-safety AST lint over every engine's registered
+    ``restore_module`` — the one contract a jaxpr cannot witness (the
+    zero-copy alias happens on the HOST, before any program runs).
+
+    ``modules`` overrides the registry with an explicit
+    ``{name: module-or-file-path}`` map (the falsifiability tests seed a
+    known-bad restore module through it)."""
+    import os
+    import sys
+
+    tools_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    if tools_root not in sys.path:  # tools/ is repo-root-anchored
+        sys.path.insert(0, tools_root)
+    from tools.lint_donation_safety import lint_file
+
+    if modules is None:
+        from ..ops import engine_api
+
+        modules = {}
+        for name in engine_names or ("dense", "sparse", "pview"):
+            modules[name] = engine_api.engine(name).contracts.restore_module
+
+    violations: List[Violation] = []
+    for name, module in modules.items():
+        if not module:
+            violations.append(Violation(
+                "restore_seam", name,
+                "engine registers no restore_module — the r6 copy=True "
+                "restore rule is unverifiable for it; set "
+                "EngineContracts.restore_module",
+            ))
+            continue
+        path = (
+            module if os.path.exists(module)
+            else importlib.import_module(module).__file__
+        )
+        for f in lint_file(path):
+            violations.append(Violation(
+                "restore_seam", name,
+                f"{f.message} (in {f.function})",
+                where=f"{f.path}:{f.line}",
+            ))
+    return violations
+
+
+# -- dispatch -----------------------------------------------------------------
+
+#: checker registry: contract name -> (enabled-for, callable)
+CHECKERS: Dict[str, Callable[[AuditProgram], List[Violation]]] = {
+    "donation_alias": check_donation_alias,
+    "transfer_free": check_transfer_free,
+    "no_plane_materialization": check_no_plane_materialization,
+    "forbid_wide_values": check_forbid_wide_values,
+    "memory_budget": check_memory_budget,
+}
+
+
+def applicable_contracts(prog: AuditProgram, compile_programs: bool = True):
+    c = prog.contracts
+    names = []
+    if c.donation_alias:
+        names.append("donation_alias")
+    if c.transfer_free:
+        names.append("transfer_free")
+    if c.no_plane_materialization and prog.is_window:
+        names.append("no_plane_materialization")
+    if c.forbid_wide_values:
+        names.append("forbid_wide_values")
+    if compile_programs:
+        names.append("memory_budget")
+    return names
+
+
+def run_contracts(
+    prog: AuditProgram, compile_programs: bool = True
+) -> Dict[str, List[Violation]]:
+    """Every applicable contract for one program. ``compile_programs=False``
+    skips the AOT compile (memory budget + optimized-HLO alias facts) and
+    audits the traced/lowered forms only — the fast tier-1 mode."""
+    out: Dict[str, List[Violation]] = {}
+    for name in applicable_contracts(prog, compile_programs):
+        if name == "donation_alias":
+            out[name] = check_donation_alias(
+                prog, use_compiled=compile_programs
+            )
+        else:
+            out[name] = CHECKERS[name](prog)
+    return out

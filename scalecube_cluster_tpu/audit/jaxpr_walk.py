@@ -1,0 +1,102 @@
+"""Shared jaxpr traversal for the static audit plane (r12).
+
+Every contract checker in :mod:`.contracts` walks the CLOSED jaxpr of a
+window program — including every sub-jaxpr a primitive carries in its
+params (scan bodies, cond branches, pjit calls, custom_jvp wrappers) —
+so nothing a decorator or helper function hides from a source regex can
+hide from the audit. This module is the one spelling of that traversal,
+plus the source-provenance summarizer findings use to name the offending
+equation's origin line.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+
+def sub_jaxprs(eqn) -> Iterator:
+    """Every jaxpr carried in one equation's params (scan/cond/pjit/...),
+    unwrapped from ClosedJaxpr when needed."""
+    for v in eqn.params.values():
+        for sub in v if isinstance(v, (list, tuple)) else [v]:
+            tn = type(sub).__name__
+            if tn == "ClosedJaxpr":
+                yield sub.jaxpr
+            elif tn == "Jaxpr":
+                yield sub
+
+
+def walk_eqns(jaxpr, depth: int = 0) -> Iterator[Tuple[object, int]]:
+    """Depth-first over every equation at every nesting level."""
+    for eqn in jaxpr.eqns:
+        yield eqn, depth
+        for sj in sub_jaxprs(eqn):
+            yield from walk_eqns(sj, depth + 1)
+
+
+def outer_scans(jaxpr, in_scan: bool = False) -> Iterator:
+    """The scan equations NOT nested inside another scan — the window
+    loops whose ys are the per-tick stacked outputs. Sub-scans inside a
+    tick (samplers, merge sweeps) are deliberately excluded: their ys feed
+    the tick computation, so the ys-only escape analysis does not apply to
+    them."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            if not in_scan:
+                yield eqn
+            for sj in sub_jaxprs(eqn):
+                yield from outer_scans(sj, True)
+        else:
+            for sj in sub_jaxprs(eqn):
+                yield from outer_scans(sj, in_scan)
+
+
+def is_var(v) -> bool:
+    """True for a jaxpr Var (Literals and DropVars carry no dataflow)."""
+    return type(v).__name__ == "Var"
+
+
+def var_avals(eqn) -> Iterator:
+    for v in eqn.invars:
+        if is_var(v):
+            yield v.aval
+
+
+def provenance(eqn) -> str:
+    """``file:line (function)`` of the traced source that emitted this
+    equation — the actionable pointer every finding carries. Private-API
+    tolerant: falls back to the primitive name if jax moves the helper."""
+    try:
+        from jax._src import source_info_util
+
+        s = source_info_util.summarize(eqn.source_info)
+        return s if s else f"<{eqn.primitive.name}>"
+    except Exception:  # pragma: no cover - jax internals moved
+        return f"<{eqn.primitive.name}>"
+
+
+def count_wide_dims(aval, threshold: int) -> int:
+    """How many dims of ``aval`` are >= ``threshold`` (the capacity-scaled
+    width test — audit params guarantee every non-capacity dim is smaller
+    than capacity, see programs.build_matrix)."""
+    return sum(1 for d in getattr(aval, "shape", ()) if d >= threshold)
+
+
+def is_wide(aval, threshold: int) -> bool:
+    """A capacity²-proportional value: >= 2 dims each >= capacity."""
+    return count_wide_dims(aval, threshold) >= 2
+
+
+def find_wide_gather(eqn, threshold: int) -> Optional[object]:
+    """The first gather/dynamic_slice equation inside ``eqn`` (itself or
+    any sub-jaxpr — a cond branch hides nothing) that CONSUMES a wide
+    plane; None when there is none."""
+    if eqn.primitive.name in ("gather", "dynamic_slice"):
+        if any(is_wide(a, threshold) for a in var_avals(eqn)):
+            return eqn
+    for sj in sub_jaxprs(eqn):
+        for sub in sj.eqns:
+            hit = find_wide_gather(sub, threshold)
+            if hit is not None:
+                return hit
+    return None

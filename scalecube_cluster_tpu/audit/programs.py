@@ -1,0 +1,432 @@
+"""The audit matrix: every engine's window programs as inspectable objects.
+
+One :class:`AuditProgram` wraps one jitted program the driver actually
+dispatches — a window builder from the :class:`..ops.engine_api.EngineOps`
+descriptor (unarmed, trace-armed, mesh-sharded) or a telemetry-plane
+device program (the metric-ring row reduction and its donated append) —
+together with the ABSTRACT arguments it is lowered against
+(``jax.ShapeDtypeStruct`` leaves; a mesh run carries ``NamedSharding``)
+and the bookkeeping the contract checkers need: which flattened argument
+positions are donated, what one copy of the donatable state weighs
+per device, and which capacity value makes a dimension "wide".
+
+Nothing here executes a tick: programs are traced (``jax.make_jaxpr``),
+lowered (``.lower()`` → StableHLO), and optionally AOT-compiled
+(``.compile()`` → optimized HLO + ``memory_analysis``) on abstract inputs
+only, so the full matrix audits in seconds and the same code can audit a
+million-member pview program without allocating it.
+
+Audit-shape precondition: every sizing knob that is NOT the member
+capacity (rumor/pool/announce slots, trace ring length and field count)
+is kept STRICTLY below ``capacity`` by :func:`build_matrix`, so "dim >=
+capacity" is exactly "capacity-scaled dim" for the wide-plane checks.
+:func:`build_matrix` asserts this rather than trusting it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import engine_api
+
+#: ticks per audited window — small keeps compiles fast; every contract is
+#: tick-count-invariant (the checks run on the scan BODY / whole jaxpr)
+DEFAULT_N_TICKS = 4
+DEFAULT_CAPACITY = 128
+#: sharded runs need capacity % (32 * mesh.size) == 0 (the r9/r11 word rule)
+DEFAULT_SHARDED_CAPACITY = 256
+
+MIB = 1 << 20
+
+
+def _abstract(tree, shardings=None):
+    if shardings is None:
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+        )
+    return jax.tree.map(
+        lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+        tree,
+        shardings,
+    )
+
+
+def _leaf_paths(tree) -> List[str]:
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(kp) for kp, _ in paths]
+
+
+def _tree_bytes(tree, per_device: bool = False) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = leaf.shape
+        sharding = getattr(leaf, "sharding", None)
+        if per_device and sharding is not None:
+            try:
+                shape = sharding.shard_shape(shape)
+            except Exception:  # replicated / abstract corner: full copy
+                pass
+        n = 1
+        for d in shape:
+            n *= d
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+@dataclasses.dataclass
+class AuditProgram:
+    """One compiled-surface claim: a jitted program + its audit metadata."""
+
+    name: str  # e.g. "dense/i32/unarmed"
+    engine: str  # "dense" | "sparse" | "pview" | plane programs keep owner
+    variant: str  # "unarmed" | "traced" | "sharded" | "telemetry-row" | ...
+    key_dtype: str
+    capacity: int
+    n_ticks: int
+    fn: Callable  # the jitted callable (positional args only)
+    abstract_args: Tuple  # ShapeDtypeStruct pytrees, positionally
+    donated_argnums: Tuple[int, ...]
+    contracts: engine_api.EngineContracts
+    #: denominator of the memory budget: one copy of the donatable state
+    #: (plus ring, for armed programs), PER DEVICE for sharded programs
+    budget_basis_bytes: int
+    #: dims >= this are capacity-scaled (see module docstring precondition)
+    wide_threshold: int
+    #: whether the scan-materialization / forbid-wide checks apply (window
+    #: programs; the telemetry row/append programs hold no tick scan)
+    is_window: bool = True
+    mesh_size: int = 1
+
+    # -- cached derived forms -------------------------------------------------
+    _closed = None
+    _lowered = None
+    _compiled = None
+
+    @property
+    def closed_jaxpr(self):
+        if self._closed is None:
+            fn = self.fn
+            self._closed = jax.make_jaxpr(lambda *a: fn(*a))(
+                *self.abstract_args
+            )
+        return self._closed
+
+    @property
+    def lowered(self):
+        if self._lowered is None:
+            self._lowered = self.fn.lower(*self.abstract_args)
+        return self._lowered
+
+    @property
+    def mlir_text(self) -> str:
+        return self.lowered.as_text()
+
+    def compiled(self):
+        if self._compiled is None:
+            self._compiled = self.lowered.compile()
+        return self._compiled
+
+    def memory(self) -> Dict[str, int]:
+        """XLA ``memory_analysis`` of the compiled program (per-device
+        figures for an SPMD module) + the derived peak-live bytes."""
+        ma = self.compiled().memory_analysis()
+        out: Dict[str, int] = {}
+        for field in (
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+            "alias_size_in_bytes",
+            "generated_code_size_in_bytes",
+        ):
+            v = getattr(ma, field, None)
+            if v is not None:
+                out[field] = int(v)
+        out["peak_live_bytes"] = (
+            out.get("argument_size_in_bytes", 0)
+            + out.get("output_size_in_bytes", 0)
+            + out.get("temp_size_in_bytes", 0)
+            - out.get("alias_size_in_bytes", 0)
+        )
+        return out
+
+    # -- donation bookkeeping -------------------------------------------------
+
+    def donated_leaf_info(self) -> List[Tuple[int, str, int]]:
+        """(flat arg position, leaf path, byte size) of every leaf of every
+        donated argument — the positions the alias map must cover."""
+        out: List[Tuple[int, str, int]] = []
+        pos = 0
+        for i, arg in enumerate(self.abstract_args):
+            leaves = jax.tree.leaves(arg)
+            if i in self.donated_argnums:
+                paths = _leaf_paths(arg)
+                for path, leaf in zip(paths, leaves):
+                    n = 1
+                    for d in leaf.shape:
+                        n *= d
+                    out.append((pos, f"arg{i}{path}", n * leaf.dtype.itemsize))
+                    pos += 1
+            else:
+                pos += len(leaves)
+        return out
+
+    def flat_invars(self) -> list:
+        return list(self.closed_jaxpr.jaxpr.invars)
+
+
+def _assert_audit_shape(name: str, capacity: int, sizes: Dict[str, int]):
+    """The build-time precondition that makes ``dim >= capacity`` mean
+    ``capacity-scaled``: every non-capacity sizing knob strictly below
+    capacity."""
+    offenders = {k: v for k, v in sizes.items() if v >= capacity}
+    if offenders:
+        raise ValueError(
+            f"audit matrix misconfigured for {name}: non-capacity dims "
+            f"{offenders} are >= capacity {capacity}, so the wide-plane "
+            "checks could not tell pools from planes — shrink the knobs or "
+            "raise --capacity"
+        )
+
+
+def _audit_params(engine: str, capacity: int, key_dtype: str):
+    """Small-but-real protocol params for the audit shapes (the N=128
+    configs of ISSUE 7): bounded pools sized strictly below capacity."""
+    if engine == "dense":
+        from ..ops.state import SimParams
+
+        p = SimParams(capacity=capacity, rumor_slots=16, key_dtype=key_dtype)
+        sizes = {"rumor_slots": p.rumor_slots}
+    elif engine == "sparse":
+        from ..ops.sparse import SparseParams
+
+        p = SparseParams(
+            capacity=capacity, rumor_slots=16, mr_slots=capacity // 2,
+            announce_slots=32,
+        )
+        sizes = {
+            "rumor_slots": p.rumor_slots,
+            "mr_slots": p.mr_slots,
+            "announce_slots": p.announce_slots,
+        }
+    elif engine == "pview":
+        from ..ops.pview import PviewParams
+
+        p = PviewParams(
+            capacity=capacity, rumor_slots=16, mr_slots=capacity // 2,
+            announce_slots=32, key_dtype=key_dtype,
+        )
+        sizes = {
+            "rumor_slots": p.rumor_slots,
+            "mr_slots": p.mr_pool,
+            "announce_slots": p.announce_slots,
+            "view_slots": p.view_slots,
+        }
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+    _assert_audit_shape(f"{engine}/{key_dtype}", capacity, sizes)
+    return p
+
+
+def _trace_spec(capacity: int):
+    from ..trace.schema import TraceSpec
+
+    spec = TraceSpec(tracer_rows=(1, 2), rumor_slots=(0,), ring_len=64)
+    _assert_audit_shape(
+        "trace", capacity,
+        {"ring_len": spec.ring_len, "n_fields": spec.n_fields},
+    )
+    return spec
+
+
+def _key_abstract():
+    k = jax.random.PRNGKey(0)
+    return jax.ShapeDtypeStruct(k.shape, k.dtype)
+
+
+def build_engine_programs(
+    engine_name: str,
+    capacity: int = DEFAULT_CAPACITY,
+    n_ticks: int = DEFAULT_N_TICKS,
+    key_dtypes: Optional[Sequence[str]] = None,
+    variants: Optional[Sequence[str]] = None,
+    sharded_capacity: int = DEFAULT_SHARDED_CAPACITY,
+) -> List[AuditProgram]:
+    """The audit programs of one engine: for each registered key dtype the
+    unarmed window, and for the primary (i32) dtype the trace-armed window,
+    the telemetry-plane device programs, and (where the engine supports a
+    mesh) the sharded window over all local devices.
+
+    ``variants`` filters to a subset ("unarmed", "traced", "telemetry",
+    "sharded") — the fast tier-1 test audits unarmed+traced only.
+    """
+    eng = engine_api.engine(engine_name)
+    contracts = eng.contracts
+    dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
+    want = set(variants) if variants else {"unarmed", "traced", "telemetry", "sharded"}
+    key_abs = _key_abstract()
+    programs: List[AuditProgram] = []
+
+    for kd in dtypes:
+        params = _audit_params(engine_name, capacity, kd)
+        n_initial = max(2, (capacity * 3) // 4)
+        state = eng.init_state(params, n_initial, True, eng.dense_links_default)
+        abs_state = _abstract(state)
+        state_bytes = _tree_bytes(abs_state)
+
+        if "unarmed" in want:
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/unarmed",
+                engine=engine_name, variant="unarmed", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_run(params, n_ticks),
+                abstract_args=(abs_state, key_abs),
+                donated_argnums=(0,),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes,
+                wide_threshold=capacity,
+            ))
+
+        if kd == dtypes[0] and "traced" in want:
+            spec = _trace_spec(capacity)
+            buf = jax.ShapeDtypeStruct((spec.ring_len, spec.n_fields), jnp.int32)
+            cur = jax.ShapeDtypeStruct((), jnp.int32)
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/traced",
+                engine=engine_name, variant="traced", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_traced_run(params, n_ticks, spec),
+                abstract_args=(abs_state, key_abs, buf, cur),
+                donated_argnums=(0, 2),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes + _tree_bytes(buf),
+                wide_threshold=capacity,
+            ))
+
+        if kd == dtypes[0] and "telemetry" in want:
+            programs.extend(_telemetry_programs(
+                eng, params, abs_state, key_abs, capacity, n_ticks, contracts
+            ))
+
+        if "sharded" in want and eng.supports_mesh and eng.state_shardings:
+            programs.append(_sharded_program(
+                eng, engine_name, kd, sharded_capacity, n_ticks, contracts
+            ))
+
+    return programs
+
+
+def _telemetry_programs(
+    eng, params, abs_state, key_abs, capacity, n_ticks, contracts
+) -> List[AuditProgram]:
+    """The r8 armed path's device programs: the per-window ring-row
+    reduction (engine ``telemetry_window_vector`` + sentinel columns, the
+    exact ``TelemetryPlane._row_fn`` spelling) and the donated ring append
+    (the exact ``MetricRing._append`` spelling). The armed WINDOW program
+    is the unarmed one — arming changes what happens to the window's
+    outputs, not the window (the r8 neutrality proof); these two programs
+    are what arming adds."""
+    from ..telemetry.plane import SENTINEL_SERIES
+
+    # abstract per-window metrics: shape-evaluate the undonated window
+    undonated = eng.make_run(params, n_ticks, donate=False)
+    out_abs = jax.eval_shape(lambda s, k: undonated(s, k), abs_state, key_abs)
+    ms_abs = out_abs[2]
+
+    vector_fn = eng.telemetry_window_vector
+
+    def _row(ms, state, false_dead, key_regr):
+        return jnp.concatenate([
+            vector_fn(ms, state),
+            jnp.stack([false_dead, key_regr]).astype(jnp.float32),
+        ])
+
+    scalar = jax.ShapeDtypeStruct((), jnp.int32)
+    row_fn = jax.jit(_row)
+    n_series = len(eng.telemetry_series) + len(SENTINEL_SERIES)
+    ring_len = 64
+    _assert_audit_shape(
+        f"{eng.name}/telemetry", capacity,
+        {"ring_len": ring_len, "n_series": n_series},
+    )
+    ring_abs = jax.ShapeDtypeStruct((ring_len, n_series), jnp.float32)
+    row_abs = jax.ShapeDtypeStruct((n_series,), jnp.float32)
+
+    append_fn = jax.jit(lambda buf, row, idx: buf.at[idx].set(row),
+                        donate_argnums=0)
+
+    return [
+        AuditProgram(
+            name=f"{eng.name}/i32/telemetry-row",
+            engine=eng.name, variant="telemetry-row", key_dtype="i32",
+            capacity=capacity, n_ticks=n_ticks,
+            fn=row_fn,
+            abstract_args=(ms_abs, abs_state, scalar, scalar),
+            donated_argnums=(),
+            contracts=contracts,
+            budget_basis_bytes=_tree_bytes(abs_state) + _tree_bytes(ms_abs),
+            wide_threshold=capacity,
+            is_window=False,
+        ),
+        AuditProgram(
+            name=f"{eng.name}/i32/telemetry-append",
+            engine=eng.name, variant="telemetry-append", key_dtype="i32",
+            capacity=capacity, n_ticks=n_ticks,
+            fn=append_fn,
+            abstract_args=(ring_abs, row_abs, scalar),
+            donated_argnums=(0,),
+            contracts=contracts,
+            budget_basis_bytes=_tree_bytes(ring_abs),
+            wide_threshold=capacity,
+            is_window=False,
+        ),
+    ]
+
+
+def _sharded_program(
+    eng, engine_name, kd, capacity, n_ticks, contracts
+) -> AuditProgram:
+    """The mesh-sharded window over every local device, lowered on
+    abstract row-sharded inputs (no state materialized on the mesh)."""
+    from ..ops.sharding import make_mesh
+
+    mesh = make_mesh()
+    params = _audit_params(engine_name, capacity, kd)
+    n_initial = max(2, (capacity * 3) // 4)
+    dense_links = eng.dense_links_default
+    state = eng.init_state(params, n_initial, True, dense_links)
+    shardings = eng.state_shardings(mesh, dense_links, params.delay_slots)
+    abs_state = _abstract(state, shardings)
+    fn = eng.make_sharded_run(mesh, params, n_ticks, dense_links)
+    return AuditProgram(
+        name=f"{engine_name}/{kd}/sharded",
+        engine=engine_name, variant="sharded", key_dtype=kd,
+        capacity=capacity, n_ticks=n_ticks,
+        fn=fn,
+        abstract_args=(abs_state, _key_abstract()),
+        donated_argnums=(0,),
+        contracts=contracts,
+        budget_basis_bytes=_tree_bytes(abs_state, per_device=True),
+        wide_threshold=capacity,
+        mesh_size=mesh.size,
+    )
+
+
+def build_matrix(
+    engines: Optional[Sequence[str]] = None,
+    capacity: int = DEFAULT_CAPACITY,
+    n_ticks: int = DEFAULT_N_TICKS,
+    variants: Optional[Sequence[str]] = None,
+    sharded_capacity: int = DEFAULT_SHARDED_CAPACITY,
+) -> List[AuditProgram]:
+    """The full engine × key-dtype × variant audit matrix."""
+    out: List[AuditProgram] = []
+    for name in engines or ("dense", "sparse", "pview"):
+        out.extend(build_engine_programs(
+            name, capacity=capacity, n_ticks=n_ticks, variants=variants,
+            sharded_capacity=sharded_capacity,
+        ))
+    return out

@@ -1,0 +1,142 @@
+"""Verdict assembly: one machine-readable artifact per audit run.
+
+The JSON shape (``AUDIT_r12.json``, also folded into the bench collector's
+round artifact) is deliberately boring — a flat program list with per-
+contract verdicts — so CI can diff it and the COMPILE_PROOF family of
+artifacts can absorb it without schema gymnastics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import contracts as C
+from .programs import AuditProgram, build_matrix
+
+SCHEMA = 1
+
+
+def audit_programs(
+    programs: Sequence[AuditProgram],
+    compile_programs: bool = True,
+    engine_names: Optional[Sequence[str]] = None,
+    restore_seams: bool = True,
+) -> Dict:
+    """Run every applicable contract over ``programs`` and assemble the
+    verdict dict. ``compile_programs=False`` audits traced/lowered forms
+    only (no AOT compile, no memory figures) — the fast tier-1 mode."""
+    import jax
+
+    t0 = time.perf_counter()
+    entries: List[Dict] = []
+    n_violations = 0
+    for prog in programs:
+        per_contract = C.run_contracts(prog, compile_programs)
+        entry = {
+            "program": prog.name,
+            "engine": prog.engine,
+            "variant": prog.variant,
+            "key_dtype": prog.key_dtype,
+            "capacity": prog.capacity,
+            "n_ticks": prog.n_ticks,
+            "mesh_size": prog.mesh_size,
+            "donated_leaves": len(prog.donated_leaf_info()),
+            "budget_basis_bytes": prog.budget_basis_bytes,
+            "contracts": {},
+        }
+        for name, violations in per_contract.items():
+            entry["contracts"][name] = {
+                "ok": not violations,
+                "violations": [
+                    {"message": v.message, "where": v.where}
+                    for v in violations
+                ],
+            }
+            n_violations += len(violations)
+        if compile_programs:
+            entry["memory"] = prog.memory()
+            entry["memory"]["budget_bytes"] = int(
+                prog.contracts.memory_factor * prog.budget_basis_bytes
+                + prog.contracts.memory_overhead_mib * (1 << 20)
+            )
+        entries.append(entry)
+
+    seam_violations: List[C.Violation] = []
+    if restore_seams:
+        seam_violations = C.check_restore_seams(engine_names)
+        n_violations += len(seam_violations)
+
+    return {
+        "schema": SCHEMA,
+        "generated_by": "scalecube_cluster_tpu.audit",
+        "jax_version": jax.__version__,
+        "compiled": compile_programs,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+        "n_programs": len(entries),
+        "n_violations": n_violations,
+        "ok": n_violations == 0,
+        "programs": entries,
+        "restore_seams": {
+            "checked": restore_seams,
+            "ok": not seam_violations,
+            "violations": [
+                {"engine": v.program, "message": v.message, "where": v.where}
+                for v in seam_violations
+            ],
+        },
+    }
+
+
+def audit_all(
+    engines: Optional[Sequence[str]] = None,
+    capacity: int = 128,
+    n_ticks: int = 4,
+    variants: Optional[Sequence[str]] = None,
+    sharded_capacity: int = 256,
+    compile_programs: bool = True,
+) -> Dict:
+    """Build the matrix and audit it — the CLI/test entry point."""
+    programs = build_matrix(
+        engines, capacity=capacity, n_ticks=n_ticks, variants=variants,
+        sharded_capacity=sharded_capacity,
+    )
+    return audit_programs(
+        programs, compile_programs=compile_programs, engine_names=engines
+    )
+
+
+def format_text(verdict: Dict) -> str:
+    """Human rendering of one verdict dict (the CLI's default output)."""
+    lines: List[str] = []
+    ok = "PASS" if verdict["ok"] else "FAIL"
+    lines.append(
+        f"program audit: {ok} — {verdict['n_programs']} program(s), "
+        f"{verdict['n_violations']} violation(s), "
+        f"{verdict['elapsed_s']}s (jax {verdict['jax_version']}, "
+        f"{'compiled' if verdict['compiled'] else 'lowered-only'})"
+    )
+    for entry in verdict["programs"]:
+        marks = []
+        for cname, c in entry["contracts"].items():
+            marks.append(f"{cname}={'ok' if c['ok'] else 'VIOLATED'}")
+        mem = entry.get("memory")
+        memtxt = (
+            f" peak={mem['peak_live_bytes']}B/budget={mem['budget_bytes']}B"
+            if mem else ""
+        )
+        lines.append(f"  {entry['program']}: {' '.join(marks)}{memtxt}")
+        for cname, c in entry["contracts"].items():
+            for v in c["violations"]:
+                where = f" [{v['where']}]" if v["where"] else ""
+                lines.append(f"    ! {cname}: {v['message']}{where}")
+    seams = verdict["restore_seams"]
+    if seams["checked"]:
+        lines.append(
+            f"  restore seams: {'ok' if seams['ok'] else 'VIOLATED'}"
+        )
+        for v in seams["violations"]:
+            lines.append(
+                f"    ! {v['engine']}: {v['message']} [{v['where']}]"
+            )
+    return "\n".join(lines)

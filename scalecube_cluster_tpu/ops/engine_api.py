@@ -24,6 +24,11 @@ through :func:`resolve` / :func:`of_driver`:
   prefer-forgotten-rows join policy), ``staleness`` (the health
   snapshot's identity-dissemination reduce), ``key_plane`` (the narrow-
   layout checkpoint guard), ``pool_slots`` (bounded-pool sizing).
+* **audit seam (r12)** — ``contracts`` (:class:`EngineContracts`, the
+  static program contracts the audit plane proves over this engine's
+  compiled window programs) and ``state_shardings`` (abstract mesh
+  placements, so the auditor can lower the mesh-sharded variants without
+  allocating a state).
 
 Engines: ``dense`` (:mod:`.kernel` / :mod:`.state`), ``sparse``
 (:mod:`.sparse`), ``pview`` (:mod:`.pview` — the r11 O(N·k) partial-view
@@ -36,6 +41,57 @@ import dataclasses
 from typing import Callable, Optional
 
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineContracts:
+    """One engine's static program contracts (r12 audit plane).
+
+    Every flag here is a claim the repo already relies on at runtime; the
+    audit plane (:mod:`scalecube_cluster_tpu.audit`) proves each one over
+    the engine's CLOSED JAXPR / compiled HLO instead of sampling it from
+    runs:
+
+    * ``donation_alias`` — every leaf of every donated argument of the
+      window builders must appear in the compiled ``input_output_alias``
+      map (a dropped alias is the r6 double-buffer silently degrading to
+      a copy), and no donated input may escape the program unchanged
+      alongside its aliased update (the caller would see stale donated
+      data — the r6 use-after-free shape).
+    * ``transfer_free`` — no host-callback / outfeed / infeed primitive
+      anywhere in the closed jaxpr (the IR-level form of r6's
+      zero-per-window-d2h rule; decorator indirection can't hide a call
+      from the jaxpr walk the way it can from a source regex).
+    * ``no_plane_materialization`` — no in-scan gather of a wide
+      (capacity²-proportional) plane whose value escapes ONLY to the
+      per-tick stacked outputs: the measured r10 ~18%/tick pattern (any
+      such consumer forces an extra full-plane materialization per tick).
+    * ``forbid_wide_values`` — pview only: NO value of any kind in the
+      whole closed jaxpr may have two or more capacity-scaled dims. This
+      is the O(N·k) no-[N,N]-anywhere guarantee as an IR-level fact
+      (the source lint bans allocations; this bans every intermediate
+      the compiler actually builds).
+    * ``memory_factor`` / ``memory_overhead_mib`` — the window's
+      compiler-reported peak (``memory_analysis``: args + temps +
+      un-aliased outputs) must stay within
+      ``factor * abstract_state_bytes + overhead`` — the declared window
+      budget the r9/r11 max-N ladders probe, as a per-engine gate. The
+      overhead term absorbs fixed small-N costs (keys, pools, stacked
+      per-tick metrics); at ladder-scale N the factor is the whole gate.
+    * ``restore_module`` — the module whose checkpoint ``restore()`` seam
+      must satisfy the r6 ``jnp.array(copy=True)`` rule (no zero-copy
+      host alias ever enters donatable state); checked by the AST lint.
+    * ``key_dtypes`` — the key layouts the audit matrix covers.
+    """
+
+    donation_alias: bool = True
+    transfer_free: bool = True
+    no_plane_materialization: bool = True
+    forbid_wide_values: bool = False
+    memory_factor: float = 3.0
+    memory_overhead_mib: float = 2.0
+    restore_module: Optional[str] = None
+    key_dtypes: tuple = ("i32",)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +118,11 @@ class EngineOps:
     dense_links_default: bool
     supports_mesh: bool
     has_pool: bool
+    # r12 audit seam: the static contracts the audit plane proves over this
+    # engine's window programs, and the abstract mesh placements the
+    # auditor lowers the sharded variants with (None = single-device only)
+    contracts: EngineContracts = EngineContracts()
+    state_shardings: Optional[Callable] = None  # (mesh, dense_links, delay_slots)
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -73,7 +134,10 @@ def _plane_view_row(state, row):
 
 
 def _plane_tracer_view_cols(state, rows):
-    return state.view_key[:, jnp.asarray(rows, jnp.int32)].astype(jnp.int32)
+    # rows is a small index list consumed by the gather below; it never
+    # becomes donatable state
+    idx = jnp.asarray(rows, jnp.int32)  # lint: allow-zero-copy (index only)
+    return state.view_key[:, idx].astype(jnp.int32)
 
 
 def _plane_remembered_rows(state):
@@ -115,6 +179,11 @@ def _dense_engine() -> EngineOps:
 
         return shard_state(state, mesh)
 
+    def _shardings(mesh, dense_links, delay_slots):
+        from .sharding import state_shardings
+
+        return state_shardings(mesh, dense_links, delay_slots)
+
     return EngineOps(
         name="dense",
         ops=S,
@@ -138,6 +207,16 @@ def _dense_engine() -> EngineOps:
         dense_links_default=True,
         supports_mesh=True,
         has_pool=False,
+        # measured peak/state at N=128, 4-tick donated window: 1.82x — the
+        # factor leaves refactor headroom without ever admitting a second
+        # full copy of the [N, N] planes (that is ~2x state on its own,
+        # before temps)
+        contracts=EngineContracts(
+            memory_factor=2.5,
+            restore_module="scalecube_cluster_tpu.ops.state",
+            key_dtypes=("i32", "i16"),
+        ),
+        state_shardings=_shardings,
     )
 
 
@@ -153,6 +232,11 @@ def _sparse_engine() -> EngineOps:
         from .sharding import shard_sparse_state
 
         return shard_sparse_state(state, mesh)
+
+    def _shardings(mesh, dense_links, delay_slots):
+        from .sharding import sparse_state_shardings
+
+        return sparse_state_shardings(mesh, dense_links, delay_slots)
 
     return EngineOps(
         name="sparse",
@@ -177,6 +261,16 @@ def _sparse_engine() -> EngineOps:
         dense_links_default=False,
         supports_mesh=True,
         has_pool=True,
+        # measured peak/state at N=128, 4-tick donated window: 4.01x — the
+        # sparse tick stages per-phase [N, M] working sets (apply staging,
+        # pool sweeps) that the dense engine does not, so its honest budget
+        # sits higher; the factor still forbids a second whole-state copy
+        # on top of the staging
+        contracts=EngineContracts(
+            memory_factor=5.0,
+            restore_module="scalecube_cluster_tpu.ops.sparse",
+        ),
+        state_shardings=_shardings,
     )
 
 
@@ -212,6 +306,16 @@ def _pview_engine() -> EngineOps:
         dense_links_default=False,
         supports_mesh=False,
         has_pool=True,
+        # forbid_wide_values IS the engine: no value of any kind in the
+        # closed jaxpr may carry two capacity-scaled dims (the r11 O(N·k)
+        # guarantee as an IR fact). Measured peak/state at N=128, 4-tick
+        # window: 3.43x (table merges stage k+1-record working sets).
+        contracts=EngineContracts(
+            forbid_wide_values=True,
+            memory_factor=4.5,
+            restore_module="scalecube_cluster_tpu.ops.pview",
+            key_dtypes=("i32", "i16"),
+        ),
     )
 
 

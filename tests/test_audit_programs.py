@@ -1,0 +1,385 @@
+"""The r12 static audit plane: matrix gates + falsifiability (ISSUE 7).
+
+Two halves, mirroring tests/test_repo_lints.py's structure:
+
+1. **Clean-matrix gates** — the N=128 audit configs of every engine pass
+   every applicable contract, fast enough for tier-1 (<60s): the three
+   engines' unarmed + trace-armed windows and the telemetry-plane device
+   programs are traced, lowered, AOT-compiled, and checked (donation
+   aliasing, transfer-freeness, no in-scan plane materialization, the
+   pview wide-value ban, memory budgets, restore seams). The sharded
+   variants and the full i16 column ride the ``-m slow`` lane and the
+   ``tools/audit_programs.py --all`` artifact run (AUDIT_r12.json).
+
+2. **Falsifiability** — six seeded-violation programs, one per contract
+   class, each asserted CAUGHT with an actionable message naming the
+   source location:
+
+   * missing alias (a window builder that forgot ``donate_argnums``),
+   * post-donation read (donated input escaping unchanged),
+   * hidden ``pure_callback`` (decorator indirection the source lint
+     cannot see),
+   * in-scan wide-plane gather (the EXACT r10 ~18% pattern, via the real
+     dense window's watch_rows mode),
+   * budget overflow (a window holding a second un-aliased state copy),
+   * host-alias restore (a seeded restore module spelling the r6 bug).
+
+An auditor that stops flagging any of these would pass a broken tree —
+these tests make that failure loud instead of silent.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scalecube_cluster_tpu.audit import (
+    AuditProgram,
+    check_donation_alias,
+    check_memory_budget,
+    check_no_plane_materialization,
+    check_restore_seams,
+    check_transfer_free,
+    run_contracts,
+)
+from scalecube_cluster_tpu.audit.programs import build_engine_programs
+from scalecube_cluster_tpu.audit.report import audit_programs
+from scalecube_cluster_tpu.ops.engine_api import EngineContracts
+
+N_TICKS = 4
+CAPACITY = 128
+
+
+# ---------------------------------------------------------------------------
+# 1. clean-matrix gates (fast tier-1 subset; full matrix under -m slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
+def test_engine_window_programs_pass_all_contracts(engine):
+    """Unarmed + trace-armed + telemetry device programs, i32, N=128:
+    every applicable contract holds over the traced/lowered/compiled
+    program."""
+    programs = build_engine_programs(
+        engine, capacity=CAPACITY, n_ticks=N_TICKS,
+        key_dtypes=["i32"], variants=["unarmed", "traced", "telemetry"],
+    )
+    assert len(programs) >= 3  # window, traced window, telemetry row+append
+    for prog in programs:
+        verdict = run_contracts(prog, compile_programs=True)
+        for contract, violations in verdict.items():
+            assert violations == [], (
+                f"{prog.name}: {contract}:\n"
+                + "\n".join(str(v) for v in violations)
+            )
+
+
+def test_pview_i16_window_has_no_wide_values():
+    """The narrow-key pview layout keeps the O(N·k) wide-value ban too
+    (lowered-only: the i16 compile lives in the artifact run)."""
+    programs = build_engine_programs(
+        "pview", capacity=CAPACITY, key_dtypes=["i16"], variants=["unarmed"],
+    )
+    (prog,) = programs
+    verdict = run_contracts(prog, compile_programs=False)
+    assert verdict["forbid_wide_values"] == []
+    assert verdict["donation_alias"] == []
+    assert verdict["transfer_free"] == []
+
+
+def test_restore_seams_are_registered_and_clean():
+    assert check_restore_seams() == []
+
+
+def test_report_assembles_machine_verdict():
+    """The verdict artifact shape collect_results folds: per-program
+    contract map, overall ok, violation count."""
+    programs = build_engine_programs(
+        "pview", capacity=CAPACITY, key_dtypes=["i32"], variants=["unarmed"],
+    )
+    verdict = audit_programs(programs, compile_programs=False)
+    assert verdict["ok"] is True
+    assert verdict["n_programs"] == 1
+    entry = verdict["programs"][0]
+    assert entry["program"] == "pview/i32/unarmed"
+    assert entry["contracts"]["donation_alias"]["ok"] is True
+    assert "memory" not in entry  # lowered-only run carries no compile facts
+    assert verdict["restore_seams"]["ok"] is True
+
+
+@pytest.mark.slow
+def test_full_matrix_including_sharded_passes():
+    """The --all surface: every engine × key dtype × variant (mesh-sharded
+    included, on the 8-virtual-device CPU mesh) audits clean, compiled."""
+    from scalecube_cluster_tpu.audit import audit_all
+
+    verdict = audit_all()
+    assert verdict["ok"], [
+        v for e in verdict["programs"]
+        for c in e["contracts"].values() for v in c["violations"]
+    ]
+    names = {e["program"] for e in verdict["programs"]}
+    assert {"dense/i32/sharded", "dense/i16/sharded",
+            "sparse/i32/sharded"} <= names
+
+
+# ---------------------------------------------------------------------------
+# 2. falsifiability: six seeded violations, one per contract class
+# ---------------------------------------------------------------------------
+
+
+def _program(name, fn, args, donated, contracts=None, basis=None, **kw):
+    return AuditProgram(
+        name=name, engine="seeded", variant="seeded", key_dtype="i32",
+        capacity=CAPACITY, n_ticks=N_TICKS, fn=fn, abstract_args=args,
+        donated_argnums=donated,
+        contracts=contracts or EngineContracts(),
+        budget_basis_bytes=basis or 0,
+        wide_threshold=CAPACITY, **kw,
+    )
+
+
+def _state_abs():
+    return jax.ShapeDtypeStruct((CAPACITY, CAPACITY), jnp.float32)
+
+
+def test_seeded_missing_alias_is_caught():
+    """Violation class 1: a window builder that FORGOT donate_argnums —
+    the program claims a donated state but the lowered module aliases
+    nothing; the finding names the dropped leaf."""
+
+    def window(state, key):
+        return state * 2.0, key
+
+    fn = jax.jit(window)  # <- no donate_argnums: the r6 regression
+    prog = _program(
+        "seeded/missing-alias", fn, (_state_abs(), _state_abs()), (0,)
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the dropped donation"
+    assert any("arg0" in v.message and "donation" in v.message.lower()
+               for v in violations)
+
+
+def test_seeded_post_donation_read_is_caught():
+    """Violation class 2: the donated input escapes UNCHANGED alongside
+    its aliased update — the r6 use-after-free shape (the caller's
+    returned value aliases freed memory)."""
+
+    def window(state, key):
+        return state.at[0].add(1.0), state, key * 2.0
+
+    fn = jax.jit(window, donate_argnums=0)
+    prog = _program(
+        "seeded/post-donation-read", fn, (_state_abs(), _state_abs()), (0,)
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the escaping donated input"
+    assert any("UNCHANGED" in v.message for v in violations)
+
+
+def test_seeded_hidden_pure_callback_is_caught():
+    """Violation class 3: a pure_callback reached through DECORATOR
+    indirection under an innocuous name — invisible to the source lint
+    (no matchable attribute chain), but an equation in the closed jaxpr.
+    The finding carries source provenance."""
+
+    def _devicely(f):  # an innocent-looking decorator hiding the hatch
+        hatch = getattr(jax, "pure_" + "callback")
+
+        def wrapped(x):
+            return hatch(f, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        return wrapped
+
+    @_devicely
+    def _mean_adjust(x):
+        return x
+
+    def window(state, key):
+        def body(c, _):
+            return c + _mean_adjust(c), c.sum()
+
+        out, sums = jax.lax.scan(body, state, None, length=N_TICKS)
+        return out, key, sums
+
+    fn = jax.jit(window, donate_argnums=0)
+    prog = _program(
+        "seeded/hidden-callback", fn, (_state_abs(), _state_abs()), (0,)
+    )
+    violations = check_transfer_free(prog)
+    assert violations, "auditor missed the hidden pure_callback"
+    v = violations[0]
+    assert "pure_callback" in v.message
+    assert v.where, "finding must carry source provenance"
+    # provenance names this test file (the traced call site), not jax guts
+    assert "test_audit_programs" in v.where
+
+    # cross-check: the SOURCE lint cannot see this spelling (that's why
+    # the IR-level prover exists)
+    from tools.lint_host_callbacks import lint_file
+
+    findings = lint_file(os.path.abspath(__file__))
+    assert not any("pure_callback" in f.message for f in findings)
+
+
+def test_seeded_in_scan_wide_gather_is_caught():
+    """Violation class 4: the EXACT r10 pattern, spelled by the real dense
+    window builder — watch_rows gathers tracer columns of the [N, N] view
+    plane inside the scan and exports them ONLY to the stacked per-tick
+    outputs (~18%/tick measured). The production no-consumer path
+    (watch_rows=None) audits clean; this is the opt-in it costs."""
+    from scalecube_cluster_tpu.ops import engine_api
+    from scalecube_cluster_tpu.audit.programs import (
+        _abstract, _audit_params, _key_abstract, _tree_bytes,
+    )
+
+    eng = engine_api.engine("dense")
+    params = _audit_params("dense", CAPACITY, "i32")
+    state = eng.init_state(params, 96, True, True)
+    abs_state = _abstract(state)
+    watch = jnp.arange(4, dtype=jnp.int32)
+    base = eng.make_run(params, N_TICKS)
+
+    fn = jax.jit(
+        lambda s, k: base(s, k, watch_rows=watch), donate_argnums=0
+    )
+    prog = _program(
+        "seeded/in-scan-wide-gather", fn, (abs_state, _key_abstract()), (0,),
+        basis=_tree_bytes(abs_state),
+    )
+    violations = check_no_plane_materialization(prog)
+    assert violations, "auditor missed the in-scan wide-plane gather"
+    v = violations[0]
+    assert "materialization" in v.message
+    assert f"({CAPACITY}, {CAPACITY})" in v.message
+    assert v.where, "finding must name the offending equation's source"
+
+    # and the unarmed spelling of the SAME builder audits clean
+    clean = _program(
+        "dense/unarmed-control", eng.make_run(params, N_TICKS),
+        (abs_state, _key_abstract()), (0,), basis=_tree_bytes(abs_state),
+    )
+    assert check_no_plane_materialization(clean) == []
+
+
+def test_seeded_budget_overflow_is_caught():
+    """Violation class 5: a window that keeps a second, un-aliased copy of
+    the state alive past its declared budget (factor 1.2 + 64 KiB here —
+    tight enough that the duplicate plane must trip it)."""
+
+    def window(state, key):
+        # the aliased update PLUS a full un-aliased derived plane output
+        return state.at[0].add(1.0), state * 3.0 + key
+
+    fn = jax.jit(window, donate_argnums=0)
+    state = _state_abs()
+    basis = state.shape[0] * state.shape[1] * 4
+    tight = EngineContracts(memory_factor=1.2, memory_overhead_mib=1 / 16)
+    prog = _program(
+        "seeded/budget-overflow", fn, (state, _state_abs()), (0,),
+        contracts=tight, basis=basis,
+    )
+    violations = check_memory_budget(prog)
+    assert violations, "auditor missed the budget overflow"
+    v = violations[0]
+    assert "exceeds the declared budget" in v.message
+    assert "memory_analysis" in v.message
+
+
+def test_seeded_host_alias_restore_is_caught(tmp_path):
+    """Violation class 6: a restore seam spelling the exact r6 bug
+    (zero-copy jnp.asarray of npz buffers into donatable state), seeded as
+    a registered restore module — the audit names engine, file, and line."""
+    bad = tmp_path / "seeded_restore.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def restore(arrays):
+            return {k: jnp.asarray(v) for k, v in arrays.items()}
+
+        def load(path):
+            with np.load(path) as npz:
+                return restore(dict(npz))
+    """))
+    violations = check_restore_seams(modules={"seeded": str(bad)})
+    assert violations, "auditor missed the host-alias restore"
+    v = violations[0]
+    assert v.program == "seeded"
+    assert "zero-copy" in v.message
+    assert "restore" in v.message
+    assert str(bad) in v.where and v.where.endswith(":6")
+
+
+def test_unregistered_restore_module_is_flagged():
+    """A contracts entry with no restore_module is itself a finding — an
+    engine cannot opt out of the r6 rule by not registering a seam."""
+    violations = check_restore_seams(modules={"noseam": None})
+    assert violations and "restore_module" in violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# checker-robustness regressions (r12 review)
+# ---------------------------------------------------------------------------
+
+
+def test_unused_donated_leaf_is_flagged_and_numbering_stays_aligned():
+    """jit DROPS unused arguments and renumbers the lowered/compiled
+    parameters over the kept ones. The checker must (a) flag the unused
+    donated leaf itself (its donation is vacuous) and (b) NOT misreport a
+    later, correctly-aliased leaf through the shifted numbering."""
+
+    def window(state, key):
+        # leaf 0 is neither read nor returned — lowering will drop it
+        _, b, c = state
+        return (b.at[0].add(1.0), c * 2.0), key
+
+    fn = jax.jit(window, donate_argnums=0)
+    leaf = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    prog = _program(
+        "seeded/unused-donated-leaf", fn,
+        ((leaf, leaf, leaf), jax.ShapeDtypeStruct((), jnp.float32)), (0,),
+    )
+    violations = check_donation_alias(prog)
+    msgs = "\n".join(v.message for v in violations)
+    assert any("UNUSED" in v.message and "arg0[0]" in v.message
+               for v in violations), msgs
+    # leaves 1 and 2 ARE aliased — the shifted numbering must not flag them
+    assert not any("arg0[1]" in v.message or "arg0[2]" in v.message
+                   for v in violations), msgs
+
+
+def test_wide_closure_constant_is_caught_by_forbid_wide_values():
+    """A capacity-squared lookup table baked in as a closed-over CONSTANT
+    never appears as an equation output — the wide-value ban must scan
+    constvars too, or a pview refactor could park an O(N²) buffer on
+    device while the audit reports PROVED."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.audit import check_forbid_wide_values
+
+    table = jnp.asarray(np.zeros((CAPACITY, CAPACITY), np.float32))
+
+    def window(state, key):
+        return state + table[0, 0], key
+
+    fn = jax.jit(window, donate_argnums=0)
+    leaf = jax.ShapeDtypeStruct((CAPACITY,), jnp.float32)
+    prog = _program(
+        "seeded/wide-closure-const", fn,
+        (leaf, jax.ShapeDtypeStruct((), jnp.float32)), (0,),
+        contracts=EngineContracts(forbid_wide_values=True),
+    )
+    violations = check_forbid_wide_values(prog)
+    assert violations, "auditor missed the wide closure constant"
+    assert any("CONSTANT" in v.message or "closed over" in v.message
+               for v in violations)

@@ -1,75 +1,57 @@
-"""Tier-1 repo lints (r8 CI tooling satellite).
+"""Tier-1 repo lints (r8 CI tooling satellite; unified on tools/lintlib in
+r12).
 
 1. Donation-safety: no zero-copy ``jnp.asarray`` on restore/donation paths
    anywhere in the package — the r6 use-after-free class (an aligned npz
    buffer aliased into state the driver later donates) must stay dead.
-   The lint is also exercised on a known-bad fixture so a silently broken
-   lint can't report a false clean.
+   Extended in r12 to the seams added since r6: the pview restore spelling
+   and the ``ops/engine_api.py`` donatable-state seam.
 2. Pytest-marker audit: every soak/slow test is reachable from a marker
    expression (``-m slow``) and every custom marker is registered.
 3. Plane-dtype lint (r9): no new full-width [N, N] bool/i32 plane
-   allocation in ops/ bypassing ops/bitplane.py, and no float64 promotion
-   in the packed reductions. Falsifiability-tested like the others.
+   allocation in ops/ bypassing ops/bitplane.py, no float64 promotion in
+   the packed reductions, and the pview capacity-squared hard ban (r11).
 4. Host-callback lint (r10): no ``jax.debug.print`` / ``io_callback`` /
-   ``pure_callback`` / ``device_get`` inside ops/ tick paths — the
-   zero-transfer discipline made static instead of resting on the
-   transfer-spy tests alone. Falsifiability-tested like the others.
+   ``pure_callback`` / ``device_get`` inside ops/ tick paths.
+
+Every lint is falsifiability-tested through ONE harness
+(:func:`test_lint_catches_seeded_violations`): a known-bad fixture is
+written to disk, the lint must flag exactly the seeded lines (and honor
+its suppression marker), so a silently broken lint can't report a false
+clean. The IR-level superset of lint 4 lives in the r12 audit plane
+(``tests/test_audit_programs.py``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import textwrap
+from typing import Callable, Optional, Set
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tools.audit_pytest_markers import audit, registered_markers
-from tools.lint_donation_safety import lint_file, lint_tree
+from tools.lint_donation_safety import lint_file as lint_donation_file
+from tools.lint_donation_safety import lint_tree as lint_donation_tree
 from tools.lint_host_callbacks import lint_file as lint_callbacks_file
 from tools.lint_host_callbacks import lint_tree as lint_callbacks_tree
 from tools.lint_plane_dtypes import lint_file as lint_planes_file
 from tools.lint_plane_dtypes import lint_tree as lint_planes_tree
 
 
+# ---------------------------------------------------------------------------
+# clean-tree gates: the package passes every lint
+# ---------------------------------------------------------------------------
+
+
 def test_package_is_donation_safe():
-    findings = lint_tree(os.path.join(REPO, "scalecube_cluster_tpu"))
+    findings = lint_donation_tree(os.path.join(REPO, "scalecube_cluster_tpu"))
     assert findings == [], "\n".join(str(f) for f in findings)
-
-
-def test_lint_catches_the_r6_bug_class(tmp_path):
-    """Falsifiability: the exact pre-r6-fix restore spelling must be
-    flagged, in all three shapes (asarray in restore, copy-less array in
-    restore, asarray next to np.load), and the suppression comment works."""
-    bad = tmp_path / "bad.py"
-    bad.write_text(textwrap.dedent("""
-        import jax.numpy as jnp
-        import numpy as np
-
-        def restore(arrays):
-            return {k: jnp.asarray(v) for k, v in arrays.items()}
-
-        def _restore_locked(data):
-            return jnp.array(data, copy=False)
-
-        def load_checkpoint(path):
-            with np.load(path) as npz:
-                return jnp.asarray(npz["view_key"])
-
-        def fine(path):
-            with np.load(path) as npz:
-                return jnp.array(npz["x"], copy=True)
-
-        def suppressed(arrays):
-            with np.load(arrays) as npz:
-                return jnp.asarray(npz["x"])  # lint: allow-zero-copy
-    """))
-    findings = lint_file(str(bad))
-    assert len(findings) == 3
-    assert {f.function for f in findings} == {
-        "restore", "_restore_locked", "load_checkpoint"
-    }
 
 
 def test_ops_plane_dtypes_are_packed():
@@ -79,71 +61,6 @@ def test_ops_plane_dtypes_are_packed():
         os.path.join(REPO, "scalecube_cluster_tpu", "ops")
     )
     assert findings == [], "\n".join(str(f) for f in findings)
-
-
-def test_plane_lint_catches_the_bypass_class(tmp_path):
-    """Falsifiability: an [N, N] bool plane, an [N, N] i32 plane, and a
-    float64 promotion must all be flagged; [N, R] planes, key-dtype
-    allocations, and suppressed lines must pass."""
-    bad = tmp_path / "bad_ops.py"
-    bad.write_text(textwrap.dedent("""
-        import jax.numpy as jnp
-
-        def alloc(n, r, kd):
-            a = jnp.zeros((n, n), bool)                 # flagged: bool plane
-            b = jnp.full((n, n), -1, jnp.int32)         # flagged: i32 plane
-            c = jnp.zeros((n, r), bool)                 # fine: not square
-            d = jnp.full((n, n), -1, kd)                # fine: key dtype var
-            e = jnp.zeros((n, n), bool)  # lint: allow-wide-plane
-            return a, b, c, d, e
-
-        def reduce_bad(w):
-            return w.sum(dtype=jnp.float64)             # flagged: float64
-
-        def reduce_ok(w):
-            return w.sum(dtype=jnp.int32)
-    """))
-    findings = lint_planes_file(str(bad))
-    assert len(findings) == 3, "\n".join(str(f) for f in findings)
-    assert {f.function for f in findings} == {"alloc", "reduce_bad"}
-
-
-def test_pview_lint_hard_bans_capacity_squared_allocs(tmp_path):
-    """Falsifiability for plane-lint rule 3: inside a file named pview.py,
-    [N, N] allocations of ANY dtype, the [D, N, N] form, the word-packed
-    [N, ceil(N/32)] form, np allocations, and capacity-attribute spellings
-    are all flagged, the suppression marker does NOT exempt them, and
-    O(N·k) / [N, R] / [G, G] shapes pass."""
-    bad = tmp_path / "pview.py"
-    bad.write_text(textwrap.dedent("""
-        import jax.numpy as jnp
-        import numpy as np
-
-        def alloc(n, k, r, g, d, state):
-            a = jnp.zeros((n, n), jnp.float32)            # flagged: any dtype
-            b = jnp.zeros((d, n, n), bool)                # flagged: [D, N, N]
-            c = jnp.zeros((n, (n + 31) // 32), jnp.uint32)  # flagged: packed
-            e = np.full((n, n), -1, np.int32)             # flagged: np alloc
-            f = jnp.zeros((state.capacity, n), bool)      # flagged: capacity attr
-            s = jnp.zeros((n, n), bool)  # lint: allow-wide-plane (no exemption)
-            ok1 = jnp.zeros((n, k), jnp.int32)
-            ok2 = jnp.zeros((n, r), bool)
-            ok3 = jnp.zeros((g, g), jnp.float32)
-            ok4 = jnp.zeros((n + 1,), bool)
-            return a, b, c, e, f, s, ok1, ok2, ok3, ok4
-    """))
-    findings = lint_planes_file(str(bad))
-    assert len(findings) == 6, "\n".join(str(f) for f in findings)
-    assert all("pview" in f.message for f in findings)
-
-    # the same square alloc OUTSIDE pview.py falls back to rules 1/2 only
-    other = tmp_path / "other_ops.py"
-    other.write_text(
-        "import jax.numpy as jnp\n"
-        "def alloc(n):\n"
-        "    return jnp.zeros((n, n), jnp.float32)\n"
-    )
-    assert lint_planes_file(str(other)) == []
 
 
 def test_ops_tick_paths_have_no_host_callbacks():
@@ -157,34 +74,6 @@ def test_ops_tick_paths_have_no_host_callbacks():
     assert findings == [], "\n".join(str(f) for f in findings)
 
 
-def test_host_callback_lint_catches_the_escape_hatches(tmp_path):
-    """Falsifiability: every spelled escape hatch is flagged (qualified and
-    from-imported), the suppression comment works, and plain jnp calls
-    pass clean."""
-    bad = tmp_path / "bad_tick.py"
-    bad.write_text(textwrap.dedent("""
-        import jax
-        import jax.numpy as jnp
-        from jax.experimental import io_callback
-        from jax import pure_callback
-
-        def _phase(state):
-            jax.debug.print("tick {}", state.tick)          # flagged
-            io_callback(print, None, state.tick)            # flagged
-            pure_callback(lambda x: x, state.tick, state.tick)  # flagged
-            v = jax.device_get(state.tick)                  # flagged
-            return state, v
-
-        def _fine(state):
-            x = jnp.where(state.up, 1, 0)
-            jax.debug.print("ok {}", x)  # lint: allow-host-callback
-            return x.sum()
-    """))
-    findings = lint_callbacks_file(str(bad))
-    assert len(findings) == 4, "\n".join(str(f) for f in findings)
-    assert {f.function for f in findings} == {"_phase"}
-
-
 def test_marker_audit_is_clean():
     """Every soak-class test is reachable via -m slow; markers registered."""
     findings = audit(os.path.join(REPO, "tests"))
@@ -195,3 +84,230 @@ def test_slow_marker_is_registered():
     assert "slow" in registered_markers(
         os.path.join(REPO, "tests", "conftest.py")
     )
+
+
+# ---------------------------------------------------------------------------
+# the ONE falsifiability harness (r12): seed a known-bad fixture, assert
+# the lint flags exactly the seeded lines and honors its suppression marker
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LintCase:
+    id: str
+    lint: Callable
+    filename: str  # some rules key on the basename (pview.py, engine_api.py)
+    source: str
+    expect_count: int
+    expect_functions: Optional[Set[str]] = None
+    expect_message_substr: Optional[str] = None
+
+
+LINT_CASES = [
+    LintCase(
+        id="donation-r6-restore-class",
+        lint=lint_donation_file,
+        filename="bad.py",
+        # the exact pre-r6-fix restore spelling, in all three shapes
+        # (asarray in restore, copy-less array in restore, asarray next to
+        # np.load); the suppression comment and copy=True pass
+        source="""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def restore(arrays):
+                return {k: jnp.asarray(v) for k, v in arrays.items()}
+
+            def _restore_locked(data):
+                return jnp.array(data, copy=False)
+
+            def load_checkpoint(path):
+                with np.load(path) as npz:
+                    return jnp.asarray(npz["view_key"])
+
+            def fine(path):
+                with np.load(path) as npz:
+                    return jnp.array(npz["x"], copy=True)
+
+            def suppressed(arrays):
+                with np.load(arrays) as npz:
+                    return jnp.asarray(npz["x"])  # lint: allow-zero-copy
+        """,
+        expect_count=3,
+        expect_functions={"restore", "_restore_locked", "load_checkpoint"},
+    ),
+    LintCase(
+        id="donation-r12-pview-restore-spelling",
+        lint=lint_donation_file,
+        filename="pview.py",
+        # the EXACT ops/pview.py restore shape (state-class splat over a
+        # dict comprehension) with the unsafe conversion the r6 rule bans
+        source="""
+            import jax.numpy as jnp
+
+            def restore(arrays):
+                return PviewState(**{k: jnp.asarray(v) for k, v in arrays.items()})
+
+            def restore_ok(arrays):
+                return PviewState(**{k: jnp.array(v, copy=True) for k, v in arrays.items()})
+        """,
+        expect_count=1,
+        expect_functions={"restore"},
+    ),
+    LintCase(
+        id="donation-r12-engine-api-seam",
+        lint=lint_donation_file,
+        filename="engine_api.py",
+        # window-builder closures in the engine registry: EVERY zero-copy
+        # spelling needs an explicit blessing, whatever the function name
+        # (rule 1 keys on 'restore'; the seam rule must not)
+        source="""
+            import jax.numpy as jnp
+            import numpy as np
+
+            _DEFAULT_ROWS = jnp.asarray(np.arange(4))  # module level: flagged too
+
+            def _dense_engine():
+                def _init(p, n, warm, template):
+                    return jnp.asarray(template)
+
+                def _window_seed(rows):
+                    return jnp.array(rows)
+
+                def _blessed(rows):
+                    return jnp.asarray(rows)  # lint: allow-zero-copy (index only)
+
+                return (_init, _window_seed, _blessed)
+        """,
+        expect_count=3,
+        expect_functions={"_init", "_window_seed", "_dense_engine", "<module>"},
+        expect_message_substr="engine_api donatable-state seam",
+    ),
+    LintCase(
+        id="planes-r9-bypass-class",
+        lint=lint_planes_file,
+        filename="bad_ops.py",
+        # an [N, N] bool plane, an [N, N] i32 plane, and a float64
+        # promotion are flagged; [N, R] planes, key-dtype allocations, and
+        # suppressed lines pass
+        source="""
+            import jax.numpy as jnp
+
+            def alloc(n, r, kd):
+                a = jnp.zeros((n, n), bool)                 # flagged: bool plane
+                b = jnp.full((n, n), -1, jnp.int32)         # flagged: i32 plane
+                c = jnp.zeros((n, r), bool)                 # fine: not square
+                d = jnp.full((n, n), -1, kd)                # fine: key dtype var
+                e = jnp.zeros((n, n), bool)  # lint: allow-wide-plane
+                return a, b, c, d, e
+
+            def reduce_bad(w):
+                return w.sum(dtype=jnp.float64)             # flagged: float64
+
+            def reduce_ok(w):
+                return w.sum(dtype=jnp.int32)
+        """,
+        expect_count=3,
+        expect_functions={"alloc", "reduce_bad"},
+    ),
+    LintCase(
+        id="planes-r11-pview-hard-ban",
+        lint=lint_planes_file,
+        filename="pview.py",
+        # inside a file named pview.py, [N, N] allocations of ANY dtype,
+        # the [D, N, N] form, the word-packed [N, ceil(N/32)] form, np
+        # allocations, and capacity-attribute spellings are all flagged,
+        # the suppression marker does NOT exempt them, and O(N·k) /
+        # [N, R] / [G, G] shapes pass
+        source="""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def alloc(n, k, r, g, d, state):
+                a = jnp.zeros((n, n), jnp.float32)            # flagged: any dtype
+                b = jnp.zeros((d, n, n), bool)                # flagged: [D, N, N]
+                c = jnp.zeros((n, (n + 31) // 32), jnp.uint32)  # flagged: packed
+                e = np.full((n, n), -1, np.int32)             # flagged: np alloc
+                f = jnp.zeros((state.capacity, n), bool)      # flagged: capacity attr
+                s = jnp.zeros((n, n), bool)  # lint: allow-wide-plane (no exemption)
+                ok1 = jnp.zeros((n, k), jnp.int32)
+                ok2 = jnp.zeros((n, r), bool)
+                ok3 = jnp.zeros((g, g), jnp.float32)
+                ok4 = jnp.zeros((n + 1,), bool)
+                return a, b, c, e, f, s, ok1, ok2, ok3, ok4
+        """,
+        expect_count=6,
+        expect_message_substr="pview",
+    ),
+    LintCase(
+        id="callbacks-r10-escape-hatches",
+        lint=lint_callbacks_file,
+        filename="bad_tick.py",
+        # every spelled escape hatch is flagged (qualified and
+        # from-imported), the suppression comment works, and plain jnp
+        # calls pass clean
+        source="""
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import io_callback
+            from jax import pure_callback
+
+            def _phase(state):
+                jax.debug.print("tick {}", state.tick)          # flagged
+                io_callback(print, None, state.tick)            # flagged
+                pure_callback(lambda x: x, state.tick, state.tick)  # flagged
+                v = jax.device_get(state.tick)                  # flagged
+                return state, v
+
+            def _fine(state):
+                x = jnp.where(state.up, 1, 0)
+                jax.debug.print("ok {}", x)  # lint: allow-host-callback
+                return x.sum()
+        """,
+        expect_count=4,
+        expect_functions={"_phase"},
+    ),
+]
+
+
+@pytest.mark.parametrize("case", LINT_CASES, ids=lambda c: c.id)
+def test_lint_catches_seeded_violations(case, tmp_path):
+    bad = tmp_path / case.filename
+    bad.write_text(textwrap.dedent(case.source))
+    findings = case.lint(str(bad))
+    detail = "\n".join(str(f) for f in findings)
+    assert len(findings) == case.expect_count, detail
+    if case.expect_functions is not None:
+        assert {f.function for f in findings} <= case.expect_functions, detail
+    if case.expect_message_substr is not None:
+        assert all(
+            case.expect_message_substr in f.message for f in findings
+        ), detail
+    # every finding names the seeded file and a real line
+    assert all(f.path == str(bad) and f.line > 0 for f in findings), detail
+
+
+def test_square_alloc_outside_pview_uses_rules_1_2(tmp_path):
+    """The same float32 square alloc OUTSIDE pview.py falls back to rules
+    1/2 only (any-dtype hard ban is pview-scoped)."""
+    other = tmp_path / "other_ops.py"
+    other.write_text(
+        "import jax.numpy as jnp\n"
+        "def alloc(n):\n"
+        "    return jnp.zeros((n, n), jnp.float32)\n"
+    )
+    assert lint_planes_file(str(other)) == []
+
+
+def test_suppression_markers_are_rule_scoped(tmp_path):
+    """One suppression grammar (lint: allow-<tag>) — and a marker for one
+    rule must NOT silence another rule on the same line."""
+    bad = tmp_path / "cross.py"
+    bad.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def restore(arrays):
+            return jnp.asarray(arrays)  # lint: allow-wide-plane (wrong tag)
+    """))
+    findings = lint_donation_file(str(bad))
+    assert len(findings) == 1, "\n".join(str(f) for f in findings)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Static program audit CLI — prove the r6–r11 contracts over every
+engine's compiled window programs (ISSUE 7 tentpole).
+
+Walks the closed jaxprs, lowered StableHLO, and AOT-compiled HLO of the
+dense/sparse/pview window builders (unarmed, trace-armed, the telemetry
+plane's device programs, and the mesh-sharded variants) and checks the
+per-engine contract registry (``EngineOps.contracts``):
+
+* donation-alias integrity (r6),
+* transfer-freeness (r6/r8/r10) at the primitive level,
+* no in-scan wide-plane materialization (the r10 ~18%/tick pattern),
+* the pview O(N·k) no-wide-value guarantee (r11),
+* per-engine compiled memory budgets (r9/r11),
+* the restore-seam copy rule via the AST lint (r6).
+
+Usage::
+
+    python tools/audit_programs.py --all                # human verdict
+    python tools/audit_programs.py --all --json         # machine verdict
+    python tools/audit_programs.py --all --json --out AUDIT_r12.json
+    python tools/audit_programs.py --engine pview --variants unarmed,traced
+    python tools/audit_programs.py --all --no-compile   # lowered-only, fast
+
+Exit status 0 when every contract holds, 1 on any violation — wire it
+into CI next to the repo lints. Runs entirely on abstract inputs (no
+state is allocated at audit shapes beyond the small concrete template);
+an 8-virtual-device CPU mesh stands in for the TPU slice exactly as
+``benchmarks/compile_proof_100k.py`` does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ENGINES = ("dense", "sparse", "pview")
+VARIANTS = ("unarmed", "traced", "telemetry", "sharded")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="static program audit over the engine window builders"
+    )
+    ap.add_argument("--all", action="store_true",
+                    help="audit every engine (default when no --engine)")
+    ap.add_argument("--engine", action="append", choices=ENGINES,
+                    help="audit one engine (repeatable)")
+    ap.add_argument("--variants", default=None,
+                    help=f"comma list from {VARIANTS} (default: all)")
+    ap.add_argument("--capacity", type=int, default=128,
+                    help="member capacity of the single-device audit shapes")
+    ap.add_argument("--sharded-capacity", type=int, default=256,
+                    help="capacity of the mesh-sharded shapes "
+                         "(must satisfy capacity %% (32*devices) == 0)")
+    ap.add_argument("--n-ticks", type=int, default=4,
+                    help="ticks per audited window")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="skip AOT compiles: audit traced/lowered forms only "
+                         "(drops the memory gate + compiled alias map)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable verdict")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON verdict to this path")
+    args = ap.parse_args(argv)
+
+    engines = args.engine if args.engine else list(ENGINES)
+    variants = args.variants.split(",") if args.variants else None
+    if variants:
+        bad = set(variants) - set(VARIANTS)
+        if bad:
+            ap.error(f"unknown variants {sorted(bad)}; pick from {VARIANTS}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from scalecube_cluster_tpu.audit import audit_all, format_text
+
+    verdict = audit_all(
+        engines=engines,
+        capacity=args.capacity,
+        n_ticks=args.n_ticks,
+        variants=variants,
+        sharded_capacity=args.sharded_capacity,
+        compile_programs=not args.no_compile,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    if args.json:
+        # one line: benchmarks/collect_results.py harvests stdout JSON lines
+        print(json.dumps(verdict))
+    else:
+        print(format_text(verdict))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

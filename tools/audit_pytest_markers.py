@@ -16,8 +16,8 @@ audit pins the policy:
    builtin or registered in conftest.py (``markers`` ini lines) — unknown
    markers would make ``-m`` expressions silently select nothing.
 
-AST-based; run directly (exit 1 on findings) or through
-``tests/test_repo_lints.py``.
+AST-based via :mod:`lintlib`; run directly (exit 1 on findings) or
+through ``tests/test_repo_lints.py``.
 """
 
 from __future__ import annotations
@@ -26,8 +26,14 @@ import ast
 import os
 import re
 import sys
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
+
+from dataclasses import dataclass
+
+try:
+    from lintlib import default_root
+except ImportError:  # pragma: no cover - imported as tools.audit_pytest_markers
+    from tools.lintlib import default_root
 
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -37,6 +43,9 @@ BUILTIN_MARKS = {
 
 @dataclass(frozen=True)
 class Finding:
+    """Marker findings have no meaningful enclosing function — a location
+    and a message suffice (unlike :class:`lintlib.Finding`)."""
+
     path: str
     line: int
     message: str
@@ -145,9 +154,7 @@ def audit(tests_dir: str) -> List[Finding]:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
-    tests_dir = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
-    )
+    tests_dir = argv[0] if argv else default_root("tests")
     findings = audit(tests_dir)
     for f in findings:
         print(f)

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Donation-safety lint: flag zero-copy ``jnp.asarray`` on restore paths.
+"""Donation-safety lint: flag zero-copy ``jnp.asarray`` on restore paths
+and engine seams.
 
 The bug class (found in r6, regression-tested in test_dispatch_pipeline.
 test_restored_state_is_donation_safe): ``jnp.asarray`` ZERO-COPIES a
@@ -10,17 +11,27 @@ collected, observed as a restored driver silently diverging with foreign
 data several windows later. The fix is ``jnp.array(..., copy=True)``
 (jax-owned buffers); this lint keeps the class from coming back.
 
-Rules (AST-based, no imports of the linted code):
+Rules (AST-based via :mod:`lintlib`, no imports of the linted code):
 
 1. In any function whose name contains ``restore``: calls to
    ``jnp.asarray`` / ``jax.numpy.asarray`` are flagged, and ``jnp.array``
-   calls must pass an explicit ``copy=True``.
+   calls must pass an explicit ``copy=True``. This covers every engine's
+   checkpoint seam by NAME — ``ops.state.restore``, ``ops.sparse.restore``,
+   ``ops.pview.restore``, the driver's ``_restore_locked`` — and the audit
+   plane additionally pins each engine's registered
+   ``EngineContracts.restore_module`` through this rule
+   (``scalecube_cluster_tpu.audit.check_restore_seams``).
 2. In any function that calls ``np.load`` / ``numpy.load`` (an npz/npy
    deserialization site): ``jnp.asarray`` of anything is flagged — the
    loaded buffers are exactly the aligned-host-memory case.
+3. (r12) In ``ops/engine_api.py`` — the one module whose closures build
+   and thread DONATABLE state for every engine — every ``jnp.asarray``
+   and copy-less ``jnp.array`` must be explicitly blessed: a zero-copy
+   there flows straight into a donated window program regardless of the
+   enclosing function's name, which is what rule 1 keys on.
 
 A line may opt out with a ``# lint: allow-zero-copy`` comment (for code
-that provably never reaches a donated program).
+that provably never reaches a donated program), stating its reason.
 
 Run directly (``python tools/lint_donation_safety.py [root]``, exit 1 on
 findings) or through the tier-1 test ``tests/test_repo_lints.py``.
@@ -30,133 +41,130 @@ from __future__ import annotations
 
 import ast
 import os
-import sys
-from dataclasses import dataclass
 from typing import List, Optional
 
+try:  # direct script use vs package-ish import from tests/audit
+    from lintlib import (
+        Finding,
+        calls_in,
+        default_root,
+        functions_in,
+        make_lint_tree,
+        parse_file,
+        run_main,
+        suppressed,
+    )
+except ImportError:  # pragma: no cover - imported as tools.lint_donation_safety
+    from tools.lintlib import (
+        Finding,
+        calls_in,
+        default_root,
+        functions_in,
+        make_lint_tree,
+        parse_file,
+        run_main,
+        suppressed,
+    )
+
 SUPPRESS = "lint: allow-zero-copy"
+_TAG = "allow-zero-copy"
 
 #: attribute chains that spell the jax asarray entry point
 _ASARRAY_CHAINS = {("jnp", "asarray"), ("jax", "numpy", "asarray")}
 _ARRAY_CHAINS = {("jnp", "array"), ("jax", "numpy", "array")}
 _NPLOAD_CHAINS = {("np", "load"), ("numpy", "load")}
 
-
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    function: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
+#: rule 3: modules that ARE the donatable-state seam — every zero-copy
+#: spelling inside them needs an explicit blessing
+_SEAM_BASENAMES = {"engine_api.py"}
 
 
-def _attr_chain(node: ast.AST) -> Optional[tuple]:
-    """``jnp.asarray`` -> ("jnp", "asarray"); None for anything fancier."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
-def _calls_in(fn: ast.AST):
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain is not None:
-                yield node, chain
-
-
-def _suppressed(source_lines: List[str], lineno: int) -> bool:
-    line = source_lines[lineno - 1] if 0 < lineno <= len(source_lines) else ""
-    return SUPPRESS in line
+def _copyless_array(call: ast.Call) -> bool:
+    copy_kw = next((kw for kw in call.keywords if kw.arg == "copy"), None)
+    return copy_kw is None or not (
+        isinstance(copy_kw.value, ast.Constant) and copy_kw.value.value is True
+    )
 
 
 def lint_file(path: str) -> List[Finding]:
-    with open(path, "r") as fh:
-        source = fh.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 0, "<module>",
-                        f"unparseable: {exc.msg}")]
-    lines = source.splitlines()
-    findings: List[Finding] = []
+    tree, lines, err = parse_file(path)
+    if err is not None:
+        return [err]
+    seam = os.path.basename(path) in _SEAM_BASENAMES
+    # one finding per call site: a nested def is walked by itself AND by
+    # every enclosing function, so key on the call location and let the
+    # INNERMOST qualifying function win (ast.walk yields outer-first)
+    by_site: dict = {}
 
-    funcs = [
-        n for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
-    for fn in funcs:
+    for fn in functions_in(tree):
         is_restore = "restore" in fn.name.lower()
         loads_np = any(
-            chain in _NPLOAD_CHAINS for _, chain in _calls_in(fn)
+            chain in _NPLOAD_CHAINS for _, chain in calls_in(fn)
         )
-        if not (is_restore or loads_np):
+        if not (is_restore or loads_np or seam):
             continue
         why = (
             "a restore path" if is_restore
-            else "a function that deserializes numpy archives"
+            else "a function that deserializes numpy archives" if loads_np
+            else "the engine_api donatable-state seam"
         )
-        for call, chain in _calls_in(fn):
-            if _suppressed(lines, call.lineno):
+        for call, chain in calls_in(fn):
+            if suppressed(lines, call.lineno, _TAG):
                 continue
+            site = (call.lineno, call.col_offset, chain)
             if chain in _ASARRAY_CHAINS:
-                findings.append(Finding(
+                by_site[site] = Finding(
                     path, call.lineno, fn.name,
                     f"jnp.asarray in {why} can zero-copy an aligned host "
                     "buffer that a later donated window frees — use "
                     "jnp.array(..., copy=True)",
-                ))
-            elif is_restore and chain in _ARRAY_CHAINS:
-                copy_kw = next(
-                    (kw for kw in call.keywords if kw.arg == "copy"), None
                 )
-                if copy_kw is None or not (
-                    isinstance(copy_kw.value, ast.Constant)
-                    and copy_kw.value.value is True
-                ):
-                    findings.append(Finding(
+            elif (is_restore or seam) and chain in _ARRAY_CHAINS:
+                if _copyless_array(call):
+                    by_site[site] = Finding(
                         path, call.lineno, fn.name,
-                        "jnp.array on a restore path must pass an explicit "
+                        f"jnp.array in {why} must pass an explicit "
                         "copy=True (donation safety)",
-                    ))
-    return findings
+                    )
+
+    if seam:
+        # module-LEVEL calls belong to no FunctionDef — the seam rule
+        # covers them too (a module constant threaded into a donated
+        # window is the same hazard, minus even a function name to key on)
+        in_function = {
+            (call.lineno, call.col_offset, chain)
+            for fn in functions_in(tree)
+            for call, chain in calls_in(fn)
+        }
+        why = "the engine_api donatable-state seam"
+        for call, chain in calls_in(tree):
+            site = (call.lineno, call.col_offset, chain)
+            if site in in_function or suppressed(lines, call.lineno, _TAG):
+                continue
+            if chain in _ASARRAY_CHAINS:
+                by_site[site] = Finding(
+                    path, call.lineno, "<module>",
+                    f"jnp.asarray in {why} can zero-copy an aligned host "
+                    "buffer that a later donated window frees — use "
+                    "jnp.array(..., copy=True)",
+                )
+            elif chain in _ARRAY_CHAINS and _copyless_array(call):
+                by_site[site] = Finding(
+                    path, call.lineno, "<module>",
+                    f"jnp.array in {why} must pass an explicit "
+                    "copy=True (donation safety)",
+                )
+    return [by_site[k] for k in sorted(by_site, key=lambda s: (s[0], s[1]))]
 
 
-def lint_tree(root: str) -> List[Finding]:
-    findings: List[Finding] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames
-            if d not in ("__pycache__", ".git", ".pytest_cache")
-        ]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                findings.extend(lint_file(os.path.join(dirpath, name)))
-    return findings
+lint_tree = make_lint_tree(lint_file)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "scalecube_cluster_tpu",
+    return run_main(
+        lint_tree, default_root("scalecube_cluster_tpu"),
+        "donation-safety", argv,
     )
-    findings = lint_tree(root)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} donation-safety finding(s)")
-        return 1
-    print("donation-safety lint: clean")
-    return 0
 
 
 if __name__ == "__main__":

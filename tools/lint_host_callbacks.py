@@ -3,9 +3,15 @@
 
 The zero-transfer discipline (r6: a no-consumer ``step()`` performs ZERO
 device→host transfers; r8/r10 extend it to the armed telemetry and trace
-planes) has so far been guarded only by the transfer-spy TESTS — which spy
-on ``np.asarray`` and would MISS the other ways device values reach the
-host from inside a jitted tick:
+planes) has two static guards: this SOURCE lint, and — since r12 — the
+audit plane's :func:`~scalecube_cluster_tpu.audit.check_transfer_free`,
+which walks the CLOSED JAXPR of every window program and therefore
+catches what source matching cannot (a callback reached through decorator
+indirection or a re-exported helper). The lint stays because it runs
+without jax and fires on code paths no window program reaches yet.
+
+Flagged callees (however the module was imported — ``jax.debug.print``,
+``debug.print``, a bare ``io_callback`` from a ``from``-import, ...):
 
 * ``jax.debug.print`` / ``jax.debug.callback`` — a host callback per
   traced invocation;
@@ -13,30 +19,46 @@ host from inside a jitted tick:
   host round trips baked into the program;
 * ``jax.device_get`` — a synchronous transfer.
 
-Any of these inside ``ops/`` (the tick kernels, phases, and state
-mutators that run under jit) would silently serialize the pipelined
-dispatch, so this lint makes the discipline STATIC: AST-walk every
-function in the tree and flag calls whose attribute chain spells one of
-the escape hatches, however the module was imported (``jax.debug.print``,
-``debug.print``, a bare ``io_callback`` from a ``from``-import, ...).
-
 A line may opt out with ``# lint: allow-host-callback`` (for host-side
 helper code in an ops module that provably never runs under jit).
 
 Run directly (``python tools/lint_host_callbacks.py [root]``, exit 1 on
 findings) or through the tier-1 test ``tests/test_repo_lints.py`` — which
-also falsifiability-tests it on known-bad fixtures, like the r8/r9 lints.
+also falsifiability-tests it on known-bad fixtures, like the other lints.
 """
 
 from __future__ import annotations
 
 import ast
-import os
-import sys
-from dataclasses import dataclass
 from typing import List, Optional
 
+try:
+    from lintlib import (
+        Finding,
+        attr_chain,
+        default_root,
+        enclosing_function_map,
+        make_lint_tree,
+        owner_of,
+        parse_file,
+        run_main,
+        suppressed,
+    )
+except ImportError:  # pragma: no cover - imported as tools.lint_host_callbacks
+    from tools.lintlib import (
+        Finding,
+        attr_chain,
+        default_root,
+        enclosing_function_map,
+        make_lint_tree,
+        owner_of,
+        parse_file,
+        run_main,
+        suppressed,
+    )
+
 SUPPRESS = "lint: allow-host-callback"
+_TAG = "allow-host-callback"
 
 #: trailing attribute-chain spellings of the host escape hatches; a call
 #: matches when its chain ENDS with one of these (so jax.debug.print,
@@ -50,28 +72,6 @@ _BAD_SUFFIXES = {
 }
 
 
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    function: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
-
-
-def _attr_chain(node: ast.AST) -> Optional[tuple]:
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
 def _match(chain: tuple) -> Optional[str]:
     for suffix, why in _BAD_SUFFIXES.items():
         if chain[-len(suffix):] == suffix:
@@ -79,75 +79,37 @@ def _match(chain: tuple) -> Optional[str]:
     return None
 
 
-def _suppressed(source_lines: List[str], lineno: int) -> bool:
-    line = source_lines[lineno - 1] if 0 < lineno <= len(source_lines) else ""
-    return SUPPRESS in line
-
-
 def lint_file(path: str) -> List[Finding]:
-    with open(path, "r") as fh:
-        source = fh.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 0, "<module>",
-                        f"unparseable: {exc.msg}")]
-    lines = source.splitlines()
+    tree, lines, err = parse_file(path)
+    if err is not None:
+        return [err]
     findings: List[Finding] = []
-    # map call line -> enclosing function name (innermost wins)
-    funcs = [
-        n for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
+    owners = enclosing_function_map(tree)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
-        chain = _attr_chain(node.func)
+        chain = attr_chain(node.func)
         if chain is None:
             continue
         why = _match(chain)
-        if why is None or _suppressed(lines, node.lineno):
+        if why is None or suppressed(lines, node.lineno, _TAG):
             continue
-        owner = "<module>"
-        for fn in funcs:
-            if fn.lineno <= node.lineno <= (fn.end_lineno or fn.lineno):
-                owner = fn.name  # keep innermost (walk order is outer-first)
         findings.append(Finding(
-            path, node.lineno, owner,
+            path, node.lineno, owner_of(owners, node),
             f"{'.'.join(chain)}: {why} — forbidden in ops/ tick paths "
             "(zero-transfer discipline)",
         ))
     return findings
 
 
-def lint_tree(root: str) -> List[Finding]:
-    findings: List[Finding] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames
-            if d not in ("__pycache__", ".git", ".pytest_cache")
-        ]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                findings.extend(lint_file(os.path.join(dirpath, name)))
-    return findings
+lint_tree = make_lint_tree(lint_file)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "scalecube_cluster_tpu",
-        "ops",
+    return run_main(
+        lint_tree, default_root("scalecube_cluster_tpu", "ops"),
+        "host-callback", argv,
     )
-    findings = lint_tree(root)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} host-callback finding(s)")
-        return 1
-    print("host-callback lint: clean")
-    return 0
 
 
 if __name__ == "__main__":

@@ -13,7 +13,8 @@ and hard to spot in review:
    integer reduce that touches ``float64`` anywhere silently runs the
    whole [N, W] plane through doubles under x64 mode.
 
-Rules (AST-based, no imports of the linted code; ops/ only):
+Rules (AST-based via :mod:`lintlib`, no imports of the linted code;
+ops/ only):
 
 1. ``jnp.zeros/ones/full/empty`` with a member-square shape — a literal
    shape tuple containing two ADJACENT identical dims (``(n, n)``,
@@ -32,9 +33,11 @@ Rules (AST-based, no imports of the linted code; ops/ only):
    32)`` all match (a dim is capacity-scaled when it references ``n`` /
    ``n_initial`` / a ``capacity`` attribute). There is NO suppression
    marker for this rule — an [N, N]-proportional plane in pview.py is a
-   design regression, not a style call.
+   design regression, not a style call. (Since r12 the audit plane also
+   proves the stronger IR-level form: NO VALUE in the compiled pview
+   window has two capacity-scaled dims — ``check_forbid_wide_values``.)
 
-A line may opt out with ``# lint: allow-wide-plane`` (rules 1 only — e.g.
+A line may opt out with ``# lint: allow-wide-plane`` (rule 1 only — e.g.
 the ``changed_at`` timestamp plane, which is semantically i32) or
 ``# lint: allow-float64`` (rule 2), stating its reason inline.
 
@@ -46,12 +49,37 @@ from __future__ import annotations
 
 import ast
 import os
-import sys
-from dataclasses import dataclass
 from typing import List, Optional
+
+try:
+    from lintlib import (
+        Finding,
+        attr_chain,
+        default_root,
+        enclosing_function_map,
+        make_lint_tree,
+        owner_of,
+        parse_file,
+        run_main,
+        suppressed,
+    )
+except ImportError:  # pragma: no cover - imported as tools.lint_plane_dtypes
+    from tools.lintlib import (
+        Finding,
+        attr_chain,
+        default_root,
+        enclosing_function_map,
+        make_lint_tree,
+        owner_of,
+        parse_file,
+        run_main,
+        suppressed,
+    )
 
 SUPPRESS_PLANE = "lint: allow-wide-plane"
 SUPPRESS_F64 = "lint: allow-float64"
+_TAG_PLANE = "allow-wide-plane"
+_TAG_F64 = "allow-float64"
 
 _ALLOC_CHAINS = {
     ("jnp", "zeros"), ("jnp", "ones"), ("jnp", "full"), ("jnp", "empty"),
@@ -70,28 +98,6 @@ _NP_ALLOC_CHAINS = {
 _CAPACITY_NAMES = {"n", "n_initial"}
 
 
-@dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    function: str
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
-
-
-def _attr_chain(node: ast.AST) -> Optional[tuple]:
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
 def _dim_token(node: ast.AST) -> Optional[str]:
     """A comparable spelling of one shape dim (name, attribute chain, or
     int literal); None for computed dims."""
@@ -99,7 +105,7 @@ def _dim_token(node: ast.AST) -> Optional[str]:
         return node.id
     if isinstance(node, ast.Constant) and isinstance(node.value, int):
         return str(node.value)
-    chain = _attr_chain(node)
+    chain = attr_chain(node)
     return ".".join(chain) if chain else None
 
 
@@ -140,44 +146,28 @@ def _dtype_of(call: ast.Call, chain: tuple) -> Optional[tuple]:
     statically. zeros/ones/empty: (shape, dtype); full: (shape, fill, dtype)."""
     for kw in call.keywords:
         if kw.arg == "dtype":
-            c = _attr_chain(kw.value)
+            c = attr_chain(kw.value)
             return c if c else None
     pos = 2 if chain[-1] == "full" else 1
     if len(call.args) > pos:
-        c = _attr_chain(call.args[pos])
+        c = attr_chain(call.args[pos])
         return c if c else None
     return None
 
 
-def _suppressed(lines: List[str], lineno: int, marker: str) -> bool:
-    line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
-    return marker in line
-
-
 def lint_file(path: str) -> List[Finding]:
-    with open(path, "r") as fh:
-        source = fh.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 0, "<module>",
-                        f"unparseable: {exc.msg}")]
-    lines = source.splitlines()
+    tree, lines, err = parse_file(path)
+    if err is not None:
+        return [err]
     findings: List[Finding] = []
-
-    # enclosing-function names for readable findings
-    parents: dict = {}
-    for fn in ast.walk(tree):
-        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for child in ast.walk(fn):
-                parents.setdefault(id(child), fn.name)
+    owners = enclosing_function_map(tree)
 
     skip_f64 = os.path.basename(path) == "dcn.py"  # multi-host glue, no planes
     pview = os.path.basename(path) == "pview.py"
     for node in ast.walk(tree):
-        where = parents.get(id(node), "<module>")
+        where = owner_of(owners, node)
         if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
+            chain = attr_chain(node.func)
             if (
                 pview
                 and chain in (_ALLOC_CHAINS | _NP_ALLOC_CHAINS)
@@ -195,7 +185,7 @@ def lint_file(path: str) -> List[Finding]:
                 ))
                 continue
             if chain in _ALLOC_CHAINS and node.args and _member_square(node.args[0]):
-                if _suppressed(lines, node.lineno, SUPPRESS_PLANE):
+                if suppressed(lines, node.lineno, _TAG_PLANE):
                     continue
                 dt = _dtype_of(node, chain)
                 if dt in _BOOL_DTYPES:
@@ -214,9 +204,9 @@ def lint_file(path: str) -> List[Finding]:
                         f"`# {SUPPRESS_PLANE}`",
                     ))
         elif isinstance(node, ast.Attribute) and not skip_f64:
-            chain = _attr_chain(node)
-            if chain in _F64_CHAINS and not _suppressed(
-                lines, node.lineno, SUPPRESS_F64
+            chain = attr_chain(node)
+            if chain in _F64_CHAINS and not suppressed(
+                lines, node.lineno, _TAG_F64
             ):
                 findings.append(Finding(
                     path, node.lineno, where,
@@ -227,33 +217,14 @@ def lint_file(path: str) -> List[Finding]:
     return findings
 
 
-def lint_tree(root: str) -> List[Finding]:
-    findings: List[Finding] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [
-            d for d in dirnames
-            if d not in ("__pycache__", ".git", ".pytest_cache")
-        ]
-        for name in sorted(filenames):
-            if name.endswith(".py"):
-                findings.extend(lint_file(os.path.join(dirpath, name)))
-    return findings
+lint_tree = make_lint_tree(lint_file)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = argv if argv is not None else sys.argv[1:]
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "scalecube_cluster_tpu", "ops",
+    return run_main(
+        lint_tree, default_root("scalecube_cluster_tpu", "ops"),
+        "plane-dtype", argv,
     )
-    findings = lint_tree(root)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} plane-dtype finding(s)")
-        return 1
-    print("plane-dtype lint: clean")
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Shared AST lint framework (r12 satellite).
+
+The four repo lints (donation safety, plane dtypes, host callbacks,
+pytest markers) each grew their own copy of the same scaffolding:
+attribute-chain extraction, suppression-comment handling, the
+``__pycache__``-skipping file walk, the ``Finding`` record, and a
+``main()`` that prints findings and exits 1. This module is the ONE
+spelling of that scaffolding; each ``tools/lint_*.py`` keeps only its
+rules (and its public ``lint_file`` / ``lint_tree`` / ``main`` surface,
+which ``tests/test_repo_lints.py`` and the audit plane's restore-seam
+check import).
+
+Suppression grammar — one spelling for every lint::
+
+    some_flagged_call(...)  # lint: allow-<tag> [reason]
+
+where ``<tag>`` names the rule being waived (``allow-zero-copy``,
+``allow-wide-plane``, ``allow-float64``, ``allow-host-callback``).
+:func:`suppressed` matches ``lint: allow-<tag>`` on the flagged line, so
+a marker for one rule never silences another.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+#: every suppression marker is ``lint: allow-<tag>`` — the shared grammar
+SUPPRESS_PREFIX = "lint: "
+
+#: directories the file walk never descends into
+SKIP_DIRS = ("__pycache__", ".git", ".pytest_cache")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit: a clickable location plus an actionable message."""
+
+    path: str
+    line: int
+    function: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: in {self.function}: {self.message}"
+
+
+def attr_chain(node: ast.AST) -> Optional[tuple]:
+    """``jnp.asarray`` -> ("jnp", "asarray"); None for anything fancier
+    (subscripts, calls-of-calls, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def calls_in(root: ast.AST) -> Iterator[Tuple[ast.Call, tuple]]:
+    """Every Call under ``root`` whose callee spells as an attribute chain."""
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain is not None:
+                yield node, chain
+
+
+def suppressed(lines: List[str], lineno: int, tag: str) -> bool:
+    """True when the flagged line carries ``# lint: allow-<tag>``."""
+    line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+    return f"{SUPPRESS_PREFIX}{tag}" in line
+
+
+def parse_file(path: str):
+    """(tree, source lines, None) — or (None, [], Finding) on a syntax
+    error, so every lint reports unparseable files the same way."""
+    with open(path, "r") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return None, [], Finding(
+            path, exc.lineno or 0, "<module>", f"unparseable: {exc.msg}"
+        )
+    return tree, source.splitlines(), None
+
+
+def functions_in(tree: ast.AST) -> List[ast.AST]:
+    return [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def enclosing_function_map(tree: ast.AST) -> Dict[int, str]:
+    """id(node) -> INNERMOST enclosing function name (walk order is
+    outer-first, so later assignments win by overwriting)."""
+    owners: Dict[int, str] = {}
+    for fn in functions_in(tree):
+        for child in ast.walk(fn):
+            owners[id(child)] = fn.name
+    return owners
+
+
+def owner_of(owners: Dict[int, str], node: ast.AST) -> str:
+    return owners.get(id(node), "<module>")
+
+
+def walk_python_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def make_lint_tree(lint_file: Callable[[str], List[Finding]]):
+    """The shared tree walk: ``lint_file`` over every .py under root."""
+
+    def lint_tree(root: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in walk_python_files(root):
+            findings.extend(lint_file(path))
+        return findings
+
+    return lint_tree
+
+
+def default_root(*parts: str) -> str:
+    """Repo-anchored default lint root (tools/ lives at the repo top)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(repo, *parts)
+
+
+def run_main(
+    lint_tree: Callable[[str], List[Finding]],
+    root: str,
+    label: str,
+    argv: Optional[List[str]] = None,
+) -> int:
+    """The shared CLI body: lint ``argv[0] or root``, print findings,
+    exit 1 when any."""
+    argv = argv if argv is not None else sys.argv[1:]
+    target = argv[0] if argv else root
+    findings = lint_tree(target)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} {label} finding(s)")
+        return 1
+    print(f"{label} lint: clean")
+    return 0
