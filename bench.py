@@ -303,6 +303,33 @@ def main() -> None:
                     cmd += [flag, sys.argv[i + 1]]
         raise SystemExit(subprocess.call(cmd))
 
+    # r13: --strategy/--topology run the dissemination certification
+    # harness (benchmarks/config12_strategies.py — spread-time curves
+    # checked against the cited theory bounds) through the same
+    # backend-probe/retry path; both flags default inside the delegate
+    # (--strategy alone certifies it on the 'full' topology and vice
+    # versa). Forwards --n/--engine/--out when present.
+    if "--strategy" in sys.argv or "--topology" in sys.argv:
+        import os
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [
+            sys.executable,
+            os.path.join(here, "benchmarks", "config12_strategies.py"),
+        ]
+        for flag in ("--strategy", "--topology", "--n", "--engine", "--seeds",
+                     "--fanout", "--control-n", "--out"):
+            if flag in sys.argv:
+                i = sys.argv.index(flag)
+                if i + 1 < len(sys.argv):
+                    cmd += [flag, sys.argv[i + 1]]
+        if "--out" not in sys.argv:  # default: refresh the standing artifact
+            cmd += ["--out", os.path.join(here, "STRATEGY_BENCH_r13.json")]
+        if "--quick" in sys.argv:
+            cmd.append("--quick")
+        raise SystemExit(subprocess.call(cmd))
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

@@ -103,6 +103,17 @@ def _r11(rec):
     )
 
 
+def _r13(rec):
+    ctl = rec.get("default_spec_control") or {}
+    return ctl.get("ticks_per_s"), (
+        f"default-spec control on {ctl.get('backend', '?')} "
+        f"(strategy zoo: {rec.get('n_certified')}/"
+        f"{rec.get('n_entries')} combos certified on "
+        f"{len(rec.get('certified_strategies', []))} strategies x "
+        f"{len(rec.get('certified_topologies', []))} topologies)"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
@@ -110,7 +121,39 @@ ROUND_BENCH_FILES = [
     (9, "BITPLANE_BENCH_r09.json", _r9),
     (10, "TRACE_BENCH_r10.json", _r10),
     (11, "PVIEW_BENCH_r11.json", _r11),
+    (13, "STRATEGY_BENCH_r13.json", _r13),
 ]
+
+
+def collect_strategy_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r13 strategy-certification artifact:
+    which (strategy x topology x engine) combos certified against their
+    bound, without duplicating the curves."""
+    path = root / "STRATEGY_BENCH_r13.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        return {
+            "present": True,
+            "ok": rec.get("ok"),
+            "n_certified": rec.get("n_certified"),
+            "n_entries": rec.get("n_entries"),
+            "certified_strategies": rec.get("certified_strategies"),
+            "certified_topologies": rec.get("certified_topologies"),
+            "entries": {
+                f"{e['engine']}/{e['strategy']}/{e['topology']}": {
+                    "certified": e.get("certified"),
+                    "spread_ticks_max": e.get("spread_ticks_max"),
+                    "bound_ticks": e.get("bound_ticks"),
+                }
+                for e in rec.get("entries", [])
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
 
 
 def collect_trajectory(root: pathlib.Path) -> list:
@@ -236,6 +279,12 @@ def main() -> None:
     results += run([py, "benchmarks/config11_pview.py", "--no-verify",
                     "--probe-base", "131072", "--probe-cap", "131072"],
                    timeout=3000)
+    # r13 dissemination strategy zoo: spread-time curves certified against
+    # the cited theory bounds (full matrix in the dedicated artifact run;
+    # the matrix pass refreshes the standing artifact on the pruned-but-
+    # still->=3x3 quick subset)
+    results += run([py, "benchmarks/config12_strategies.py", "--quick",
+                    "--out", "STRATEGY_BENCH_r13.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -260,6 +309,9 @@ def main() -> None:
         # r12: standing static-audit verdict summary (full detail lives in
         # AUDIT_r12.json, refreshed by the tools/audit_programs.py run above)
         "program_audit": collect_audit_summary(ROOT),
+        # r13: strategy-zoo certification verdicts (curves live in
+        # STRATEGY_BENCH_r13.json, refreshed by the config12 run above)
+        "strategy_bench": collect_strategy_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

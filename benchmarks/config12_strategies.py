@@ -1,0 +1,175 @@
+"""Driver config #12: dissemination strategy zoo — certified spread curves.
+
+The r13 acceptance gate: for every (strategy x topology) in the matrix,
+measure the rumor spread-time distribution (ticks from injection to 100%
+up-member coverage, over seeds, full SWIM tick running, zero loss) and
+certify the worst seed against the cited theory bound with explicit
+constants (``dissemination/certify.py``'s table — Pittel '87 push,
+Karp et al. push-pull, arXiv:1504.03277 pipelined steady state,
+arXiv:1311.2839 / arXiv:1805.08531 deterministic doubling schedules).
+The ring's LINEAR class is certified from below too — the comparative
+content ("expander log, ring linear") is asserted, not eyeballed.
+
+Also records a strategy-armed throughput control: the DEFAULT spec must
+trace the byte-identical program, so its ticks/s is the r11 dense arm's
+number (any drift here means the strategy seam touched the default
+path).
+
+    python benchmarks/config12_strategies.py [--n 256] [--seeds 5]
+        [--quick] [--strategy S --topology T] [--engine dense|pview]
+        [--control-n 4096] [--no-control] [--out STRATEGY_BENCH_r13.json]
+
+One JSON line on stdout (collect_results harvests it); ``--out`` writes
+the full artifact with per-entry coverage curves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib as _p
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+from common import emit, log
+
+#: --quick certification subset (still >= 3 strategies x >= 3 topologies)
+QUICK_MATRIX = (
+    ("push", "full", "dense"),
+    ("push", "ring", "dense"),
+    ("push", "expander", "dense"),
+    ("push_pull", "full", "dense"),
+    ("push_pull", "expander", "dense"),
+    ("pipelined", "ring", "dense"),
+    ("pipelined", "expander", "dense"),
+    ("accelerated", "ring", "dense"),
+    ("accelerated", "expander", "dense"),
+    ("push", "expander", "pview"),
+)
+
+
+def _throughput_control(n: int) -> dict:
+    """Default-spec dense ticks/s (one rumor round through the sweep
+    window) — the program-identity control: params carry the DEFAULT
+    DissemSpec, so this must reproduce the r11 dense arm's number."""
+    import jax
+    import numpy as np
+
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.ops.kernel import make_run
+    from scalecube_cluster_tpu.utils.cluster_math import gossip_periods_to_sweep
+
+    params = S.SimParams(
+        capacity=n, fanout=3, repeat_mult=3, ping_req_k=3, fd_every=5,
+        sync_every=150, suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False,
+    )
+    budget = gossip_periods_to_sweep(params.repeat_mult, n)
+    state = S.init_state(params, n, warm=True)
+    step = make_run(params, budget)
+    key = jax.random.PRNGKey(0)
+    state = S.spread_rumor(state, 0, origin=0)
+    state, key, _ms, _w = step(state, key)  # compile + warm
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    state = S.spread_rumor(state, 0, origin=97)
+    state, key, ms, _w = step(state, key)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    cov = np.asarray(ms["rumor_coverage"])[:, 0]
+    assert (cov >= 1.0).any(), f"control N={n}: no convergence in {budget}"
+    # backend is part of the record: the trajectory fold compares rounds,
+    # and a CPU-measured control must not read as a TPU regression
+    return {"n": n, "ticks_per_s": round(budget / dt, 2),
+            "backend": jax.default_backend()}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=256,
+                    help="member count of the certification runs")
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--fanout", type=int, default=3)
+    ap.add_argument("--rumor-slots", type=int, default=8)
+    ap.add_argument("--quick", action="store_true",
+                    help="2 seeds + the pruned matrix")
+    ap.add_argument("--strategy", default=None,
+                    help="single-combo mode (bench.py --strategy delegate)")
+    ap.add_argument("--topology", default=None)
+    ap.add_argument("--engine", default="dense", choices=("dense", "pview"))
+    ap.add_argument("--control-n", type=int, default=4096,
+                    help="size of the default-spec throughput control")
+    ap.add_argument("--no-control", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    # backend probe + bounded retry (bench.py's r6 path): a wedged tunnel
+    # must leave a structured failure artifact, not a hang
+    from bench import emit_failure, probe_backend
+
+    ok, attempts = probe_backend()
+    if not ok:
+        emit_failure("backend_probe", 1, attempts, "config12 probe failed")
+        raise SystemExit(1)
+
+    from scalecube_cluster_tpu.dissemination.certify import (
+        DEFAULT_MATRIX,
+        spread_certifier,
+    )
+
+    if args.strategy or args.topology:
+        matrix = ((args.strategy or "push", args.topology or "full",
+                   args.engine),)
+    elif args.quick:
+        matrix = QUICK_MATRIX
+    else:
+        matrix = DEFAULT_MATRIX
+    seeds = tuple(range(2 if args.quick else args.seeds))
+
+    t0 = time.perf_counter()
+    record = spread_certifier(
+        matrix=matrix, n=args.n, seeds=seeds, fanout=args.fanout,
+        rumor_slots=args.rumor_slots, log=log,
+    )
+    record["wall_seconds"] = round(time.perf_counter() - t0, 1)
+    record["config"] = "config12_strategies"
+    if not args.no_control:
+        try:
+            record["default_spec_control"] = _throughput_control(args.control_n)
+            log(f"default-spec control: {record['default_spec_control']}")
+        except Exception as exc:  # noqa: BLE001 — control is advisory
+            record["default_spec_control"] = {"error": repr(exc)}
+
+    if args.out:
+        out = _p.Path(args.out)
+        with open(out, "w") as f:
+            json.dump({"config": "config12_strategies", "result": record}, f,
+                      indent=1)
+        log(f"wrote {out}")
+
+    # one stdout JSON line, curves elided (they live in --out)
+    emit({
+        "metric": "strategy_spread_certified",
+        "value": record["n_certified"],
+        "unit": "combos",
+        "n_entries": record["n_entries"],
+        "ok": record["ok"],
+        "certified_strategies": record["certified_strategies"],
+        "certified_topologies": record["certified_topologies"],
+        "pipeline_steady_state_ok": (
+            record["pipeline_steady_state"]["certified"]
+            if record["pipeline_steady_state"] is not None
+            else None  # matrix had no pipelined entry (single-combo mode)
+        ),
+        "default_spec_control": record.get("default_spec_control"),
+        "wall_seconds": record["wall_seconds"],
+    })
+    if not record["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

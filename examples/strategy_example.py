@@ -1,0 +1,63 @@
+"""Dissemination strategy zoo (r13): compare spread curves on two topologies.
+
+Runs one rumor to full coverage at N=512 under three strategies on the
+expander overlay and two on the ring, printing each curve (coverage per
+tick) plus its certified theory bound — the log-vs-linear gap between
+the topologies and the deterministic schedules' tight constants are the
+point of the exercise.
+
+    JAX_PLATFORMS=cpu python examples/strategy_example.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalecube_cluster_tpu.dissemination import DissemSpec
+from scalecube_cluster_tpu.dissemination.certify import (
+    certify_spread,
+    measure_spread,
+)
+
+N = 512
+COMBOS = [
+    ("push", "expander"),
+    ("push_pull", "expander"),
+    ("accelerated", "expander"),
+    ("push", "ring"),
+    ("accelerated", "ring"),
+]
+
+
+def sparkline(curve, width: int = 48) -> str:
+    blocks = " ▁▂▃▄▅▆▇█"
+    if len(curve) > width:
+        stride = -(-len(curve) // width)
+        curve = curve[::stride]
+    return "".join(blocks[min(int(c * (len(blocks) - 1)), len(blocks) - 1)]
+                   for c in curve)
+
+
+def main() -> None:
+    print(f"rumor spread at N={N}, fanout 3, zero loss (1 seed each):\n")
+    for strategy, topology in COMBOS:
+        spec = DissemSpec(strategy=strategy, topology=topology)
+        rec = certify_spread(measure_spread(spec, n=N, seeds=(0,)))
+        t = rec["spread_ticks"][0]
+        shown = "inc." if t is None else f"{t:>4}"  # None = never full
+        mark = "OK " if rec["certified"] else "VIOLATION"
+        lower = (f", >= {rec['lower_bound_ticks']} (certified linear)"
+                 if rec["lower_bound_ticks"] else "")
+        print(f"{strategy:>12} x {topology:<9} {shown} ticks  "
+              f"<= bound {rec['bound_ticks']}{lower}  [{mark}]")
+        print(f"{'':>12}   {sparkline(rec['coverage_curves'][0])}\n")
+    print("expander spreads in O(log N) rounds; the ring is a linear")
+    print("wavefront — and the accelerated doubling schedule hits its")
+    print("deterministic bound with almost no slack on both.")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ from .compile_cache import (
 from .config import (
     ChaosConfig,
     ClusterConfig,
+    DisseminationConfig,
     FailureDetectorConfig,
     GossipConfig,
     MembershipConfig,
@@ -35,6 +36,7 @@ from .version import __version__
 __all__ = [
     "ChaosConfig",
     "ClusterConfig",
+    "DisseminationConfig",
     "FailureDetectorConfig",
     "GossipConfig",
     "MembershipConfig",

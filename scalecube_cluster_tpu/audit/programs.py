@@ -266,7 +266,9 @@ def build_engine_programs(
     eng = engine_api.engine(engine_name)
     contracts = eng.contracts
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
-    want = set(variants) if variants else {"unarmed", "traced", "telemetry", "sharded"}
+    want = set(variants) if variants else {
+        "unarmed", "traced", "telemetry", "sharded", "strategy",
+    }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
 
@@ -310,6 +312,29 @@ def build_engine_programs(
             programs.extend(_telemetry_programs(
                 eng, params, abs_state, key_abs, capacity, n_ticks, contracts
             ))
+
+        if kd == dtypes[0] and "strategy" in want:
+            # r13: every registered non-default (strategy x topology)
+            # window enters the matrix under the SAME contracts — the
+            # dissemination spec changes the traced program, never the
+            # state shape, so the abstract args are shared
+            from ..dissemination import DissemSpec
+
+            for strat, topo in contracts.strategy_variants:
+                sp = dataclasses.replace(
+                    params, dissem=DissemSpec(strategy=strat, topology=topo)
+                )
+                programs.append(AuditProgram(
+                    name=f"{engine_name}/{kd}/strategy-{strat}-{topo}",
+                    engine=engine_name, variant="strategy", key_dtype=kd,
+                    capacity=capacity, n_ticks=n_ticks,
+                    fn=eng.make_run(sp, n_ticks),
+                    abstract_args=(abs_state, key_abs),
+                    donated_argnums=(0,),
+                    contracts=contracts,
+                    budget_basis_bytes=state_bytes,
+                    wide_threshold=capacity,
+                ))
 
         if "sharded" in want and eng.supports_mesh and eng.state_shardings:
             programs.append(_sharded_program(

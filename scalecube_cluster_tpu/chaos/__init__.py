@@ -29,7 +29,25 @@ from .engine import (
     StateTimeline,
     run_driver_scenario,
 )
-from .sentinels import SentinelSpec, build_spec, init_sentinel_state, sentinel_report
+from .sentinels import (
+    SentinelSpec,
+    build_spec,
+    dissemination_budget_scale,
+    init_sentinel_state,
+    sentinel_report,
+)
+
+
+def spread_certifier(*args, **kwargs):
+    """r13 spread-time certification sweep (re-exported from
+    :mod:`..dissemination.certify`): measures each strategy's rumor
+    spread-time distribution per topology, checks it against the cited
+    theory bound, and — given ``bus=`` a telemetry bus — publishes the
+    verdicts onto the same ordered event stream the scenario events ride."""
+    from ..dissemination.certify import spread_certifier as _sc
+
+    return _sc(*args, **kwargs)
+
 
 __all__ = [
     "Partition",
@@ -45,6 +63,8 @@ __all__ = [
     "run_driver_scenario",
     "SentinelSpec",
     "build_spec",
+    "dissemination_budget_scale",
     "init_sentinel_state",
     "sentinel_report",
+    "spread_certifier",
 ]

@@ -57,8 +57,40 @@ def default_converge_budget(params) -> int:
     (nodes that must learn of their own premature death via a periodic
     seed-SYNC and refute — benchmarks/config4_partition.py budgets 8 sync
     intervals for the same reason), plus the detection slack for any death
-    rumors still in flight at the heal."""
-    return 8 * params.sync_every + default_detect_budget(params)
+    rumors still in flight at the heal. The raw budget is scaled by the
+    armed dissemination strategy/topology (r13,
+    :func:`dissemination_budget_scale`)."""
+    raw = 8 * params.sync_every + default_detect_budget(params)
+    return max(1, int(round(raw * dissemination_budget_scale(params))))
+
+
+#: r13 strategy-aware re-convergence scaling. Deterministic schedules
+#: (pipelined/accelerated) TIGHTEN the budget: their chord rotation
+#: guarantees every overlay edge is exercised within one rotation, so the
+#: gossip-driven share of re-convergence loses its coupon-collector tail.
+#: Constrained topologies LOOSEN it by their diameter class (ring linear,
+#: torus 2-D), and a WAN-delayed geo overlay loosens further with the
+#: configured cross-zone delay (every inter-zone anti-entropy exchange
+#: pays the delay both ways).
+_STRATEGY_SCALE = {
+    "push": 1.0, "push_pull": 1.0, "pipelined": 0.75, "accelerated": 0.75,
+}
+_TOPOLOGY_SCALE = {
+    "full": 1.0, "expander": 1.0, "ring": 1.5, "torus": 1.25, "geo": 2.0,
+}
+
+
+def dissemination_budget_scale(params) -> float:
+    """Multiplier the auto re-convergence budget applies for the armed
+    dissemination spec (1.0 for the default push/full and for params
+    objects that predate the spec)."""
+    spec = getattr(params, "dissem", None)
+    if spec is None or spec.is_default:
+        return 1.0
+    scale = _STRATEGY_SCALE[spec.strategy] * _TOPOLOGY_SCALE[spec.topology]
+    if spec.topology == "geo" and spec.geo_wan_delay_ticks:
+        scale *= 1.0 + spec.geo_wan_delay_ticks / 64.0
+    return scale
 
 
 @dataclass

@@ -180,6 +180,32 @@ class SimConfig:
 
 
 @dataclass(frozen=True)
+class DisseminationConfig:
+    """Dissemination strategy-zoo knobs (r13; no reference analogue — the
+    reference ships uniform-random push only, ``GossipProtocolImpl``).
+
+    ``strategy`` selects the gossip phase's peer-selection + payload
+    policy (``push`` / ``push_pull`` / ``pipelined`` / ``accelerated``)
+    and ``topology`` the overlay the fanout peers are drawn from
+    (``full`` / ``ring`` / ``torus`` / ``expander`` / ``geo``) — see
+    ``dissemination/spec.py`` for the catalog and docs/DISSEMINATION.md
+    for the certified-bound table. The defaults reproduce the legacy
+    program byte-for-byte. FD probes and SYNC anti-entropy always keep
+    the reference's uniform semantics."""
+
+    strategy: str = "push"
+    topology: str = "full"
+    degree: int = 0  # expander/geo chord budget (0 = auto ceil_log2)
+    torus_rows: int = 0  # 0 = auto (largest divisor <= sqrt(N))
+    geo_zones: int = 4
+    geo_wan_delay_ticks: int = 0  # mean cross-zone delay, in ticks
+    pipeline_budget: int = 1  # pipelined: rumor slots per message
+
+    def replace(self, **kw) -> "DisseminationConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Chaos scenario-engine knobs (new; no reference analogue — the sim's
     fault-injection + invariant-sentinel subsystem, see ``chaos/``).
@@ -271,6 +297,7 @@ class ClusterConfig:
     membership: MembershipConfig = field(default_factory=MembershipConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
     sim: SimConfig = field(default_factory=SimConfig)
+    dissemination: DisseminationConfig = field(default_factory=DisseminationConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
@@ -326,6 +353,9 @@ class ClusterConfig:
     def with_sim(self, op: Lens) -> "ClusterConfig":
         return replace(self, sim=op(self.sim))
 
+    def with_dissemination(self, op: Lens) -> "ClusterConfig":
+        return replace(self, dissemination=op(self.dissemination))
+
     def with_chaos(self, op: Lens) -> "ClusterConfig":
         return replace(self, chaos=op(self.chaos))
 
@@ -370,6 +400,11 @@ class ClusterConfig:
                 "need 0 < sim.active_slots < sim.view_slots (the pview "
                 "passive reservoir must be non-empty)"
             )
+        # the spec dataclass owns strategy/topology validation (one
+        # spelling for config- and params-level construction)
+        from .dissemination.spec import DissemSpec
+
+        DissemSpec.from_config(self)
         if self.chaos.check_interval_ticks <= 0:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):

@@ -1,0 +1,22 @@
+"""Dissemination strategy zoo (r13): pluggable gossip strategies,
+topology-aware circulant adjacency, and certified spread-time curves.
+
+See :mod:`.spec` for the strategy/topology catalog, :mod:`.strategies`
+for the engine seam, :mod:`.topology` for the chord generators, and
+:mod:`.certify` for the theory-vs-measured certification harness
+(``spread_certifier``). docs/DISSEMINATION.md is the narrative."""
+
+from .spec import DEFAULT, STRATEGIES, TOPOLOGIES, DissemSpec  # noqa: F401
+from . import strategies, topology  # noqa: F401
+
+
+def __getattr__(name):
+    # certify pulls in the engines; keep the package import light for the
+    # params modules that only need the spec
+    if name in ("certify", "spread_certifier", "measure_spread", "theory_bound"):
+        from . import certify as _c
+
+        if name == "certify":
+            return _c
+        return getattr(_c, name)
+    raise AttributeError(name)

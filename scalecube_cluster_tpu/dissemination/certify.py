@@ -1,0 +1,442 @@
+"""Spread-time certification harness (r13): theory vs measured curves.
+
+For every (strategy x topology) the harness measures the rumor spread-time
+distribution — inject one user rumor into a warm, loss-free cluster and
+count ticks until EVERY up member is infected, across seeds — and checks
+the worst measured time against a closed-form bound derived from the
+cited result with explicit engineering constants:
+
+==============  ==========  =======================================  ==========================
+strategy        topology    bound (ticks; L=ceil_log2 N, F=fanout)   source of the asymptotic
+==============  ==========  =======================================  ==========================
+push            full        3L + 8                                   Pittel '87 (log2 N + ln N + o(log N)); via arXiv:1311.2839 §1
+push_pull       full        3L + 8 (and <= push's measured median)   Karp et al. FOCS'00 push-pull O(log N); via arXiv:1504.03277 §1
+push            expander    4L + 8                                   conductance-bounded spreading (arXiv:1311.2839 refs)
+push_pull       expander    4L + 8                                   same
+push            ring        N  (and >= (N/2)/(2F): certified LINEAR) wavefront diameter argument (the comparative baseline)
+push            torus       3(r + c) + 8                             2-D wavefront diameter
+push            geo         4*ceil_log2(zs) + 2Z(1+W) + 16           intra-zone spreading + Z WAN hops of delay W
+accelerated     any         deterministic schedule bound, below      doubling-chord schedule (arXiv:1311.2839 randomness-efficient spreading; structure-exploiting iteration in the spirit of arXiv:1805.08531)
+pipelined       any         accelerated bound * ceil(R/B) + R + 8    budget-rotation stretch; steady-state rate per arXiv:1504.03277
+==============  ==========  =======================================  ==========================
+
+Deterministic-schedule bound D(T): ring ceil(N / min(F, 2)) + 4 (each
+tick extends the interval by one per scheduled direction); torus
+ceil(4 / min(F, 4)) * (r + c) + 8; doubling chord sets (full / expander
+/ geo-local) 4 * ceil(C / F) + 8 — two full rotations apply the
+ascending chords in order from any cyclic start, doubling the infected
+interval per chord; geo adds Z * (1 + W) + 8 for the inter-zone ring.
+
+These are ENGINEERING bounds: the asymptotic shape comes from the cited
+theory, the constants are chosen with explicit safety margin and are
+part of the recorded artifact — a regression that breaks a strategy's
+scaling class (say, turns expander push linear) fails the check long
+before the constant matters. Measurements run the FULL SWIM tick (FD,
+suspicion, SYNC all live) at zero link loss, so the curve is the
+strategy's, not an idealization's: user rumors spread ONLY through the
+gossip phase (SYNC anti-entropy carries membership records, not rumor
+infections), which is exactly why the spread time isolates the
+dissemination strategy.
+
+``spread_certifier`` is the chaos/telemetry-facing entry point: it runs
+a matrix of specs, optionally publishing per-entry certification events
+onto a telemetry bus, and returns the artifact record
+``benchmarks/config12_strategies.py`` writes to STRATEGY_BENCH_r13.json.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import topology as topo
+from .spec import DissemSpec
+
+# ONE ceil_log2 spelling with the topology generators (true ceiling —
+# ceil_log2(256) = 8): the bound formulas and the chord-set caps must
+# agree on what "log2 N" means or the recorded formula strings lie
+_ceil_log2 = topo._ceil_log2
+
+
+def det_schedule_bound(spec: DissemSpec, n: int, fanout: int) -> int:
+    """Deterministic rotation bound D(T) for the accelerated schedule."""
+    if spec.topology == "ring":
+        return -(-n // min(fanout, 2)) + 4
+    if spec.topology == "torus":
+        r, c = topo.torus_dims(spec, n)
+        return -(-4 // min(fanout, 4)) * (r + c) + 8
+    ch = topo.chords(spec, n)
+    base = 4 * -(-len(ch) // fanout) + 8
+    if spec.topology == "geo":
+        base += spec.geo_zones * (1 + spec.geo_wan_delay_ticks) + 8
+    return base
+
+
+def theory_bound(
+    spec: DissemSpec, n: int, fanout: int, rumor_slots: int = 8
+) -> dict:
+    """Closed-form spread-time bound for one (strategy, topology) at size
+    ``n`` — see the module-docstring table. Returns ``{bound_ticks,
+    lower_bound_ticks, formula, citation}`` (``lower_bound_ticks`` is 0
+    except where the certification also asserts slowness — the ring's
+    linear-diameter class)."""
+    L = _ceil_log2(n)
+    s, t = spec.strategy, spec.topology
+    lower = 0
+    if s == "accelerated":
+        bound = det_schedule_bound(spec, n, fanout)
+        formula = "det_schedule_bound(T)"
+        citation = "arXiv:1311.2839 (doubling schedule); arXiv:1805.08531 (structure-exploiting iteration)"
+    elif s == "pipelined":
+        stretch = -(-rumor_slots // min(spec.pipeline_budget, rumor_slots))
+        bound = det_schedule_bound(spec, n, fanout) * stretch + rumor_slots + 8
+        formula = f"det_schedule_bound(T) * ceil(R/B)={stretch} + R + 8"
+        citation = "arXiv:1504.03277 (pipelined gossiping)"
+    elif t in ("full", "expander"):
+        c = 3 if t == "full" else 4
+        bound = c * L + 8
+        formula = f"{c}*ceil_log2(N) + 8"
+        citation = (
+            "Pittel '87 via arXiv:1311.2839"
+            if t == "full"
+            else "conductance-bounded spreading, arXiv:1311.2839 refs"
+        )
+        if s == "push_pull":
+            citation = "Karp et al. FOCS'00 push-pull; " + citation
+    elif t == "ring":
+        bound = n
+        lower = (n // 2) // (2 * fanout)
+        formula = "N (upper); (N/2)/(2F) (lower: certified linear)"
+        citation = "wavefront diameter argument"
+    elif t == "torus":
+        r, c = topo.torus_dims(spec, n)
+        bound = 3 * (r + c) + 8
+        formula = "3*(rows + cols) + 8"
+        citation = "2-D wavefront diameter"
+    else:  # geo
+        zs = topo.zone_size(spec, n)
+        Z, W = spec.geo_zones, spec.geo_wan_delay_ticks
+        bound = 4 * _ceil_log2(zs) + 2 * Z * (1 + W) + 16
+        formula = "4*ceil_log2(zone) + 2*Z*(1+W) + 16"
+        citation = "intra-zone spreading + inter-zone delay ring"
+    return {
+        "bound_ticks": int(bound),
+        "lower_bound_ticks": int(lower),
+        "formula": formula,
+        "citation": citation,
+    }
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def _dense_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
+                  window: int):
+    import jax
+
+    from ..ops import state as S
+    from ..ops.kernel import make_run
+    from ..ops.state import SimParams
+
+    delay_slots = 0
+    if spec.topology == "geo" and spec.geo_wan_delay_ticks > 0:
+        delay_slots = min(2 * spec.geo_wan_delay_ticks + 2, 8)
+    params = SimParams(
+        capacity=n, fanout=fanout, repeat_mult=3, ping_req_k=2, fd_every=5,
+        sync_every=64, suspicion_mult=5, rumor_slots=rumor_slots,
+        seed_rows=(0,), full_metrics=False, dissem=spec,
+        delay_slots=delay_slots,
+    )
+    step = make_run(params, window)
+
+    def fresh(origin: int):
+        st = S.init_state(params, n, warm=True)
+        st = topo.apply_geo_wan_delay(st, spec, S, n)
+        return S.spread_rumor(st, 0, origin=origin)
+
+    def inject(st, slot: int, origin: int):
+        return S.spread_rumor(st, slot, origin=origin)
+
+    return params, step, fresh, inject, jax
+
+
+def _pview_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
+                  window: int):
+    import jax
+
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    if spec.topology == "geo" and spec.geo_wan_delay_ticks > 0:
+        raise ValueError(
+            "the pview engine has no per-link delay plane — certify geo "
+            "WAN delay on the dense engine"
+        )
+    params = PV.PviewParams(
+        capacity=n, fanout=fanout, repeat_mult=3, ping_req_k=2, fd_every=5,
+        sync_every=64, suspicion_mult=5, rumor_slots=rumor_slots,
+        seed_rows=(0,), dissem=spec,
+    )
+    step = PV.make_pview_run(params, window)
+
+    def fresh(origin: int):
+        st = PV.init_pview_state(params, n, warm=True)
+        return PV.spread_rumor(st, 0, origin=origin)
+
+    def inject(st, slot: int, origin: int):
+        return PV.spread_rumor(st, slot, origin=origin)
+
+    return params, step, fresh, inject, jax
+
+
+_RUNNERS = {"dense": _dense_runner, "pview": _pview_runner}
+
+
+def measure_spread(
+    spec: DissemSpec,
+    n: int = 256,
+    engine: str = "dense",
+    seeds: Sequence[int] = (0, 1, 2, 3, 4),
+    fanout: int = 3,
+    rumor_slots: int = 8,
+    max_ticks: Optional[int] = None,
+    window: int = 32,
+) -> dict:
+    """Measure the single-rumor spread-time distribution of one spec:
+    ticks from injection to 100% up-member coverage, per seed (seed
+    varies both the origin row and the PRNG chain). Returns the raw
+    measurement record; ``None`` in ``spread_ticks`` marks a seed that
+    never reached full coverage within ``max_ticks``."""
+    bound = theory_bound(spec, n, fanout, rumor_slots)
+    if max_ticks is None:
+        max_ticks = 4 * bound["bound_ticks"] + 4 * window
+    params, step, fresh, _inject, jax = _RUNNERS[engine](
+        spec, n, fanout, rumor_slots, window
+    )
+    ticks: list = []
+    curves: list = []
+    for seed in seeds:
+        st = fresh(origin=(seed * 37 + 1) % n)
+        key = jax.random.PRNGKey(1000 + seed)
+        cov_curve: list = []
+        hit = None
+        for w0 in range(0, max_ticks, window):
+            st, key, ms, _w = step(st, key)
+            cov = np.asarray(ms["rumor_coverage"])[:, 0]
+            cov_curve.extend(float(c) for c in cov)
+            full = np.nonzero(cov >= 1.0)[0]
+            if full.size:
+                hit = w0 + int(full[0]) + 1
+                break
+        ticks.append(hit)
+        if len(cov_curve) > 512:  # artifact size: stride long curves
+            stride = -(-len(cov_curve) // 512)
+            cov_curve = cov_curve[::stride]
+        curves.append([round(c, 4) for c in cov_curve])
+    del step  # drop the compiled window before the next spec compiles
+    good = [t for t in ticks if t is not None]
+    return {
+        "strategy": spec.strategy,
+        "topology": spec.topology,
+        "engine": engine,
+        "n": n,
+        "fanout": fanout,
+        "rumor_slots": rumor_slots,
+        "seeds": list(seeds),
+        "spread_ticks": ticks,
+        "spread_ticks_median": float(np.median(good)) if good else None,
+        "spread_ticks_max": max(good) if good else None,
+        "coverage_curves": curves,
+        **{k: v for k, v in bound.items()},
+    }
+
+
+def certify_spread(record: dict) -> dict:
+    """Fold the bound check into a measurement record: every seed must
+    reach full coverage, the worst seed must beat ``bound_ticks``, and a
+    nonzero ``lower_bound_ticks`` (the ring's linear class) must also be
+    EXCEEDED by the best seed — certifying the topology is genuinely
+    slow, which is the curve's comparative content."""
+    ticks = record["spread_ticks"]
+    ok = all(t is not None for t in ticks)
+    if ok:
+        ok = max(ticks) <= record["bound_ticks"]
+        if record["lower_bound_ticks"]:
+            ok = ok and min(ticks) >= record["lower_bound_ticks"]
+    return {**record, "certified": bool(ok)}
+
+
+def measure_pipeline_steady_state(
+    spec: DissemSpec,
+    n: int = 256,
+    n_rumors: int = 4,
+    seeds: Sequence[int] = (0,),
+    fanout: int = 3,
+    rumor_slots: int = 8,
+    window: int = 32,
+) -> dict:
+    """The pipelined strategy's multi-rumor claim (arXiv:1504.03277):
+    ``n_rumors`` rumors injected TOGETHER must each individually meet the
+    stretched single-rumor bound — concurrent rumors share the budget
+    rotation as a pipeline instead of multiplying each other's completion
+    time. Records per-rumor completions + the pipelining overhead (last
+    vs first completion)."""
+    assert spec.strategy == "pipelined"
+    bound = theory_bound(spec, n, fanout, rumor_slots)["bound_ticks"]
+    max_ticks = 4 * bound + 4 * window
+    params, step, fresh, inject, jax = _RUNNERS["dense"](
+        spec, n, fanout, rumor_slots, window
+    )
+    runs = []
+    for seed in seeds:
+        st = fresh(origin=(seed * 37 + 1) % n)
+        for k in range(1, n_rumors):
+            st = inject(st, k, origin=(seed * 37 + 1 + k * 11) % n)
+        key = jax.random.PRNGKey(2000 + seed)
+        done = [None] * n_rumors
+        for w0 in range(0, max_ticks, window):
+            st, key, ms, _w = step(st, key)
+            cov = np.asarray(ms["rumor_coverage"])[:, :n_rumors]
+            for k in range(n_rumors):
+                if done[k] is None:
+                    full = np.nonzero(cov[:, k] >= 1.0)[0]
+                    if full.size:
+                        done[k] = w0 + int(full[0]) + 1
+            if all(d is not None for d in done):
+                break
+        runs.append(done)
+    del step
+    flat = [d for run in runs for d in run]
+    ok = all(d is not None and d <= bound for d in flat)
+    return {
+        "strategy": spec.strategy,
+        "topology": spec.topology,
+        "n": n,
+        "n_rumors": n_rumors,
+        "completions": runs,
+        "single_rumor_bound_ticks": bound,
+        "pipelining_overhead_ticks": (
+            max(d for d in flat) - min(d for d in flat)
+            if flat and all(d is not None for d in flat)
+            else None
+        ),
+        "certified": bool(ok),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the chaos/telemetry-facing entry point
+# ---------------------------------------------------------------------------
+
+#: the default certification matrix (>= 3 strategies x >= 3 topologies,
+#: the r13 acceptance floor, plus the comparative extras)
+DEFAULT_MATRIX = (
+    ("push", "full", "dense"),
+    ("push", "ring", "dense"),
+    ("push", "torus", "dense"),
+    ("push", "expander", "dense"),
+    ("push", "geo", "dense"),
+    ("push_pull", "full", "dense"),
+    ("push_pull", "expander", "dense"),
+    ("pipelined", "ring", "dense"),
+    ("pipelined", "expander", "dense"),
+    ("pipelined", "full", "dense"),
+    ("accelerated", "ring", "dense"),
+    ("accelerated", "torus", "dense"),
+    ("accelerated", "expander", "dense"),
+    ("push", "expander", "pview"),
+    ("accelerated", "expander", "pview"),
+)
+
+
+def spread_certifier(
+    matrix=None,
+    n: int = 256,
+    seeds: Sequence[int] = (0, 1, 2, 3, 4),
+    fanout: int = 3,
+    rumor_slots: int = 8,
+    geo_wan_delay_ticks: int = 2,
+    pipeline_budget: int = 2,
+    bus=None,
+    log=None,
+) -> dict:
+    """Run the certification matrix and return the r13 artifact record.
+
+    ``bus`` (a ``telemetry.TelemetryBus``) receives one
+    ``spread_certified`` event per entry — the chaos/telemetry
+    integration: a certification sweep armed next to a live driver's
+    plane leaves its verdicts on the same ordered stream the scenario
+    events ride. ``log`` is an optional ``print``-like progress sink."""
+    entries = []
+    matrix = tuple(matrix or DEFAULT_MATRIX)
+    for strat, topol, engine in matrix:
+        spec = DissemSpec(
+            strategy=strat,
+            topology=topol,
+            geo_wan_delay_ticks=geo_wan_delay_ticks if topol == "geo" else 0,
+            pipeline_budget=pipeline_budget,
+        )
+        rec = certify_spread(
+            measure_spread(
+                spec, n=n, engine=engine, seeds=seeds, fanout=fanout,
+                rumor_slots=rumor_slots,
+            )
+        )
+        entries.append(rec)
+        if log:
+            log(
+                f"{engine}/{strat}/{topol}: spread {rec['spread_ticks']} "
+                f"<= bound {rec['bound_ticks']} "
+                f"{'OK' if rec['certified'] else 'VIOLATION'}"
+            )
+        if bus is not None:
+            bus.publish(
+                "dissemination", "spread_certified",
+                strategy=strat, topology=topol, engine=engine,
+                certified=rec["certified"],
+                spread_ticks_max=rec["spread_ticks_max"],
+                bound_ticks=rec["bound_ticks"],
+            )
+    # the steady-state claim belongs to the pipelined strategy: it runs
+    # (and gates the verdict) only when the matrix certifies pipelined —
+    # a single-combo run of another strategy must not pay for it nor
+    # fail on it
+    pipeline = None
+    if any(strat == "pipelined" for strat, _t, _e in matrix):
+        pipeline = measure_pipeline_steady_state(
+            DissemSpec(strategy="pipelined", topology="expander",
+                       pipeline_budget=pipeline_budget),
+            n=n, seeds=tuple(seeds)[:1], fanout=fanout,
+            rumor_slots=rumor_slots,
+        )
+        if log:
+            log(
+                f"pipelined steady-state: completions "
+                f"{pipeline['completions']} "
+                f"<= {pipeline['single_rumor_bound_ticks']} "
+                f"{'OK' if pipeline['certified'] else 'VIOLATION'}"
+            )
+        if bus is not None:
+            bus.publish(
+                "dissemination", "pipeline_steady_state",
+                certified=pipeline["certified"],
+                overhead=pipeline["pipelining_overhead_ticks"],
+            )
+    strategies = sorted({e["strategy"] for e in entries if e["certified"]})
+    topologies = sorted({e["topology"] for e in entries if e["certified"]})
+    return {
+        "n": n,
+        "seeds": list(seeds),
+        "fanout": fanout,
+        "rumor_slots": rumor_slots,
+        "entries": entries,
+        "pipeline_steady_state": pipeline,
+        "certified_strategies": strategies,
+        "certified_topologies": topologies,
+        "n_certified": sum(1 for e in entries if e["certified"]),
+        "n_entries": len(entries),
+        "ok": all(e["certified"] for e in entries)
+        and (pipeline is None or pipeline["certified"]),
+    }

@@ -1,0 +1,119 @@
+"""Strategy resolution helpers the three gossip phases share (r13).
+
+The seam is deliberately tiny: a strategy is (a) a PEER-SELECTION rule —
+which ``fanout`` targets each sender contacts this tick — plus (b) a
+PAYLOAD-BUDGET rule — which user-rumor slots ride the message — plus (c)
+an optional PULL REPLY leg. Everything is elementwise integer/f32 math
+computable under ``xp=jnp`` (the kernels) and ``xp=np`` (the scalar
+oracles) with bit-identical results, which is what keeps every (engine ×
+strategy) window in oracle lockstep.
+
+Deviations from the cited papers, stated once:
+
+* **DZ-1 (overlay vs view).** On a structured topology, sends are gated
+  on the PHYSICAL liveness of both endpoints (``up[src] & up[dst]`` —
+  the same edge gate as always) but NOT on the sender's membership view
+  of the target: the overlay is configured wiring, and a member does not
+  stop using a static link because it currently suspects the neighbor.
+  Membership semantics are unaffected — every record still enters
+  through the same monotone merge gates.
+* **DZ-2 (pull replies ride the contact).** A ``push_pull`` reply is
+  sent by a peer that a payload-bearing message REACHED this tick
+  (undelayed contacts only) and lands immediately: the reply shares the
+  round trip the push established, like the reference's request/response
+  exchanges. Its delivery draw is an independent hashed uniform on the
+  reverse link (``SALT_PULL`` family, ops/rand.py).
+* **DZ-3 (budget throttles user rumors only).** The pipelined budget
+  rotates over USER-rumor slots; membership records (failure-detection
+  plumbing) are never throttled — safety traffic is not subject to the
+  bandwidth experiment.
+* **DZ-4 (duplicate chords).** When ``fanout`` exceeds the chord count,
+  deterministic schedules revisit chords within a tick (distinct edge
+  draws, idempotent merges) rather than refusing the configuration.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.rand import SALT_PULL, SALT_PULL_STRIDE
+from . import topology
+
+
+def pull_salt(s: int) -> int:
+    """Per-fanout-slot salt of the pull-reply delivery draw (slots must
+    not share draws; see the salt-spacing rule in ops/rand.py)."""
+    return SALT_PULL + s * SALT_PULL_STRIDE
+
+
+def structured_peers(spec, n: int, tick, u_sel, xp=jnp):
+    """Closed-form circulant peer selection: ``peers [N, F] i32`` +
+    ``valid [N, F]`` (always true — DZ-1/DZ-4). ``u_sel`` is the [N, F]
+    uniform block the engine's sampler would have consumed (only the
+    random strategies read it; the deterministic schedules ignore it, but
+    the draw stream is generated either way so arming a strategy never
+    perturbs the other phases' randomness)."""
+    ch = topology.chords(spec, n)
+    C = len(ch)
+    F = u_sel.shape[1]
+    rows = xp.arange(n, dtype=xp.int32)
+    ch_arr = xp.asarray(np.asarray(ch, np.int32))
+    cols = []
+    for s in range(F):
+        if spec.strategy in ("push", "push_pull"):
+            ci = xp.minimum(
+                (u_sel[:, s] * np.float32(C)).astype(xp.int32), C - 1
+            )
+        elif spec.strategy == "pipelined":
+            ci = (tick * F + s) % C
+        else:  # accelerated — the doubling walk
+            ci = (tick + s) % C
+        cols.append((rows + ch_arr[ci]) % n)
+    peers = xp.stack(cols, 1).astype(xp.int32)
+    valid = xp.ones((n, F), bool)
+    return peers, valid
+
+
+def structured_peer_row(spec, n: int, tick: int, i: int, u_row):
+    """Scalar-oracle mirror of :func:`structured_peers` for one sender row
+    — identical f32 trunc-multiply and modular arithmetic."""
+    ch = topology.chords(spec, n)
+    C = len(ch)
+    F = len(u_row)
+    peers = np.zeros(F, np.int32)
+    for s in range(F):
+        if spec.strategy in ("push", "push_pull"):
+            ci = min(int(np.float32(u_row[s]) * np.float32(C)), C - 1)
+        elif spec.strategy == "pipelined":
+            ci = (tick * F + s) % C
+        else:
+            ci = (tick + s) % C
+        peers[s] = (i + ch[ci]) % n
+    return peers, np.ones(F, bool)
+
+
+def try_stride_uniforms(u_try, tries: int):
+    """The [N, F] uniform block a rejection-sampling engine (sparse/pview)
+    hands to the random structured selection: the FIRST try column of each
+    pick (one uniform per pick, the rest of the try block unread)."""
+    return u_try[:, ::tries]
+
+
+def rumor_budget_mask(spec, n_slots: int, tick, xp=jnp):
+    """Pipelined payload budget: the [R] bool window of user-rumor slots a
+    message may carry this tick (rotating, ``pipeline_budget`` wide), or
+    ``None`` for the unthrottled strategies (DZ-3)."""
+    if spec.strategy != "pipelined":
+        return None
+    b = min(spec.pipeline_budget, n_slots)
+    idx = xp.arange(n_slots, dtype=xp.int32)
+    return ((idx - tick) % n_slots) < b
+
+
+def budget_ok(spec, slot: int, tick: int, n_slots: int) -> bool:
+    """Scalar-oracle mirror of :func:`rumor_budget_mask` for one slot."""
+    if spec.strategy != "pipelined":
+        return True
+    b = min(spec.pipeline_budget, n_slots)
+    return ((slot - tick) % n_slots) < b

@@ -82,6 +82,13 @@ class EngineContracts:
       must satisfy the r6 ``jnp.array(copy=True)`` rule (no zero-copy
       host alias ever enters donatable state); checked by the AST lint.
     * ``key_dtypes`` — the key layouts the audit matrix covers.
+    * ``strategy_variants`` — (strategy, topology) pairs (r13): the
+      non-default dissemination specs whose window programs enter the
+      audit matrix alongside the default push/full program, so every
+      shipped (engine x strategy) window proves the same donation /
+      transfer-freeness / materialization / memory contracts. State
+      shapes are spec-independent (circulant adjacency is closed-form),
+      so the variants share the engine's abstract state.
     """
 
     donation_alias: bool = True
@@ -92,6 +99,7 @@ class EngineContracts:
     memory_overhead_mib: float = 2.0
     restore_module: Optional[str] = None
     key_dtypes: tuple = ("i32",)
+    strategy_variants: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,6 +223,12 @@ def _dense_engine() -> EngineOps:
             memory_factor=2.5,
             restore_module="scalecube_cluster_tpu.ops.state",
             key_dtypes=("i32", "i16"),
+            # r13: push_pull gathers the contacted peers' piggyback rows —
+            # the heaviest non-default strategy program — plus one
+            # deterministic-schedule representative
+            strategy_variants=(
+                ("push_pull", "expander"), ("accelerated", "ring"),
+            ),
         ),
         state_shardings=_shardings,
     )
@@ -269,6 +283,7 @@ def _sparse_engine() -> EngineOps:
         contracts=EngineContracts(
             memory_factor=5.0,
             restore_module="scalecube_cluster_tpu.ops.sparse",
+            strategy_variants=(("pipelined", "expander"),),
         ),
         state_shardings=_shardings,
     )
@@ -315,6 +330,12 @@ def _pview_engine() -> EngineOps:
             memory_factor=4.5,
             restore_module="scalecube_cluster_tpu.ops.pview",
             key_dtypes=("i32", "i16"),
+            # r13: the closed-form circulant selection must keep the
+            # no-[N, N]-anywhere guarantee — forbid_wide_values is proved
+            # over the strategy windows too
+            strategy_variants=(
+                ("accelerated", "expander"), ("push_pull", "ring"),
+            ),
         ),
     )
 

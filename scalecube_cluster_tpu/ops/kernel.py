@@ -61,6 +61,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..dissemination import strategies as dz
 from . import bitplane as bp
 from .lattice import (
     RANK_ALIVE,
@@ -509,6 +510,15 @@ def _gossip_phase(
         & state.rumor_active[None, :]
         & (state.tick - state.infected_at < spread[:, None])
     )  # [N, R]
+    # Dissemination strategy seam (r13): the default spec traces exactly
+    # the legacy program below; ``bmask`` is the pipelined strategy's
+    # rotating payload window (DZ-3: user rumors only) and ``rumor_pay``
+    # the slots a message may carry. ``rumor_young`` itself stays
+    # unbudgeted — the quiet gate must see out-of-window rumors as pending
+    # work for a later rotation.
+    spec = params.dissem
+    bmask = dz.rumor_budget_mask(spec, R, state.tick)
+    rumor_pay = rumor_young if bmask is None else rumor_young & bmask[None, :]
     # A node only sends a GOSSIP_REQ when it has something to put in it — the
     # reference sends nothing when selectGossipsToSend comes back empty
     # (doSpreadGossip:141-184 iterates selected gossips). So (a) message
@@ -530,7 +540,14 @@ def _gossip_phase(
         gossip_work = gossip_work | (arriving_key > NOC).any() | arriving_inf.any()
 
     def _deliver(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
-        if _packed(params):
+        if not spec.uniform_selection:
+            # structured topology / deterministic schedule: closed-form
+            # circulant targets (DZ-1: sends gate on up[src] & up[dst],
+            # not on the sender's view of the neighbor)
+            peers, peer_valid = dz.structured_peers(
+                spec, n, state.tick, r.gossip_sel
+            )
+        elif _packed(params):
             peers, peer_valid = _sample_distinct_words(
                 bp.word_andnot(klw, bp.diag_words(n)), n, r.gossip_sel
             )
@@ -570,7 +587,7 @@ def _gossip_phase(
             # message counts inside the ClusterMath per-node bound's
             # constant instead of fanout-times it.
             payload_r = (
-                rumor_young
+                rumor_pay
                 & (state.infected_from != p[:, None])
                 & (state.rumor_origin[None, :] != p[:, None])
             )
@@ -613,6 +630,29 @@ def _gossip_phase(
             now_r = send_r & ok_now[:, None]
             recv_inf = recv_inf.at[p].max(now_r)
             recv_src = recv_src.at[p].max(jnp.where(now_r, rows[:, None], -1))
+            if spec.wants_pull:
+                # push-pull reply (DZ-2): the contacted peer answers the
+                # SAME undelayed contact with ITS young records + rumors,
+                # gated on one hashed reverse-link delivery draw. The
+                # reply merges into the same cellwise scatter-max join,
+                # so ordering against the forward deliveries is moot.
+                rev_u = fetch_uniform(state.tick, dz.pull_salt(s), rows, p)
+                rev_ok = ok_now & (rev_u < (1.0 - _loss_at(state, p, rows)))
+                buf = jnp.maximum(
+                    buf, jnp.where(rev_ok[:, None], piggyback[p], NOC)
+                )
+                reply_r = (
+                    rumor_pay[p]
+                    & (state.infected_from[p] != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                    & rev_ok[:, None]
+                )
+                recv_inf = recv_inf | reply_r
+                recv_src = jnp.maximum(
+                    recv_src, jnp.where(reply_r, p[:, None], -1)
+                )
+                sent = sent + rev_ok.sum()
+                rumor_sent = rumor_sent + reply_r.sum()
 
         own = state.view_key
         accept = (

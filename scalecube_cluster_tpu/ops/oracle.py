@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..dissemination import strategies as dz
 from . import bitplane
 from .lattice import (
     ALIVE,
@@ -273,11 +274,41 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 if arr_inf[i, ru]:
                     recv_inf[i, ru] = True
                     recv_src[i, ru] = max(recv_src[i, ru], int(arr_src[i, ru]))
+    spec = params.dissem
+    R = params.rumor_slots
+
+    def _young_row(pr, a: int, spread_a: int):
+        """Sender ``a``'s sendable user-rumor slots toward peer ``b`` is a
+        per-peer filter; this is the peer-independent part (+ the r13
+        pipelined budget window, DZ-3)."""
+        return [
+            ru
+            for ru in range(R)
+            if pr.infected[a, ru]
+            and pr.r_active[ru]
+            and t - pr.infected_at[a, ru] < spread_a
+            and dz.budget_ok(spec, ru, t, R)
+        ]
+
     for i in range(n):
         if not pre.up[i]:
             continue
         spread = params.repeat_mult * _ceil_log2(_cluster_size(pre, i))
-        peers, valid = _sample_distinct_row(_live_mask(pre, i), r["gossip_sel"][i])
+        if spec.uniform_selection:
+            peers, valid = _sample_distinct_row(
+                _live_mask(pre, i), r["gossip_sel"][i]
+            )
+        else:
+            peers, valid = dz.structured_peer_row(
+                spec, n, t, i, r["gossip_sel"][i]
+            )
+        young_rumors_i = _young_row(pre, i, spread)
+        # loop-invariant half of the kernel's has_payload gate (the pull
+        # reply's eligibility) — hoisted out of the fanout loop
+        young_any_i = spec.wants_pull and any(
+            pre.key[i, j] >= 0 and t - pre.changed[i, j] < spread
+            for j in range(n)
+        )
         for s in range(f):
             if not valid[s]:
                 continue
@@ -306,25 +337,40 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                         o.pending_key[slot_d, p, j] = max(
                             int(o.pending_key[slot_d, p, j]), int(pre.key[i, j])
                         )
-            for ru in range(params.rumor_slots):
-                if (
-                    pre.infected[i, ru]
-                    and pre.r_active[ru]
-                    and t - pre.infected_at[i, ru] < spread
-                    # known-infected filter: skip the peer that delivered
-                    # this rumor to us, and its origin (kernel._deliver)
-                    and pre.infected_from[i, ru] != p
-                    and pre.r_origin[ru] != p
-                ):
-                    if dd == 0:
-                        recv_inf[p, ru] = True
-                        recv_src[p, ru] = max(recv_src[p, ru], i)
-                    else:
-                        slot_d = (t + dd) % D
-                        o.pending_inf[slot_d, p, ru] = True
-                        o.pending_src[slot_d, p, ru] = max(
-                            int(o.pending_src[slot_d, p, ru]), i
-                        )
+            send_rumors = [
+                ru
+                for ru in young_rumors_i
+                # known-infected filter: skip the peer that delivered
+                # this rumor to us, and its origin (kernel._deliver)
+                if pre.infected_from[i, ru] != p and pre.r_origin[ru] != p
+            ]
+            for ru in send_rumors:
+                if dd == 0:
+                    recv_inf[p, ru] = True
+                    recv_src[p, ru] = max(recv_src[p, ru], i)
+                else:
+                    slot_d = (t + dd) % D
+                    o.pending_inf[slot_d, p, ru] = True
+                    o.pending_src[slot_d, p, ru] = max(
+                        int(o.pending_src[slot_d, p, ru]), i
+                    )
+            if spec.wants_pull and dd == 0:
+                # push-pull reply (kernel DZ-2): fires iff the kernel's
+                # forward ``ok`` fired — i.e. the contact actually carried
+                # payload — and the reverse-link hashed draw survives
+                if not (young_any_i or send_rumors):
+                    continue
+                rev = np.float32(fetch_uniform(t, dz.pull_salt(s), i, p, xp=np))
+                if not rev < (np.float32(1.0) - _loss(pre, p, i)):
+                    continue
+                spread_p = params.repeat_mult * _ceil_log2(_cluster_size(pre, p))
+                for j in range(n):
+                    if pre.key[p, j] >= 0 and t - pre.changed[p, j] < spread_p:
+                        recv_key[i, j] = max(recv_key[i, j], int(pre.key[p, j]))
+                for ru in _young_row(pre, p, spread_p):
+                    if pre.infected_from[p, ru] != i and pre.r_origin[ru] != i:
+                        recv_inf[i, ru] = True
+                        recv_src[i, ru] = max(recv_src[i, ru], p)
     for i in range(n):
         if not pre.up[i]:
             continue

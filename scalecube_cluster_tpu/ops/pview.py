@@ -118,6 +118,8 @@ from .rand import (
     fetch_uniform,
     split_tick_key,
 )
+from ..dissemination import strategies as _dz
+from ..dissemination.spec import DissemSpec
 from .sparse import TELEMETRY_SERIES as _SPARSE_TELEMETRY_SERIES, _alloc_phase, _allocate
 from .state import NEVER, NO_CANDIDATE_I32, delay_mean_to_q
 
@@ -188,6 +190,12 @@ class PviewParams:
     early_free: bool = True
     full_metrics: bool = False
     key_dtype: str = "i32"
+    # Dissemination strategy/topology (r13, dissemination/): the default
+    # spec traces the byte-identical legacy program. Structured topologies
+    # are CLOSED-FORM circulant chords — no [N, N] (nor [N, k] extra)
+    # adjacency state, so the O(N·k) forbid_wide_values contract holds
+    # unchanged for every strategy.
+    dissem: DissemSpec = DissemSpec()
 
     def __post_init__(self):
         if not (0 < self.active_slots < self.view_slots):
@@ -278,6 +286,7 @@ class PviewParams:
                 ),
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
+            dissem=DissemSpec.from_config(config),
         )
 
 
@@ -1181,6 +1190,12 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
             & state.rumor_active[None, :]
             & (state.tick - state.infected_at < spread)
         )
+        # dissemination strategy seam (r13): pipelined budget window over
+        # the USER-rumor payload (DZ-3; the default spec is a no-op)
+        spec = params.dissem
+        bmask = _dz.rumor_budget_mask(spec, young_u.shape[1], state.tick)
+        if bmask is not None:
+            young_u = young_u & bmask[None, :]
 
         def _mr_pre(st: PviewState):
             age = st.minf_age
@@ -1199,10 +1214,19 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
 
         age, ym_p = jax.lax.cond(mr_any, _mr_pre, _mr_pre_skip, state)
         state = state.replace(minf_age=age)
-        _slots, peers, peer_valid = _sample_slots(
-            state, rows, r.gossip_try, F, params.sample_tries,
-            params.active_slots,
-        )
+        if spec.uniform_selection:
+            _slots, peers, peer_valid = _sample_slots(
+                state, rows, r.gossip_try, F, params.sample_tries,
+                params.active_slots,
+            )
+        else:
+            # structured topology / deterministic schedule (DZ-1): closed-
+            # form circulant targets — global member ids, no table lookup
+            # (rumor planes are table-independent), no [N, N] anywhere
+            peers, peer_valid = _dz.structured_peers(
+                spec, n, state.tick,
+                _dz.try_stride_uniforms(r.gossip_try, params.sample_tries),
+            )
 
         yu_p = _pack_bits(young_u)
         Wm, Wu = ym_p.shape[1], yu_p.shape[1]
@@ -1269,6 +1293,35 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
             recv_m_p,
         )
         rumor_sent = deliver_u_all.sum()
+        if spec.wants_pull:
+            # push-pull reply (DZ-2): each sender whose undelayed contact
+            # landed pulls the peer's payload back over the same round
+            # trip — a per-slot row gather (one target per sender per
+            # slot, so no inverse index), one hashed reverse-link draw
+            for s in range(F):
+                p_s = p_all[s]
+                rev_u = fetch_uniform(state.tick, _dz.pull_salt(s), rows, p_s)
+                rev_ok = ok_now_all[s] & (
+                    rev_u < (1.0 - _loss_at(state, p_s, rows))
+                )
+                pl_rev = payload[p_s]
+                yu_rev = _unpack_bits(pl_rev[:, Wm : Wm + Wu], R)
+                from_rev = pl_rev[:, Wm + Wu :].astype(jnp.int32)
+                reply_u = (
+                    yu_rev
+                    & rev_ok[:, None]
+                    & (from_rev != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                recv_u = recv_u | reply_u
+                recv_src = jnp.maximum(
+                    recv_src, jnp.where(reply_u, p_s[:, None], -1)
+                )
+                recv_m_p = recv_m_p | jnp.where(
+                    rev_ok[:, None], pl_rev[:, :Wm], jnp.uint32(0)
+                )
+                sent = sent + rev_ok.sum()
+                rumor_sent = rumor_sent + reply_u.sum()
         if D:
             no_sender = jnp.full((n,), -1, jnp.int32)
             for s in range(F):

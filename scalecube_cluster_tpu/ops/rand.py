@@ -72,6 +72,13 @@ class TickRandoms(NamedTuple):
 SALT_GOSSIP = 0x40000000
 SALT_SYNC_REQ = 0x80000000
 SALT_SYNC_ACK = 0xC0000000
+# Pull-reply delivery draws (r13 push-pull strategy): one salt per fanout
+# slot — SALT_PULL + s * SALT_PULL_STRIDE for slot s. The stride (2^25)
+# keeps slots' draws row-independent below 2^25 members per the shift rule
+# above, and the whole family [0x20000000, 0x30000000) stays at least 2^28
+# away from the merge-site salts for any fanout <= 8.
+SALT_PULL = 0x20000000
+SALT_PULL_STRIDE = 0x02000000
 
 
 def fetch_uniform(tick, salt: int, i, j, xp=jnp):

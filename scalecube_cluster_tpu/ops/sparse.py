@@ -125,6 +125,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..dissemination import strategies as _dz
+from ..dissemination.spec import DissemSpec
 from .kernel import TELEMETRY_SERIES as _CORE_TELEMETRY_SERIES, ceil_log2
 from .lattice import (
     ALIVE,
@@ -290,6 +292,7 @@ class SparseParams:
                 ),
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
+            dissem=DissemSpec.from_config(config),
         )
 
     # hierarchical-namespace relatedness gate on every merge accept
@@ -297,6 +300,10 @@ class SparseParams:
     # when False. Unrelated records never enter a view, so peer selection
     # (drawn from the view) needs no extra gating.
     namespace_gate: bool = False
+    # Dissemination strategy/topology (r13, dissemination/): the default
+    # spec traces the byte-identical legacy program; non-default specs swap
+    # only the gossip phase's peer selection / payload policy.
+    dissem: DissemSpec = DissemSpec()
 
 
 class SparseState(struct.PyTreeNode):
@@ -1216,6 +1223,12 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             & state.rumor_active[None, :]
             & (state.tick - state.infected_at < spread[:, None])
         )
+        # dissemination strategy seam (r13): pipelined budget window over
+        # the USER-rumor payload (DZ-3; the default spec is a no-op)
+        spec = params.dissem
+        bmask = _dz.rumor_budget_mask(spec, young_u.shape[1], state.tick)
+        if bmask is not None:
+            young_u = young_u & bmask[None, :]
 
         # ALL [N, M] work is gated on the pool being non-empty: a pure
         # user-rumor dissemination (or any membership-quiet stretch) skips
@@ -1242,9 +1255,18 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
 
         age, ym_p = jax.lax.cond(mr_any, _mr_pre, _mr_pre_skip, state)
         state = state.replace(minf_age=age)
-        peers, peer_valid = _sample_rejection(
-            state, rows, r.gossip_try, params.fanout, params.sample_tries
-        )
+        if spec.uniform_selection:
+            peers, peer_valid = _sample_rejection(
+                state, rows, r.gossip_try, params.fanout, params.sample_tries
+            )
+        else:
+            # structured topology / deterministic schedule (DZ-1): closed-
+            # form circulant targets; the random strategies consume the
+            # first try column of each pick's rejection block
+            peers, peer_valid = _dz.structured_peers(
+                spec, n, state.tick,
+                _dz.try_stride_uniforms(r.gossip_try, params.sample_tries),
+            )
 
         # ONE combined per-sender payload row [packed-M | packed-R | from]:
         # row-gathers cost per ROW on TPU (~independent of row width), so the
@@ -1335,6 +1357,36 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             recv_m_p,
         )
         rumor_sent = deliver_u_all.sum()
+        if spec.wants_pull:
+            # push-pull reply (DZ-2): every sender whose undelayed contact
+            # landed pulls the peer's payload back over the same round
+            # trip — a per-slot row gather (each sender has exactly one
+            # target per slot, so no inverse index is needed), gated on
+            # one hashed reverse-link draw per contact
+            for s in range(F):
+                p_s = p_all[s]
+                rev_u = fetch_uniform(state.tick, _dz.pull_salt(s), rows, p_s)
+                rev_ok = ok_now_all[s] & (
+                    rev_u < (1.0 - _loss_at(state, p_s, rows))
+                )
+                pl_rev = payload[p_s]
+                yu_rev = _unpack_bits(pl_rev[:, Wm : Wm + Wu], R)
+                from_rev = pl_rev[:, Wm + Wu :].astype(jnp.int32)
+                reply_u = (
+                    yu_rev
+                    & rev_ok[:, None]
+                    & (from_rev != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                recv_u = recv_u | reply_u
+                recv_src = jnp.maximum(
+                    recv_src, jnp.where(reply_u, p_s[:, None], -1)
+                )
+                recv_m_p = recv_m_p | jnp.where(
+                    rev_ok[:, None], pl_rev[:, :Wm], jnp.uint32(0)
+                )
+                sent = sent + rev_ok.sum()
+                rumor_sent = rumor_sent + reply_u.sum()
         if D:
             # late deliveries stay per-slot (delay runs are small-N
             # fidelity configurations; the rings force per-slot scatters)

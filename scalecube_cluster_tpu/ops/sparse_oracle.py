@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..dissemination import strategies as _dz
 from .lattice import RANK_ALIVE, RANK_DEAD, RANK_LEAVING, RANK_SUSPECT
 from .rand import (
     SALT_GOSSIP,
@@ -261,15 +262,23 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         young_m = np.zeros((n, M), bool)
         peers_all = np.zeros((n, f), np.int32)
         valid_all = np.zeros((n, f), bool)
+        spec = params.dissem
         for i in range(n):
-            peers_all[i], valid_all[i] = _pick_rejection(
-                pre, i, r["gossip_try"][i], f, T
-            )
+            if spec.uniform_selection:
+                peers_all[i], valid_all[i] = _pick_rejection(
+                    pre, i, r["gossip_try"][i], f, T
+                )
+            else:
+                peers_all[i], valid_all[i] = _dz.structured_peer_row(
+                    spec, n, t, i, r["gossip_try"][i][::T]
+                )
             for ru in range(R):
                 young_u[i, ru] = (
                     pre.infected[i, ru]
                     and pre.rumor_active[ru]
                     and t - int(pre.infected_at[i, ru]) < spread[i]
+                    # r13 pipelined payload budget (DZ-3)
+                    and _dz.budget_ok(spec, ru, t, R)
                 )
             for m in range(M):
                 young_m[i, m] = (
@@ -307,6 +316,25 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     inv_now[p] = max(inv_now[p], j)
                 else:
                     inv_late[p] = max(inv_late[p], j)
+                if spec.wants_pull and dd == 0:
+                    # push-pull reply (sparse.py DZ-2 mirror): the peer the
+                    # undelayed contact reached answers with ITS payload,
+                    # gated on the reverse-link hashed draw
+                    rev = np.float32(
+                        fetch_uniform(t, _dz.pull_salt(s), j, p, xp=np)
+                    )
+                    if rev < (np.float32(1.0) - _loss(pre, p, j)):
+                        for ru in range(R):
+                            if (
+                                young_u[p, ru]
+                                and int(pre.infected_from[p, ru]) != j
+                                and int(pre.rumor_origin[ru]) != j
+                            ):
+                                recv_u[j, ru] = True
+                                recv_src[j, ru] = max(int(recv_src[j, ru]), p)
+                        for m in range(M):
+                            if young_m[p, m] and int(pre.mr_origin[m]) != j:
+                                recv_m[j, m] = True
             for i in range(n):  # receivers
                 j = int(inv_now[i])
                 if j >= 0:

@@ -24,6 +24,7 @@ import numpy as np
 from flax import struct
 
 from ..config import ClusterConfig
+from ..dissemination.spec import DissemSpec
 from . import bitplane
 from .lattice import (
     ALIVE,
@@ -118,6 +119,12 @@ class SimParams:
     # in tests/test_bitplane_engine.py pin it). Config spelling:
     # ClusterConfig.sim.plane_dtype.
     key_dtype: str = "i32"
+    # Dissemination strategy/topology (r13, dissemination/): the default
+    # spec traces the byte-identical legacy program; non-default specs swap
+    # ONLY the gossip phase's peer selection / payload policy (FD and SYNC
+    # keep the reference's uniform semantics). Config spelling:
+    # ClusterConfig.dissemination.
+    dissem: DissemSpec = DissemSpec()
 
     @staticmethod
     def from_config(
@@ -162,6 +169,7 @@ class SimParams:
                 ),
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
+            dissem=DissemSpec.from_config(config),
         )
 
 

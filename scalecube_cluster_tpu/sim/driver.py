@@ -1079,6 +1079,38 @@ class SimDriver:
             )
 
     # -- chaos scenarios (fault timelines + invariant sentinels) -------------
+    def set_dissemination(self, spec=None, *, strategy=None, topology=None,
+                          **spec_kw) -> None:
+        """Swap the dissemination strategy/topology (r13) on a live driver.
+
+        Pass a full :class:`..dissemination.DissemSpec`, or field overrides
+        (``strategy=``/``topology=``/any other spec field) applied on top
+        of the current spec. The spec is a STATIC program property: the
+        compiled window cache is invalidated and the next step compiles
+        the strategy-armed windows (the state itself is spec-independent,
+        so no state migration happens and checkpoints stay compatible).
+        A no-op when the requested spec equals the armed one."""
+        import dataclasses as _dc
+
+        from ..dissemination import DissemSpec
+
+        with self._lock:
+            cur = getattr(self.params, "dissem", DissemSpec())
+            if spec is None:
+                overrides = {
+                    k: v
+                    for k, v in dict(
+                        strategy=strategy, topology=topology, **spec_kw
+                    ).items()
+                    if v is not None
+                }
+                spec = _dc.replace(cur, **overrides) if overrides else cur
+            if spec == cur:
+                return
+            self.params = _dc.replace(self.params, dissem=spec)
+            self._step_cache.clear()
+            self._step_stats.clear()
+
     def run_scenario(
         self,
         scenario,
@@ -1087,6 +1119,9 @@ class SimDriver:
         sentinels: bool = True,
         max_window: int = 32,
         trace: bool = False,
+        strategy: str | None = None,
+        topology: str | None = None,
+        dissem=None,
     ) -> dict:
         """Run a :class:`..chaos.Scenario` against this driver: scripted
         fault events applied between windows (partitions, loss storms, link
@@ -1102,9 +1137,17 @@ class SimDriver:
         arming: the scenario's crashed rows become tracer members (an
         already-armed plane is reused as-is), so sentinel violations — and
         successful detections — resolve to sewn probe-miss → suspect →
-        DEAD span trees in the report."""
+        DEAD span trees in the report.
+
+        ``strategy=`` / ``topology=`` / ``dissem=`` (r13) arm a
+        dissemination spec via :meth:`set_dissemination` before the
+        scenario runs; the sentinel budgets are derived strategy-aware
+        (deterministic schedules tighten re-convergence, WAN-delayed geo
+        loosens it — ``chaos.sentinels.dissemination_budget_scale``)."""
         from ..chaos.engine import run_driver_scenario
 
+        if dissem is not None or strategy is not None or topology is not None:
+            self.set_dissemination(dissem, strategy=strategy, topology=topology)
         return run_driver_scenario(
             self, scenario, config=config, sentinels=sentinels,
             max_window=max_window, trace=trace,

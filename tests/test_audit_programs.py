@@ -65,14 +65,23 @@ CAPACITY = 128
 
 @pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
 def test_engine_window_programs_pass_all_contracts(engine):
-    """Unarmed + trace-armed + telemetry device programs, i32, N=128:
-    every applicable contract holds over the traced/lowered/compiled
-    program."""
+    """Unarmed + trace-armed + telemetry device programs + every
+    registered non-default strategy window (r13), i32, N=128: every
+    applicable contract holds over the traced/lowered/compiled program."""
     programs = build_engine_programs(
         engine, capacity=CAPACITY, n_ticks=N_TICKS,
-        key_dtypes=["i32"], variants=["unarmed", "traced", "telemetry"],
+        key_dtypes=["i32"],
+        variants=["unarmed", "traced", "telemetry", "strategy"],
     )
     assert len(programs) >= 3  # window, traced window, telemetry row+append
+    # the r13 acceptance: push (the unarmed default) + at least one
+    # non-default strategy per engine ride the tier-1 fast matrix; the
+    # engines' FULL registered variant sets compile under -m slow /
+    # tools/audit_programs.py --all
+    strategy_programs = [p for p in programs if p.variant == "strategy"]
+    assert strategy_programs
+    programs = [p for p in programs if p.variant != "strategy"]
+    programs += strategy_programs[:1]
     for prog in programs:
         verdict = run_contracts(prog, compile_programs=True)
         for contract, violations in verdict.items():
@@ -167,6 +176,44 @@ def test_seeded_missing_alias_is_caught():
     assert violations, "auditor missed the dropped donation"
     assert any("arg0" in v.message and "donation" in v.message.lower()
                for v in violations)
+
+
+def test_seeded_strategy_builder_dropping_donation_is_caught():
+    """Violation class 1, r13 flavor: a REAL strategy-parameterized window
+    builder (the dense accelerated/ring window) built with donate=False
+    but REGISTERED as donated — the exact shape a refactor of the
+    strategy seam could introduce. The auditor must flag every dropped
+    state leaf, proving the strategy windows sit behind the same gate as
+    the default program."""
+    import dataclasses as _dc
+
+    from scalecube_cluster_tpu.audit.programs import _audit_params, _abstract
+    from scalecube_cluster_tpu.dissemination import DissemSpec
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine("dense")
+    params = _dc.replace(
+        _audit_params("dense", CAPACITY, "i32"),
+        dissem=DissemSpec(strategy="accelerated", topology="ring"),
+    )
+    state = eng.init_state(params, CAPACITY - 4, True, True)
+    abs_state = _abstract(state)
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    fn = eng.make_run(params, N_TICKS, donate=False)  # <- dropped donation
+    prog = _program(
+        "seeded/strategy-dropped-donation", fn, (abs_state, key_abs), (0,),
+        contracts=eng.contracts,
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the strategy builder's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)
+
+    # control: the real donated builder with the same spec audits clean
+    good = _program(
+        "seeded/strategy-donated", eng.make_run(params, N_TICKS),
+        (abs_state, key_abs), (0,), contracts=eng.contracts,
+    )
+    assert check_donation_alias(good) == []
 
 
 def test_seeded_post_donation_read_is_caught():

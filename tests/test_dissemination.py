@@ -1,0 +1,595 @@
+"""r13 dissemination strategy zoo: lockstep + certification + integration.
+
+The contract the tentpole must keep (ISSUE 9 acceptance):
+
+1. Every shipped (engine x strategy) window is BIT-EXACT against its
+   strategy-aware scalar oracle — per strategy, at N in {33, 256}, dense
+   and pview, wide i32 and narrow i16 key layouts (the sparse engine's
+   strategy seam is covered by its own lockstep here too).
+2. The default spec traces the byte-identical legacy program (the whole
+   pre-r13 suite is the regression gate; here we pin the spec-level
+   switches).
+3. Topology generators are connected circulants; the pipelined budget
+   window rotates; config-level validation routes through the one spec
+   spelling.
+4. Dense and pview agree as convergence oracles UNDER A NON-DEFAULT
+   strategy (same up set, same detections, live edges ALIVE).
+5. A strategy-armed driver keeps the r6-r10 discipline: armed
+   (telemetry + trace) bit-identical to unarmed, step() transfer-free
+   under the numpy-asarray spy.
+6. Chaos: Partition + heal runs all-sentinels-green under a non-default
+   strategy with the STRATEGY-AWARE (tightened) re-convergence budget.
+7. The certification harness's bounds hold on a live measurement and
+   its verdict logic is falsifiable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from functools import partial
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.pview as PV
+import scalecube_cluster_tpu.ops.pview_oracle as PO
+import scalecube_cluster_tpu.ops.sparse as SP
+import scalecube_cluster_tpu.ops.sparse_oracle as SO
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.config import ClusterConfig, TelemetryConfig
+from scalecube_cluster_tpu.dissemination import (
+    DissemSpec,
+    strategies as dz,
+    topology as topo,
+)
+from scalecube_cluster_tpu.sim import SimDriver
+
+#: one representative per strategy, on a non-trivial topology each
+STRATEGY_SPECS = [
+    DissemSpec(strategy="push", topology="expander"),
+    DissemSpec(strategy="push_pull", topology="expander"),
+    DissemSpec(strategy="pipelined", topology="ring", pipeline_budget=2),
+    DissemSpec(strategy="accelerated", topology="torus", torus_rows=3),
+]
+_IDS = [f"{s.strategy}-{s.topology}" for s in STRATEGY_SPECS]
+
+
+# ---------------------------------------------------------------------------
+# 1. spec + topology units
+# ---------------------------------------------------------------------------
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown strategy"):
+        DissemSpec(strategy="flood")
+    with pytest.raises(ValueError, match="unknown topology"):
+        DissemSpec(topology="hypercube")
+    with pytest.raises(ValueError, match="pipeline_budget"):
+        DissemSpec(pipeline_budget=0)
+    assert DissemSpec().is_default
+    assert not DissemSpec(topology="ring").is_default
+    assert DissemSpec(strategy="push_pull").uniform_selection
+    assert not DissemSpec(strategy="pipelined").uniform_selection
+
+
+def test_config_routes_through_spec():
+    cfg = ClusterConfig.default_sim().with_dissemination(
+        lambda d: d.replace(strategy="accelerated", topology="expander")
+    )
+    cfg.validate()
+    p = S.SimParams.from_config(cfg, capacity=64)
+    assert p.dissem == DissemSpec(strategy="accelerated", topology="expander")
+    assert SP.SparseParams.from_config(cfg, capacity=64).dissem == p.dissem
+    assert PV.PviewParams.from_config(cfg, capacity=64).dissem == p.dissem
+    bad = cfg.with_dissemination(lambda d: d.replace(strategy="flood"))
+    with pytest.raises(ValueError, match="unknown strategy"):
+        bad.validate()
+
+
+@pytest.mark.parametrize("topology", ["ring", "torus", "expander", "geo"])
+@pytest.mark.parametrize("n", [33 * 4, 64, 256])
+def test_topology_chords_connected(topology, n):
+    """Chord sets are ascending, in-range, and generate Z_n (the overlay
+    reaches every member)."""
+    spec = DissemSpec(strategy="accelerated", topology=topology)
+    ch = topo.chords(spec, n)
+    assert list(ch) == sorted(set(ch))
+    assert all(0 < c < n for c in ch)
+    assert topo.connectivity_ok(spec, n)
+
+
+def test_full_topology_has_no_chords_for_uniform():
+    with pytest.raises(ValueError, match="no chord set"):
+        topo.chords(DissemSpec(), 64)
+
+
+def test_budget_mask_rotates_and_matches_scalar():
+    spec = DissemSpec(strategy="pipelined", pipeline_budget=3)
+    seen = set()
+    for t in range(8):
+        m = dz.rumor_budget_mask(spec, 8, t, xp=np)
+        assert m.sum() == 3
+        assert [dz.budget_ok(spec, r, t, 8) for r in range(8)] == list(m)
+        seen.update(np.nonzero(m)[0].tolist())
+    assert seen == set(range(8))  # every slot gets wire time each rotation
+    assert dz.rumor_budget_mask(DissemSpec(), 8, 0) is None
+
+
+def test_structured_peers_jnp_np_and_scalar_agree():
+    n = 24  # divisible by the torus spec's rows and the geo zones
+    rng = np.random.default_rng(0)
+    u = rng.random((n, 3), np.float32)
+    for spec in STRATEGY_SPECS + [DissemSpec(strategy="push", topology="geo")]:
+        if spec.uniform_selection:
+            continue
+        pj, _ = dz.structured_peers(spec, n, 7, jnp.asarray(u))
+        pn, _ = dz.structured_peers(spec, n, 7, u, xp=np)
+        assert (np.asarray(pj) == pn).all(), spec
+        for i in range(n):
+            pr, _ = dz.structured_peer_row(spec, n, 7, i, u[i])
+            assert (pr == pn[i]).all(), (spec, i)
+
+
+# ---------------------------------------------------------------------------
+# 2. per-strategy oracle lockstep — dense
+# ---------------------------------------------------------------------------
+
+
+def _dense_params(n, spec, key_dtype="i32", **kw):
+    base = dict(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=6, seed_rows=(0,),
+        key_dtype=key_dtype, dissem=spec,
+    )
+    base.update(kw)
+    return S.SimParams(**base)
+
+
+def _dense_lockstep(params, n0, seed, ticks):
+    n = params.capacity
+    step = jax.jit(partial(K.tick, params=params))
+    st = S.init_state(params, n0, warm=True)
+    rng = np.random.default_rng(seed)
+    loss = rng.integers(0, 16, size=(n, n)).astype(np.float32) / 64.0  # exact f32
+    lj = jnp.asarray(loss)
+    st = st.replace(loss=lj, fetch_rt=S._roundtrip(lj))
+    key = jax.random.PRNGKey(100 + seed)
+    for t in range(ticks):
+        if t == 1:
+            st = S.spread_rumor(st, 0, origin=3)
+        if t == 3:
+            st = S.crash_row(st, 7)
+        if t == 7:
+            st = S.spread_rumor(st, 1, origin=12)
+        if t == 12:
+            st = S.join_row(st, n0, seed_rows=[0])
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        oracle = O.oracle_tick(st, k, params)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
+    return st
+
+
+@pytest.mark.parametrize("spec", STRATEGY_SPECS, ids=_IDS)
+def test_dense_lockstep_n33(spec):
+    _dense_lockstep(_dense_params(33, spec), 30, seed=3, ticks=16)
+
+
+def test_dense_lockstep_n256_pull():
+    """The riskiest strategy program (the push_pull reply leg) stays
+    lockstep at N=256; the remaining strategies' 256-point rides the
+    ``-m slow`` lane (identical harness, tier-1 keeps the N=33 matrix)."""
+    _dense_lockstep(
+        _dense_params(256, DissemSpec(strategy="push_pull", topology="expander")),
+        250, seed=5, ticks=4,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", STRATEGY_SPECS, ids=_IDS)
+def test_dense_lockstep_n256_full_matrix(spec):
+    if spec.topology == "torus":
+        spec = dataclasses.replace(spec, torus_rows=16)
+    _dense_lockstep(_dense_params(256, spec), 250, seed=5, ticks=4)
+    _dense_lockstep(_dense_params(256, spec, key_dtype="i16"), 250, seed=9,
+                    ticks=4)
+
+
+def test_dense_lockstep_narrow_keys():
+    """The i16 bit-plane layout stays strategy-lockstep (N=33 here; the
+    256-point narrow matrix rides the slow lane above)."""
+    _dense_lockstep(
+        _dense_params(33, DissemSpec(strategy="accelerated", topology="expander"),
+                      key_dtype="i16"),
+        30, seed=7, ticks=16,
+    )
+
+
+def test_dense_lockstep_pull_with_delay_ring():
+    """Pull replies ride undelayed contacts only (DZ-2) — exact against
+    the oracle with the delay rings live."""
+    params = _dense_params(
+        33, DissemSpec(strategy="push_pull", topology="expander"),
+        delay_slots=3,
+    )
+    step = jax.jit(partial(K.tick, params=params))
+    st = S.init_state(params, 30, warm=True, uniform_delay=0.8)
+    key = jax.random.PRNGKey(21)
+    for t in range(18):
+        if t == 1:
+            st = S.spread_rumor(st, 0, origin=3)
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        O.assert_equivalent(st_next, O.oracle_tick(st, k, params))
+        st = st_next
+
+
+# ---------------------------------------------------------------------------
+# 3. per-strategy oracle lockstep — pview (and the sparse seam)
+# ---------------------------------------------------------------------------
+
+
+def _pview_params(n, spec, key_dtype="i32", **kw):
+    base = dict(
+        capacity=n, view_slots=10, active_slots=4, fanout=2, repeat_mult=3,
+        ping_req_k=2, fd_every=2, sync_every=5, suspicion_mult=2,
+        sweep_every=2, sample_tries=4, rumor_slots=3, mr_slots=16,
+        announce_slots=8, sync_announce=2, seed_rows=(0, 1), apply_slots=4,
+        key_dtype=key_dtype, dissem=spec,
+    )
+    base.update(kw)
+    return PV.PviewParams(**base)
+
+
+def _pview_lockstep(params, n0, seed, ticks):
+    step = jax.jit(partial(PV.pview_tick, params=params))
+    st = PV.init_pview_state(params, n0, warm=True)
+    key = jax.random.PRNGKey(200 + seed)
+    for t in range(ticks):
+        if t == 1:
+            st = PV.spread_rumor(st, 0, origin=3)
+        if t == 2:
+            st = PV.set_uniform_loss(st, 0.25)
+        if t == 4:
+            st = PV.crash_row(st, 4)
+        if t == 10:
+            st = PV.join_row(st, params.capacity - 1, seed_rows=[0])
+        key, k = jax.random.split(key)
+        st_next, _ = step(st, k)
+        PO.assert_pview_equivalent(st_next, PO.pview_oracle_tick(st, k, params))
+        st = st_next
+    return st
+
+
+@pytest.mark.parametrize("spec", STRATEGY_SPECS, ids=_IDS)
+def test_pview_lockstep_n33(spec):
+    _pview_lockstep(_pview_params(33, spec), 28, seed=3, ticks=14)
+
+
+def test_pview_lockstep_n256_pull():
+    """Pull-leg pview program lockstep at N=256 (fast); the full strategy
+    matrix at 256 rides ``-m slow`` below."""
+    _pview_lockstep(
+        _pview_params(256, DissemSpec(strategy="push_pull", topology="expander"),
+                      mr_slots=32),
+        250, seed=5, ticks=4,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("spec", STRATEGY_SPECS, ids=_IDS)
+def test_pview_lockstep_n256_full_matrix(spec):
+    if spec.topology == "torus":
+        spec = dataclasses.replace(spec, torus_rows=16)
+    _pview_lockstep(_pview_params(256, spec, mr_slots=32), 250, seed=5, ticks=4)
+    _pview_lockstep(
+        _pview_params(256, spec, key_dtype="i16", mr_slots=32), 250, seed=9,
+        ticks=4,
+    )
+
+
+def test_pview_lockstep_narrow_keys():
+    _pview_lockstep(
+        _pview_params(33, DissemSpec(strategy="accelerated", topology="expander"),
+                      key_dtype="i16"),
+        28, seed=7, ticks=14,
+    )
+
+
+def test_sparse_lockstep_strategies():
+    """The sparse engine's strategy seam (selection + budget + pull) is
+    oracle-exact too — one deterministic and one pull config."""
+    for spec in (
+        DissemSpec(strategy="pipelined", topology="ring", pipeline_budget=2),
+        DissemSpec(strategy="push_pull", topology="expander"),
+    ):
+        params = SP.SparseParams(
+            capacity=33, fanout=2, repeat_mult=3, ping_req_k=2, fd_every=2,
+            sync_every=5, suspicion_mult=2, sweep_every=2, sample_tries=4,
+            rumor_slots=3, mr_slots=16, announce_slots=8, sync_announce=2,
+            seed_rows=(0, 1), dissem=spec,
+        )
+        step = jax.jit(partial(SP.sparse_tick, params=params))
+        st = SP.init_sparse_state(params, 28, warm=True, dense_links=False)
+        key = jax.random.PRNGKey(31)
+        for t in range(12):
+            if t == 1:
+                st = SP.spread_rumor(st, 0, origin=3)
+            if t == 2:
+                st = SP.set_uniform_loss(st, 0.25)
+            if t == 4:
+                st = SP.crash_row(st, 4)
+            key, k = jax.random.split(key)
+            st_next, _ = step(st, k)
+            SO.assert_sparse_equivalent(st_next, SO.sparse_oracle_tick(st, k, params))
+            st = st_next
+
+
+# ---------------------------------------------------------------------------
+# 4. dense vs pview convergence oracle under a non-default strategy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_dense_vs_pview_convergence_oracle_under_strategy():
+    """Seeded Crash + Partition + heal on BOTH engines, both armed with
+    accelerated/expander: each re-converges under its own (tightened)
+    sentinel budget and the decoded steady-state membership verdicts
+    agree — the r11 convergence-oracle gate holds off the default
+    strategy path too."""
+    from scalecube_cluster_tpu.chaos import Crash, Partition, Scenario
+    from scalecube_cluster_tpu.ops.lattice import RANK_ALIVE, RANK_DEAD, key_status
+
+    n = 64
+    spec = DissemSpec(strategy="accelerated", topology="expander")
+    scn = Scenario(
+        name="conv-oracle-strategy",
+        events=[
+            Crash(rows=[9], at=3),
+            Partition(groups=[range(0, 32), range(32, 64)], at=30, heal_at=80),
+        ],
+        # past every (strategy-tightened) deadline: crash 3+60, heal 80+81
+        horizon=280,
+        check_interval=8,
+    )
+    pv = SimDriver(
+        _pview_params(n, spec, view_slots=12, active_slots=5, fanout=3,
+                      sync_every=6, mr_slots=32, announce_slots=16,
+                      seed_rows=(0, 32), apply_slots=6),
+        n, warm=True, seed=0,
+    )
+    dn = SimDriver(
+        S.SimParams(
+            capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+            sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0, 32),
+            dissem=spec,
+        ),
+        n, warm=True, seed=0,
+    )
+    rep_pv = pv.run_scenario(scn)
+    rep_dn = dn.run_scenario(scn)
+    assert rep_pv["ok"], rep_pv["sentinels"]
+    assert rep_dn["ok"], rep_dn["sentinels"]
+
+    up_pv = np.asarray(pv.state.up)
+    up_dn = np.asarray(dn.state.up)
+    assert (up_pv == up_dn).all()
+    self_pv = np.asarray(pv.state.self_key)
+    diag_dn = np.asarray(jnp.diagonal(dn.state.view_key)).astype(np.int32)
+    assert ((self_pv[up_pv] & 3) == RANK_ALIVE).all()
+    assert (np.asarray(key_status(diag_dn))[up_dn] == 0).all()
+    vk = np.asarray(dn.state.view_key).astype(np.int32)
+    assert ((vk[up_dn, 9] & 3) == RANK_DEAD).all()
+    sid = np.asarray(pv.state.nbr_id)
+    keys = np.asarray(pv.state.nbr_key).astype(np.int32)
+    holds = (sid == 9) & up_pv[:, None] & ((keys & 3) != RANK_DEAD)
+    assert not holds.any()
+
+
+# ---------------------------------------------------------------------------
+# 5. strategy-armed driver: neutrality + transfer-freeness
+# ---------------------------------------------------------------------------
+
+
+def test_strategy_armed_telemetry_trace_neutral_and_transfer_free(monkeypatch):
+    """A pipelined/expander dense driver with telemetry + trace armed:
+    bit-identical to its unarmed twin window for window, and step()
+    performs zero device→host transfers under the numpy-asarray spy —
+    the r8/r10 discipline holds on strategy-armed windows."""
+    params = _dense_params(24, DissemSpec(strategy="pipelined",
+                                          topology="expander",
+                                          pipeline_budget=2))
+    a = SimDriver(params, 20, warm=True, seed=11)
+    b = SimDriver(params, 20, warm=True, seed=11)
+    b.arm_telemetry(TelemetryConfig(ring_len=8))
+    b.arm_trace(tracer_rows=(1, 5), rumor_slots=(0,))
+    for w in range(4):
+        if w == 1:
+            for d in (a, b):
+                d.crash(5)
+                d.spread_rumor(origin=3, payload="p")
+        a.step(3)
+        b.step(3)
+        for f in dataclasses.fields(type(a.state)):
+            x = np.asarray(getattr(a.state, f.name))
+            y = np.asarray(getattr(b.state, f.name))
+            assert np.array_equal(x, y), (
+                f"armed/unarmed divergence in {f.name} at window {w}"
+            )
+    assert b.telemetry.ring.windows == 4
+    assert b.trace.stats()["records"] > 0
+
+    b.sync()
+    real_asarray = np.asarray
+    transfers = []
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            transfers.append(np.shape(obj))
+        return real_asarray(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    try:
+        for _ in range(4):
+            b.step(2)
+    finally:
+        monkeypatch.undo()
+    assert transfers == [], f"strategy-armed step() read back: {transfers}"
+
+
+# ---------------------------------------------------------------------------
+# 6. chaos under a non-default strategy
+# ---------------------------------------------------------------------------
+
+
+def test_budget_scale_per_strategy_and_topology():
+    from scalecube_cluster_tpu.chaos.sentinels import (
+        default_converge_budget,
+        dissemination_budget_scale,
+    )
+
+    p = _dense_params(64, DissemSpec())
+    assert dissemination_budget_scale(p) == 1.0
+    tighten = dataclasses.replace(
+        p, dissem=DissemSpec(strategy="pipelined", topology="expander")
+    )
+    loosen = dataclasses.replace(
+        p, dissem=DissemSpec(strategy="push", topology="geo",
+                             geo_wan_delay_ticks=8)
+    )
+    ring = dataclasses.replace(p, dissem=DissemSpec(topology="ring"))
+    assert dissemination_budget_scale(tighten) == 0.75
+    assert dissemination_budget_scale(loosen) == pytest.approx(2.25)
+    assert dissemination_budget_scale(ring) == 1.5
+    base = default_converge_budget(p)
+    assert default_converge_budget(tighten) < base < default_converge_budget(loosen)
+
+
+def test_chaos_partition_heal_green_under_strategy():
+    """Partition + heal + crash, dense engine, armed via
+    ``run_scenario(strategy=..., topology=...)``: all sentinels green
+    under the TIGHTENED deterministic-schedule budget, and the report's
+    budget reflects the strategy-aware scaling."""
+    from scalecube_cluster_tpu.chaos import Crash, Partition, Scenario
+    from scalecube_cluster_tpu.chaos.sentinels import default_converge_budget
+
+    n = 40
+    d = SimDriver(
+        S.SimParams(
+            capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+            sync_every=6, suspicion_mult=2, rumor_slots=4, seed_rows=(0, 20),
+        ),
+        n, warm=True, seed=0,
+    )
+    scn = Scenario(
+        name="strategy-part-heal",
+        events=[
+            Crash(rows=[5], at=4),
+            Partition(groups=[range(0, 20), range(20, 40)], at=10, heal_at=50),
+        ],
+        check_interval=16,
+    )
+    rep = d.run_scenario(scn, strategy="accelerated", topology="expander")
+    assert d.params.dissem == DissemSpec(strategy="accelerated",
+                                         topology="expander")
+    assert rep["ok"], rep
+    assert rep["violations"] == 0
+    assert all(c["converged_at"] is not None for c in rep["sentinels"]["convergence"])
+    # the armed budget IS the tightened one
+    assert rep["sentinels"]["converge_budget"] == default_converge_budget(d.params)
+    assert (
+        rep["sentinels"]["converge_budget"]
+        < default_converge_budget(
+            dataclasses.replace(d.params, dissem=DissemSpec())
+        )
+    )
+
+
+def test_set_dissemination_swap_and_noop():
+    d = SimDriver(_dense_params(12, DissemSpec()), 10, warm=True, seed=0)
+    d.step(1)
+    assert d._step_cache  # compiled default window
+    d.set_dissemination()  # no-op: cache survives
+    assert d._step_cache
+    d.set_dissemination(strategy="accelerated", topology="ring")
+    assert d.params.dissem.strategy == "accelerated"
+    assert not d._step_cache  # invalidated; next step recompiles
+    d.step(1)
+    assert d._step_cache
+
+
+# ---------------------------------------------------------------------------
+# 7. certification harness
+# ---------------------------------------------------------------------------
+
+
+def test_theory_bound_table_shapes():
+    from scalecube_cluster_tpu.dissemination.certify import theory_bound
+
+    for spec, n in [
+        (DissemSpec(), 256),
+        (DissemSpec(topology="ring"), 256),
+        (DissemSpec(strategy="accelerated", topology="expander"), 256),
+        (DissemSpec(strategy="pipelined", topology="full"), 256),
+        (DissemSpec(strategy="push", topology="geo", geo_wan_delay_ticks=2), 256),
+    ]:
+        b = theory_bound(spec, n, fanout=3)
+        assert b["bound_ticks"] > 0 and b["formula"] and b["citation"]
+    # the ring's linear class certifies slowness from below too
+    ring = theory_bound(DissemSpec(topology="ring"), 256, fanout=3)
+    assert ring["lower_bound_ticks"] > 0
+    # bounds scale with their class: ring linear, expander logarithmic
+    r1k = theory_bound(DissemSpec(topology="ring"), 1024, fanout=3)
+    e1k = theory_bound(
+        DissemSpec(strategy="push", topology="expander"), 1024, fanout=3
+    )
+    assert r1k["bound_ticks"] == 4 * ring["bound_ticks"]
+    assert e1k["bound_ticks"] - 8 <= 2 * theory_bound(
+        DissemSpec(strategy="push", topology="expander"), 256, fanout=3
+    )["bound_ticks"]
+
+
+def test_certify_verdict_is_falsifiable():
+    from scalecube_cluster_tpu.dissemination.certify import certify_spread
+
+    base = {"spread_ticks": [5, 6], "bound_ticks": 10, "lower_bound_ticks": 0}
+    assert certify_spread(dict(base))["certified"]
+    assert not certify_spread(dict(base, spread_ticks=[5, 11]))["certified"]
+    assert not certify_spread(dict(base, spread_ticks=[5, None]))["certified"]
+    # a "fast ring" breaks the certified-linear lower bound
+    assert not certify_spread(
+        dict(base, spread_ticks=[2, 3], lower_bound_ticks=4)
+    )["certified"]
+
+
+def test_spread_certifier_live_entry_and_bus():
+    """One live measured entry (dense accelerated/expander at N=64)
+    certifies against its deterministic bound, and the verdict lands on a
+    telemetry bus — the chaos/telemetry integration seam."""
+    from scalecube_cluster_tpu.dissemination.certify import spread_certifier
+    from scalecube_cluster_tpu.telemetry.bus import TelemetryBus
+
+    bus = TelemetryBus(capacity=64)
+    rec = spread_certifier(
+        matrix=(("accelerated", "expander", "dense"),),
+        n=48, seeds=(0,), bus=bus,
+    )
+    assert rec["ok"], rec["entries"]
+    assert rec["n_certified"] == 1
+    kinds = [r.kind for r in bus.tail()]
+    assert "spread_certified" in kinds
+    # the steady-state check belongs to pipelined matrices only (a
+    # single-combo run of another strategy neither pays nor gates on it)
+    assert "pipeline_steady_state" not in kinds
+    assert rec["pipeline_steady_state"] is None
