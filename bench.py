@@ -330,6 +330,30 @@ def main() -> None:
             cmd.append("--quick")
         raise SystemExit(subprocess.call(cmd))
 
+    # r14: --adaptive runs the adaptive-FD false-positive certification
+    # harness (benchmarks/config13_adaptive.py — adaptive-vs-static
+    # false-DEAD curves under sweeping loss floors) through the same
+    # backend-probe/retry path. Forwards --n/--seeds/--out when present.
+    if "--adaptive" in sys.argv:
+        import os
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [
+            sys.executable,
+            os.path.join(here, "benchmarks", "config13_adaptive.py"),
+        ]
+        for flag in ("--n", "--seeds", "--loss-floors", "--out"):
+            if flag in sys.argv:
+                i = sys.argv.index(flag)
+                if i + 1 < len(sys.argv):
+                    cmd += [flag, sys.argv[i + 1]]
+        if "--out" not in sys.argv:  # default: refresh the standing artifact
+            cmd += ["--out", os.path.join(here, "ADAPTIVE_BENCH_r14.json")]
+        if "--quick" in sys.argv:
+            cmd.append("--quick")
+        raise SystemExit(subprocess.call(cmd))
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

@@ -114,6 +114,19 @@ def _r13(rec):
     )
 
 
+def _r14(rec):
+    # no throughput headline — r14's gate is the false-positive
+    # certification; the trajectory row carries the verdict as its note
+    return None, (
+        f"adaptive-FD certification: adaptive false-DEAD "
+        f"{rec.get('adaptive_false_dead_total')} vs static "
+        f"{rec.get('static_false_dead_total')} over loss floors "
+        f"{rec.get('loss_floors_pct')}%, detections_ok="
+        f"{rec.get('adaptive_detections_ok')}, certified="
+        f"{rec.get('certified')}"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
@@ -122,7 +135,31 @@ ROUND_BENCH_FILES = [
     (10, "TRACE_BENCH_r10.json", _r10),
     (11, "PVIEW_BENCH_r11.json", _r11),
     (13, "STRATEGY_BENCH_r13.json", _r13),
+    (14, "ADAPTIVE_BENCH_r14.json", _r14),
 ]
+
+
+def collect_adaptive_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r14 adaptive-FD certification
+    artifact: the false-DEAD totals of both arms + the verdict."""
+    path = root / "ADAPTIVE_BENCH_r14.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        return {
+            "present": True,
+            "certified": rec.get("certified"),
+            "adaptive_false_dead_total": rec.get("adaptive_false_dead_total"),
+            "static_false_dead_total": rec.get("static_false_dead_total"),
+            "adaptive_detections_ok": rec.get("adaptive_detections_ok"),
+            "loss_floors_pct": rec.get("loss_floors_pct"),
+            "adaptive_knobs": rec.get("adaptive_knobs"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
 
 
 def collect_strategy_summary(root: pathlib.Path) -> dict:
@@ -285,6 +322,11 @@ def main() -> None:
     # still->=3x3 quick subset)
     results += run([py, "benchmarks/config12_strategies.py", "--quick",
                     "--out", "STRATEGY_BENCH_r13.json"], timeout=3000)
+    # r14 adaptive failure detection: false-positive certification under
+    # the loss-adversarial chaos family (adaptive FP=0 where the static
+    # control records >0, true-crash latency within the existing budgets)
+    results += run([py, "benchmarks/config13_adaptive.py", "--quick",
+                    "--out", "ADAPTIVE_BENCH_r14.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -312,6 +354,9 @@ def main() -> None:
         # r13: strategy-zoo certification verdicts (curves live in
         # STRATEGY_BENCH_r13.json, refreshed by the config12 run above)
         "strategy_bench": collect_strategy_summary(ROOT),
+        # r14: adaptive-FD false-positive certification verdict (entries
+        # live in ADAPTIVE_BENCH_r14.json, refreshed by the config13 run)
+        "adaptive_bench": collect_adaptive_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

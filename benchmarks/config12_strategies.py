@@ -47,6 +47,8 @@ QUICK_MATRIX = (
     ("pipelined", "expander", "dense"),
     ("accelerated", "ring", "dense"),
     ("accelerated", "expander", "dense"),
+    # r14 fifth strategy: the robust/tuneable family (arXiv:1506.02288)
+    ("tuneable", "expander", "dense"),
     ("push", "expander", "pview"),
 )
 

@@ -1,0 +1,313 @@
+"""Adaptive failure-detection plane (r14): Lifeguard-style local health +
+confirmation-scaled suspicion.
+
+Static SWIM deployments pick one probe timeout and one suspicion multiplier
+and hope both fit every member forever. Lifeguard (the SWIM extension
+hashicorp/memberlist ships) showed the false-positive rate collapses when
+each member *adapts* those constants to evidence: a member whose own probes
+keep missing (it is slow, lossy, or GC-bound) should trust its own verdicts
+less — stretching the timers it originates — while a suspicion corroborated
+by many independent members can be declared DEAD fast. This module is that
+plane for all three tick engines (dense ``ops/kernel.py``, sparse
+``ops/sparse.py``, pview ``ops/pview.py``), under the repo's r13 discipline:
+
+* **One hashable spec** (:class:`AdaptiveSpec`) rides every engine's static
+  params object as ``params.adaptive``. The DEFAULT spec traces the
+  byte-identical legacy window program — adaptive state, phases, and
+  arithmetic exist only in windows built from an ``enabled=True`` spec
+  (``make_adaptive_run``), so default users cannot pay for any of this.
+* **One state pytree** (:class:`AdaptiveState`), identical across engines —
+  three [N] int32 planes, no [N, N] anywhere (the pview wide-value ban
+  holds over adaptive windows too):
+
+  - ``lh`` — the per-member **local-health score** (Lifeguard's LHA
+    multiplier): saturating counter in ``[0, lh_max]``, +1 per failed own
+    probe round, +1 per self-refutation (someone suspected ME — evidence I
+    look flaky from outside), −1 per acked probe round. A member's own
+    direct-probe timeout and the suspicion sweeps it runs both scale by
+    ``(1 + lh)``.
+  - ``conf_key`` / ``conf`` — the per-subject **suspicion-confirmation
+    episode**: ``conf_key[j]`` is the highest SUSPECT-rank precedence key
+    accepted about ``j`` so far and ``conf[j]`` counts accepted SUSPECT
+    records at (or below) that episode, saturating at ``conf_target``. A
+    higher-key SUSPECT accept supersedes the episode and restarts the
+    count. The suspicion time-to-DEAD interpolates log-scaled from
+    ``max_mult`` (lone accusation) down to ``min_mult`` (fully
+    corroborated) — Lifeguard's timeout schedule in integer math.
+
+* **Bit-exact oracles.** Every formula here is xp-generic (``xp=jnp`` in
+  the kernels, ``xp=np`` in the scalar oracles) pure integer/f32 work with
+  no transcendentals, so each engine's adaptive window stays in FULL-state
+  lockstep with its per-node scalar oracle.
+
+Deviations from the Lifeguard/reference mechanisms, stated once:
+
+* **AD-1 (global confirmation episodes).** Lifeguard counts per-observer
+  suspicion confirmations carried in suspect messages; this repo's records
+  carry no suspector identity, so confirmations are counted globally per
+  SUBJECT — one counter incremented by every accepted SUSPECT record about
+  the subject anywhere (FD verdicts, gossip merges, SYNC merges alike).
+  This is the same modelling move the sparse engine's suspicion episodes
+  (``sus_key``/``sus_since``, its deviation 1) already made for the timer
+  itself. An observer's sweep consults the counter only for cells whose
+  key is within the episode (``cell_key <= conf_key``), so a NEWER
+  suspicion never inherits a stale episode's confirmations.
+* **AD-2 (redelivery ≈ independence).** Without suspector identities, k
+  accepted copies of a SUSPECT record approximate k independent
+  suspectors. Over-counting only *shortens* the window toward
+  ``min_mult`` — never below the static engine's floor when ``min_mult >=
+  suspicion_mult`` (the shipped default).
+* **AD-3 (observer-side scaling).** Lifeguard scales the timers of the
+  member that *originates* a suspicion. Per-cell origin bits would cost a
+  wide plane, so the sweep scales by the OBSERVER's ``(1 + lh)`` — every
+  suspicion a degraded observer is aging, whether it originated it or
+  merely accepted it, ages slowly. Strictly more conservative.
+* **AD-4 (direct leg only).** Only the direct-probe timeout stretches with
+  ``lh`` (``fd_direct_timeout_ticks * (1 + lh)``, capped by ``lh_max``);
+  indirect-probe legs and SYNC keep their static budgets. The indirect
+  path exists precisely to route around the prober's own link, so
+  stretching it would mask exactly the evidence ``lh`` measures. Timeout
+  scaling is live only under the delay model (``params.delay_slots > 0``)
+  — without modelled delay there is no timeout to beat, which the
+  closed-form timeliness factor makes exact (factor 1.0).
+* **AD-5 (refutes are never throttled).** A suspected member's refutation
+  (the ``bump_inc`` incarnation bump) is a MEMBERSHIP record: it rides the
+  gossip stream's unbudgeted class (dissemination deviation DZ-3), so no
+  pipelined/tuneable payload budget can delay the fast path that clears a
+  false suspicion. This was already true; the adaptive plane depends on
+  it, so tests pin it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+#: scatter-max identity shared with the engines' key planes
+NO_CANDIDATE = int(np.iinfo(np.int32).min)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveSpec:
+    """Hashable static adaptive-FD spec (defaults = the legacy program).
+
+    ``enabled=False`` (the default) is the r13 discipline's off switch: the
+    window builders trace the byte-identical legacy program and no adaptive
+    state exists. ``enabled=True`` arms all three mechanisms; the knobs:
+
+    * ``lh_max`` — local-health score ceiling (Lifeguard caps its score;
+      the probe timeout and sweep scale by at most ``1 + lh_max``).
+    * ``min_mult`` / ``max_mult`` — the suspicion-multiplier range the
+      confirmation count interpolates across (legacy uses the single
+      ``params.suspicion_mult``; keep ``min_mult >= suspicion_mult`` to
+      never declare faster than the static engine would have).
+    * ``conf_target`` — confirmations at which the multiplier reaches
+      ``min_mult`` (the count saturates here).
+    """
+
+    enabled: bool = False
+    lh_max: int = 8
+    min_mult: int = 5
+    max_mult: int = 10
+    conf_target: int = 4
+
+    def __post_init__(self):
+        if self.lh_max < 0:
+            raise ValueError("lh_max must be >= 0")
+        if self.min_mult < 1:
+            raise ValueError("min_mult must be >= 1")
+        if self.max_mult < self.min_mult:
+            raise ValueError("max_mult must be >= min_mult")
+        if self.conf_target < 1:
+            raise ValueError("conf_target must be >= 1")
+
+    @property
+    def is_default(self) -> bool:
+        """True iff the spec selects the byte-identical legacy program."""
+        return not self.enabled
+
+    @property
+    def levels(self) -> int:
+        """The log-scale denominator L = bit_length(conf_target) — static."""
+        return max(1, int(self.conf_target).bit_length())
+
+    @staticmethod
+    def from_config(config) -> "AdaptiveSpec":
+        """Map a ``ClusterConfig.adaptive`` block (or an absent one)."""
+        ac = getattr(config, "adaptive", None)
+        if ac is None:
+            return AdaptiveSpec()
+        return AdaptiveSpec(
+            enabled=ac.enabled,
+            lh_max=ac.lh_max,
+            min_mult=ac.min_mult,
+            max_mult=ac.max_mult,
+            conf_target=ac.conf_target,
+        )
+
+
+#: the one shared default instance (``params.adaptive`` default value)
+DEFAULT = AdaptiveSpec()
+
+
+class AdaptiveState(struct.PyTreeNode):
+    """The adaptive plane's device state — identical shape for all three
+    engines: three [N] i32 planes (see the module docstring). Donated
+    alongside the engine state by ``make_adaptive_run``."""
+
+    lh: jax.Array  # i32 [N] — local-health score, in [0, lh_max]
+    conf_key: jax.Array  # i32 [N] — suspicion episode key (NO_CANDIDATE none)
+    conf: jax.Array  # i32 [N] — confirmations, saturated at conf_target
+
+
+def init_adaptive_state(capacity: int) -> AdaptiveState:
+    return AdaptiveState(
+        lh=jnp.zeros((capacity,), jnp.int32),
+        conf_key=jnp.full((capacity,), NO_CANDIDATE, jnp.int32),
+        conf=jnp.zeros((capacity,), jnp.int32),
+    )
+
+
+def adaptive_state_arrays(ad: AdaptiveState) -> dict:
+    """Checkpoint view (host numpy) of the adaptive planes."""
+    return {
+        "_ad_lh": np.asarray(ad.lh),
+        "_ad_conf_key": np.asarray(ad.conf_key),
+        "_ad_conf": np.asarray(ad.conf),
+    }
+
+
+def restore_adaptive_state(arrays: dict) -> AdaptiveState:
+    """Inverse of :func:`adaptive_state_arrays` — ``jnp.array(copy=True)``
+    like every engine restore (the planes are donated; a zero-copy npz
+    alias would be the r6 use-after-free)."""
+    return AdaptiveState(
+        lh=jnp.array(arrays["_ad_lh"], copy=True),
+        conf_key=jnp.array(arrays["_ad_conf_key"], copy=True),
+        conf=jnp.array(arrays["_ad_conf"], copy=True),
+    )
+
+
+# ---------------------------------------------------------------------------
+# shared math (xp-generic: jnp in the kernels, np in the scalar oracles)
+# ---------------------------------------------------------------------------
+
+
+def bit_length(x, xp=jnp):
+    """Elementwise ``int.bit_length`` for small non-negative int arrays —
+    the same compare-and-count spelling as ``kernel.ceil_log2`` (not
+    imported: this module must stay engine-agnostic)."""
+    x = xp.asarray(x).astype(xp.int32)
+    return (
+        (x[..., None] >= (1 << xp.arange(31, dtype=xp.int32)))
+        .sum(-1)
+        .astype(xp.int32)
+    )
+
+
+def conf_mult_num(spec: AdaptiveSpec, conf, xp=jnp):
+    """Numerator of the confirmation-scaled suspicion multiplier, per
+    subject: ``max_mult*L - (max_mult - min_mult) * bit_length(min(conf,
+    K))`` with ``L = bit_length(K)``. The sweep computes ``timeout = base *
+    num * (1 + lh) // L`` — all integer, so kernels and oracles agree
+    bit-for-bit. At conf=0 the multiplier is ``max_mult``; at conf>=K it is
+    exactly ``min_mult`` (``bit_length(K) == L``)."""
+    L = spec.levels
+    c = xp.minimum(xp.asarray(conf).astype(xp.int32), spec.conf_target)
+    return (
+        xp.int32(spec.max_mult * L)
+        - xp.int32(spec.max_mult - spec.min_mult) * bit_length(c, xp=xp)
+    ).astype(xp.int32)
+
+
+def conf_mult_num_scalar(spec: AdaptiveSpec, conf: int) -> int:
+    """Scalar-oracle mirror of :func:`conf_mult_num` for one subject."""
+    L = spec.levels
+    c = min(int(conf), spec.conf_target)
+    return spec.max_mult * L - (spec.max_mult - spec.min_mult) * int(c).bit_length()
+
+
+def fold(
+    spec: AdaptiveSpec,
+    lh,
+    conf_key,
+    conf,
+    *,
+    acc_key,
+    acc_cnt,
+    miss,
+    succ,
+    refuted,
+    up,
+    xp=jnp,
+):
+    """End-of-tick adaptive-state fold — ONE spelling for kernels (xp=jnp)
+    and oracles (xp=np). All of a tick's evidence lands here:
+
+    * ``miss``/``succ`` [N] bool — this tick's own-probe outcome (FD rounds
+      only; both False off-round). ``refuted`` [N] bool — the refute phase
+      fired for the row. lh moves by ``miss + refuted - succ``, clamps to
+      ``[0, lh_max]``, and resets to 0 for down rows (a restarted identity
+      starts healthy).
+    * ``acc_key``/``acc_cnt`` [N] — per-subject max accepted SUSPECT key
+      and total accepted-SUSPECT count across every merge site this tick.
+      A higher key supersedes the episode (count restarts at this tick's
+      arrivals); an equal-or-lower key confirms it. The count saturates at
+      ``conf_target`` (the multiplier is flat beyond it).
+
+    The fold runs on PRE-tick adaptive state: phases read the previous
+    tick's scores, which keeps phase order out of the adaptive semantics
+    and makes the oracle mirror trivial.
+
+    Returns ``(lh', conf_key', conf')``.
+    """
+    i32 = xp.int32
+    lh2 = (
+        xp.asarray(lh).astype(i32)
+        + xp.asarray(miss).astype(i32)
+        + xp.asarray(refuted).astype(i32)
+        - xp.asarray(succ).astype(i32)
+    )
+    lh2 = xp.clip(lh2, 0, spec.lh_max).astype(i32)
+    lh_new = xp.where(xp.asarray(up), lh2, i32(0)).astype(i32)
+    ck = xp.asarray(conf_key).astype(i32)
+    ak = xp.asarray(acc_key).astype(i32)
+    supersede = ak > ck
+    conf_key_new = xp.maximum(ck, ak).astype(i32)
+    base = xp.where(supersede, i32(0), xp.asarray(conf).astype(i32))
+    conf_new = xp.minimum(
+        base + xp.asarray(acc_cnt).astype(i32), spec.conf_target
+    ).astype(i32)
+    return lh_new, conf_key_new, conf_new
+
+
+def scaled_timely_rt(q1, q2, t_base: int, lh, lh_max: int, xp=jnp):
+    """Lifeguard-scaled direct-probe timeliness: the closed-form
+    ``P(round trip <= t_base * (1 + lh))`` under the geometric link-delay
+    model, per row. Runs the SAME f32 convolution recurrence as
+    ``kernel._timely_rt`` out to ``t_base * (1 + lh_max)`` steps, capturing
+    the partial sum at every multiple of ``t_base``; each row selects its
+    own capture. The captured value after ``t`` steps is bit-identical to
+    running the legacy recurrence for ``t`` steps, so the scalar oracle
+    mirrors this with a plain ``_timely(q1, q2, t_base * (1 + lh_i))``."""
+    if t_base <= 0:
+        one = xp.ones_like(q1)
+        return ((1.0 - q1) * (1.0 - q2) * one).astype(xp.float32)
+    h = xp.ones_like(q1)
+    acc = h
+    q2p = xp.ones_like(q2)
+    captures = []
+    for step in range(1, t_base * (1 + lh_max) + 1):
+        q2p = q2p * q2
+        h = q1 * h + q2p
+        acc = acc + h
+        if step % t_base == 0:
+            captures.append(acc)
+    table = xp.stack(captures, 0)  # [1 + lh_max, ...rows]
+    idx = xp.clip(xp.asarray(lh).astype(xp.int32), 0, lh_max)
+    sel = xp.take_along_axis(table, idx[None, ...], axis=0)[0]
+    return ((1.0 - q1) * (1.0 - q2) * sel).astype(xp.float32)

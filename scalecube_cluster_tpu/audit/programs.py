@@ -267,7 +267,7 @@ def build_engine_programs(
     contracts = eng.contracts
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
-        "unarmed", "traced", "telemetry", "sharded", "strategy",
+        "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -335,6 +335,29 @@ def build_engine_programs(
                     budget_basis_bytes=state_bytes,
                     wide_threshold=capacity,
                 ))
+
+        if kd == dtypes[0] and "adaptive" in want and eng.make_adaptive_run:
+            # r14: the adaptive-FD window under the SAME contracts — the
+            # AdaptiveState pytree is donated alongside the engine state
+            # (argnums 0, 1) and joins the budget basis; the spec changes
+            # the traced program, never the engine-state shape
+            from ..adaptive import AdaptiveSpec, init_adaptive_state
+
+            ap = dataclasses.replace(
+                params, adaptive=AdaptiveSpec(enabled=True)
+            )
+            abs_ad = _abstract(init_adaptive_state(capacity))
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/adaptive",
+                engine=engine_name, variant="adaptive", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_adaptive_run(ap, n_ticks),
+                abstract_args=(abs_state, abs_ad, key_abs),
+                donated_argnums=(0, 1),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes + _tree_bytes(abs_ad),
+                wide_threshold=capacity,
+            ))
 
         if "sharded" in want and eng.supports_mesh and eng.state_shardings:
             programs.append(_sharded_program(

@@ -21,7 +21,17 @@ at the sync points (``SimDriver.health_snapshot``, ``GET /chaos``, the final
 scenario report).
 """
 
-from .events import Crash, LinkFlap, LossStorm, Partition, Restart, Scenario
+from .events import (
+    AsymmetricLoss,
+    Crash,
+    FlakyObserver,
+    LinkFlap,
+    LossStorm,
+    Partition,
+    Restart,
+    Scenario,
+    SlowMember,
+)
 from .engine import (
     DriverChaosRunner,
     EmulatorChaosRunner,
@@ -55,6 +65,9 @@ __all__ = [
     "LinkFlap",
     "Crash",
     "Restart",
+    "SlowMember",
+    "AsymmetricLoss",
+    "FlakyObserver",
     "Scenario",
     "ScenarioError",
     "StateTimeline",

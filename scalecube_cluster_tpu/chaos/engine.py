@@ -25,13 +25,16 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .events import (
+    AsymmetricLoss,
     Crash,
+    FlakyObserver,
     LinkFlap,
     LossStorm,
     Partition,
     Restart,
     Scenario,
     ScenarioError,
+    SlowMember,
 )
 from .sentinels import build_spec, sentinel_report
 
@@ -47,10 +50,74 @@ class _Step:
     payload: tuple
 
 
+def _window(ev, end_attr: str):
+    end = getattr(ev, end_attr, None)
+    return ev.at, (float("inf") if end is None else end)
+
+
+def _validate_degraded_composition(scenario: Scenario) -> None:
+    """The r14 degraded family's start/end handlers WRITE the loss/delay
+    planes they touch; compositions whose teardown would clobber another
+    active event's links are refused LOUDLY here (both runners route
+    through :func:`schedule`) instead of silently mis-modelling:
+
+    * two ``SlowMember`` events overlapping in time — each covers every
+      link touching its cohort, so the earlier ``until`` zeroes delay on
+      the cross-cohort links the later event still owns;
+    * overlapping ``AsymmetricLoss``/``FlakyObserver`` events with
+      intersecting cohorts — the shared links' loss is last-writer-wins;
+    * a degraded event overlapping an active ``Partition`` or ``LinkFlap``
+      window — the degraded writes would overwrite (and its teardown
+      lift) the block plane on shared links. ``LossStorm`` composes on the
+      device engines (the storm stash replays loss mutations) and is
+      checked separately by the emulator runner, whose single
+      default-settings slot cannot stash.
+    """
+    from .events import DEGRADED_EVENT_TYPES
+
+    deg = [e for e in scenario.events if isinstance(e, DEGRADED_EVENT_TYPES)]
+    for i in range(len(deg)):
+        a0, a1 = _window(deg[i], "until")
+        for j in range(i + 1, len(deg)):
+            b0, b1 = _window(deg[j], "until")
+            if not (a0 < b1 and b0 < a1):
+                continue
+            both_slow = isinstance(deg[i], SlowMember) and isinstance(
+                deg[j], SlowMember
+            )
+            if both_slow or (set(deg[i].rows) & set(deg[j].rows)):
+                raise ScenarioError(
+                    f"{type(deg[i]).__name__}{list(deg[i].rows)} and "
+                    f"{type(deg[j]).__name__}{list(deg[j].rows)} overlap in "
+                    "time on shared links — the earlier teardown would "
+                    "clobber the later event's plane; stagger the windows"
+                )
+    blocks = [
+        (ev, _window(ev, "heal_at")) for ev in scenario.events
+        if isinstance(ev, Partition)
+    ] + [
+        (ev, _window(ev, "until")) for ev in scenario.events
+        if isinstance(ev, LinkFlap)
+    ]
+    for d in deg:
+        d0, d1 = _window(d, "until")
+        for bev, (b0, b1) in blocks:
+            if d0 < b1 and b0 < d1:
+                raise ScenarioError(
+                    f"{type(d).__name__}{list(d.rows)} overlaps an active "
+                    f"{type(bev).__name__}: the degraded family's loss/delay "
+                    "writes would overwrite (and its teardown lift) the "
+                    "block plane on shared links — stagger the events"
+                )
+
+
 def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
     """Expand a scenario into the ordered (tick, seq) action list both the
     state and the emulator runners replay. Flap toggles materialize here;
-    a flap always ends CLEAR (a trailing up-toggle at ``until``)."""
+    a flap always ends CLEAR (a trailing up-toggle at ``until``). Degraded
+    events (r14) that would compose silently-wrong with block events are
+    refused at compile time (:func:`_validate_degraded_composition`)."""
+    _validate_degraded_composition(scenario)
     steps: List[_Step] = []
     seq = itertools.count()
     for ev in scenario.events:
@@ -77,6 +144,21 @@ def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
                 steps.append(_Step(t, next(seq), kind, f"{kind}@{t}", (ev.pairs,)))
             steps.append(_Step(until, next(seq), "flap_up",
                                f"flap_end@{until}", (ev.pairs,)))
+        elif isinstance(ev, SlowMember):
+            steps.append(_Step(ev.at, next(seq), "slow_start",
+                               f"slow({ev.mean_delay_ticks}t){list(ev.rows)}@{ev.at}",
+                               (ev.rows, ev.mean_delay_ticks)))
+            if ev.until is not None:
+                steps.append(_Step(ev.until, next(seq), "slow_end",
+                                   f"slow_end@{ev.until}", (ev.rows,)))
+        elif isinstance(ev, (AsymmetricLoss, FlakyObserver)):
+            direction = getattr(ev, "direction", "out")
+            steps.append(_Step(ev.at, next(seq), "asym_start",
+                               f"asym({ev.pct}%/{direction}){list(ev.rows)}@{ev.at}",
+                               (ev.rows, ev.pct, direction)))
+            if ev.until is not None:
+                steps.append(_Step(ev.until, next(seq), "asym_end",
+                                   f"asym_end@{ev.until}", (ev.rows, direction)))
         elif isinstance(ev, Crash):
             steps.append(_Step(ev.at, next(seq), "crash",
                                f"crash{list(ev.rows)}@{ev.at}", (ev.rows,)))
@@ -142,6 +224,14 @@ class StateTimeline:
                         f"{s.kind} needs per-link (dense) links; this engine "
                         "has no per-pair link plane"
                     )
+                if s.kind in ("slow_start", "slow_end", "asym_start",
+                              "asym_end"):
+                    raise ScenarioError(
+                        f"{s.kind} (r14 loss-adversarial family) needs "
+                        "per-link (dense) links; this engine has no "
+                        "per-pair link plane — run these scenarios on the "
+                        "dense engine (dense_links=True)"
+                    )
 
     def next_tick(self) -> Optional[int]:
         return self._steps[self._i].tick if self._i < len(self._steps) else None
@@ -196,6 +286,57 @@ class StateTimeline:
                     st = ops.set_link_loss(st, [s], [d], clear)
                 return st
 
+        elif step.kind == "slow_start":
+            rows, delay = step.payload
+
+            def fn(st, rows=rows, delay=delay):
+                # exponential-mean delay on every link touching the cohort
+                # (both directions) — ops.set_link_delay validates that the
+                # engine's delay rings are armed (params.delay_slots > 0)
+                n = st.capacity
+                everyone = list(range(n))
+                st = ops.set_link_delay(st, everyone, list(rows), float(delay))
+                return ops.set_link_delay(st, list(rows), everyone, float(delay))
+
+        elif step.kind == "slow_end":
+            (rows,) = step.payload
+
+            def fn(st, rows=rows):
+                n = st.capacity
+                everyone = list(range(n))
+                st = ops.set_link_delay(st, everyone, list(rows), 0.0)
+                return ops.set_link_delay(st, list(rows), everyone, 0.0)
+
+        elif step.kind == "asym_start":
+            rows, pct, direction = step.payload
+
+            def fn(st, rows=rows, p=pct / 100.0, d=direction, clear=None):
+                # ``clear`` is the storm-replay convention's floor: an asym
+                # write landing DURING a LossStorm must not punch a
+                # below-floor hole in the uniform storm (the LossStorm
+                # contract) — apply max(pct, floor); the clean variant
+                # replays on the restored matrix at storm end
+                eff = p if clear is None else max(p, clear)
+                n = st.capacity
+                everyone = list(range(n))
+                if d in ("in", "both"):
+                    st = ops.set_link_loss(st, everyone, list(rows), eff)
+                if d in ("out", "both"):
+                    st = ops.set_link_loss(st, list(rows), everyone, eff)
+                return st
+
+        elif step.kind == "asym_end":
+            rows, direction = step.payload
+
+            def fn(st, rows=rows, d=direction, clear=0.0):
+                n = st.capacity
+                everyone = list(range(n))
+                if d in ("in", "both"):
+                    st = ops.set_link_loss(st, everyone, list(rows), clear)
+                if d in ("out", "both"):
+                    st = ops.set_link_loss(st, list(rows), everyone, clear)
+                return st
+
         elif step.kind == "crash":
             (rows,) = step.payload
 
@@ -222,7 +363,8 @@ class StateTimeline:
             raise ScenarioError(f"unknown timeline action {step.kind!r}")
 
         if self._storm_stash is not None and step.kind in (
-            "partition_block", "partition_heal", "flap_down", "flap_up"
+            "partition_block", "partition_heal", "flap_down", "flap_up",
+            "asym_start", "asym_end",
         ):
             # the CLEAN variant replays on the restored matrix at storm end;
             # during the storm, links that clear only drop to the storm
@@ -516,6 +658,27 @@ class EmulatorChaosRunner:
         if len(emulators) != len(addresses):
             raise ScenarioError("emulators and addresses must align by row")
         scenario.validate_rows(len(emulators))  # groups/pairs/rows/seeds
+        # r14: the emulator's ONE default-outbound-settings slot per node
+        # cannot stash/restore the way the device StateTimeline's storm
+        # stash does, so a LossStorm overlapping a degraded event would
+        # clobber whichever wrote the slot last — refuse loudly (the device
+        # engines compose these correctly; run composed scenarios there)
+        from .events import DEGRADED_EVENT_TYPES
+
+        deg = [e for e in scenario.events
+               if isinstance(e, DEGRADED_EVENT_TYPES)]
+        storms = [e for e in scenario.events if isinstance(e, LossStorm)]
+        for d in deg:
+            d0, d1 = _window(d, "until")
+            for s in storms:
+                s0, s1 = _window(s, "until")
+                if d0 < s1 and s0 < d1:
+                    raise ScenarioError(
+                        f"{type(d).__name__}{list(d.rows)} overlaps a "
+                        "LossStorm: the emulator runner's single default-"
+                        "outbound slot cannot hold both — stagger them, or "
+                        "run the composed scenario on a device engine"
+                    )
         self.scenario = scenario
         self._emus = list(emulators)
         self._addrs = list(addresses)
@@ -570,6 +733,50 @@ class EmulatorChaosRunner:
             (pairs,) = step.payload
             for s, d in pairs:
                 self._emus[s].unblock_outbound([self._addrs[d]])
+        elif step.kind == "slow_start":
+            # NOTE (r14): the emulator maps degraded events COARSELY — a
+            # per-destination entry overrides the node's default settings
+            # entirely (loss AND delay travel together), so a flaky
+            # member's sends toward a concurrently slow member carry the
+            # slow delay at full reliability. Intersecting-cohort overlaps
+            # are refused by schedule(); disjoint-cohort residue is this
+            # documented approximation. The device engines model the loss
+            # and delay planes independently.
+            rows, delay = step.payload
+            for r in rows:
+                self._emus[r].set_default_outbound_settings(0.0, delay)
+            for i, emu in enumerate(self._emus):
+                if i not in rows:
+                    for r in rows:
+                        emu.set_outbound_settings(self._addrs[r], 0.0, delay)
+        elif step.kind == "slow_end":
+            (rows,) = step.payload
+            for r in rows:
+                self._emus[r].set_default_outbound_settings(0.0, 0.0)
+            for i, emu in enumerate(self._emus):
+                if i not in rows:
+                    for r in rows:
+                        emu.unblock_outbound([self._addrs[r]])
+        elif step.kind == "asym_start":
+            rows, pct, direction = step.payload
+            if direction in ("in", "both"):
+                for i, emu in enumerate(self._emus):
+                    if i not in rows:
+                        for r in rows:
+                            emu.set_outbound_settings(self._addrs[r], pct, 0.0)
+            if direction in ("out", "both"):
+                for r in rows:
+                    self._emus[r].set_default_outbound_settings(pct, 0.0)
+        elif step.kind == "asym_end":
+            rows, direction = step.payload
+            if direction in ("in", "both"):
+                for i, emu in enumerate(self._emus):
+                    if i not in rows:
+                        for r in rows:
+                            emu.unblock_outbound([self._addrs[r]])
+            if direction in ("out", "both"):
+                for r in rows:
+                    self._emus[r].set_default_outbound_settings(0.0, 0.0)
         elif step.kind == "crash":
             (rows,) = step.payload
             for r in rows:

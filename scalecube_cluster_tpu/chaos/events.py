@@ -101,6 +101,89 @@ class LinkFlap:
 
 
 @dataclass(frozen=True)
+class SlowMember:
+    """Members that are SLOW but alive (r14): every link to/from ``rows``
+    gains ``mean_delay_ticks`` of exponential-mean delay in [at, until).
+
+    The Lifeguard false-positive archetype: a slow member's probe round
+    trips start missing the static ping timeout, so a static detector
+    declares it DEAD while it is still running. Needs the dense-link
+    engine with ``params.delay_slots > 0`` (the delay model); ``until``
+    clears the touched links back to zero delay."""
+
+    rows: Sequence[int]
+    mean_delay_ticks: float
+    at: int
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("SlowMember needs at least one row")
+        if self.mean_delay_ticks <= 0:
+            raise ScenarioError("SlowMember.mean_delay_ticks must be > 0")
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("SlowMember.until must be > at")
+
+
+@dataclass(frozen=True)
+class AsymmetricLoss:
+    """Lossy-but-alive members (r14): directed loss floor of ``pct``
+    percent on the links INTO ``rows`` (``direction="in"``), OUT of them
+    (``"out"``), or both, in [at, until).
+
+    ``"in"`` starves the member of probes and ACK requests — observers'
+    probes fail and the member looks dead from outside. ``"out"`` makes
+    the member a degraded OBSERVER — its own probes fail, so a static
+    detector lets it spray false suspicions of healthy peers. Dense-link
+    engines only; ``until`` clears the touched links (to the active
+    storm's floor while one is running, like every link mutation)."""
+
+    rows: Sequence[int]
+    pct: float
+    at: int
+    until: Optional[int] = None
+    direction: str = "in"
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("AsymmetricLoss needs at least one row")
+        if not (0.0 < self.pct <= 100.0):
+            raise ScenarioError("AsymmetricLoss.pct must be in (0, 100]")
+        if self.direction not in ("in", "out", "both"):
+            raise ScenarioError(
+                "AsymmetricLoss.direction must be 'in', 'out', or 'both'"
+            )
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("AsymmetricLoss.until must be > at")
+
+
+@dataclass(frozen=True)
+class FlakyObserver:
+    """A degraded observer (r14): outbound loss floor of ``pct`` percent on
+    every link OUT of ``rows`` in [at, until) — sugar for
+    ``AsymmetricLoss(direction="out")``, named for the failure mode it
+    exercises: the member whose own probes keep failing and who therefore
+    accuses healthy peers. The adaptive plane's local-health score is the
+    defense (its lh climbs, stretching the suspicions it ages)."""
+
+    rows: Sequence[int]
+    pct: float
+    at: int
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("FlakyObserver needs at least one row")
+        if not (0.0 < self.pct <= 100.0):
+            raise ScenarioError("FlakyObserver.pct must be in (0, 100]")
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("FlakyObserver.until must be > at")
+
+
+@dataclass(frozen=True)
 class Crash:
     """Hard-kill ``rows`` at tick ``at`` (no goodbye; peers must detect)."""
 
@@ -129,7 +212,14 @@ class Restart:
             raise ScenarioError("Restart needs at least one row")
 
 
-EVENT_TYPES = (Partition, LossStorm, LinkFlap, Crash, Restart)
+EVENT_TYPES = (
+    Partition, LossStorm, LinkFlap, Crash, Restart,
+    SlowMember, AsymmetricLoss, FlakyObserver,
+)
+
+#: the r14 loss-adversarial family: events that DEGRADE members without
+#: killing them — the false-positive sentinel's watch cohort
+DEGRADED_EVENT_TYPES = (SlowMember, AsymmetricLoss, FlakyObserver)
 
 
 @dataclass(frozen=True)
@@ -142,6 +232,14 @@ class Scenario:
     from the engine params — see :func:`.sentinels.build_spec`), and
     ``check_interval`` sets the sentinel sampling cadence in ticks (sentinel
     facts are latching/monotone, so sampling is sound — see sentinels.py).
+
+    ``fp_watch_rows`` (r14) adds explicit rows to the FALSE-POSITIVE
+    sentinel's watch cohort — by default it watches the degraded-but-alive
+    rows of SlowMember / AsymmetricLoss / FlakyObserver events (minus any
+    row a Crash also hits). A watched row tombstoned by any up observer is
+    a false positive; ``fp_enforce=False`` records the count without
+    counting it as a violation (the static-timeout CONTROL arm of the r14
+    certification is expected to violate — documented, not hidden).
     """
 
     name: str
@@ -150,9 +248,12 @@ class Scenario:
     detect_budget: Optional[int] = None
     converge_budget: Optional[int] = None
     check_interval: Optional[int] = None
+    fp_watch_rows: Sequence[int] = ()
+    fp_enforce: bool = True
 
     def __post_init__(self):
         object.__setattr__(self, "events", tuple(self.events))
+        object.__setattr__(self, "fp_watch_rows", _rows(self.fp_watch_rows))
         for ev in self.events:
             if not isinstance(ev, EVENT_TYPES):
                 raise ScenarioError(f"unknown scenario event {ev!r}")
@@ -164,8 +265,8 @@ class Scenario:
     # -- derived views -------------------------------------------------------
     def referenced_rows(self) -> set:
         """Every row any event names: crash/restart targets + their seeds,
-        partition group members, flap endpoints."""
-        rows: set = set()
+        partition group members, flap endpoints, degraded/fp-watch rows."""
+        rows: set = set(self.fp_watch_rows)
         for ev in self.events:
             for attr in ("rows", "seed_rows"):
                 rows.update(getattr(ev, attr, ()))
@@ -174,6 +275,21 @@ class Scenario:
             for s, d in getattr(ev, "pairs", ()):
                 rows.update((s, d))
         return rows
+
+    def degraded_rows(self) -> set:
+        """Rows the r14 loss-adversarial events degrade WITHOUT killing
+        (SlowMember / AsymmetricLoss / FlakyObserver targets, minus rows a
+        Crash also hits) — the false-positive sentinel's default watch
+        cohort: these members stay alive the whole scenario, so a DEAD
+        verdict about any of them is by construction a false positive."""
+        deg: set = set()
+        crashed: set = set()
+        for ev in self.events:
+            if isinstance(ev, DEGRADED_EVENT_TYPES):
+                deg.update(ev.rows)
+            elif isinstance(ev, Crash):
+                crashed.update(ev.rows)
+        return deg - crashed
 
     def validate_rows(self, capacity: int) -> None:
         """Fail FAST on rows outside ``[0, capacity)`` — a silent JAX
@@ -219,6 +335,13 @@ class Scenario:
                 for s, d in ev.pairs:
                     touched.update((s, d))
             elif isinstance(ev, LossStorm) and ev.pct >= loss_storm_immunity_pct:
+                touched.update(range(capacity))
+            elif isinstance(ev, DEGRADED_EVENT_TYPES):
+                # a degraded member is both suspectable (its links fail)
+                # and a degraded OBSERVER (its own probes fail — it can
+                # falsely suspect anyone), so the legacy no-false-DEAD
+                # vouching covers nobody while these run; the r14
+                # false-positive sentinel is the guarantee for this family
                 touched.update(range(capacity))
         return {r for r in touched if 0 <= r < capacity}
 

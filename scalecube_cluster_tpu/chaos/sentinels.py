@@ -74,6 +74,9 @@ def default_converge_budget(params) -> int:
 #: pays the delay both ways).
 _STRATEGY_SCALE = {
     "push": 1.0, "push_pull": 1.0, "pipelined": 0.75, "accelerated": 0.75,
+    # tuneable (r14): the deterministic share covers the rotation, the
+    # random share keeps coupon-collector tails — neither tighten nor loosen
+    "tuneable": 1.0,
 }
 _TOPOLOGY_SCALE = {
     "full": 1.0, "expander": 1.0, "ring": 1.5, "torus": 1.25, "geo": 2.0,
@@ -111,15 +114,23 @@ class SentinelSpec:
     converge_budget: int = 0
     check_interval: int = 32
     horizon: int = 0
+    #: r14 false-positive watch cohort: degraded-but-alive rows (SlowMember/
+    #: AsymmetricLoss/FlakyObserver targets + Scenario.fp_watch_rows). A
+    #: watched row tombstoned by any up observer is a false positive;
+    #: fp_enforce=False records without judging (the static control arm).
+    fp_watch: np.ndarray = field(default_factory=lambda: np.zeros(0, bool))
+    fp_enforce: bool = True
 
     def device_arrays(self, t0: int = 0) -> Dict[str, object]:
         """Upload the spec once at arm time. ``t0`` is the absolute tick the
         scenario was armed at; sentinel checks compare ``state.tick - t0``
         against the (relative) event ticks, so detect/conv stamps come back
-        in scenario-relative ticks like every deadline in the report."""
+        in scenario-relative ticks like every deadline in the report.
+        The ``fp_watch`` plane ships only when the cohort is non-empty —
+        legacy scenarios keep their exact legacy check program."""
         import jax.numpy as jnp
 
-        return {
+        out = {
             "t0": jnp.int32(t0),
             "never_faulted": jnp.asarray(self.never_faulted),
             "crash_rows": jnp.asarray(self.crash_rows),
@@ -127,6 +138,9 @@ class SentinelSpec:
             "crash_until": jnp.asarray(self.crash_until),
             "conv_from": jnp.asarray(self.conv_from),
         }
+        if self.fp_watch.size and bool(self.fp_watch.any()):
+            out["fp_watch"] = jnp.asarray(self.fp_watch)
+        return out
 
 
 def build_spec(
@@ -156,6 +170,16 @@ def build_spec(
     touched = scenario.fault_touched_rows(n, immunity)
     never = np.ones((n,), bool)
     never[sorted(touched)] = False
+
+    # r14 false-positive cohort: degraded-but-alive rows plus explicit
+    # fp_watch_rows (explicit rows are NOT crash-excluded — that is the
+    # falsifiability hook: watch a row you then crash and the sentinel
+    # must fire)
+    fp = np.zeros((n,), bool)
+    fp_rows = sorted(
+        set(scenario.degraded_rows()) | set(scenario.fp_watch_rows)
+    )
+    fp[[r for r in fp_rows if 0 <= r < n]] = True
 
     crash_rows: List[int] = []
     crash_at: List[int] = []
@@ -199,6 +223,8 @@ def build_spec(
         detect_budget=detect,
         converge_budget=converge,
         check_interval=check,
+        fp_watch=fp,
+        fp_enforce=scenario.fp_enforce,
     )
     auto_horizon = max(
         scenario.last_event_tick() + 1,
@@ -227,6 +253,8 @@ def init_sentinel_state(
         "detect_tick": jnp.full((len(spec.crash_rows),), -1, jnp.int32),
         "conv_tick": jnp.full((len(spec.conv_from),), -1, jnp.int32),
     }
+    if spec.fp_watch.size and bool(spec.fp_watch.any()):
+        sent["fp_dead_max"] = jnp.int32(0)
     if sparse:
         sent["n_live_drift"] = jnp.int32(0)
     return sent
@@ -275,11 +303,17 @@ def sentinel_report(sent_host: Dict[str, np.ndarray], spec: SentinelSpec,
     # pview's internal-consistency sentinel (duplicate/self table entries —
     # the partial-view analogue of the sparse n_live drift)
     view_breaks = int(sent_host.get("view_invariant_breaks", 0))
+    # r14 false-positive sentinel: degraded-but-alive members tombstoned.
+    # Judged only when the scenario enforces it — the static-timeout
+    # control arm RECORDS its false positives without failing the run.
+    fp_dead = int(sent_host.get("fp_dead_max", 0))
+    fp_judged = "fp_dead_max" in sent_host and spec.fp_enforce
     violations = (
         (1 if false_dead else 0)
         + (1 if regress else 0)
         + (1 if n_live_drift else 0)
         + (1 if view_breaks else 0)
+        + (1 if (fp_judged and fp_dead) else 0)
         + sum(1 for d in detections if not d["ok"])
         + sum(1 for c in convergence if not c["ok"])
     )
@@ -295,6 +329,10 @@ def sentinel_report(sent_host: Dict[str, np.ndarray], spec: SentinelSpec,
         "violations": violations,
         "ok": violations == 0,
     }
+    if "fp_dead_max" in sent_host:
+        report["false_positive_dead_max"] = fp_dead
+        report["false_positive_enforced"] = bool(spec.fp_enforce)
+        report["false_positive_watch_members"] = int(spec.fp_watch.sum())
     if "n_live_drift" in sent_host:
         report["n_live_drift"] = n_live_drift
     if "view_invariant_breaks" in sent_host:

@@ -200,8 +200,32 @@ class DisseminationConfig:
     geo_zones: int = 4
     geo_wan_delay_ticks: int = 0  # mean cross-zone delay, in ticks
     pipeline_budget: int = 1  # pipelined: rumor slots per message
+    tuneable_mix: float = 0.5  # tuneable: P(deterministic chord) per send
 
     def replace(self, **kw) -> "DisseminationConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class AdaptiveConfig:
+    """Adaptive failure-detection knobs (r14; Lifeguard-lineage — no
+    reference analogue: scalecube-cluster ships static suspicion math).
+
+    ``enabled=False`` (the default) keeps the byte-identical legacy window
+    programs. Armed, each member tracks a local-health score (own
+    probe-miss/refutation evidence, ``lh_max`` cap) scaling its direct
+    probe timeout and the suspicion sweeps it runs, and suspicion
+    time-to-DEAD interpolates log-scaled from ``max_mult`` (lone
+    accusation) to ``min_mult`` (>= ``conf_target`` accepted
+    confirmations). See ``adaptive.py`` / docs/ADAPTIVE_FD.md."""
+
+    enabled: bool = False
+    lh_max: int = 8
+    min_mult: int = 5
+    max_mult: int = 10
+    conf_target: int = 4
+
+    def replace(self, **kw) -> "AdaptiveConfig":
         return replace(self, **kw)
 
 
@@ -298,6 +322,7 @@ class ClusterConfig:
     transport: TransportConfig = field(default_factory=TransportConfig)
     sim: SimConfig = field(default_factory=SimConfig)
     dissemination: DisseminationConfig = field(default_factory=DisseminationConfig)
+    adaptive: AdaptiveConfig = field(default_factory=AdaptiveConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
@@ -356,6 +381,9 @@ class ClusterConfig:
     def with_dissemination(self, op: Lens) -> "ClusterConfig":
         return replace(self, dissemination=op(self.dissemination))
 
+    def with_adaptive(self, op: Lens) -> "ClusterConfig":
+        return replace(self, adaptive=op(self.adaptive))
+
     def with_chaos(self, op: Lens) -> "ClusterConfig":
         return replace(self, chaos=op(self.chaos))
 
@@ -405,6 +433,10 @@ class ClusterConfig:
         from .dissemination.spec import DissemSpec
 
         DissemSpec.from_config(self)
+        # the adaptive spec dataclass owns its knob validation likewise
+        from .adaptive import AdaptiveSpec
+
+        AdaptiveSpec.from_config(self)
         if self.chaos.check_interval_ticks <= 0:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):

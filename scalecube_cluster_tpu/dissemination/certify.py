@@ -88,6 +88,15 @@ def theory_bound(
         bound = det_schedule_bound(spec, n, fanout)
         formula = "det_schedule_bound(T)"
         citation = "arXiv:1311.2839 (doubling schedule); arXiv:1805.08531 (structure-exploiting iteration)"
+    elif s == "tuneable":
+        # the mixed walk covers the deterministic rotation in expected
+        # 1/mix rotations; the randomized complement spreads push-like on
+        # the same chords — take the stretched deterministic bound plus
+        # the randomized log term as a (generous, certifiable) ceiling
+        mix = max(float(spec.tuneable_mix), 0.1)
+        bound = int(round(det_schedule_bound(spec, n, fanout) / mix)) + 3 * L + 8
+        formula = f"det_schedule_bound(T)/max(mix,0.1)={mix:g} + 3*ceil_log2(N) + 8"
+        citation = "arXiv:1506.02288 (robust and tuneable gossiping family)"
     elif s == "pipelined":
         stretch = -(-rumor_slots // min(spec.pipeline_budget, rumor_slots))
         bound = det_schedule_bound(spec, n, fanout) * stretch + rumor_slots + 8
@@ -348,6 +357,11 @@ DEFAULT_MATRIX = (
     ("accelerated", "expander", "dense"),
     ("push", "expander", "pview"),
     ("accelerated", "expander", "pview"),
+    # r14 fifth strategy (ROADMAP item-3 leftover): the robust/tuneable
+    # family, certified on the expander (and the ring's linear class is
+    # already pinned by the pure strategies above)
+    ("tuneable", "expander", "dense"),
+    ("tuneable", "full", "dense"),
 )
 
 

@@ -35,6 +35,17 @@ Strategies (PAPERS.md upgrades over uniform-random push):
   advancing one chord per tick — on geometric chord sets the infected
   interval doubles per covered chord, giving a DETERMINISTIC O(log N)
   bound.
+* ``tuneable`` — the robust/tuneable gossip family (arXiv:1506.02288,
+  "A Robust and Tuneable Family of Gossiping Algorithms"): each send
+  follows the deterministic doubling walk with probability
+  ``tuneable_mix`` and an independently drawn uniform chord otherwise —
+  one knob trades the deterministic schedule's speed against the
+  randomized family's robustness to adversarial loss/crashes (the paper's
+  interpolation, transplanted to circulant chord selection). ``mix=1``
+  degenerates to the accelerated walk, ``mix=0`` to uniform random
+  chords; both halves consume the SAME per-slot uniform (the decision's
+  residual rescales into the random chord draw), so the engine draw
+  stream is untouched.
 
 Topologies (circulant overlays — every neighbor is ``(i + chord) mod N``,
 so pview never materializes an [N, N] adjacency and even the dense engine
@@ -58,7 +69,7 @@ from __future__ import annotations
 
 import dataclasses
 
-STRATEGIES = ("push", "push_pull", "pipelined", "accelerated")
+STRATEGIES = ("push", "push_pull", "pipelined", "accelerated", "tuneable")
 TOPOLOGIES = ("full", "ring", "torus", "expander", "geo")
 
 
@@ -78,6 +89,9 @@ class DissemSpec:
     geo_wan_delay_ticks: int = 0
     #: pipelined: user-rumor slots carried per message (rotating window)
     pipeline_budget: int = 1
+    #: tuneable: probability each send follows the deterministic doubling
+    #: walk instead of a uniform random chord (arXiv:1506.02288's knob)
+    tuneable_mix: float = 0.5
 
     def __post_init__(self):
         if self.strategy not in STRATEGIES:
@@ -98,6 +112,8 @@ class DissemSpec:
             raise ValueError("geo_wan_delay_ticks must be >= 0")
         if self.pipeline_budget < 1:
             raise ValueError("pipeline_budget must be >= 1")
+        if not (0.0 <= self.tuneable_mix <= 1.0):
+            raise ValueError("tuneable_mix must be in [0, 1]")
 
     # -- static program-shape switches ---------------------------------------
     @property
@@ -134,6 +150,7 @@ class DissemSpec:
             geo_zones=dc.geo_zones,
             geo_wan_delay_ticks=dc.geo_wan_delay_ticks,
             pipeline_budget=dc.pipeline_budget,
+            tuneable_mix=getattr(dc, "tuneable_mix", 0.5),
         )
 
 

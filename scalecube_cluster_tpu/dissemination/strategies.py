@@ -67,12 +67,35 @@ def structured_peers(spec, n: int, tick, u_sel, xp=jnp):
             )
         elif spec.strategy == "pipelined":
             ci = (tick * F + s) % C
+        elif spec.strategy == "tuneable":
+            # robust/tuneable family (arXiv:1506.02288): deterministic
+            # doubling-walk chord with probability ``mix``, else a uniform
+            # chord from the SAME per-slot uniform's residual (one draw
+            # serves both the decision and the random pick, so arming the
+            # family never perturbs the engines' draw streams)
+            ci = _tuneable_chord(spec, C, tick, s, u_sel[:, s], xp=xp)
         else:  # accelerated — the doubling walk
             ci = (tick + s) % C
         cols.append((rows + ch_arr[ci]) % n)
     peers = xp.stack(cols, 1).astype(xp.int32)
     valid = xp.ones((n, F), bool)
     return peers, valid
+
+
+def _tuneable_chord(spec, C: int, tick, s: int, u, xp=jnp):
+    """The tuneable family's per-slot chord index (xp-generic, elementwise
+    f32 — identical under jnp and np, which is the oracle-lockstep
+    contract). ``u < mix`` follows the deterministic walk; otherwise the
+    residual ``(u - mix) / (1 - mix)`` rescales into a uniform chord draw."""
+    mix = np.float32(spec.tuneable_mix)
+    det = xp.asarray((tick + s) % C, dtype=xp.int32)
+    if spec.tuneable_mix >= 1.0:
+        return xp.broadcast_to(det, xp.shape(u)).astype(xp.int32)
+    if spec.tuneable_mix <= 0.0:
+        return xp.minimum((u * np.float32(C)).astype(xp.int32), C - 1)
+    u2 = (u - mix) / np.float32(1.0 - mix)
+    rand = xp.clip((u2 * np.float32(C)).astype(xp.int32), 0, C - 1)
+    return xp.where(u < mix, det, rand).astype(xp.int32)
 
 
 def structured_peer_row(spec, n: int, tick: int, i: int, u_row):
@@ -87,6 +110,12 @@ def structured_peer_row(spec, n: int, tick: int, i: int, u_row):
             ci = min(int(np.float32(u_row[s]) * np.float32(C)), C - 1)
         elif spec.strategy == "pipelined":
             ci = (tick * F + s) % C
+        elif spec.strategy == "tuneable":
+            ci = int(
+                _tuneable_chord(
+                    spec, C, tick, s, np.float32(u_row[s]), xp=np
+                )
+            )
         else:
             ci = (tick + s) % C
         peers[s] = (i + ch[ci]) % n

@@ -131,6 +131,10 @@ class EngineOps:
     # auditor lowers the sharded variants with (None = single-device only)
     contracts: EngineContracts = EngineContracts()
     state_shardings: Optional[Callable] = None  # (mesh, dense_links, delay_slots)
+    #: r14 adaptive-FD window builder ((params, n_ticks) -> jitted window
+    #: with (state, adaptive_state) donated, argnums (0, 1)); every engine
+    #: registers one — the spec on params must be enabled or it refuses
+    make_adaptive_run: Optional[Callable] = None
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -225,12 +229,15 @@ def _dense_engine() -> EngineOps:
             key_dtypes=("i32", "i16"),
             # r13: push_pull gathers the contacted peers' piggyback rows —
             # the heaviest non-default strategy program — plus one
-            # deterministic-schedule representative
+            # deterministic-schedule representative; r14 adds the tuneable
+            # family (the fifth strategy) to the audited set
             strategy_variants=(
                 ("push_pull", "expander"), ("accelerated", "ring"),
+                ("tuneable", "expander"),
             ),
         ),
         state_shardings=_shardings,
+        make_adaptive_run=K.make_adaptive_run,
     )
 
 
@@ -286,6 +293,7 @@ def _sparse_engine() -> EngineOps:
             strategy_variants=(("pipelined", "expander"),),
         ),
         state_shardings=_shardings,
+        make_adaptive_run=SP.make_sparse_adaptive_run,
     )
 
 
@@ -337,6 +345,7 @@ def _pview_engine() -> EngineOps:
                 ("accelerated", "expander"), ("push_pull", "ring"),
             ),
         ),
+        make_adaptive_run=PV.make_pview_adaptive_run,
     )
 
 

@@ -61,6 +61,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from .. import adaptive as _adp
 from ..dissemination import strategies as dz
 from . import bitplane as bp
 from .lattice import (
@@ -83,7 +84,7 @@ from .rand import (
     fetch_uniform,
     split_tick_key,
 )
-from .state import NEVER as NEVER_I32, SimParams, SimState
+from .state import NEVER as NEVER_I32, NO_CANDIDATE_I32, SimParams, SimState
 
 
 def ceil_log2(n: jnp.ndarray) -> jnp.ndarray:
@@ -324,7 +325,8 @@ def _fetch_gate(
 
 
 def _fd_phase(
-    state: SimState, r: FdRandoms, params: SimParams, trace: bool = False
+    state: SimState, r: FdRandoms, params: SimParams, trace: bool = False,
+    ad=None,
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     rows = jnp.arange(n)
@@ -344,11 +346,23 @@ def _fd_phase(
     # sub-interval timeout, SURVEY.md §7 hard part i).
     p_direct = _rt_at(state, rows, tgt)
     if params.delay_slots:
-        p_direct = p_direct * _timely_rt(
-            _delay_q_at(state, rows, tgt),
-            _delay_q_at(state, tgt, rows),
-            params.fd_direct_timeout_ticks,
-        )
+        if ad is not None:
+            # Lifeguard LHA (r14, AD-4): each prober's DIRECT timeout
+            # stretches to t_base * (1 + lh_i) — a degraded member gives
+            # its own round trips more time before accusing anyone
+            p_direct = p_direct * _adp.scaled_timely_rt(
+                _delay_q_at(state, rows, tgt),
+                _delay_q_at(state, tgt, rows),
+                params.fd_direct_timeout_ticks,
+                ad.lh,
+                params.adaptive.lh_max,
+            )
+        else:
+            p_direct = p_direct * _timely_rt(
+                _delay_q_at(state, rows, tgt),
+                _delay_q_at(state, tgt, rows),
+                params.fd_direct_timeout_ticks,
+            )
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     # Indirect probe via k relays: PING_REQ -> transit PING -> transit ACK ->
@@ -404,6 +418,20 @@ def _fd_phase(
         "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (accept & ~ack).sum(),
     }
+    if ad is not None:
+        # adaptive evidence exports (r14): own-probe outcomes feed lh; new
+        # SUSPECT verdicts are the episode's origin confirmations (AD-1)
+        sus_w = accept & ~ack
+        metrics["_ad_miss"] = has_tgt & ~ack
+        metrics["_ad_succ"] = has_tgt & ack
+        metrics["_ad_cnt"] = (
+            jnp.zeros((n,), jnp.int32).at[tgt].add(sus_w.astype(jnp.int32))
+        )
+        metrics["_ad_key"] = (
+            jnp.full((n,), NO_CANDIDATE_I32, jnp.int32)
+            .at[tgt]
+            .max(jnp.where(sus_w, cand_key.astype(jnp.int32), NO_CANDIDATE_I32))
+        )
     if trace:
         # trace-plane export (r10): the probe internals the causal trace
         # ring records — values this phase already computed, so an armed
@@ -423,7 +451,7 @@ def _fd_phase(
     return st, metrics
 
 
-def _suspicion_phase(state: SimState, params: SimParams, trace=None):
+def _suspicion_phase(state: SimState, params: SimParams, trace=None, ad=None):
     """SUSPECT cells whose suspicion window expired become DEAD at the same
     incarnation (rank 2 -> 3 is key+1). ``changed_at`` is the suspicion
     start: every accepted change that leaves a cell SUSPECT also (re)stamps
@@ -432,7 +460,13 @@ def _suspicion_phase(state: SimState, params: SimParams, trace=None):
     ``trace`` (a TraceSpec) switches the return to ``(state, sus_tr)`` with
     the tracers' expiry transitions exported from the sweep branch's own
     ``expired`` temp (r10 — reading a branch temp is free; reading the
-    carried plane post-hoc is a full extra materialization per tick)."""
+    carried plane post-hoc is a full extra materialization per tick).
+
+    ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) swaps the static
+    timeout for the confirmation-scaled, observer-health-scaled window:
+    ``base_i * mult(conf_j) * (1 + lh_i) // L`` — well-corroborated
+    suspicions expire at ``min_mult``, lone accusations from a degraded
+    observer age out at ``max_mult * (1 + lh)``."""
     recompute = _packed(params)
     # Packed mode recomputes the suspect mask INSIDE the rare sweep branch:
     # a mask captured by the lax.cond closure is a cond operand, so the
@@ -446,14 +480,28 @@ def _suspicion_phase(state: SimState, params: SimParams, trace=None):
         sus = (
             (state.view_key & 3) == RANK_SUSPECT if recompute else suspect
         )
-        timeout = (
-            params.suspicion_mult * ceil_log2(_cluster_size(state)) * params.fd_every
-        )
-        expired = (
-            sus
-            & (state.tick - state.changed_at >= timeout[:, None])
-            & state.up[:, None]
-        )
+        if ad is not None:
+            aspec = params.adaptive
+            L = aspec.levels
+            base = ceil_log2(_cluster_size(state)) * params.fd_every  # [N]
+            num_conf = _adp.conf_mult_num(aspec, ad.conf)  # [N]
+            # a cell whose suspicion is NEWER than the episode gets no
+            # acceleration from the episode's confirmations (AD-1)
+            in_ep = state.view_key.astype(jnp.int32) <= ad.conf_key[None, :]
+            num = jnp.where(
+                in_ep, num_conf[None, :], jnp.int32(aspec.max_mult * L)
+            )
+            factor = base * (1 + ad.lh)  # [N] — AD-3 observer scaling
+            timeout = (factor[:, None] * num) // jnp.int32(L)  # [N, N]
+            overdue = state.tick - state.changed_at >= timeout
+        else:
+            timeout = (
+                params.suspicion_mult
+                * ceil_log2(_cluster_size(state))
+                * params.fd_every
+            )
+            overdue = state.tick - state.changed_at >= timeout[:, None]
+        expired = sus & overdue & state.up[:, None]
         st = state.replace(
             view_key=jnp.where(expired, state.view_key + 1, state.view_key),
             changed_at=jnp.where(expired, state.tick, state.changed_at),
@@ -480,7 +528,7 @@ def _suspicion_phase(state: SimState, params: SimParams, trace=None):
 
 
 def _gossip_phase(
-    state: SimState, r: RoundRandoms, params: SimParams
+    state: SimState, r: RoundRandoms, params: SimParams, adaptive: bool = False
 ) -> tuple[SimState, dict[str, jax.Array]]:
     n = state.capacity
     R = params.rumor_slots
@@ -691,24 +739,39 @@ def _gossip_phase(
                 pending_inf=bp.pack_bits(pend_inf_b.at[slot_now].set(False)),
                 pending_src=pend_src.at[slot_now].set(-1),
             )
-        return st, {
+        m = {
             "gossip_msgs": sent,
             "rumor_sends": rumor_sent,
             "rumor_deliveries": newly_inf.sum(),
         }
+        if adaptive:
+            # confirmation evidence (r14, AD-1/AD-2): every accepted
+            # SUSPECT record counts one believer; the per-subject max key
+            # is the episode candidate
+            sus_acc = accept & ((buf & 3) == RANK_SUSPECT)
+            m["_ad_cnt"] = sus_acc.astype(jnp.int32).sum(axis=0)
+            m["_ad_key"] = jnp.where(
+                sus_acc, buf.astype(jnp.int32), NO_CANDIDATE_I32
+            ).max(axis=0)
+        return st, m
 
     def _quiet(state: SimState) -> tuple[SimState, dict[str, jax.Array]]:
-        return state, {
+        m = {
             "gossip_msgs": jnp.int32(0),
             "rumor_sends": jnp.int32(0),
             "rumor_deliveries": jnp.int32(0),
         }
+        if adaptive:
+            m["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            m["_ad_key"] = jnp.full((n,), NO_CANDIDATE_I32, jnp.int32)
+        return state, m
 
     return jax.lax.cond(gossip_work, _deliver, _quiet, state)
 
 
 def _sync_phase(
-    state: SimState, r: RoundRandoms, params: SimParams, trace: bool = False
+    state: SimState, r: RoundRandoms, params: SimParams, trace: bool = False,
+    adaptive: bool = False,
 ) -> tuple[SimState, dict[str, jax.Array]]:
     """Anti-entropy full-table exchange for this tick's due callers.
 
@@ -841,6 +904,26 @@ def _sync_phase(
     ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
     st = st.replace(force_sync=st.force_sync & ~ok_full)
     metrics = {"sync_roundtrips": ok.sum()}
+    if adaptive:
+        # confirmation evidence (r14, AD-1): accepted SUSPECT records in
+        # both merge directions. Duplicate peer slots recompute IDENTICAL
+        # acc rows, so the REQ count gates on the first slot per peer
+        # (callers are distinct rows — the ACK side needs no gate).
+        peer_eff = jnp.where(ok, peer, -1 - jnp.arange(K, dtype=jnp.int32))
+        first_p = ok & (
+            jnp.argmax(peer_eff[:, None] == peer_eff[None, :], axis=1)
+            == jnp.arange(K)
+        )
+        m_req = acc & first_p[:, None] & ((buf_p & 3) == RANK_SUSPECT)
+        m_ack = accept & ((ack_cand & 3) == RANK_SUSPECT)
+        metrics["_ad_cnt"] = (
+            m_req.astype(jnp.int32).sum(axis=0)
+            + m_ack.astype(jnp.int32).sum(axis=0)
+        )
+        metrics["_ad_key"] = jnp.maximum(
+            jnp.where(m_req, buf_p.astype(jnp.int32), NO_CANDIDATE_I32).max(axis=0),
+            jnp.where(m_ack, ack_cand.astype(jnp.int32), NO_CANDIDATE_I32).max(axis=0),
+        )
     if trace:
         # trace-plane export (r10): this tick's caller compaction + merge
         # outcomes (SYNC initiated/merged spans) — read-only internals
@@ -855,7 +938,7 @@ def _sync_phase(
     return st, metrics
 
 
-def _refute_phase(state: SimState, trace=None):
+def _refute_phase(state: SimState, trace=None, adaptive: bool = False):
     """A running node that finds itself SUSPECT — or even DEAD (a lingering
     cross-partition death rumor can land after a heal) — re-announces ALIVE
     with a bumped incarnation. The reference refutes ANY overriding record
@@ -898,6 +981,9 @@ def _refute_phase(state: SimState, trace=None):
     st = jax.lax.cond(need.any(), _apply, lambda st: st, state)
     if trace is not None:
         return st, need[jnp.asarray(trace.tracer_rows, jnp.int32)]
+    if adaptive:
+        # r14 lh evidence: someone suspected ME — I look flaky from outside
+        return st, need
     return st
 
 
@@ -935,7 +1021,7 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
 
 
 def tick(
-    state: SimState, key: jax.Array, params: SimParams, trace=None
+    state: SimState, key: jax.Array, params: SimParams, trace=None, ad=None
 ) -> tuple[SimState, dict[str, Any]]:
     """Advance the whole cluster by one gossip period. Pure; jit/shard me.
 
@@ -946,7 +1032,24 @@ def tick(
     would cost a full extra materialization per tick), so the state
     trajectory is BIT-IDENTICAL armed vs unarmed and the armed tick stays
     within noise (the lockstep + overhead gates pin both, for both
-    engines)."""
+    engines).
+
+    ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) arms the adaptive
+    failure-detection plane; the return becomes ``(state, ad', metrics)``.
+    ``ad=None`` (the default) traces the byte-identical legacy program —
+    no adaptive op, branch, or state exists in the jaxpr then."""
+    armed = ad is not None
+    if armed:
+        if trace is not None:
+            raise ValueError(
+                "trace-armed adaptive windows are not supported — run the "
+                "trace plane on a static-FD driver, or drop arm_trace"
+            )
+        if params.adaptive.is_default:
+            raise ValueError(
+                "adaptive tick needs an enabled AdaptiveSpec on params "
+                "(params.adaptive = AdaptiveSpec(enabled=True, ...))"
+            )
     state = state.replace(tick=state.tick + 1)
     fd_key, round_key = split_tick_key(key)
     r = draw_round_randoms(round_key, state.capacity, params.fanout)
@@ -957,7 +1060,7 @@ def tick(
     # gossip/SYNC stream).
     def _fd_on(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
         fd_r = draw_fd_randoms(fd_key, st.capacity, params.ping_req_k)
-        return _fd_phase(st, fd_r, params, trace=trace is not None)
+        return _fd_phase(st, fd_r, params, trace=trace is not None, ad=ad)
 
     def _fd_off(st: SimState) -> tuple[SimState, dict[str, jax.Array]]:
         m = {
@@ -965,6 +1068,12 @@ def tick(
             "fd_failed_probes": jnp.int32(0),
             "fd_new_suspects": jnp.int32(0),
         }
+        if armed:
+            n = st.capacity
+            m["_ad_miss"] = jnp.zeros((n,), bool)
+            m["_ad_succ"] = jnp.zeros((n,), bool)
+            m["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            m["_ad_key"] = jnp.full((n,), NO_CANDIDATE_I32, jnp.int32)
         if trace is not None:
             from ..trace import capture as _tc
 
@@ -976,18 +1085,40 @@ def tick(
     if trace is not None:
         state, trace_sus = _suspicion_phase(state, params, trace=trace)
     else:
-        state = _suspicion_phase(state, params)
-    state, g_m = _gossip_phase(state, r, params)
-    state, s_m = _sync_phase(state, r, params, trace=trace is not None)
+        state = _suspicion_phase(state, params, ad=ad)
+    state, g_m = _gossip_phase(state, r, params, adaptive=armed)
+    state, s_m = _sync_phase(
+        state, r, params, trace=trace is not None, adaptive=armed
+    )
     if trace is not None:
         state, trace_ref = _refute_phase(state, trace=trace)
+    elif armed:
+        state, refuted = _refute_phase(state, adaptive=True)
     else:
         state = _refute_phase(state)
     state = _rumor_sweep(state, params)
 
     trace_fd = fd_m.pop("trace_fd", None)
     trace_sync = s_m.pop("trace_sync", None)
+    if armed:
+        miss = fd_m.pop("_ad_miss")
+        succ = fd_m.pop("_ad_succ")
+        acc_cnt = fd_m.pop("_ad_cnt") + g_m.pop("_ad_cnt") + s_m.pop("_ad_cnt")
+        acc_key = jnp.maximum(
+            jnp.maximum(fd_m.pop("_ad_key"), g_m.pop("_ad_key")),
+            s_m.pop("_ad_key"),
+        )
+        lh2, ck2, cf2 = _adp.fold(
+            params.adaptive, ad.lh, ad.conf_key, ad.conf,
+            acc_key=acc_key, acc_cnt=acc_cnt,
+            miss=miss, succ=succ, refuted=refuted, up=state.up,
+        )
+        ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
     metrics = {**fd_m, **g_m, **s_m, **state_metrics(state, params)}
+    if armed:
+        metrics["adaptive_lh_high"] = ad.lh.max()
+        metrics["adaptive_conf_high"] = ad.conf.max()
+        return state, ad, metrics
     if trace is not None:
         from ..trace import capture as _tc
 
@@ -1131,6 +1262,10 @@ TELEMETRY_SERIES = (
     "alive_view_fraction",  # 0 when params.full_metrics is off
     "false_suspect_pairs_max",
     "convergence_lag",  # 1 - alive_view_fraction (meaningful iff full_metrics)
+    # r14 adaptive-FD gauges (0 on static-FD windows): worst local-health
+    # score and deepest confirmation count seen across the window
+    "adaptive_lh_max",
+    "adaptive_conf_max",
 )
 
 #: window metrics reduced by SUM into the telemetry vector (counters);
@@ -1168,6 +1303,16 @@ def telemetry_window_core(ms: dict, state) -> list[jax.Array]:
         alive_frac,
         ms["false_suspect_pairs"].max().astype(f32),
         (1.0 - alive_frac).astype(f32),
+        # adaptive gauges exist only in adaptive windows' metrics (r14);
+        # static windows report 0 so the ring layout stays engine-stable
+        (
+            ms["adaptive_lh_high"].max().astype(f32)
+            if "adaptive_lh_high" in ms else f32(0.0)
+        ),
+        (
+            ms["adaptive_conf_high"].max().astype(f32)
+            if "adaptive_conf_high" in ms else f32(0.0)
+        ),
     ]
     return vec
 
@@ -1224,6 +1369,18 @@ def sentinel_core(
         (view_key >= 0) & (rank == RANK_DEAD) & up[:, None] & nf_up[None, :]
     ).any(axis=0).sum().astype(jnp.int32)
     sent["false_dead_max"] = jnp.maximum(sent["false_dead_max"], false_dead)
+
+    if "fp_watch" in spec:
+        # r14 false-positive sentinel: degraded-but-alive watched members
+        # (SlowMember / AsymmetricLoss / FlakyObserver cohorts) currently
+        # tombstoned by any up observer. Latching max like false_dead —
+        # sampling is sound. The key ships only when the cohort is
+        # non-empty, so legacy scenarios trace the legacy check program.
+        fp_up = spec["fp_watch"] & up
+        fp_dead = (
+            (view_key >= 0) & (rank == RANK_DEAD) & up[:, None] & fp_up[None, :]
+        ).any(axis=0).sum().astype(jnp.int32)
+        sent["fp_dead_max"] = jnp.maximum(sent["fp_dead_max"], fp_dead)
 
     crash_rows = spec["crash_rows"]
     if crash_rows.shape[0]:
@@ -1301,6 +1458,52 @@ def make_traced_run(params: SimParams, n_ticks: int, trace, donate: bool = True)
     return jax.jit(
         partial(run_ticks_traced, n_ticks=n_ticks, params=params, trace=trace),
         donate_argnums=(0, 2) if donate else (),
+    )
+
+
+def run_ticks_adaptive(
+    state: SimState,
+    ad,
+    key: jax.Array,
+    n_ticks: int,
+    params: SimParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Adaptive-armed :func:`run_ticks` (r14): the window scan threads the
+    :class:`..adaptive.AdaptiveState` through the carry alongside the
+    engine state. Same key chain as the legacy window."""
+
+    def body(carry, _):
+        st, a, k = carry
+        k, tick_key = jax.random.split(k)
+        st, a, m = tick(st, tick_key, params, ad=a)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, a, k), m
+
+    (state, ad, key), ms = jax.lax.scan(
+        body, (state, ad, key), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, ad, key, ms, watched
+
+
+def make_adaptive_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Jitted :func:`run_ticks_adaptive` window: engine state AND adaptive
+    state donated (argnums 0, 1) — the r6 double-buffered discipline covers
+    the adaptive planes too. Refuses a default spec: the legacy builders
+    are the byte-identical program for that case (the r13/r14 rule)."""
+    from functools import partial
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_adaptive_run needs an enabled AdaptiveSpec on params — "
+            "the default spec's program is make_run's (byte-identical "
+            "legacy window)"
+        )
+    return jax.jit(
+        partial(run_ticks_adaptive, n_ticks=n_ticks, params=params),
+        donate_argnums=(0, 1) if donate else (),
     )
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import adaptive as _adp
 from ..dissemination import strategies as dz
 from . import bitplane
 from .lattice import (
@@ -177,14 +178,34 @@ def _accept_into(o: _O, i: int, j: int, cand_key: int, salt: int,
     return True
 
 
-def oracle_tick(state: SimState, key, params: SimParams) -> _O:
-    """One tick of the scalar oracle; returns the mutated numpy mirror."""
+def oracle_tick(state: SimState, key, params: SimParams, ad=None) -> _O:
+    """One tick of the scalar oracle; returns the mutated numpy mirror.
+
+    ``ad`` (r14) is a dict ``{"lh", "conf_key", "conf"}`` of [N] int32
+    numpy arrays mirroring :class:`..adaptive.AdaptiveState`; when given,
+    the tick mirrors the adaptive kernel (scaled probe timeout, adaptive
+    suspicion sweep, confirmation counting at every merge accept) and the
+    returned mirror carries the folded next state as ``o.ad``."""
     n, f, k = params.capacity, params.fanout, params.ping_req_k
     o = _O(state)
     o.tick += 1
     t = o.tick
     r = draw_tick_randoms(key, n, f, k)
     r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    armed = ad is not None
+    if armed:
+        aspec = params.adaptive
+        ad_miss = np.zeros(n, bool)
+        ad_succ = np.zeros(n, bool)
+        ad_refuted = np.zeros(n, bool)
+        ad_cnt = np.zeros(n, np.int64)
+        ad_key = np.full(n, np.iinfo(np.int32).min, np.int64)
+
+        def _ad_note(j: int, cand: int) -> None:
+            if (cand & 3) == RANK_SUSPECT:
+                ad_cnt[j] += 1
+                ad_key[j] = max(ad_key[j], cand)
 
     # ---- FD phase (reads a pre-phase snapshot, like the kernel) ----
     pre = o.snap()
@@ -199,12 +220,16 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             tgt = int(sel[0])
             p_direct = _rt(pre, i, tgt)
             if params.delay_slots:
+                t_dir = params.fd_direct_timeout_ticks
+                if armed:
+                    # Lifeguard LHA: the prober's own timeout stretch
+                    t_dir = t_dir * (1 + int(ad["lh"][i]))
                 p_direct = np.float32(
                     p_direct
                     * _timely(
                         _delay_q(pre, i, tgt),
                         _delay_q(pre, tgt, i),
-                        params.fd_direct_timeout_ticks,
+                        t_dir,
                     )
                 )
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
@@ -239,17 +264,37 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 cand = (int(pre.key[tgt, tgt]) >> 2) << 2  # ALIVE @ target's self-inc
             else:
                 cand = ((own >> 2) << 2) | RANK_SUSPECT
+            if armed:
+                ad_miss[i] = not ack
+                ad_succ[i] = bool(ack)
             if cand > own:
                 o.key[i, tgt] = cand
                 o.changed[i, tgt] = t
+                if armed and not ack:
+                    _ad_note(tgt, cand)
 
     # ---- suspicion sweep ----
     for i in range(n):
         if not o.up[i]:
             continue
-        timeout = params.suspicion_mult * _ceil_log2(_cluster_size(o, i)) * params.fd_every
+        base = _ceil_log2(_cluster_size(o, i)) * params.fd_every
+        timeout = params.suspicion_mult * base
         for j in range(n):
-            if (o.key[i, j] & 3) == RANK_SUSPECT and t - o.changed[i, j] >= timeout:
+            if (o.key[i, j] & 3) != RANK_SUSPECT:
+                continue
+            if armed:
+                # confirmation-scaled + observer-health-scaled window
+                L = aspec.levels
+                in_ep = int(o.key[i, j]) <= int(ad["conf_key"][j])
+                num = (
+                    _adp.conf_mult_num_scalar(aspec, int(ad["conf"][j]))
+                    if in_ep
+                    else aspec.max_mult * L
+                )
+                timeout_ij = (base * num * (1 + int(ad["lh"][i]))) // L
+            else:
+                timeout_ij = timeout
+            if t - o.changed[i, j] >= timeout_ij:
                 o.key[i, j] += 1  # SUSPECT -> DEAD at the same incarnation
                 o.changed[i, j] = t
 
@@ -376,8 +421,10 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
             continue
         for j in range(n):
             if recv_key[i, j] > np.iinfo(np.int64).min:
-                _accept_into(o, i, j, int(recv_key[i, j]), SALT_GOSSIP,
-                             params.namespace_gate)
+                cand_g = int(recv_key[i, j])
+                if _accept_into(o, i, j, cand_g, SALT_GOSSIP,
+                                params.namespace_gate) and armed:
+                    _ad_note(j, cand_g)
         for ru in range(params.rumor_slots):
             if recv_inf[i, ru] and pre.r_active[ru] and not o.infected[i, ru]:
                 o.infected[i, ru] = True
@@ -438,14 +485,18 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 cand = int(pre.key[i, j])
                 recv_key[(p, j)] = max(recv_key.get((p, j), cand), cand)
     for (p, j), cand in recv_key.items():
-        _accept_into(o, p, j, cand, SALT_SYNC_REQ, params.namespace_gate)
+        if _accept_into(o, p, j, cand, SALT_SYNC_REQ,
+                        params.namespace_gate) and armed:
+            _ad_note(j, cand)
     # ack: peers' post-request tables back to callers (one snapshot for all)
     mid = o.snap()
     for i, p in callers:
         for j in range(n):
             if mid.key[p, j] >= 0:
-                _accept_into(o, i, j, int(mid.key[p, j]), SALT_SYNC_ACK,
-                             params.namespace_gate)
+                cand_a = int(mid.key[p, j])
+                if _accept_into(o, i, j, cand_a, SALT_SYNC_ACK,
+                                params.namespace_gate) and armed:
+                    _ad_note(j, cand_a)
 
     # ---- refutation (SUSPECT/DEAD self-record, or overwritten leave intent;
     # a leaver re-announces LEAVING — see kernel._refute_phase) ----
@@ -455,6 +506,8 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
         diag = int(o.key[i, i])
         rank = diag & 3
         if rank in (RANK_SUSPECT, RANK_DEAD) or (o.leaving[i] and rank != RANK_LEAVING):
+            if armed:
+                ad_refuted[i] = True
             new_rank = RANK_LEAVING if o.leaving[i] else RANK_ALIVE
             # layout-aware SATURATING bump (mirror of lattice.bump_inc):
             # a narrow key must never carry into its epoch bits
@@ -487,6 +540,23 @@ def oracle_tick(state: SimState, key, params: SimParams) -> _O:
                 continue
             o.r_active[ru] = False
 
+    if armed:
+        lh2, ck2, cf2 = _adp.fold(
+            aspec,
+            ad["lh"].astype(np.int32),
+            ad["conf_key"].astype(np.int32),
+            ad["conf"].astype(np.int32),
+            acc_key=np.clip(
+                ad_key, np.iinfo(np.int32).min, np.iinfo(np.int32).max
+            ).astype(np.int32),
+            acc_cnt=np.minimum(ad_cnt, np.iinfo(np.int32).max).astype(np.int32),
+            miss=ad_miss,
+            succ=ad_succ,
+            refuted=ad_refuted,
+            up=o.up,
+            xp=np,
+        )
+        o.ad = {"lh": lh2, "conf_key": ck2, "conf": cf2}
     return o
 
 

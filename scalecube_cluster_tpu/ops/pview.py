@@ -118,6 +118,8 @@ from .rand import (
     fetch_uniform,
     split_tick_key,
 )
+from .. import adaptive as _adp
+from ..adaptive import AdaptiveSpec
 from ..dissemination import strategies as _dz
 from ..dissemination.spec import DissemSpec
 from .sparse import TELEMETRY_SERIES as _SPARSE_TELEMETRY_SERIES, _alloc_phase, _allocate
@@ -196,6 +198,11 @@ class PviewParams:
     # adjacency state, so the O(N·k) forbid_wide_values contract holds
     # unchanged for every strategy.
     dissem: DissemSpec = DissemSpec()
+    # Adaptive failure detection (r14, adaptive.py): default = the
+    # byte-identical legacy program; enabled specs arm the Lifeguard-style
+    # plane via make_pview_adaptive_run. All adaptive state is three [N]
+    # i32 planes — forbid_wide_values holds over adaptive windows too.
+    adaptive: AdaptiveSpec = AdaptiveSpec()
 
     def __post_init__(self):
         if not (0 < self.active_slots < self.view_slots):
@@ -287,6 +294,7 @@ class PviewParams:
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
             dissem=DissemSpec.from_config(config),
+            adaptive=AdaptiveSpec.from_config(config),
         )
 
 
@@ -723,6 +731,20 @@ def sentinel_reduce(state: PviewState, sent: dict, spec: dict) -> dict:
     )
     sent["false_dead_max"] = jnp.maximum(sent["false_dead_max"], false_dead)
 
+    if "fp_watch" in spec:
+        # r14 false-positive sentinel (table-edge twin of the dense check):
+        # degraded-but-alive watched subjects tombstoned by any up observer
+        fp_up = spec["fp_watch"] & state.up
+        fp_edge = valid & state.up[:, None] & (rank == RANK_DEAD) & fp_up[sidc]
+        fp_dead = (
+            jnp.zeros((n + 1,), bool)
+            .at[jnp.where(fp_edge, sid, n)]
+            .max(fp_edge, mode="drop")[:n]
+            .sum()
+            .astype(jnp.int32)
+        )
+        sent["fp_dead_max"] = jnp.maximum(sent["fp_dead_max"], fp_dead)
+
     crash_rows_ = spec["crash_rows"]
     if crash_rows_.shape[0]:
         holds = (
@@ -776,6 +798,8 @@ def sentinel_init(state: PviewState, spec) -> dict:
         "conv_tick": jnp.full((len(spec.conv_from),), -1, jnp.int32),
         "view_invariant_breaks": jnp.int32(0),
     }
+    if spec.fp_watch.size and bool(spec.fp_watch.any()):
+        sent["fp_dead_max"] = jnp.int32(0)  # r14 false-positive sentinel
     return sent
 
 
@@ -953,7 +977,8 @@ def _register_sus(state: PviewState, sus_cand) -> PviewState:
 # ---------------------------------------------------------------------------
 
 
-def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
+def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
+              ad=None):
     """Vectorized FD round over the active view — the sparse ``_fd_phase``
     with slot-space target/relay selection and the self-record ACK."""
     n = state.capacity
@@ -968,8 +993,17 @@ def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
     tgt = tgt_all[:, 0]
     has_tgt = valid[:, 0] & state.up
 
-    p_direct = _rt_timely(state, rows, tgt, params.fd_direct_timeout_ticks) \
-        if params.delay_slots else _rt_at(state, rows, tgt)
+    if params.delay_slots and ad is not None:
+        # Lifeguard LHA (r14, AD-4): per-prober direct-timeout stretch
+        q = jnp.broadcast_to(state.delay_q, (n,))
+        p_direct = _rt_at(state, rows, tgt) * _adp.scaled_timely_rt(
+            q, q, params.fd_direct_timeout_ticks, ad.lh,
+            params.adaptive.lh_max,
+        )
+    elif params.delay_slots:
+        p_direct = _rt_timely(state, rows, tgt, params.fd_direct_timeout_ticks)
+    else:
+        p_direct = _rt_at(state, rows, tgt)
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     relays = tgt_all[:, 1:]
@@ -1007,6 +1041,17 @@ def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
         "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (eff & ~ack).sum(),
     }
+    if ad is not None:
+        # adaptive evidence (r14): sus_cand IS the per-subject max written
+        # suspect key (the episode-key contribution)
+        metrics["_ad_miss"] = has_tgt & ~ack
+        metrics["_ad_succ"] = has_tgt & ack
+        metrics["_ad_cnt"] = (
+            jnp.zeros((n,), jnp.int32)
+            .at[tgt]
+            .add((eff & ~ack).astype(jnp.int32))
+        )
+        metrics["_ad_key"] = sus_cand
     if trace:
         metrics["trace_fd"] = {
             "tgt": tgt.astype(jnp.int32),
@@ -1021,7 +1066,8 @@ def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
     return st, proposals, metrics
 
 
-def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None):
+def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None,
+                       ad=None):
     """Every ``sweep_every`` ticks: (1) suspicion-episode expiry over the
     [N, k] tables + the self records (sparse deviation 1 semantics, static
     timeout — deviation P2), with per-subject announcer election; (2) the
@@ -1046,17 +1092,39 @@ def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None):
         sid = st.nbr_id
         sidc = jnp.maximum(sid, 0)
         is_sus = (keys & 3) == RANK_SUSPECT
+        if ad is not None:
+            # r14 adaptive window: the static base (deviation P2) scaled by
+            # the subject's confirmations and the observer's local health
+            aspec = params.adaptive
+            L = aspec.levels
+            base0 = params.log2n * params.fd_every  # static int
+            num_conf = _adp.conf_mult_num(aspec, ad.conf)  # [N]
+            in_ep = keys <= ad.conf_key[sidc]
+            num = jnp.where(
+                in_ep, num_conf[sidc], jnp.int32(aspec.max_mult * L)
+            )
+            timeout_t = (
+                base0 * num * (1 + ad.lh)[:, None]
+            ) // jnp.int32(L)  # [N, k]
+            in_ep_s = st.self_key <= ad.conf_key
+            num_s = jnp.where(
+                in_ep_s, num_conf, jnp.int32(aspec.max_mult * L)
+            )
+            timeout_s = (base0 * num_s * (1 + ad.lh)) // jnp.int32(L)  # [N]
+        else:
+            timeout_t = timeout
+            timeout_s = timeout
         expired = (
             is_sus
             & st.up[:, None]
-            & ((st.tick - st.sus_since[sidc]) >= timeout)
+            & ((st.tick - st.sus_since[sidc]) >= timeout_t)
             & (keys <= st.sus_key[sidc])
         )
         new_keys = jnp.where(expired, keys + 1, keys)
         self_expired = (
             st.up
             & ((st.self_key & 3) == RANK_SUSPECT)
-            & ((st.tick - st.sus_since) >= timeout)
+            & ((st.tick - st.sus_since) >= timeout_s)
             & (st.self_key <= st.sus_key)
         )
         new_self = jnp.where(self_expired, st.self_key + 1, st.self_key)
@@ -1159,7 +1227,8 @@ def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None):
     return jax.lax.cond(on_tick, _sweep, _skip, state)
 
 
-def _gossip_phase(state: PviewState, r, params: PviewParams):
+def _gossip_phase(state: PviewState, r, params: PviewParams,
+                  adaptive: bool = False):
     """Infection-style dissemination — the sparse ``_gossip_phase`` with
     active-view peer sampling and the per-receiver A-pass record apply
     (deviation P5). Quiescent clusters skip the whole phase."""
@@ -1375,7 +1444,10 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
             # inlines A copies of the accept-and-place graph — compile
             # time, not semantics; pass order is identical)
             def apply_pass(carry, _):
-                st, minf, remaining, sus_acc, delivered, accepts = carry
+                if adaptive:
+                    st, minf, remaining, sus_acc, adcnt, delivered, accepts = carry
+                else:
+                    st, minf, remaining, sus_acc, delivered, accepts = carry
                 col = jnp.argmax(remaining, axis=1).astype(jnp.int32)
                 got = remaining[rows, col]
                 subj = st.mr_subject[col]
@@ -1387,11 +1459,37 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
                     st, subj, cand, got, SALT_GOSSIP, params.active_slots
                 )
                 sus_acc = jnp.maximum(sus_acc, sus_cand)
+                if adaptive:
+                    # r14 confirmation counting: accepted SUSPECT records
+                    # scatter-added per subject (AD-1)
+                    acc_sus = acc & ((cand & 3) == RANK_SUSPECT)
+                    adcnt = adcnt.at[jnp.where(acc_sus, subj, n)].add(
+                        acc_sus.astype(jnp.int32), mode="drop"
+                    )
+                    return (
+                        st, minf, remaining, sus_acc, adcnt,
+                        delivered + got.sum(), accepts + acc.sum(),
+                    ), None
                 return (
                     st, minf, remaining, sus_acc,
                     delivered + got.sum(), accepts + acc.sum(),
                 ), None
 
+            if adaptive:
+                carry0 = (
+                    state, state.minf_age, remaining,
+                    jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.int32(0), jnp.int32(0),
+                )
+                (
+                    (state, minf, _rem, sus_acc, adcnt, delivered, accepts),
+                    _,
+                ) = jax.lax.scan(
+                    apply_pass, carry0, None, length=params.apply_slots
+                )
+                state = _register_sus(state.replace(minf_age=minf), sus_acc)
+                return state, delivered, accepts, adcnt, sus_acc
             carry0 = (
                 state, state.minf_age, remaining,
                 jnp.full((n,), NO_CANDIDATE, jnp.int32),
@@ -1403,31 +1501,52 @@ def _gossip_phase(state: PviewState, r, params: PviewParams):
             state = _register_sus(state.replace(minf_age=minf), sus_acc)
             return state, delivered, accepts
 
-        state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
-            mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)), state
-        )
+        if adaptive:
+            def _mr_skip(st: PviewState):
+                return (
+                    st, jnp.int32(0), jnp.int32(0),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                )
+
+            state, n_mr_deliveries, n_mr_accepts, g_ad_cnt, g_ad_key = (
+                jax.lax.cond(mr_any, _mr_apply, _mr_skip, state)
+            )
+        else:
+            state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
+                mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)),
+                state,
+            )
         if D:
             state = state.replace(
                 pending_inf=pend_u.at[slot_now].set(False),
                 pending_src=pend_src.at[slot_now].set(-1),
                 pending_minf=pend_m.at[slot_now].set(False),
             )
-        return state, {
+        mets = {
             "gossip_msgs": sent,
             "rumor_sends": rumor_sent,
             "rumor_deliveries": newly_u.sum(),
             "mr_deliveries": n_mr_deliveries,
             "mr_accepts": n_mr_accepts,
         }
+        if adaptive:
+            mets["_ad_cnt"] = g_ad_cnt
+            mets["_ad_key"] = g_ad_key
+        return state, mets
 
     def _quiet(state: PviewState):
-        return state, {
+        mets = {
             "gossip_msgs": jnp.int32(0),
             "rumor_sends": jnp.int32(0),
             "rumor_deliveries": jnp.int32(0),
             "mr_deliveries": jnp.int32(0),
             "mr_accepts": jnp.int32(0),
         }
+        if adaptive:
+            mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        return state, mets
 
     return jax.lax.cond(work, _deliver, _quiet, state)
 
@@ -1440,6 +1559,7 @@ def _merge_entries(
     pre_self,
     salt: int,
     params: PviewParams,
+    adaptive: bool = False,
 ):
     """Merge each row's source's PRE-exchange table (k entries + the self
     record) into the row, sequentially by slot (deviation P4) — a
@@ -1459,13 +1579,22 @@ def _merge_entries(
     )
 
     def body(carry, xs):
-        st, acc_cnt, best_key, best_subj, sus_acc = carry
+        if adaptive:
+            st, acc_cnt, best_key, best_subj, sus_acc, adcnt = carry
+        else:
+            st, acc_cnt, best_key, best_subj, sus_acc = carry
         subj, cand = xs
         valid = has & (subj >= 0)
         st, acc, sus_cand = _apply_records(
             st, subj, cand, valid, salt, params.active_slots
         )
         sus_acc = jnp.maximum(sus_acc, sus_cand)
+        if adaptive:
+            # r14 confirmation counting (AD-1): accepted SUSPECT records
+            acc_sus = acc & ((cand & 3) == RANK_SUSPECT)
+            adcnt = adcnt.at[jnp.where(acc_sus, jnp.maximum(subj, 0), n)].add(
+                acc_sus.astype(jnp.int32), mode="drop"
+            )
         acc_cnt = acc_cnt + acc.astype(jnp.int32)
         # running top-P accepted keys (largest first; earlier step wins
         # ties — the re-gossip proposals, sparse deviation 3's cap)
@@ -1478,6 +1607,8 @@ def _merge_entries(
             best_subj = best_subj.at[:, p].set(jnp.where(take, ins_s, old_s))
             ins_k = jnp.where(take, old_k, ins_k)
             ins_s = jnp.where(take, old_s, ins_s)
+        if adaptive:
+            return (st, acc_cnt, best_key, best_subj, sus_acc, adcnt), None
         return (st, acc_cnt, best_key, best_subj, sus_acc), None
 
     carry0 = (
@@ -1487,6 +1618,13 @@ def _merge_entries(
         jnp.zeros((n, P), jnp.int32),
         jnp.full((n,), NO_CANDIDATE, jnp.int32),
     )
+    if adaptive:
+        carry0 = carry0 + (jnp.zeros((n,), jnp.int32),)
+        (state, acc_cnt, best_key, best_subj, sus_acc, adcnt), _ = jax.lax.scan(
+            body, carry0, (subj_steps, cand_steps)
+        )
+        state = _register_sus(state, sus_acc)
+        return state, acc_cnt, best_subj, best_key, adcnt, sus_acc
     (state, acc_cnt, best_key, best_subj, sus_acc), _ = jax.lax.scan(
         body, carry0, (subj_steps, cand_steps)
     )
@@ -1494,7 +1632,8 @@ def _merge_entries(
     return state, acc_cnt, best_subj, best_key
 
 
-def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
+def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
+                adaptive: bool = False):
     """Anti-entropy + shuffle: a due caller exchanges its table (plus self
     record) with one sampled active peer — both directions merge the
     other's PRE-exchange entries (deviation P4); multiple callers on one
@@ -1582,18 +1721,30 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
         .max(jnp.where(ok, jnp.arange(K, dtype=jnp.int32), -1))
     )
     req_src = jnp.where(inv_slot >= 0, caller[jnp.maximum(inv_slot, 0)], -1)
-    st, req_acc_n, req_subj, req_key = _merge_entries(
-        state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params
-    )
+    if adaptive:
+        st, req_acc_n, req_subj, req_key, req_adc, req_adk = _merge_entries(
+            state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params,
+            adaptive=True,
+        )
+    else:
+        st, req_acc_n, req_subj, req_key = _merge_entries(
+            state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params
+        )
     # ACK direction: distinct callers each merge their peer's pre-entries
     ack_src = (
         jnp.full((n,), -1, jnp.int32)
         .at[caller]
         .max(jnp.where(ok, peer, -1))
     )
-    st, ack_acc_n, ack_subj, ack_key = _merge_entries(
-        st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params
-    )
+    if adaptive:
+        st, ack_acc_n, ack_subj, ack_key, ack_adc, ack_adk = _merge_entries(
+            st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params,
+            adaptive=True,
+        )
+    else:
+        st, ack_acc_n, ack_subj, ack_key = _merge_entries(
+            st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params
+        )
 
     ok_full = jnp.zeros((n,), bool).at[caller].max(ok)
     st = st.replace(force_sync=st.force_sync & ~ok_full)
@@ -1615,6 +1766,9 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False):
         jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
     )
     metrics = {"sync_roundtrips": ok.sum()}
+    if adaptive:
+        metrics["_ad_cnt"] = req_adc + ack_adc
+        metrics["_ad_key"] = jnp.maximum(req_adk, ack_adk)
     if trace:
         winner = ok & (inv_slot[peer] == jnp.arange(K))
         metrics["trace_sync"] = {
@@ -1706,10 +1860,26 @@ def _rumor_sweeps(state: PviewState, params: PviewParams) -> PviewState:
 # ---------------------------------------------------------------------------
 
 
-def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=None):
+def pview_tick(state: PviewState, key: jax.Array, params: PviewParams,
+               trace=None, ad=None):
     """One gossip period for all N members, partial-view mode. Pure;
     jit me. Same two-subkey draw split and trace contract as the sparse
-    tick (``trace`` arms the r10 capture; trajectory bit-identical)."""
+    tick (``trace`` arms the r10 capture; trajectory bit-identical).
+
+    ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) arms the adaptive
+    failure-detection plane; the return becomes ``(state, ad', metrics)``.
+    ``ad=None`` traces the byte-identical legacy program. The adaptive
+    plane is three [N] i32 vectors — ``forbid_wide_values`` holds."""
+    armed = ad is not None
+    if armed:
+        if trace is not None:
+            raise ValueError(
+                "trace-armed adaptive windows are not supported"
+            )
+        if params.adaptive.is_default:
+            raise ValueError(
+                "adaptive tick needs an enabled AdaptiveSpec on params"
+            )
     state = state.replace(tick=state.tick + 1)
     fd_key, round_key = split_tick_key(key)
     r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
@@ -1725,7 +1895,7 @@ def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=Non
 
     def _fd_on(st: PviewState):
         fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
-        return _fd_phase(st, fd_r, params, trace=trace is not None)
+        return _fd_phase(st, fd_r, params, trace=trace is not None, ad=ad)
 
     def _fd_off(st: PviewState):
         m = {
@@ -1733,6 +1903,11 @@ def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=Non
             "fd_failed_probes": jnp.int32(0),
             "fd_new_suspects": jnp.int32(0),
         }
+        if armed:
+            m["_ad_miss"] = jnp.zeros((n,), bool)
+            m["_ad_succ"] = jnp.zeros((n,), bool)
+            m["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            m["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
         if trace is not None:
             from ..trace import capture as _tc
 
@@ -1744,9 +1919,11 @@ def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=Non
     if trace is not None:
         state, props_exp, trace_sus = _maintenance_sweep(state, params, trace=trace)
     else:
-        state, props_exp = _maintenance_sweep(state, params)
-    state, g_m = _gossip_phase(state, r, params)
-    state, props_sync, s_m = _sync_phase(state, r, params, trace=trace is not None)
+        state, props_exp = _maintenance_sweep(state, params, ad=ad)
+    state, g_m = _gossip_phase(state, r, params, adaptive=armed)
+    state, props_sync, s_m = _sync_phase(
+        state, r, params, trace=trace is not None, adaptive=armed
+    )
     state, props_ref = _refute_phase(state, params)
     state = _rumor_sweeps(state, params)
     state, a_m = _alloc_phase(
@@ -1755,7 +1932,25 @@ def pview_tick(state: PviewState, key: jax.Array, params: PviewParams, trace=Non
 
     trace_fd = fd_m.pop("trace_fd", None)
     trace_sync = s_m.pop("trace_sync", None)
+    if armed:
+        miss = fd_m.pop("_ad_miss")
+        succ = fd_m.pop("_ad_succ")
+        acc_cnt = fd_m.pop("_ad_cnt") + g_m.pop("_ad_cnt") + s_m.pop("_ad_cnt")
+        acc_key = jnp.maximum(
+            jnp.maximum(fd_m.pop("_ad_key"), g_m.pop("_ad_key")),
+            s_m.pop("_ad_key"),
+        )
+        lh2, ck2, cf2 = _adp.fold(
+            params.adaptive, ad.lh, ad.conf_key, ad.conf,
+            acc_key=acc_key, acc_cnt=acc_cnt,
+            miss=miss, succ=succ, refuted=props_ref[3], up=state.up,
+        )
+        ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
     metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    if armed:
+        metrics["adaptive_lh_high"] = ad.lh.max()
+        metrics["adaptive_conf_high"] = ad.conf.max()
+        return state, ad, metrics
     if trace is not None:
         from ..trace import capture as _tc
 
@@ -1886,6 +2081,48 @@ def run_pview_ticks_traced(
     )
     watched = ms.pop("_watched_keys") if watch_rows is not None else None
     return state, key, ms, watched, trace_buf
+
+
+def run_pview_ticks_adaptive(
+    state: PviewState,
+    ad,
+    key: jax.Array,
+    n_ticks: int,
+    params: PviewParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Adaptive-armed :func:`run_pview_ticks` (r14)."""
+
+    def body(carry, _):
+        st, a, k = carry
+        k, tick_key = jax.random.split(k)
+        st, a, m = pview_tick(st, tick_key, params, ad=a)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=view_rows(st, watch_rows))
+        return (st, a, k), m
+
+    (state, ad, key), ms = jax.lax.scan(
+        body, (state, ad, key), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, ad, key, ms, watched
+
+
+def make_pview_adaptive_run(params: PviewParams, n_ticks: int,
+                            donate: bool = True):
+    """Jitted :func:`run_pview_ticks_adaptive`: engine + adaptive state
+    donated (argnums 0, 1). Refuses a default spec."""
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_pview_adaptive_run needs an enabled AdaptiveSpec on "
+            "params — the default spec's program is make_pview_run's"
+        )
+    return jax.jit(
+        functools.partial(
+            run_pview_ticks_adaptive, n_ticks=n_ticks, params=params
+        ),
+        donate_argnums=(0, 1) if donate else (),
+    )
 
 
 def make_pview_run(params: PviewParams, n_ticks: int, donate: bool = True):

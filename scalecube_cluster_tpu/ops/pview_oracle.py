@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import adaptive as _adp
 from ..dissemination import strategies as _dz
 from .lattice import RANK_ALIVE, RANK_DEAD, RANK_LEAVING, RANK_SUSPECT
 from .rand import (
@@ -166,7 +167,11 @@ def _apply_record_b(o, i, subj, cand, salt, ka, sus: _SusBatch):
     return True
 
 
-def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
+def pview_oracle_tick(state: PviewState, key, params: PviewParams,
+                      ad=None) -> _PO:
+    """``ad`` (r14) is a dict ``{"lh", "conf_key", "conf"}`` of [N] int32
+    numpy arrays mirroring :class:`..adaptive.AdaptiveState`; the folded
+    next state comes back as ``o.ad`` (see ``oracle.oracle_tick``)."""
     n = params.capacity
     f, k_req, T = params.fanout, params.ping_req_k, params.sample_tries
     M, R = params.mr_pool, params.rumor_slots
@@ -180,6 +185,20 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
     t = o.tick
     r = draw_sparse_randoms(key, n, f, k_req, T)
     r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    armed = ad is not None
+    if armed:
+        aspec = params.adaptive
+        ad_miss = np.zeros(n, bool)
+        ad_succ = np.zeros(n, bool)
+        ad_refuted = np.zeros(n, bool)
+        ad_cnt = np.zeros(n, np.int64)
+        ad_keym = np.full(n, NO_CAND, np.int64)
+
+        def _ad_note(j: int, cand: int) -> None:
+            if (cand & 3) == RANK_SUSPECT:
+                ad_cnt[j] += 1
+                ad_keym[j] = max(ad_keym[j], cand)
 
     proposals: list[tuple[list, list, list, list]] = []
 
@@ -195,7 +214,10 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
             if not (valid[0] and pre.up[i]):
                 continue
             tgt_slot, tgt = slots[0], members[0]
-            p_direct = _rt_timely(pre, i, tgt, params.fd_direct_timeout_ticks, D)
+            t_dir = params.fd_direct_timeout_ticks
+            if armed:
+                t_dir = t_dir * (1 + int(ad["lh"][i]))
+            p_direct = _rt_timely(pre, i, tgt, t_dir, D)
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
             for s in range(k_req):
                 if ack:
@@ -215,6 +237,9 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                 cand = (int(pre.self_key[tgt]) >> 2) << 2
             else:
                 cand = ((own >> 2) << 2) | RANK_SUSPECT
+            if armed:
+                ad_miss[i] = not ack
+                ad_succ[i] = bool(ack)
             if cand > own:
                 accepted_so_far += 1
                 if accepted_so_far > V_fd:
@@ -225,6 +250,8 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                 fd_props[3][i] = True
                 if not ack:
                     sus.add(tgt, cand)
+                    if armed:
+                        _ad_note(tgt, cand)
         sus.commit(o)
     proposals.append(fd_props)
 
@@ -233,6 +260,20 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
     if (t % params.sweep_every) == 0:
         if bool((o.sus_since > NEVER).any()):
             timeout = params.suspicion_timeout_ticks
+            base0 = params.log2n * params.fd_every
+
+            def _timeout_of(i: int, subj: int, kij: int) -> int:
+                if not armed:
+                    return timeout
+                L = aspec.levels
+                in_ep = kij <= int(ad["conf_key"][subj])
+                num = (
+                    _adp.conf_mult_num_scalar(aspec, int(ad["conf"][subj]))
+                    if in_ep
+                    else aspec.max_mult * L
+                )
+                return (base0 * num * (1 + int(ad["lh"][i]))) // L
+
             expired = np.zeros((n, k), bool)
             for i in range(n):
                 if not o.up[i]:
@@ -244,7 +285,7 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                     kij = o.key_i32(i, s)
                     if (
                         (kij & 3) == RANK_SUSPECT
-                        and t - int(o.sus_since[subj]) >= timeout
+                        and t - int(o.sus_since[subj]) >= _timeout_of(i, subj, kij)
                         and kij <= int(o.sus_key[subj])
                     ):
                         expired[i, s] = True
@@ -271,7 +312,7 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                 if (
                     o.up[i]
                     and (sk & 3) == RANK_SUSPECT
-                    and t - int(o.sus_since[i]) >= timeout
+                    and t - int(o.sus_since[i]) >= _timeout_of(i, i, sk)
                     and sk <= int(o.sus_key[i])
                 ):
                     o.self_key[i] = sk + 1
@@ -488,10 +529,12 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                         continue
                     mm = eligible[i][a]
                     o.minf_age[i, mm] = 1
-                    _apply_record_b(
-                        o, i, int(pre.mr_subject[mm]), int(pre.mr_key[mm]),
-                        SALT_GOSSIP, ka, sus,
-                    )
+                    subj_m = int(pre.mr_subject[mm])
+                    cand_m = int(pre.mr_key[mm])
+                    if _apply_record_b(
+                        o, i, subj_m, cand_m, SALT_GOSSIP, ka, sus,
+                    ) and armed:
+                        _ad_note(subj_m, cand_m)
                 sus.commit(o)
         if D:
             o.pending_inf[slot_now] = False
@@ -579,6 +622,8 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
                     continue
                 acc = _apply_record_b(o, i, subj, cand, salt, ka, sus)
                 if acc:
+                    if armed:
+                        _ad_note(subj, cand)
                     acc_cnt[i] += 1
                     ins_k, ins_s = cand, subj
                     b = best[i]
@@ -647,6 +692,8 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
         ref_props[1][i] = new_diag
         ref_props[3][i] = need
         if need:
+            if armed:
+                ad_refuted[i] = True
             o.self_key[i] = new_diag
     proposals.append(ref_props)
     proposals.append(sync_props)
@@ -781,6 +828,21 @@ def pview_oracle_tick(state: PviewState, key, params: PviewParams) -> _PO:
             o.mr_created[slot] = t
             o.mr_origin[slot] = oo
             o.minf_age[oo, slot] = 1
+    if armed:
+        lh2, ck2, cf2 = _adp.fold(
+            aspec,
+            ad["lh"].astype(np.int32),
+            ad["conf_key"].astype(np.int32),
+            ad["conf"].astype(np.int32),
+            acc_key=ad_keym.astype(np.int32),
+            acc_cnt=np.minimum(ad_cnt, np.iinfo(np.int32).max).astype(np.int32),
+            miss=ad_miss,
+            succ=ad_succ,
+            refuted=ad_refuted,
+            up=o.up,
+            xp=np,
+        )
+        o.ad = {"lh": lh2, "conf_key": ck2, "conf": cf2}
     return o
 
 

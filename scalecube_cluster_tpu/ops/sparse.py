@@ -125,6 +125,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from .. import adaptive as _adp
+from ..adaptive import AdaptiveSpec
 from ..dissemination import strategies as _dz
 from ..dissemination.spec import DissemSpec
 from .kernel import TELEMETRY_SERIES as _CORE_TELEMETRY_SERIES, ceil_log2
@@ -293,6 +295,7 @@ class SparseParams:
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
             dissem=DissemSpec.from_config(config),
+            adaptive=AdaptiveSpec.from_config(config),
         )
 
     # hierarchical-namespace relatedness gate on every merge accept
@@ -304,6 +307,10 @@ class SparseParams:
     # spec traces the byte-identical legacy program; non-default specs swap
     # only the gossip phase's peer selection / payload policy.
     dissem: DissemSpec = DissemSpec()
+    # Adaptive failure detection (r14, adaptive.py): default = byte-identical
+    # legacy program; enabled specs arm the Lifeguard-style plane (windows
+    # built via make_sparse_adaptive_run).
+    adaptive: AdaptiveSpec = AdaptiveSpec()
 
 
 class SparseState(struct.PyTreeNode):
@@ -1023,7 +1030,8 @@ from .bitplane import pack_bits as _pack_bits, unpack_bits as _unpack_bits
 # ---------------------------------------------------------------------------
 
 
-def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
+def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False,
+              ad=None):
     """Vectorized FD round (``FailureDetectorImpl`` semantics, as the dense
     kernel's ``_fd_phase``) with rejection-sampled target/relay selection.
     Returns (state, proposals, metrics)."""
@@ -1037,11 +1045,22 @@ def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
 
     p_direct = _rt_at(state, rows, tgt)
     if params.delay_slots:
-        p_direct = p_direct * _timely_rt(
-            _delay_q_at(state, rows, tgt),
-            _delay_q_at(state, tgt, rows),
-            params.fd_direct_timeout_ticks,
-        )
+        if ad is not None:
+            # Lifeguard LHA (r14, AD-4): the prober's own direct timeout
+            # stretches to t_base * (1 + lh_i)
+            p_direct = p_direct * _adp.scaled_timely_rt(
+                _delay_q_at(state, rows, tgt),
+                _delay_q_at(state, tgt, rows),
+                params.fd_direct_timeout_ticks,
+                ad.lh,
+                params.adaptive.lh_max,
+            )
+        else:
+            p_direct = p_direct * _timely_rt(
+                _delay_q_at(state, rows, tgt),
+                _delay_q_at(state, tgt, rows),
+                params.fd_direct_timeout_ticks,
+            )
     direct_ok = has_tgt & state.up[tgt] & (r.fd_direct < p_direct)
 
     relays = sel[:, 1:]
@@ -1106,6 +1125,20 @@ def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
         "fd_failed_probes": (has_tgt & ~ack).sum(),
         "fd_new_suspects": (eff & ~ack).sum(),
     }
+    if ad is not None:
+        # adaptive evidence exports (r14): miss/succ feed lh un-throttled;
+        # confirmations count only WRITTEN suspect verdicts (eff)
+        sus_w = eff & ~ack
+        metrics["_ad_miss"] = has_tgt & ~ack
+        metrics["_ad_succ"] = has_tgt & ack
+        metrics["_ad_cnt"] = (
+            jnp.zeros((n,), jnp.int32).at[tgt].add(sus_w.astype(jnp.int32))
+        )
+        metrics["_ad_key"] = (
+            jnp.full((n,), NO_CANDIDATE, jnp.int32)
+            .at[tgt]
+            .max(jnp.where(sus_w, cand, NO_CANDIDATE))
+        )
     if trace:
         # trace-plane export (r10, same contract as kernel._fd_phase):
         # already-computed probe internals — zero effect on the state math
@@ -1122,13 +1155,17 @@ def _fd_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
     return st, proposals, metrics
 
 
-def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None):
+def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None,
+                     ad=None):
     """Dense expiry pass, every ``sweep_every`` ticks: SUSPECT cells whose
     subject's episode stamp is older than the observer's suspicion timeout
     become DEAD at the same incarnation (rank +1). O(N²/B) amortized.
     Returns (state, proposals) — plus the tracers' expiry export when
     ``trace`` (a TraceSpec) is set (r10; read off the sweep branch's own
-    ``expired`` temp, see ``trace.capture.expiry_trace``)."""
+    ``expired`` temp, see ``trace.capture.expiry_trace``).
+
+    ``ad`` (r14) swaps the static timeout for the confirmation-scaled,
+    observer-health-scaled window (see ``kernel._suspicion_phase``)."""
     n = state.capacity
     rows = jnp.arange(n)
     no_props = (
@@ -1139,12 +1176,28 @@ def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None):
     )
 
     def _sweep(st: SparseState):
-        timeout = params.suspicion_mult * ceil_log2(st.n_live) * params.fd_every
+        if ad is not None:
+            aspec = params.adaptive
+            L = aspec.levels
+            base = ceil_log2(st.n_live) * params.fd_every  # [N]
+            num_conf = _adp.conf_mult_num(aspec, ad.conf)  # [N]
+            in_ep = st.view_key <= ad.conf_key[None, :]
+            num = jnp.where(
+                in_ep, num_conf[None, :], jnp.int32(aspec.max_mult * L)
+            )
+            factor = base * (1 + ad.lh)  # [N] — AD-3 observer scaling
+            timeout2 = (factor[:, None] * num) // jnp.int32(L)  # [N, N]
+            overdue = (st.tick - st.sus_since)[None, :] >= timeout2
+        else:
+            timeout = (
+                params.suspicion_mult * ceil_log2(st.n_live) * params.fd_every
+            )
+            overdue = (st.tick - st.sus_since)[None, :] >= timeout[:, None]
         suspect = (st.view_key & 3) == RANK_SUSPECT
         expired = (
             suspect
             & st.up[:, None]
-            & ((st.tick - st.sus_since)[None, :] >= timeout[:, None])
+            & overdue
             & (st.view_key <= st.sus_key[None, :])
         )
         new_key = jnp.where(expired, st.view_key + 1, st.view_key)
@@ -1193,7 +1246,8 @@ def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None):
     return jax.lax.cond(on_tick & has_suspects, _sweep, _skip, state)
 
 
-def _gossip_phase(state: SparseState, r, params: SparseParams):
+def _gossip_phase(state: SparseState, r, params: SparseParams,
+                  adaptive: bool = False):
     """Infection-style dissemination of user rumors ([N, R], full fidelity)
     and membership rumors ([N, M], origin-filter — deviation 2). One message
     per (sender, peer) edge carries both payloads, as the reference's single
@@ -1496,7 +1550,10 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
             rank3 = n % 32 == 0 and not params.namespace_gate
 
             def _block(b, carry):
-                vk, ndT, cj, dacc, sus, cnt = carry
+                if adaptive:
+                    vk, ndT, cj, dacc, sus, cnt, adcnt = carry
+                else:
+                    vk, ndT, cj, dacc, sus, cnt = carry
                 c0 = b * NB
                 cols = c0 + jnp.arange(NB, dtype=jnp.int32)
                 # [NB, Wo] packed words -> small transpose -> bit expansion
@@ -1569,6 +1626,18 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 cnt = cnt + accept.sum()
                 # episode registration for accepted SUSPECT records
                 sus = jax.lax.dynamic_update_slice(sus, sus_col, (c0,))
+                if adaptive:
+                    # r14 confirmation counting: accepted SUSPECT records
+                    # per subject column (AD-1)
+                    acc_sus = accept & ((cand_b & 3) == RANK_SUSPECT)
+                    if rank3:
+                        adcnt_col = acc_sus.astype(jnp.int32).sum(axis=(0, 1))
+                    else:
+                        adcnt_col = acc_sus.astype(jnp.int32).sum(axis=0)
+                    adcnt = jax.lax.dynamic_update_slice(
+                        adcnt, adcnt_col, (c0,)
+                    )
+                    return vk, ndT, cj, dacc, sus, cnt, adcnt
                 return vk, ndT, cj, dacc, sus, cnt
 
             # nd_T and cand_j ride the carry DELIBERATELY (not closed over):
@@ -1584,11 +1653,16 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                 jnp.full((n,), NO_CANDIDATE, jnp.int32),
                 jnp.int32(0),
             )
+            if adaptive:
+                carry0 = carry0 + (jnp.zeros((n,), jnp.int32),)
             if nb == 1:
                 carry = _block(0, carry0)
             else:
                 carry = jax.lax.fori_loop(0, nb, _block, carry0)
-            vk, _ndT, _cj, delta, sus_cand, acc_cnt = carry
+            if adaptive:
+                vk, _ndT, _cj, delta, sus_cand, acc_cnt, ad_cnt = carry
+            else:
+                vk, _ndT, _cj, delta, sus_cand, acc_cnt = carry
             new_sus = jnp.maximum(state.sus_key, sus_cand)
             state = state.replace(
                 view_key=vk,
@@ -1599,38 +1673,64 @@ def _gossip_phase(state: SparseState, r, params: SparseParams):
                     new_sus > state.sus_key, state.tick, state.sus_since
                 ),
             )
+            if adaptive:
+                # sus_cand IS the per-subject max accepted SUSPECT key —
+                # the r14 episode-key contribution (AD-1)
+                return state, newly.sum(), acc_cnt, ad_cnt, sus_cand
             return state, newly.sum(), acc_cnt
 
-        state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
-            mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)), state
-        )
+        if adaptive:
+            def _mr_skip(st: SparseState):
+                return (
+                    st, jnp.int32(0), jnp.int32(0),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                )
+
+            state, n_mr_deliveries, n_mr_accepts, g_ad_cnt, g_ad_key = (
+                jax.lax.cond(mr_any, _mr_apply, _mr_skip, state)
+            )
+        else:
+            state, n_mr_deliveries, n_mr_accepts = jax.lax.cond(
+                mr_any, _mr_apply, lambda st: (st, jnp.int32(0), jnp.int32(0)),
+                state,
+            )
         if D:
             state = state.replace(
                 pending_inf=pend_u.at[slot_now].set(False),
                 pending_src=pend_src.at[slot_now].set(-1),
                 pending_minf=pend_m.at[slot_now].set(False),
             )
-        return state, {
+        mets = {
             "gossip_msgs": sent,
             "rumor_sends": rumor_sent,
             "rumor_deliveries": newly_u.sum(),
             "mr_deliveries": n_mr_deliveries,
             "mr_accepts": n_mr_accepts,
         }
+        if adaptive:
+            mets["_ad_cnt"] = g_ad_cnt
+            mets["_ad_key"] = g_ad_key
+        return state, mets
 
     def _quiet(state: SparseState):
-        return state, {
+        mets = {
             "gossip_msgs": jnp.int32(0),
             "rumor_sends": jnp.int32(0),
             "rumor_deliveries": jnp.int32(0),
             "mr_deliveries": jnp.int32(0),
             "mr_accepts": jnp.int32(0),
         }
+        if adaptive:
+            mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        return state, mets
 
     return jax.lax.cond(work, _deliver, _quiet, state)
 
 
-def _sync_phase(state: SparseState, r, params: SparseParams, trace: bool = False):
+def _sync_phase(state: SparseState, r, params: SparseParams, trace: bool = False,
+                adaptive: bool = False):
     """Anti-entropy full-table exchange — the dense kernel's compacted-K
     design (O(K·N)), minus ``changed_at``, plus liveness-delta upkeep,
     episode registration, and capped re-gossip proposals (deviation 3;
@@ -1830,6 +1930,19 @@ def _sync_phase(state: SparseState, r, params: SparseParams, trace: bool = False
         jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
     )
     metrics = {"sync_roundtrips": ok.sum()}
+    if adaptive:
+        # r14 confirmation evidence: accepted SUSPECT records both ways.
+        # Duplicate peer slots recompute identical acc rows — count the
+        # first slot per peer only (callers are distinct).
+        m_req = acc & first_p[:, None] & ((buf_p & 3) == RANK_SUSPECT)
+        m_ack = accept & ((ack_cand & 3) == RANK_SUSPECT)
+        metrics["_ad_cnt"] = (
+            m_req.astype(jnp.int32).sum(axis=0)
+            + m_ack.astype(jnp.int32).sum(axis=0)
+        )
+        # sus_req/sus_ack are already the per-subject max accepted SUSPECT
+        # keys of the two directions (the episode-key contribution)
+        metrics["_ad_key"] = sus_cand
     if trace:
         # trace-plane export (r10, same contract as kernel._sync_phase)
         metrics["trace_sync"] = {
@@ -2046,14 +2159,29 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
 # ---------------------------------------------------------------------------
 
 
-def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=None):
+def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
+                trace=None, ad=None):
     """One gossip period for all N members, sparse mode. Pure; jit/shard me.
 
     ``trace`` (a :class:`..trace.schema.TraceSpec`, static) arms the causal
     trace plane — same contract as ``kernel.tick``: the metrics dict gains
     a ``_trace_rows`` [K, F] block built from read-only [N]-sized phase
     internals (never a read of the carried [N, N] planes); the state
-    trajectory is bit-identical armed vs unarmed."""
+    trajectory is bit-identical armed vs unarmed.
+
+    ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) arms the adaptive
+    failure-detection plane; the return becomes ``(state, ad', metrics)``.
+    ``ad=None`` traces the byte-identical legacy program."""
+    armed = ad is not None
+    if armed:
+        if trace is not None:
+            raise ValueError(
+                "trace-armed adaptive windows are not supported"
+            )
+        if params.adaptive.is_default:
+            raise ValueError(
+                "adaptive tick needs an enabled AdaptiveSpec on params"
+            )
     state = state.replace(tick=state.tick + 1)
     fd_key, round_key = split_tick_key(key)
     r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
@@ -2069,7 +2197,7 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=
 
     def _fd_on(st: SparseState):
         fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
-        return _fd_phase(st, fd_r, params, trace=trace is not None)
+        return _fd_phase(st, fd_r, params, trace=trace is not None, ad=ad)
 
     def _fd_off(st: SparseState):
         m = {
@@ -2077,6 +2205,11 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=
             "fd_failed_probes": jnp.int32(0),
             "fd_new_suspects": jnp.int32(0),
         }
+        if armed:
+            m["_ad_miss"] = jnp.zeros((n,), bool)
+            m["_ad_succ"] = jnp.zeros((n,), bool)
+            m["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            m["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
         if trace is not None:
             from ..trace import capture as _tc
 
@@ -2088,9 +2221,11 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=
     if trace is not None:
         state, props_exp, trace_sus = _suspicion_sweep(state, params, trace=trace)
     else:
-        state, props_exp = _suspicion_sweep(state, params)
-    state, g_m = _gossip_phase(state, r, params)
-    state, props_sync, s_m = _sync_phase(state, r, params, trace=trace is not None)
+        state, props_exp = _suspicion_sweep(state, params, ad=ad)
+    state, g_m = _gossip_phase(state, r, params, adaptive=armed)
+    state, props_sync, s_m = _sync_phase(
+        state, r, params, trace=trace is not None, adaptive=armed
+    )
     state, props_ref = _refute_phase(state, params)
     state = _rumor_sweeps(state, params)
     # allocation compaction takes the first E valid proposals in this order:
@@ -2102,7 +2237,25 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams, trace=
 
     trace_fd = fd_m.pop("trace_fd", None)
     trace_sync = s_m.pop("trace_sync", None)
+    if armed:
+        miss = fd_m.pop("_ad_miss")
+        succ = fd_m.pop("_ad_succ")
+        acc_cnt = fd_m.pop("_ad_cnt") + g_m.pop("_ad_cnt") + s_m.pop("_ad_cnt")
+        acc_key = jnp.maximum(
+            jnp.maximum(fd_m.pop("_ad_key"), g_m.pop("_ad_key")),
+            s_m.pop("_ad_key"),
+        )
+        lh2, ck2, cf2 = _adp.fold(
+            params.adaptive, ad.lh, ad.conf_key, ad.conf,
+            acc_key=acc_key, acc_cnt=acc_cnt,
+            miss=miss, succ=succ, refuted=props_ref[3], up=state.up,
+        )
+        ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
     metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    if armed:
+        metrics["adaptive_lh_high"] = ad.lh.max()
+        metrics["adaptive_conf_high"] = ad.conf.max()
+        return state, ad, metrics
     if trace is not None:
         from ..trace import capture as _tc
 
@@ -2256,6 +2409,52 @@ def make_sparse_traced_run(
             run_sparse_ticks_traced, n_ticks=n_ticks, params=params, trace=trace
         ),
         donate_argnums=(0, 2) if donate else (),
+    )
+
+
+def run_sparse_ticks_adaptive(
+    state: SparseState,
+    ad,
+    key: jax.Array,
+    n_ticks: int,
+    params: SparseParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Adaptive-armed :func:`run_sparse_ticks` (r14): the AdaptiveState
+    rides the scan carry alongside the engine state; same key chain."""
+
+    def body(carry, _):
+        st, a, k = carry
+        k, tick_key = jax.random.split(k)
+        st, a, m = sparse_tick(st, tick_key, params, ad=a)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=st.view_key[watch_rows])
+        return (st, a, k), m
+
+    (state, ad, key), ms = jax.lax.scan(
+        body, (state, ad, key), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, ad, key, ms, watched
+
+
+def make_sparse_adaptive_run(params: SparseParams, n_ticks: int,
+                             donate: bool = True):
+    """Jitted :func:`run_sparse_ticks_adaptive`: engine + adaptive state
+    donated (argnums 0, 1). Refuses a default spec (the legacy builder is
+    the byte-identical program for that case)."""
+    import functools
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_sparse_adaptive_run needs an enabled AdaptiveSpec on "
+            "params — the default spec's program is make_sparse_run's"
+        )
+    return jax.jit(
+        functools.partial(
+            run_sparse_ticks_adaptive, n_ticks=n_ticks, params=params
+        ),
+        donate_argnums=(0, 1) if donate else (),
     )
 
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import adaptive as _adp
 from ..dissemination import strategies as _dz
 from .lattice import RANK_ALIVE, RANK_DEAD, RANK_LEAVING, RANK_SUSPECT
 from .rand import (
@@ -118,7 +119,11 @@ def _accept_gates(o, i: int, j: int, cand: int, salt: int) -> bool:
     return True
 
 
-def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
+def sparse_oracle_tick(state: SparseState, key, params: SparseParams,
+                       ad=None) -> _SO:
+    """``ad`` (r14) is a dict ``{"lh", "conf_key", "conf"}`` of [N] int32
+    numpy arrays mirroring :class:`..adaptive.AdaptiveState`; the folded
+    next state comes back as ``o.ad`` (see ``oracle.oracle_tick``)."""
     n = params.capacity
     f, k_req, T = params.fanout, params.ping_req_k, params.sample_tries
     M, R = params.mr_slots, params.rumor_slots
@@ -128,6 +133,20 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     t = o.tick
     r = draw_sparse_randoms(key, n, f, k_req, T)
     r = {name: np.asarray(getattr(r, name)) for name in r._fields}
+
+    armed = ad is not None
+    if armed:
+        aspec = params.adaptive
+        ad_miss = np.zeros(n, bool)
+        ad_succ = np.zeros(n, bool)
+        ad_refuted = np.zeros(n, bool)
+        ad_cnt = np.zeros(n, np.int64)
+        ad_keym = np.full(n, NO_CAND, np.int64)
+
+        def _ad_note(j: int, cand: int) -> None:
+            if (cand & 3) == RANK_SUSPECT:
+                ad_cnt[j] += 1
+                ad_keym[j] = max(ad_keym[j], cand)
 
     proposals: list[tuple[list, list, list, list]] = []
 
@@ -145,10 +164,12 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             tgt = int(sel[0])
             p_direct = _rt(pre, i, tgt)
             if D:
+                t_dir = params.fd_direct_timeout_ticks
+                if armed:
+                    t_dir = t_dir * (1 + int(ad["lh"][i]))
                 p_direct = np.float32(
                     p_direct
-                    * _timely(_dq(pre, i, tgt), _dq(pre, tgt, i),
-                              params.fd_direct_timeout_ticks)
+                    * _timely(_dq(pre, i, tgt), _dq(pre, tgt, i), t_dir)
                 )
             ack = bool(pre.up[tgt]) and bool(r["fd_direct"][i] < p_direct)
             for s in range(k_req):
@@ -174,6 +195,9 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 cand = (int(pre.view_key[tgt, tgt]) >> 2) << 2
             else:
                 cand = ((own >> 2) << 2) | RANK_SUSPECT
+            if armed:
+                ad_miss[i] = not ack
+                ad_succ[i] = bool(ack)
             if cand > own:
                 # verdict throttle: first V accepting rows write this round
                 accepted_so_far += 1
@@ -185,6 +209,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 fd_props[3][i] = True
                 if not ack:
                     sus_cand[tgt] = max(sus_cand[tgt], cand)
+                    if armed:
+                        _ad_note(tgt, cand)
         for j in range(n):
             if sus_cand[j] > int(o.sus_key[j]):
                 o.sus_key[j] = sus_cand[j]
@@ -194,9 +220,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
     # ---- suspicion expiry sweep (per-episode stamps, every sweep_every) ----
     exp_props = ([0] * n, [0] * n, list(range(n)), [False] * n)
     if (t % params.sweep_every) == 0 and bool((o.sus_since > NEVER).any()):
-        timeout = {
-            i: params.suspicion_mult * _ceil_log2(int(o.n_live[i])) * params.fd_every
-            for i in range(n)
+        base = {
+            i: _ceil_log2(int(o.n_live[i])) * params.fd_every for i in range(n)
         }
         expired = np.zeros((n, n), bool)
         for i in range(n):
@@ -204,9 +229,23 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 continue
             for j in range(n):
                 kij = int(o.view_key[i, j])
+                if (kij & 3) != RANK_SUSPECT:
+                    continue
+                if armed:
+                    L = aspec.levels
+                    in_ep = kij <= int(ad["conf_key"][j])
+                    num = (
+                        _adp.conf_mult_num_scalar(aspec, int(ad["conf"][j]))
+                        if in_ep
+                        else aspec.max_mult * L
+                    )
+                    timeout_ij = (
+                        base[i] * num * (1 + int(ad["lh"][i]))
+                    ) // L
+                else:
+                    timeout_ij = params.suspicion_mult * base[i]
                 if (
-                    (kij & 3) == RANK_SUSPECT
-                    and t - int(o.sus_since[j]) >= timeout[i]
+                    t - int(o.sus_since[j]) >= timeout_ij
                     and kij <= int(o.sus_key[j])
                 ):
                     expired[i, j] = True
@@ -411,6 +450,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                     continue
                 o.view_key[i, subj] = cand
                 delta += int((cand & 3) != RANK_DEAD) - int((own & 3) != RANK_DEAD)
+                if armed:
+                    _ad_note(subj, cand)
                 if (cand & 3) == RANK_SUSPECT and cand > int(o.sus_key[subj]):
                     o.sus_key[subj] = cand
                     o.sus_since[subj] = t
@@ -500,6 +541,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             ):
                 continue
             new_row[j] = cand
+            if armed:
+                _ad_note(j, cand)
             if (cand & 3) == RANK_SUSPECT:
                 sus_cand[j] = max(sus_cand[j], cand)
         delta = int(
@@ -532,6 +575,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
                 continue
             new_row[j] = cand
             acc[j] = True
+            if armed:
+                _ad_note(j, cand)
             if (cand & 3) == RANK_SUSPECT:
                 sus_cand[j] = max(sus_cand[j], cand)
         delta = int(
@@ -616,6 +661,8 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
         ref_props[1][i] = new_diag
         ref_props[3][i] = need
         if need:
+            if armed:
+                ad_refuted[i] = True
             if rank == RANK_DEAD:
                 o.n_live[i] += 1
             o.view_key[i, i] = new_diag
@@ -780,6 +827,21 @@ def sparse_oracle_tick(state: SparseState, key, params: SparseParams) -> _SO:
             o.mr_created[slot] = t
             o.mr_origin[slot] = oo
             o.minf_age[oo, slot] = 1
+    if armed:
+        lh2, ck2, cf2 = _adp.fold(
+            aspec,
+            ad["lh"].astype(np.int32),
+            ad["conf_key"].astype(np.int32),
+            ad["conf"].astype(np.int32),
+            acc_key=ad_keym.astype(np.int32),
+            acc_cnt=np.minimum(ad_cnt, np.iinfo(np.int32).max).astype(np.int32),
+            miss=ad_miss,
+            succ=ad_succ,
+            refuted=ad_refuted,
+            up=o.up,
+            xp=np,
+        )
+        o.ad = {"lh": lh2, "conf_key": ck2, "conf": cf2}
     return o
 
 

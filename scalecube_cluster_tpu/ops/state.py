@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..adaptive import AdaptiveSpec
 from ..config import ClusterConfig
 from ..dissemination.spec import DissemSpec
 from . import bitplane
@@ -125,6 +126,12 @@ class SimParams:
     # keep the reference's uniform semantics). Config spelling:
     # ClusterConfig.dissemination.
     dissem: DissemSpec = DissemSpec()
+    # Adaptive failure detection (r14, adaptive.py): the default spec is
+    # the byte-identical legacy program; an enabled spec arms the
+    # Lifeguard-style local-health + confirmation-scaled suspicion plane
+    # (windows built via make_adaptive_run, AdaptiveState threaded through
+    # the scan carry). Config spelling: ClusterConfig.adaptive.
+    adaptive: AdaptiveSpec = AdaptiveSpec()
 
     @staticmethod
     def from_config(
@@ -170,6 +177,7 @@ class SimParams:
             ),
             sync_timeout_ticks=max(0, int(config.membership.sync_timeout / dt)),
             dissem=DissemSpec.from_config(config),
+            adaptive=AdaptiveSpec.from_config(config),
         )
 
 

@@ -207,6 +207,21 @@ class SimDriver:
             )
         if dense_links is None:
             dense_links = self._eng.dense_links_default
+        # r14 adaptive failure detection: an ENABLED AdaptiveSpec on params
+        # arms the Lifeguard-style plane — the driver owns the AdaptiveState
+        # pytree and threads it through the adaptive window programs
+        aspec = getattr(params, "adaptive", None)
+        if aspec is not None and not aspec.is_default:
+            if mesh is not None:
+                raise ValueError(
+                    "adaptive failure detection is single-device for now — "
+                    "construct without mesh= or use the default AdaptiveSpec"
+                )
+            from ..adaptive import init_adaptive_state
+
+            self._ad = init_adaptive_state(params.capacity)
+        else:
+            self._ad = None
         init = self._eng.init_state(params, n_initial, warm, dense_links)
         self._dense_links = init.loss.ndim != 0
         if mesh is not None:
@@ -342,11 +357,16 @@ class SimDriver:
         from a single transfer. An armed trace plane (r10) keys separate
         TRACED window programs — same trajectory, ring threaded through."""
         traced = self._trace is not None
-        cache_key = (n_ticks, n_watch, traced)
+        adaptive = self._ad is not None
+        cache_key = (n_ticks, n_watch, traced, adaptive)
         if cache_key not in self._step_cache:
             if traced:
                 self._step_cache[cache_key] = self._eng.make_traced_run(
                     self.params, n_ticks, self._trace.spec
+                )
+            elif adaptive:
+                self._step_cache[cache_key] = self._eng.make_adaptive_run(
+                    self.params, n_ticks
                 )
             elif self.mesh is not None:
                 self._step_cache[cache_key] = self._eng.make_sharded_run(
@@ -379,7 +399,9 @@ class SimDriver:
         rows = sorted(self._watches)
         watch_arr = jnp.asarray(rows, dtype=jnp.int32) if rows else None
         step = self._get_step(n_ticks, len(rows))
-        stats = self._step_stats[(n_ticks, len(rows), self._trace is not None)]
+        stats = self._step_stats[
+            (n_ticks, len(rows), self._trace is not None, self._ad is not None)
+        ]
         t0 = time.perf_counter()
         if self._trace is not None:
             # traced window: the trace ring rides the donated carry; the
@@ -396,6 +418,12 @@ class SimDriver:
             # on_window pattern; the diff must NOT live inside the window
             # jit, see trace/capture.py)
             self._trace.on_window(self.state)
+        elif self._ad is not None:
+            # adaptive window (r14): the AdaptiveState pytree rides the
+            # donated carry next to the engine state
+            self.state, self._ad, self._key, ms, watched = step(
+                self.state, self._ad, self._key, watch_rows=watch_arr
+            )
         else:
             self.state, self._key, ms, watched = step(
                 self.state, self._key, watch_rows=watch_arr
@@ -1047,6 +1075,12 @@ class SimDriver:
         with self._lock:
             if self._trace is not None:
                 return self._trace
+            if self._ad is not None:
+                raise ValueError(
+                    "trace capture and adaptive failure detection cannot "
+                    "share a driver yet — use set_adaptive(None) first, or "
+                    "trace a static-FD driver"
+                )
             if self.mesh is not None:
                 raise ValueError(
                     "trace capture is single-device for now — arm on an "
@@ -1111,6 +1145,60 @@ class SimDriver:
             self._step_cache.clear()
             self._step_stats.clear()
 
+    def set_adaptive(self, spec=None, *, enabled: bool | None = None,
+                     **spec_kw) -> None:
+        """Swap the adaptive-FD spec (r14) on a live driver.
+
+        Pass a full :class:`..adaptive.AdaptiveSpec` (or ``None`` plus
+        field overrides applied to the current spec; ``set_adaptive(None)``
+        with no overrides DISARMS). Like :meth:`set_dissemination` the spec
+        is a static program property — the window cache is invalidated —
+        but arming/disarming also creates/drops the AdaptiveState planes:
+        local-health and confirmation memory start fresh (scores are
+        evidence about the CURRENT network conditions; a knob change is a
+        new experiment)."""
+        import dataclasses as _dc
+
+        from ..adaptive import AdaptiveSpec, init_adaptive_state
+
+        with self._lock:
+            cur = getattr(self.params, "adaptive", AdaptiveSpec())
+            if spec is None:
+                overrides = {
+                    k: v
+                    for k, v in dict(enabled=enabled, **spec_kw).items()
+                    if v is not None
+                }
+                spec = (
+                    _dc.replace(cur, **overrides)
+                    if overrides
+                    else AdaptiveSpec()
+                )
+            if spec == cur and (self._ad is not None) == (not spec.is_default):
+                return
+            if not spec.is_default:
+                if self.mesh is not None:
+                    raise ValueError(
+                        "adaptive failure detection is single-device for now"
+                    )
+                if self._trace is not None:
+                    raise ValueError(
+                        "trace capture and adaptive failure detection cannot "
+                        "share a driver yet"
+                    )
+            self.params = _dc.replace(self.params, adaptive=spec)
+            self._ad = (
+                None if spec.is_default
+                else init_adaptive_state(self.params.capacity)
+            )
+            self._step_cache.clear()
+            self._step_stats.clear()
+
+    @property
+    def adaptive_state(self):
+        """The armed :class:`..adaptive.AdaptiveState`, or None (static FD)."""
+        return self._ad
+
     def run_scenario(
         self,
         scenario,
@@ -1122,6 +1210,7 @@ class SimDriver:
         strategy: str | None = None,
         topology: str | None = None,
         dissem=None,
+        adaptive=None,
     ) -> dict:
         """Run a :class:`..chaos.Scenario` against this driver: scripted
         fault events applied between windows (partitions, loss storms, link
@@ -1148,6 +1237,9 @@ class SimDriver:
 
         if dissem is not None or strategy is not None or topology is not None:
             self.set_dissemination(dissem, strategy=strategy, topology=topology)
+        if adaptive is not None:
+            # r14: arm (or swap) the adaptive-FD plane before the scenario
+            self.set_adaptive(adaptive)
         return run_driver_scenario(
             self, scenario, config=config, sentinels=sentinels,
             max_window=max_window, trace=trace,
@@ -1224,7 +1316,7 @@ class SimDriver:
             ),
         }
         host_bytes = pickle.dumps(host)
-        return dict(
+        payload = dict(
             self._ops.snapshot(self.state),
             _key=np.asarray(self._key),
             _host=np.frombuffer(host_bytes, dtype=np.uint8),
@@ -1232,6 +1324,13 @@ class SimDriver:
             _crc32=np.uint32(zlib.crc32(host_bytes) & 0xFFFFFFFF),
             _engine=np.bytes_(self.engine.encode()),
         )
+        if self._ad is not None:
+            # r14: the adaptive planes follow the timeline (optional keys —
+            # schema unchanged; a static-FD restore ignores them)
+            from ..adaptive import adaptive_state_arrays
+
+            payload.update(adaptive_state_arrays(self._ad))
+        return payload
 
     def restore(self, path: str) -> None:
         import pickle
@@ -1325,6 +1424,22 @@ class SimDriver:
         # (warnings from the abandoned branch must not survive a restore)
         self._segmentation_warnings = host.get("segmentation_warnings", 0)
         self._recent_joins = [tuple(j) for j in host.get("recent_joins", [])]
+        # r14 adaptive planes: optional keys, popped BEFORE the engine
+        # restore (they are not engine state planes). An adaptive-armed
+        # driver restoring a static-FD checkpoint starts with fresh scores.
+        ad_arrays = {
+            k: data.pop(k)
+            for k in ("_ad_lh", "_ad_conf_key", "_ad_conf")
+            if k in data
+        }
+        if self._ad is not None:
+            from ..adaptive import init_adaptive_state, restore_adaptive_state
+
+            self._ad = (
+                restore_adaptive_state(ad_arrays)
+                if len(ad_arrays) == 3
+                else init_adaptive_state(self.params.capacity)
+            )
         try:
             state = self._ops.restore(data)
         except TypeError as exc:  # missing/extra planes: foreign or truncated

@@ -1,0 +1,663 @@
+"""r14 adaptive failure detection: lockstep + certification + integration.
+
+The contract the tentpole must keep (ISSUE 10 acceptance):
+
+1. The DEFAULT ``AdaptiveSpec`` traces the byte-identical legacy window
+   program for all three engines (jaxpr-compared here; the whole pre-r14
+   suite is the regression gate), and the adaptive builders REFUSE a
+   default spec — there is exactly one program per (spec, engine).
+2. Non-default adaptive windows are BIT-EXACT against their scalar
+   oracles in full-state lockstep — per engine, N=33 i32 (+ dense/pview
+   i16) in the fast lane, N=256 under ``-m slow`` — including the three
+   [N] adaptive planes themselves.
+3. The adaptive windows pass the r12 audit matrix (donation aliasing,
+   transfer-freeness, pview wide-value ban, memory budgets) and a seeded
+   dropped-donation variant is CAUGHT (falsifiability).
+4. The r14 false-positive sentinel is falsifiable: a watched row that
+   actually dies must trip it; a quick-blip SlowMember must NOT.
+5. The refutation fast path (AD-5): a suspected member's incarnation
+   bump disseminates even under the pipelined strategy's tightest
+   user-rumor budget — membership records are never throttled.
+6. Driver integration: adaptive windows thread + donate the
+   AdaptiveState, checkpoints carry it, set_adaptive swaps live, and the
+   trace-plane conflict fails fast.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scalecube_cluster_tpu import adaptive as adp
+from scalecube_cluster_tpu.adaptive import AdaptiveSpec, init_adaptive_state
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.pview as PV
+import scalecube_cluster_tpu.ops.pview_oracle as PO
+import scalecube_cluster_tpu.ops.sparse as SP
+import scalecube_cluster_tpu.ops.sparse_oracle as SO
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu.sim.driver import SimDriver
+
+ASPEC = AdaptiveSpec(enabled=True, lh_max=4, min_mult=2, max_mult=6,
+                     conf_target=3)
+
+
+def _dense_params(n=33, key_dtype="i32", adaptive=ASPEC):
+    return S.SimParams(
+        capacity=n, fanout=3, ping_req_k=2, fd_every=2, sync_every=10,
+        suspicion_mult=2, rumor_slots=8, seed_rows=(0,), delay_slots=3,
+        key_dtype=key_dtype, adaptive=adaptive,
+    )
+
+
+def _sparse_params(n=33, adaptive=ASPEC):
+    return SP.SparseParams(
+        capacity=n, fanout=3, ping_req_k=2, fd_every=2, sync_every=10,
+        suspicion_mult=2, sweep_every=4, rumor_slots=8, mr_slots=16,
+        announce_slots=8, seed_rows=(0,), delay_slots=3, sample_tries=4,
+        adaptive=adaptive,
+    )
+
+
+def _pview_params(n=33, key_dtype="i32", adaptive=ASPEC):
+    return PV.PviewParams(
+        capacity=n, view_slots=12, active_slots=6, fanout=3, ping_req_k=2,
+        fd_every=2, sync_every=10, suspicion_mult=2, sweep_every=4,
+        rumor_slots=8, mr_slots=16, announce_slots=8, seed_rows=(0,),
+        delay_slots=3, sample_tries=4, key_dtype=key_dtype, adaptive=adaptive,
+    )
+
+
+def _fresh_oracle_ad(n):
+    return {
+        "lh": np.zeros(n, np.int32),
+        "conf_key": np.full(n, np.iinfo(np.int32).min, np.int32),
+        "conf": np.zeros(n, np.int32),
+    }
+
+
+def _assert_ad_equal(ad, ad_o, t):
+    for name in ("lh", "conf_key", "conf"):
+        a = np.asarray(getattr(ad, name))
+        b = np.asarray(ad_o[name])
+        assert np.array_equal(a, b), (
+            f"[t={t}] adaptive plane {name} diverged at "
+            f"{np.argwhere(a != b)[:5].tolist()}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 1. default spec = byte-identical legacy program (jaxpr-compared)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
+def test_default_spec_traces_byte_identical_legacy_program(engine):
+    """The window program of params carrying an EXPLICITLY-constructed
+    default AdaptiveSpec is byte-identical (jaxpr text) to the program of
+    params built without touching the field — and an armed spec's adaptive
+    window is a genuinely different program (the test would be vacuous if
+    arming traced nothing)."""
+    import dataclasses as _dc
+
+    if engine == "dense":
+        plain = _dense_params(adaptive=AdaptiveSpec())
+        mk, init, mka = K.make_run, S.init_state, K.make_adaptive_run
+    elif engine == "sparse":
+        plain = _sparse_params(adaptive=AdaptiveSpec())
+        mk, init, mka = (
+            SP.make_sparse_run, SP.init_sparse_state, SP.make_sparse_adaptive_run,
+        )
+    else:
+        plain = _pview_params(adaptive=AdaptiveSpec())
+        mk, init, mka = (
+            PV.make_pview_run, PV.init_pview_state, PV.make_pview_adaptive_run,
+        )
+    explicit = _dc.replace(
+        plain, adaptive=AdaptiveSpec(enabled=False, lh_max=99, max_mult=77,
+                                     min_mult=7, conf_target=9)
+    )
+    if engine == "sparse":
+        st = init(plain, plain.capacity, warm=True, dense_links=False)
+    elif engine == "pview":
+        st = init(plain, plain.capacity, warm=True)
+    else:
+        st = init(plain, plain.capacity, warm=True)
+    key = jax.random.PRNGKey(0)
+    jaxpr_plain = str(jax.make_jaxpr(lambda s, k: mk(plain, 2, donate=False)(s, k))(st, key))
+    jaxpr_explicit = str(
+        jax.make_jaxpr(lambda s, k: mk(explicit, 2, donate=False)(s, k))(st, key)
+    )
+    # ALL disabled specs — whatever their knob values — trace one program
+    assert jaxpr_plain == jaxpr_explicit
+    # ... and the armed program is a different one (non-vacuousness)
+    armed = _dc.replace(plain, adaptive=ASPEC)
+    ad = init_adaptive_state(plain.capacity)
+    jaxpr_armed = str(
+        jax.make_jaxpr(
+            lambda s, a, k: mka(armed, 2, donate=False)(s, a, k)
+        )(st, ad, key)
+    )
+    assert jaxpr_armed != jaxpr_plain
+    assert len(jaxpr_armed) > len(jaxpr_plain)
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
+def test_adaptive_builders_refuse_default_spec(engine):
+    mka = {
+        "dense": (K.make_adaptive_run, _dense_params),
+        "sparse": (SP.make_sparse_adaptive_run, _sparse_params),
+        "pview": (PV.make_pview_adaptive_run, _pview_params),
+    }[engine]
+    with pytest.raises(ValueError, match="enabled AdaptiveSpec"):
+        mka[0](mka[1](adaptive=AdaptiveSpec()), 2)
+
+
+def test_adaptive_spec_validation_and_config_seam():
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    with pytest.raises(ValueError):
+        AdaptiveSpec(min_mult=0)
+    with pytest.raises(ValueError):
+        AdaptiveSpec(min_mult=5, max_mult=4)
+    with pytest.raises(ValueError):
+        AdaptiveSpec(conf_target=0)
+    with pytest.raises(ValueError):
+        AdaptiveSpec(lh_max=-1)
+    cfg = ClusterConfig.default_sim().with_adaptive(
+        lambda a: a.replace(enabled=True, min_mult=4, max_mult=9)
+    ).validate()
+    p = S.SimParams.from_config(cfg, capacity=16)
+    assert p.adaptive.enabled and p.adaptive.min_mult == 4
+    sp = SP.SparseParams.from_config(cfg, capacity=16)
+    assert sp.adaptive == p.adaptive
+    pv = PV.PviewParams.from_config(cfg, capacity=16)
+    assert pv.adaptive == p.adaptive
+    # default config stays off
+    assert S.SimParams.from_config(
+        ClusterConfig.default_sim(), capacity=16
+    ).adaptive.is_default
+
+
+def test_conf_mult_interpolation_endpoints():
+    """The integer log-schedule hits max_mult at 0 confirmations and
+    exactly min_mult at >= conf_target (both spellings agree)."""
+    spec = AdaptiveSpec(enabled=True, min_mult=3, max_mult=9, conf_target=4)
+    L = spec.levels
+    assert adp.conf_mult_num_scalar(spec, 0) == 9 * L
+    assert adp.conf_mult_num_scalar(spec, spec.conf_target) == 3 * L
+    assert adp.conf_mult_num_scalar(spec, 99) == 3 * L
+    vals = np.asarray(adp.conf_mult_num(spec, jnp.arange(8)))
+    assert vals[0] == 9 * L and vals[4] == 3 * L
+    assert (np.diff(vals) <= 0).all()  # monotone shrink
+    for c in range(8):
+        assert vals[c] == adp.conf_mult_num_scalar(spec, c)
+
+
+# ---------------------------------------------------------------------------
+# 2. full-state oracle lockstep (adaptive planes included)
+# ---------------------------------------------------------------------------
+
+
+def _run_dense_lockstep(n, key_dtype, ticks, seed):
+    params = _dense_params(n, key_dtype)
+    st = S.init_state(params, n, warm=True, uniform_loss=0.25, uniform_delay=0.8)
+    st = S.spread_rumor(st, 0, origin=3)
+    ad = init_adaptive_state(n)
+    ad_o = _fresh_oracle_ad(n)
+    key = jax.random.PRNGKey(seed)
+    tick_j = jax.jit(K.tick, static_argnums=(2,))
+    for t in range(ticks):
+        if t == 10:
+            st = S.crash_row(st, 5)
+        if t == ticks // 2:
+            st = S.join_row(st, 5, [0])
+        key, tk = jax.random.split(key)
+        o = O.oracle_tick(st, tk, params, ad=ad_o)
+        st, ad, _ms = tick_j(st, tk, params, None, ad)
+        O.assert_equivalent(st, o)
+        _assert_ad_equal(ad, o.ad, t)
+        ad_o = o.ad
+    return ad
+
+
+def test_dense_adaptive_oracle_lockstep_i32():
+    ad = _run_dense_lockstep(33, "i32", 40, seed=7)
+    # the run must actually exercise the plane (suspicions + evidence)
+    assert int(np.asarray(ad.conf).max()) > 0
+    assert int(np.asarray(ad.lh).max()) > 0
+
+
+@pytest.mark.slow
+def test_dense_adaptive_oracle_lockstep_i16():
+    # the narrow layout's N=33 leg; i16 also rides the N=256 slow matrix
+    _run_dense_lockstep(33, "i16", 28, seed=9)
+
+
+def test_sparse_adaptive_oracle_lockstep():
+    n = 33
+    params = _sparse_params(n)
+    st = SP.init_sparse_state(params, n, warm=True, uniform_loss=0.25,
+                              uniform_delay=0.8)
+    st = SP.spread_rumor(st, 0, origin=3)
+    ad = init_adaptive_state(n)
+    ad_o = _fresh_oracle_ad(n)
+    key = jax.random.PRNGKey(11)
+    tick_j = jax.jit(SP.sparse_tick, static_argnums=(2,))
+    for t in range(32):
+        if t == 10:
+            st = SP.crash_row(st, 5)
+        if t == 22:
+            st = SP.join_row(st, 5, [0])
+        key, tk = jax.random.split(key)
+        o = SO.sparse_oracle_tick(st, tk, params, ad=ad_o)
+        st, ad, _ms = tick_j(st, tk, params, None, ad)
+        SO.assert_sparse_equivalent(st, o)
+        _assert_ad_equal(ad, o.ad, t)
+        ad_o = o.ad
+    assert int(np.asarray(ad.conf).max()) > 0
+
+
+def _run_pview_lockstep(n, key_dtype, ticks, seed):
+    params = _pview_params(n, key_dtype)
+    st = PV.init_pview_state(params, n, warm=True, uniform_loss=0.25,
+                             uniform_delay=0.8)
+    st = PV.spread_rumor(st, 0, origin=3)
+    ad = init_adaptive_state(n)
+    ad_o = _fresh_oracle_ad(n)
+    key = jax.random.PRNGKey(seed)
+    tick_j = jax.jit(PV.pview_tick, static_argnums=(2,))
+    for t in range(ticks):
+        if t == 10:
+            st = PV.crash_row(st, 5)
+        if t == ticks - 10:
+            st = PV.join_row(st, 5, [0])
+        key, tk = jax.random.split(key)
+        o = PO.pview_oracle_tick(st, tk, params, ad=ad_o)
+        st, ad, _ms = tick_j(st, tk, params, None, ad)
+        PO.assert_pview_equivalent(st, o)
+        _assert_ad_equal(ad, o.ad, t)
+        ad_o = o.ad
+    return ad
+
+
+def test_pview_adaptive_oracle_lockstep_i32():
+    ad = _run_pview_lockstep(33, "i32", 32, seed=23)
+    assert int(np.asarray(ad.conf).max()) > 0
+
+
+@pytest.mark.slow
+def test_pview_adaptive_oracle_lockstep_i16():
+    _run_pview_lockstep(33, "i16", 32, seed=29)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine,key_dtype", [
+    ("dense", "i32"), ("dense", "i16"), ("sparse", "i32"),
+    ("pview", "i32"), ("pview", "i16"),
+])
+def test_adaptive_oracle_lockstep_n256(engine, key_dtype):
+    """The acceptance matrix's N=256 leg: full-state + adaptive-plane
+    lockstep at the certification size (slow lane; N=33 rides tier-1)."""
+    if engine == "dense":
+        _run_dense_lockstep(256, key_dtype, 16, seed=101)
+    elif engine == "sparse":
+        n = 256
+        params = _sparse_params(n)
+        st = SP.init_sparse_state(params, n, warm=True, uniform_loss=0.2,
+                                  uniform_delay=0.6)
+        ad = init_adaptive_state(n)
+        ad_o = _fresh_oracle_ad(n)
+        key = jax.random.PRNGKey(103)
+        tick_j = jax.jit(SP.sparse_tick, static_argnums=(2,))
+        for t in range(16):
+            if t == 5:
+                st = SP.crash_row(st, 50)
+            key, tk = jax.random.split(key)
+            o = SO.sparse_oracle_tick(st, tk, params, ad=ad_o)
+            st, ad, _ms = tick_j(st, tk, params, None, ad)
+            SO.assert_sparse_equivalent(st, o)
+            _assert_ad_equal(ad, o.ad, t)
+            ad_o = o.ad
+    else:
+        _run_pview_lockstep(256, key_dtype, 16, seed=107)
+
+
+# ---------------------------------------------------------------------------
+# 3. audit matrix (r12 contracts over adaptive windows) + falsifiability
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
+def test_adaptive_window_passes_audit_contracts(engine):
+    from scalecube_cluster_tpu.audit import run_contracts
+    from scalecube_cluster_tpu.audit.programs import build_engine_programs
+
+    (prog,) = build_engine_programs(
+        engine, capacity=128, n_ticks=4, key_dtypes=["i32"],
+        variants=["adaptive"],
+    )
+    assert prog.variant == "adaptive"
+    # dense compiles (memory budget + optimized-HLO alias facts); the other
+    # engines audit traced/lowered forms here — their compiled adaptive
+    # matrix rides tools/audit_programs.py --all / AUDIT_r12.json
+    verdict = run_contracts(prog, compile_programs=(engine == "dense"))
+    for contract, violations in verdict.items():
+        assert violations == [], (
+            f"{prog.name}: {contract}:\n" + "\n".join(map(str, violations))
+        )
+    if engine == "pview":
+        assert "forbid_wide_values" in verdict  # the O(N·k) ban applies
+
+
+def test_seeded_adaptive_builder_dropping_donation_is_caught():
+    """Falsifiability (ISSUE 10 satellite): the REAL dense adaptive window
+    built with donate=False but registered as donated — the auditor must
+    flag the dropped state AND adaptive leaves; the donated control is
+    clean."""
+    import dataclasses as _dc
+
+    from scalecube_cluster_tpu.audit import AuditProgram, check_donation_alias
+    from scalecube_cluster_tpu.audit.programs import _abstract, _audit_params
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine("dense")
+    params = _dc.replace(
+        _audit_params("dense", 128, "i32"), adaptive=AdaptiveSpec(enabled=True)
+    )
+    state = eng.init_state(params, 124, True, True)
+    abs_state = _abstract(state)
+    abs_ad = _abstract(init_adaptive_state(128))
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    def _prog(fn, name):
+        return AuditProgram(
+            name=name, engine="dense", variant="adaptive", key_dtype="i32",
+            capacity=128, n_ticks=4, fn=fn,
+            abstract_args=(abs_state, abs_ad, key_abs),
+            donated_argnums=(0, 1), contracts=eng.contracts,
+            budget_basis_bytes=0, wide_threshold=128,
+        )
+
+    bad = _prog(K.make_adaptive_run(params, 4, donate=False),
+                "seeded/adaptive-dropped-donation")
+    violations = check_donation_alias(bad)
+    assert violations, "auditor missed the adaptive builder's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)
+    good = _prog(K.make_adaptive_run(params, 4), "seeded/adaptive-donated")
+    assert check_donation_alias(good) == []
+
+
+# ---------------------------------------------------------------------------
+# 4. false-positive sentinel falsifiability + quick blips
+# ---------------------------------------------------------------------------
+
+
+def test_fp_sentinel_catches_seeded_false_positive():
+    """A watched member isolated behind a never-healing partition stays
+    ALIVE while every observer tombstones it — the exact false-positive
+    shape the sentinel exists for, seeded deliberately on a STATIC
+    detector. It must fire and count as a violation (a false-positive
+    detector that cannot fire is no detector). The up-gate is part of the
+    semantics: a watched row that actually CRASHES is not a false
+    positive (test_quick_blip covers the negative side)."""
+    from scalecube_cluster_tpu.chaos import events as ev
+
+    import dataclasses as _dc
+
+    n = 16
+    # delay rings off: this test needs only the loss/partition plane, and
+    # the undelayed window program is materially cheaper to compile
+    params = _dc.replace(_dense_params(n, adaptive=AdaptiveSpec()),
+                         delay_slots=0)
+    d = SimDriver(params, n, warm=True, seed=2)
+    scen = ev.Scenario(
+        name="seeded-fp",
+        events=(
+            ev.Partition(groups=[[7], [r for r in range(n) if r != 7]], at=2),
+        ),
+        fp_watch_rows=(7,),  # "this member is healthy, I swear"
+        horizon=60,
+    )
+    rep = d.run_scenario(scen)
+    s = rep["sentinels"]
+    assert s["false_positive_dead_max"] >= 1
+    assert s["false_positive_enforced"] is True
+    assert rep["violations"] >= 1  # the seeded false positive is caught
+    # the control arm's spelling records WITHOUT judging
+    d2 = SimDriver(params, n, warm=True, seed=2)
+    rep2 = d2.run_scenario(scen.replace(fp_enforce=False))
+    s2 = rep2["sentinels"]
+    assert s2["false_positive_dead_max"] >= 1
+    assert s2["false_positive_enforced"] is False
+    assert rep2["violations"] == 0
+
+
+def test_quick_blip_slow_member_does_not_trip_fp_sentinel():
+    """A SlowMember blip far shorter than any suspicion window must leave
+    the false-positive sentinel at zero on the ADAPTIVE plane — the
+    sentinel watches real tombstones, not transient suspicion."""
+    from scalecube_cluster_tpu.chaos import events as ev
+
+    n = 16
+    params = _dense_params(n, adaptive=ASPEC)
+    d = SimDriver(params, n, warm=True, seed=3)
+    scen = ev.Scenario(
+        name="quick-blip",
+        events=(ev.SlowMember(rows=[4], mean_delay_ticks=1.5, at=5, until=13),),
+        horizon=60,
+    )
+    rep = d.run_scenario(scen)
+    s = rep["sentinels"]
+    assert s["false_positive_watch_members"] == 1
+    assert s["false_positive_dead_max"] == 0
+    assert rep["violations"] == 0, rep
+
+
+def test_degraded_events_validate_and_schedule():
+    from scalecube_cluster_tpu.chaos import events as ev
+    from scalecube_cluster_tpu.chaos.engine import schedule
+
+    with pytest.raises(ev.ScenarioError):
+        ev.SlowMember(rows=[], mean_delay_ticks=1.0, at=0)
+    with pytest.raises(ev.ScenarioError):
+        ev.SlowMember(rows=[1], mean_delay_ticks=0.0, at=0)
+    with pytest.raises(ev.ScenarioError):
+        ev.AsymmetricLoss(rows=[1], pct=0.0, at=0)
+    with pytest.raises(ev.ScenarioError):
+        ev.AsymmetricLoss(rows=[1], pct=50.0, at=5, until=5)
+    with pytest.raises(ev.ScenarioError):
+        ev.AsymmetricLoss(rows=[1], pct=50.0, at=0, direction="sideways")
+    with pytest.raises(ev.ScenarioError):
+        ev.FlakyObserver(rows=[1], pct=101.0, at=0)
+    scen = ev.Scenario(
+        name="sched",
+        events=(
+            ev.SlowMember(rows=[1], mean_delay_ticks=2.0, at=2, until=9),
+            ev.AsymmetricLoss(rows=[2], pct=30.0, at=3, until=8),
+            ev.FlakyObserver(rows=[3], pct=40.0, at=4),
+        ),
+        horizon=40,
+    )
+    kinds = [s.kind for s in schedule(scen)]
+    assert kinds == [
+        "slow_start", "asym_start", "asym_start", "asym_end", "slow_end",
+    ]
+    assert scen.degraded_rows() == {1, 2, 3}
+    # a degraded row that also crashes is NOT auto-watched ...
+    scen2 = scen.replace(events=scen.events + (ev.Crash(rows=[2], at=20),))
+    assert scen2.degraded_rows() == {1, 3}
+    # ... but an explicit fp_watch row always is (the falsifiability hook)
+    from scalecube_cluster_tpu.chaos.sentinels import build_spec
+
+    spec = build_spec(scen2.replace(fp_watch_rows=(2,)), _dense_params(16))
+    assert bool(spec.fp_watch[2])
+    # degraded events need per-link planes: the lean sparse driver refuses
+    from scalecube_cluster_tpu.chaos.engine import StateTimeline, schedule as _sched
+    from scalecube_cluster_tpu.chaos.events import ScenarioError
+
+    with pytest.raises(ScenarioError, match="dense"):
+        StateTimeline(scen, SP, dense_links=False)
+    # silently-wrong compositions are refused at compile time (r14 review
+    # hardening): overlapping SlowMembers (cross-cohort delay teardown),
+    # intersecting-cohort asym overlaps, and degraded-over-Partition
+    with pytest.raises(ScenarioError, match="overlap"):
+        _sched(ev.Scenario(name="x", events=(
+            ev.SlowMember(rows=[1], mean_delay_ticks=1.0, at=0, until=20),
+            ev.SlowMember(rows=[2], mean_delay_ticks=1.0, at=10, until=30),
+        ), horizon=40))
+    with pytest.raises(ScenarioError, match="overlap"):
+        _sched(ev.Scenario(name="x", events=(
+            ev.AsymmetricLoss(rows=[1, 2], pct=30.0, at=0, until=20),
+            ev.FlakyObserver(rows=[2], pct=30.0, at=10, until=30),
+        ), horizon=40))
+    with pytest.raises(ScenarioError, match="Partition"):
+        _sched(ev.Scenario(name="x", events=(
+            ev.Partition(groups=[[0, 1], [2, 3]], at=0, heal_at=50),
+            ev.AsymmetricLoss(rows=[2], pct=30.0, at=10, until=30),
+        ), horizon=60))
+    # staggered windows compose fine
+    _sched(ev.Scenario(name="x", events=(
+        ev.SlowMember(rows=[1], mean_delay_ticks=1.0, at=0, until=10),
+        ev.SlowMember(rows=[2], mean_delay_ticks=1.0, at=10, until=20),
+    ), horizon=40))
+    # the emulator runner additionally refuses storm + degraded overlap
+    from scalecube_cluster_tpu.chaos.engine import EmulatorChaosRunner
+
+    with pytest.raises(ScenarioError, match="LossStorm"):
+        EmulatorChaosRunner(
+            ev.Scenario(name="x", events=(
+                ev.LossStorm(pct=30.0, at=0, until=50),
+                ev.SlowMember(rows=[1], mean_delay_ticks=1.0, at=10, until=30),
+            ), horizon=60),
+            [object()] * 4, [f"mem://{i}" for i in range(4)],
+        )
+
+
+# ---------------------------------------------------------------------------
+# 5. AD-5: refutes ride the unbudgeted gossip class (pipelined strategy)
+# ---------------------------------------------------------------------------
+
+
+def test_refutation_disseminates_under_pipelined_budget():
+    """Arm the tightest pipelined user-rumor budget (1 slot/message) AND
+    the adaptive plane, force a false suspicion of a healthy member, and
+    verify its bumped-incarnation refutation reaches every up observer —
+    membership records (DZ-3) are never throttled, so the adaptive
+    refutation fast path cannot be starved by the bandwidth experiment."""
+    import dataclasses as _dc
+
+    from scalecube_cluster_tpu.dissemination import DissemSpec
+
+    n = 16
+    params = _dc.replace(
+        _dense_params(n, adaptive=ASPEC),
+        dissem=DissemSpec(strategy="pipelined", topology="expander",
+                          pipeline_budget=1),
+        delay_slots=0,
+    )
+    st = S.init_state(params, n, warm=True)
+    # observer 3 believes row 8 is SUSPECT at inc 0 (a planted false rumor)
+    vk = np.asarray(st.view_key).copy()
+    from scalecube_cluster_tpu.ops.lattice import RANK_SUSPECT
+
+    vk[3, 8] = RANK_SUSPECT
+    st = st.replace(
+        view_key=jnp.asarray(vk),
+        changed_at=st.changed_at.at[3, 8].set(0),
+    )
+    ad = init_adaptive_state(n)
+    key = jax.random.PRNGKey(5)
+    tick_j = jax.jit(K.tick, static_argnums=(2,))
+    for _ in range(3 * params.sync_every):
+        key, tk = jax.random.split(key)
+        st, ad, _ms = tick_j(st, tk, params, None, ad)
+    vk = np.asarray(st.view_key)
+    up = np.asarray(st.up)
+    # every up observer now holds row 8 ALIVE at a bumped incarnation
+    col = vk[up, 8]
+    assert ((col & 3) == 0).all(), "refutation did not reach every observer"
+    assert (((col >> 2) & 0x1FFFFF) >= 1).all(), "incarnation bump lost"
+    # the refuted member's lh recorded the event (someone suspected ME)
+    assert int(np.asarray(ad.lh)[8]) >= 0  # folded (may have decayed)
+
+
+# ---------------------------------------------------------------------------
+# 6. driver integration
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_driver_checkpoint_roundtrip(tmp_path):
+    n = 24
+    params = _dense_params(n, adaptive=ASPEC)
+    d = SimDriver(params, n, warm=True, seed=3)
+    d.set_link_loss(range(12), range(12, 24), 0.6)
+    d.step(16)
+    d.crash(7)
+    d.step(16)
+    lh1 = np.asarray(d.adaptive_state.lh).copy()
+    ck = str(tmp_path / "a.npz")
+    d.checkpoint(ck)
+    d.step(8)
+    d2 = SimDriver(params, n, warm=True, seed=3)
+    d2.restore(ck)
+    assert np.array_equal(np.asarray(d2.adaptive_state.lh), lh1)
+    d2.step(8)
+    assert np.array_equal(
+        np.asarray(d2.state.view_key), np.asarray(d.state.view_key)
+    )
+    for name in ("lh", "conf_key", "conf"):
+        assert np.array_equal(
+            np.asarray(getattr(d2.adaptive_state, name)),
+            np.asarray(getattr(d.adaptive_state, name)),
+        ), name
+
+
+def test_set_adaptive_swap_and_guards():
+    n = 16
+    d = SimDriver(_dense_params(n, adaptive=AdaptiveSpec()), n, warm=True, seed=1)
+    assert d.adaptive_state is None
+    d.step(4)
+    d.set_adaptive(ASPEC)
+    assert d.adaptive_state is not None
+    d.step(4)
+    # arming trace on an adaptive driver fails fast (no silent degrade)
+    with pytest.raises(ValueError, match="adaptive"):
+        d.arm_trace()
+    d.set_adaptive(None)
+    assert d.adaptive_state is None
+    d.step(4)
+    # the reverse guard: set_adaptive on a trace-armed driver
+    d2 = SimDriver(_dense_params(n, adaptive=AdaptiveSpec()), n, warm=True, seed=1)
+    d2.arm_trace()
+    with pytest.raises(ValueError, match="adaptive"):
+        d2.set_adaptive(ASPEC)
+
+
+def test_adaptive_telemetry_series_and_armed_plane():
+    """The adaptive gauges ride every engine's telemetry series, and an
+    armed telemetry plane consumes adaptive windows' metrics (the ring
+    row length matches the series)."""
+    for series in (K.TELEMETRY_SERIES, SP.TELEMETRY_SERIES, PV.TELEMETRY_SERIES):
+        assert "adaptive_lh_max" in series
+        assert "adaptive_conf_max" in series
+    n = 16
+    d = SimDriver(_dense_params(n), n, warm=True, seed=4)
+    plane = d.arm_telemetry()
+    d.set_link_loss(range(8), range(8, 16), 0.7)
+    d.step(20)
+    snap = plane.ring.snapshot()
+    assert snap["rows"].shape[1] == len(plane.names)
+    idx = list(plane.names).index("adaptive_lh_max")
+    # suspicion activity under 70% asymmetric loss must move the gauge
+    assert np.asarray(snap["rows"])[:, idx].max() >= 1.0

@@ -124,6 +124,46 @@ def test_partition_heal_reconverges_with_zero_violations(engine):
     assert snap["armed"] is False  # run completed
 
 
+# r14 satellite (ROADMAP item-3 "strategy sweeps inside the churn/soak
+# lanes"): the SAME partition-heal scenario re-converges under non-default
+# dissemination strategies, with the strategy-aware (tightened/loosened)
+# sentinel budgets. Fast lane runs one non-default combo; the matrix rides
+# `-m slow` below.
+def _run_partition_heal_with_strategy(engine, strategy, topology):
+    if engine == "dense":
+        d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    else:
+        d = SimDriver(_sparse_params(), 12, warm=True, seed=0, dense_links=True)
+    rep = d.run_scenario(SPLIT_SCENARIO, strategy=strategy, topology=topology)
+    assert rep["ok"], (engine, strategy, topology, rep)
+    assert rep["violations"] == 0
+    sent = rep["sentinels"]
+    assert sent["false_dead_members_max"] == 0
+    conv = sent["convergence"]
+    assert len(conv) == 1 and conv[0]["ok"]
+    assert _all_up_alive(d)
+
+
+def test_partition_heal_reconverges_under_push_pull_strategy():
+    """Fast lane: one non-default strategy through the churn scenario."""
+    _run_partition_heal_with_strategy("dense", "push_pull", "expander")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+@pytest.mark.parametrize("strategy,topology", [
+    ("push", "expander"),
+    ("push_pull", "expander"),
+    ("accelerated", "expander"),
+    ("tuneable", "expander"),
+])
+def test_partition_heal_strategy_matrix(engine, strategy, topology):
+    """Slow lane: the chaos x strategy matrix — every shipped random AND
+    deterministic family (plus the r14 tuneable family) re-converges the
+    scripted split under its strategy-aware budget."""
+    _run_partition_heal_with_strategy(engine, strategy, topology)
+
+
 def test_mixed_scenario_detection_and_restart(engine_params=None):
     """Crash detection latency is bounded and reported; the restarted row is
     a FRESH identity (member ordinal advanced) and the cluster re-converges

@@ -60,6 +60,8 @@ STRATEGY_SPECS = [
     DissemSpec(strategy="push_pull", topology="expander"),
     DissemSpec(strategy="pipelined", topology="ring", pipeline_budget=2),
     DissemSpec(strategy="accelerated", topology="torus", torus_rows=3),
+    # r14 fifth strategy: the robust/tuneable family (arXiv:1506.02288)
+    DissemSpec(strategy="tuneable", topology="expander", tuneable_mix=0.5),
 ]
 _IDS = [f"{s.strategy}-{s.topology}" for s in STRATEGY_SPECS]
 
@@ -80,6 +82,50 @@ def test_spec_validation():
     assert not DissemSpec(topology="ring").is_default
     assert DissemSpec(strategy="push_pull").uniform_selection
     assert not DissemSpec(strategy="pipelined").uniform_selection
+    # r14 tuneable family: the mix knob validates, selection is chord-based
+    # even on "full" (the virtual-hypercube set), pull is off
+    with pytest.raises(ValueError, match="tuneable_mix"):
+        DissemSpec(strategy="tuneable", tuneable_mix=1.5)
+    with pytest.raises(ValueError, match="tuneable_mix"):
+        DissemSpec(strategy="tuneable", tuneable_mix=-0.1)
+    tn = DissemSpec(strategy="tuneable")
+    assert not tn.is_default and not tn.uniform_selection
+    assert not tn.deterministic and not tn.wants_pull
+    assert len(topo.chords(tn, 64)) >= 2
+
+
+def test_tuneable_mix_endpoints_degenerate_correctly():
+    """mix=1 IS the accelerated walk; mix=0 IS the uniform chord draw —
+    per slot, against the same uniforms (the one-draw rescaling rule)."""
+    n = 24
+    rng = np.random.default_rng(1)
+    u = rng.random((n, 3), np.float32)
+    det, _ = dz.structured_peers(
+        DissemSpec(strategy="accelerated", topology="expander"), n, 9,
+        jnp.asarray(u),
+    )
+    all_det, _ = dz.structured_peers(
+        DissemSpec(strategy="tuneable", topology="expander",
+                   tuneable_mix=1.0), n, 9, jnp.asarray(u),
+    )
+    assert (np.asarray(det) == np.asarray(all_det)).all()
+    rand, _ = dz.structured_peers(
+        DissemSpec(strategy="push", topology="expander"), n, 9,
+        jnp.asarray(u),
+    )
+    all_rand, _ = dz.structured_peers(
+        DissemSpec(strategy="tuneable", topology="expander",
+                   tuneable_mix=0.0), n, 9, jnp.asarray(u),
+    )
+    assert (np.asarray(rand) == np.asarray(all_rand)).all()
+    # a middling mix draws from BOTH families across slots/rows
+    mixed, _ = dz.structured_peers(
+        DissemSpec(strategy="tuneable", topology="expander",
+                   tuneable_mix=0.5), n, 9, jnp.asarray(u),
+    )
+    mixed = np.asarray(mixed)
+    assert (mixed == np.asarray(det)).any()
+    assert (mixed != np.asarray(det)).any()
 
 
 def test_config_routes_through_spec():
