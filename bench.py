@@ -354,6 +354,33 @@ def main() -> None:
             cmd.append("--quick")
         raise SystemExit(subprocess.call(cmd))
 
+    # r15: --fleet runs the scenario-batched fleet benchmark
+    # (benchmarks/config14_fleet.py — batched-vs-serial member-ticks/sec,
+    # Monte Carlo spread + false-positive certification, the max-S×N
+    # ladder) through the same backend-probe/retry path. Forwards
+    # --seeds/--mc-n/--out when present.
+    if "--fleet" in sys.argv:
+        import os
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        cmd = [
+            sys.executable,
+            os.path.join(here, "benchmarks", "config14_fleet.py"),
+        ]
+        for flag in ("--seeds", "--fp-seeds", "--mc-n", "--out"):
+            if flag in sys.argv:
+                i = sys.argv.index(flag)
+                if i + 1 < len(sys.argv):
+                    cmd += [flag, sys.argv[i + 1]]
+        if "--out" not in sys.argv:  # default: refresh the standing artifact
+            cmd += ["--out", os.path.join(here, "FLEET_BENCH_r15.json")]
+        for passthrough in ("--quick", "--skip-ladder", "--skip-strategy-ab",
+                            "--skip-fp"):
+            if passthrough in sys.argv:
+                cmd.append(passthrough)
+        raise SystemExit(subprocess.call(cmd))
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

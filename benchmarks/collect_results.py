@@ -127,6 +127,18 @@ def _r14(rec):
     )
 
 
+def _r15(rec):
+    gate = (rec.get("throughput") or [{}])[0]
+    mc = rec.get("mc_spread") or {}
+    return None, (
+        f"fleet engine: batched {gate.get('batched_member_ticks_per_s')} "
+        f"member-ticks/s = {gate.get('speedup_batched_vs_serial')}x the "
+        f"serial control at S={gate.get('s')}xN={gate.get('n')} over "
+        f"{gate.get('fleet_devices')} device(s); MC {mc.get('n_certified')}/"
+        f"{mc.get('n_entries')} cells x {mc.get('n_seeds')} seeds certified"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
@@ -136,6 +148,7 @@ ROUND_BENCH_FILES = [
     (11, "PVIEW_BENCH_r11.json", _r11),
     (13, "STRATEGY_BENCH_r13.json", _r13),
     (14, "ADAPTIVE_BENCH_r14.json", _r14),
+    (15, "FLEET_BENCH_r15.json", _r15),
 ]
 
 
@@ -188,6 +201,56 @@ def collect_strategy_summary(root: pathlib.Path) -> dict:
                 }
                 for e in rec.get("entries", [])
             },
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
+def collect_fleet_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r15 fleet artifact: the batched-vs-
+    serial gate, the MC certification tallies + per-cell intervals, and
+    the false-positive arms' Wilson intervals."""
+    path = root / "FLEET_BENCH_r15.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        gate = (rec.get("throughput") or [{}])[0]
+        mc = rec.get("mc_spread") or {}
+        fp = rec.get("mc_false_positive") or {}
+        return {
+            "present": True,
+            "certified": rec.get("certified"),
+            "batched_member_ticks_per_s": gate.get(
+                "batched_member_ticks_per_s"
+            ),
+            "speedup_batched_vs_serial": gate.get(
+                "speedup_batched_vs_serial"
+            ),
+            "transfer_free": gate.get("transfer_free"),
+            "fleet_devices": gate.get("fleet_devices"),
+            "mc_cells_certified": mc.get("n_certified"),
+            "mc_cells": mc.get("n_entries"),
+            "mc_seeds_per_cell": mc.get("n_seeds"),
+            "mc_entries": {
+                f"{e['engine']}/{e['strategy']}/{e['topology']}": {
+                    "certified": e.get("certified"),
+                    "p99": e.get("spread_ticks_p99"),
+                    "p99_ci": e.get("p99_ci"),
+                    "bound_ticks": e.get("bound_ticks"),
+                    "wilson": e.get("wilson"),
+                }
+                for e in mc.get("entries", [])
+            },
+            "fp_certified": fp.get("certified"),
+            "fp_static_wilson": (fp.get("static") or {}).get(
+                "fp_rate_wilson"
+            ),
+            "fp_adaptive_wilson": (fp.get("adaptive") or {}).get(
+                "fp_rate_wilson"
+            ),
         }
     except Exception as exc:  # noqa: BLE001 — aggregation must not die
         return {"present": True, "error": repr(exc)}
@@ -327,6 +390,12 @@ def main() -> None:
     # control records >0, true-crash latency within the existing budgets)
     results += run([py, "benchmarks/config13_adaptive.py", "--quick",
                     "--out", "ADAPTIVE_BENCH_r14.json"], timeout=3000)
+    # r15 fleet engine: batched-vs-serial throughput gate + Monte Carlo
+    # spread/false-positive certification (512 seeds/cell on --quick; the
+    # >=1000-seed matrix + max-S×N ladder belong to the dedicated
+    # artifact run: bench.py --fleet)
+    results += run([py, "benchmarks/config14_fleet.py", "--quick",
+                    "--out", "FLEET_BENCH_r15.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -357,6 +426,9 @@ def main() -> None:
         # r14: adaptive-FD false-positive certification verdict (entries
         # live in ADAPTIVE_BENCH_r14.json, refreshed by the config13 run)
         "adaptive_bench": collect_adaptive_summary(ROOT),
+        # r15: fleet-engine gate + Monte Carlo certification intervals
+        # (full artifact in FLEET_BENCH_r15.json, refreshed by config14)
+        "fleet_bench": collect_fleet_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

@@ -39,6 +39,12 @@ DEFAULT_N_TICKS = 4
 DEFAULT_CAPACITY = 128
 #: sharded runs need capacity % (32 * mesh.size) == 0 (the r9/r11 word rule)
 DEFAULT_SHARDED_CAPACITY = 256
+#: scenario-axis length of the r15 fleet audit shapes — small keeps the
+#: vmapped compile fast, and every fleet contract is S-invariant (donation
+#: covers the whole stacked pytree, the memory budget is declared
+#: per-scenario × S, wide-plane checks key on capacity-scaled dims so the
+#: S dim must stay strictly below capacity — asserted at build)
+DEFAULT_FLEET_SCENARIOS = 4
 
 MIB = 1 << 20
 
@@ -268,6 +274,7 @@ def build_engine_programs(
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
         "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
+        "fleet",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -356,6 +363,57 @@ def build_engine_programs(
                 donated_argnums=(0, 1),
                 contracts=contracts,
                 budget_basis_bytes=state_bytes + _tree_bytes(abs_ad),
+                wide_threshold=capacity,
+            ))
+
+        if kd == dtypes[0] and "fleet" in want and eng.make_fleet_run:
+            # r15: the scenario-batched window — the SAME contracts proved
+            # over the vmapped program: every leaf of the stacked [S, ...]
+            # state must alias (donation covers the fleet pytree), the
+            # program stays transfer-free, no in-scan wide-plane gather
+            # feeds only the stacked outputs, pview's wide-value ban holds
+            # over the batched values ([S, N, k] carries ONE capacity-
+            # scaled dim), and the compiled peak stays within the budget
+            # declared PER SCENARIO × S. S stays strictly below capacity
+            # so "dim >= capacity" keeps meaning "capacity-scaled".
+            s_fleet = DEFAULT_FLEET_SCENARIOS
+            _assert_audit_shape(
+                f"{engine_name}/{kd}/fleet", capacity,
+                {"fleet_scenarios": s_fleet},
+            )
+            abs_fleet = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    (s_fleet,) + x.shape, x.dtype
+                ),
+                abs_state,
+            )
+            keys_abs = jax.ShapeDtypeStruct(
+                (s_fleet,) + key_abs.shape, key_abs.dtype
+            )
+            fleet_contracts = contracts
+            if contracts.fleet_memory_factor is not None:
+                fleet_contracts = dataclasses.replace(
+                    contracts, memory_factor=contracts.fleet_memory_factor
+                )
+            # audit the SHIPPED fleet program: every production fleet
+            # consumer (the MC certification service, config14) runs the
+            # quiet_gates=False fleet profile where the engine exposes it
+            # — a contract break hiding in the ungated active branches
+            # must not slip past a gated audit
+            fleet_params = params
+            if hasattr(params, "quiet_gates"):
+                fleet_params = dataclasses.replace(
+                    params, quiet_gates=False
+                )
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/fleet",
+                engine=engine_name, variant="fleet", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fleet_run(fleet_params, n_ticks),
+                abstract_args=(abs_fleet, keys_abs),
+                donated_argnums=(0,),
+                contracts=fleet_contracts,
+                budget_basis_bytes=s_fleet * state_bytes,
                 wide_threshold=capacity,
             ))
 

@@ -13,7 +13,9 @@ from . import strategies, topology  # noqa: F401
 def __getattr__(name):
     # certify pulls in the engines; keep the package import light for the
     # params modules that only need the spec
-    if name in ("certify", "spread_certifier", "measure_spread", "theory_bound"):
+    if name in ("certify", "spread_certifier", "measure_spread", "theory_bound",
+                "certify_spread_mc", "fp_rate_mc", "mc_spread_certifier",
+                "MC_MIN_SAMPLES"):
         from . import certify as _c
 
         if name == "certify":

@@ -42,13 +42,49 @@ dissemination strategy.
 a matrix of specs, optionally publishing per-entry certification events
 onto a telemetry bus, and returns the artifact record
 ``benchmarks/config12_strategies.py`` writes to STRATEGY_BENCH_r13.json.
+
+**Monte Carlo certification (r15).** The serial harness above draws its
+verdict from a handful of seeds run one window-dispatch at a time — an
+engineering SPOT CHECK, and labeled as such in every artifact
+(``verdict_kind: "spot-check"`` whenever ``sample_size <
+theory_bound()["mc_min_samples"]``). The fleet engine
+(:mod:`..ops.fleet`) turns the same measurement into a statistical one:
+:func:`certify_spread_mc` vmaps the cell's window over ≥1000 scenarios
+(one rumor per scenario, per-scenario origin + PRNG chain), folds
+ticks-to-coverage ON DEVICE across windows (one [S] readback per cell,
+never per seed), and reports REAL confidence intervals —
+
+* a **Wilson score interval** on ``P(spread_ticks <= bound_ticks)``:
+  with ``k`` of ``S`` seeds inside the bound and ``p̂ = k/S``,
+  ``(p̂ + z²/2S ± z·sqrt(p̂(1-p̂)/S + z²/4S²)) / (1 + z²/S)``;
+* **distribution-free order-statistic CIs** on the median and p99
+  spread-time quantiles: the q-quantile's CI is the pair of order
+  statistics at ranks ``S·q ± z·sqrt(S·q(1-q))`` (the binomial rank
+  bracket, normal-approximated — exact to <1 rank at the S ≥ 1000
+  sample sizes this service runs).
+
+A cell certifies when every seed finished, the p99 CI's UPPER endpoint
+sits inside the theory bound, the Wilson LOWER bound on
+``P(within bound)`` is ≥ 0.99, and (for the ring's linear class) the p01
+CI's LOWER endpoint exceeds the linear lower bound.
+:func:`fp_rate_mc` is the chaos twin: the r14 false-positive sentinel's
+check, vmapped over a fleet driven through a loss-adversarial scenario
+by the batched ``StateTimeline`` fold, with a Wilson interval on the
+per-scenario false-DEAD rate. ``mc_spread_certifier`` runs the MC matrix
+for ``benchmarks/config14_fleet.py`` → FLEET_BENCH_r15.json.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence
 
 import numpy as np
+
+#: minimum seeds for a verdict to count as Monte Carlo rather than a
+#: spot check — every bound record carries it (``mc_min_samples``) so
+#: artifacts can never silently mix single-seed and MC verdicts
+MC_MIN_SAMPLES = 1000
 
 from . import topology as topo
 from .spec import DissemSpec
@@ -134,6 +170,11 @@ def theory_bound(
         "lower_bound_ticks": int(lower),
         "formula": formula,
         "citation": citation,
+        # r15: the sample-size floor below which a verdict against this
+        # bound is a SPOT CHECK, not a Monte Carlo certification — the
+        # measurement records stamp verdict_kind from it, so the two
+        # never mix silently in an artifact
+        "mc_min_samples": MC_MIN_SAMPLES,
     }
 
 
@@ -142,12 +183,11 @@ def theory_bound(
 # ---------------------------------------------------------------------------
 
 
-def _dense_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
-                  window: int):
-    import jax
-
+def _dense_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
+    """(params, base_state_fn, ops_module) for one dense certification
+    cell — shared by the serial spot-check runner and the MC fleet
+    service (same protocol knobs, same warm loss-free start)."""
     from ..ops import state as S
-    from ..ops.kernel import make_run
     from ..ops.state import SimParams
 
     delay_slots = 0
@@ -159,23 +199,15 @@ def _dense_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
         seed_rows=(0,), full_metrics=False, dissem=spec,
         delay_slots=delay_slots,
     )
-    step = make_run(params, window)
 
-    def fresh(origin: int):
+    def base():
         st = S.init_state(params, n, warm=True)
-        st = topo.apply_geo_wan_delay(st, spec, S, n)
-        return S.spread_rumor(st, 0, origin=origin)
+        return topo.apply_geo_wan_delay(st, spec, S, n)
 
-    def inject(st, slot: int, origin: int):
-        return S.spread_rumor(st, slot, origin=origin)
-
-    return params, step, fresh, inject, jax
+    return params, base, S
 
 
-def _pview_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
-                  window: int):
-    import jax
-
+def _pview_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
     import scalecube_cluster_tpu.ops.pview as PV
 
     if spec.topology == "geo" and spec.geo_wan_delay_ticks > 0:
@@ -188,11 +220,43 @@ def _pview_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
         sync_every=64, suspicion_mult=5, rumor_slots=rumor_slots,
         seed_rows=(0,), dissem=spec,
     )
+
+    def base():
+        return PV.init_pview_state(params, n, warm=True)
+
+    return params, base, PV
+
+
+_SETUPS = {"dense": _dense_setup, "pview": _pview_setup}
+
+
+def _dense_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
+                  window: int):
+    import jax
+
+    from ..ops.kernel import make_run
+
+    params, base, S = _dense_setup(spec, n, fanout, rumor_slots)
+    step = make_run(params, window)
+
+    def fresh(origin: int):
+        return S.spread_rumor(base(), 0, origin=origin)
+
+    def inject(st, slot: int, origin: int):
+        return S.spread_rumor(st, slot, origin=origin)
+
+    return params, step, fresh, inject, jax
+
+
+def _pview_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
+                  window: int):
+    import jax
+
+    params, base, PV = _pview_setup(spec, n, fanout, rumor_slots)
     step = PV.make_pview_run(params, window)
 
     def fresh(origin: int):
-        st = PV.init_pview_state(params, n, warm=True)
-        return PV.spread_rumor(st, 0, origin=origin)
+        return PV.spread_rumor(base(), 0, origin=origin)
 
     def inject(st, slot: int, origin: int):
         return PV.spread_rumor(st, slot, origin=origin)
@@ -254,6 +318,15 @@ def measure_spread(
         "fanout": fanout,
         "rumor_slots": rumor_slots,
         "seeds": list(seeds),
+        # r15: a handful of serial seeds is a spot check, never a Monte
+        # Carlo verdict — the label travels with the record so artifacts
+        # cannot mix the two silently (certify_spread_mc stamps
+        # "monte-carlo" + real confidence intervals)
+        "sample_size": len(seeds),
+        "verdict_kind": (
+            "spot-check" if len(seeds) < bound["mc_min_samples"]
+            else "monte-carlo"
+        ),
         "spread_ticks": ticks,
         "spread_ticks_median": float(np.median(good)) if good else None,
         "spread_ticks_max": max(good) if good else None,
@@ -324,6 +397,11 @@ def measure_pipeline_steady_state(
         "topology": spec.topology,
         "n": n,
         "n_rumors": n_rumors,
+        "sample_size": len(list(seeds)),
+        "verdict_kind": (
+            "spot-check" if len(list(seeds)) < MC_MIN_SAMPLES
+            else "monte-carlo"
+        ),
         "completions": runs,
         "single_rumor_bound_ticks": bound,
         "pipelining_overhead_ticks": (
@@ -453,4 +531,415 @@ def spread_certifier(
         "n_entries": len(entries),
         "ok": all(e["certified"] for e in entries)
         and (pipeline is None or pipeline["certified"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Monte Carlo certification service (r15, fleet-backed)
+# ---------------------------------------------------------------------------
+
+def _z_for(conf: float) -> float:
+    """Two-sided normal quantile for a confidence level — exact via the
+    stdlib inverse CDF, so a non-standard ``conf`` yields intervals at
+    the confidence the artifact claims (never a silent 95% fallback)."""
+    if not 0.0 < conf < 1.0:
+        raise ValueError(f"confidence must be in (0, 1), got {conf}")
+    from statistics import NormalDist
+
+    return NormalDist().inv_cdf(0.5 + conf / 2.0)
+
+
+def wilson_interval(k: int, n: int, conf: float = 0.95) -> tuple:
+    """Wilson score interval for a binomial proportion k/n — the interval
+    every MC artifact records for P(spread <= bound) / P(false-DEAD > 0).
+    Well-behaved at the boundaries (k=0 / k=n), unlike the Wald interval,
+    which is why it is the recorded method."""
+    if n <= 0:
+        return 0.0, 1.0
+    z = _z_for(conf)
+    p = k / n
+    denom = 1.0 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = z * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n)) / denom
+    return max(0.0, center - half), min(1.0, center + half)
+
+
+def quantile_ci(sorted_samples, q: float, conf: float = 0.95) -> tuple:
+    """(point, (lo, hi)): the empirical q-quantile with a distribution-free
+    order-statistic confidence interval — the CI endpoints are the order
+    statistics at ranks ``n·q ± z·sqrt(n·q(1-q))`` (the binomial rank
+    bracket, normal-approximated; exact to <1 rank at the MC sample sizes
+    this service runs). ``sorted_samples`` must be ascending."""
+    xs = np.asarray(sorted_samples)
+    n = xs.shape[0]
+    if n == 0:
+        return None, (None, None)
+    z = _z_for(conf)
+    mu = n * q
+    sd = math.sqrt(max(n * q * (1 - q), 0.0))
+    point = float(xs[min(max(math.ceil(mu) - 1, 0), n - 1)])
+    lo = int(np.clip(math.floor(mu - z * sd) - 1, 0, n - 1))
+    hi = int(np.clip(math.ceil(mu + z * sd), 0, n - 1))
+    return point, (float(xs[lo]), float(xs[hi]))
+
+
+def certify_spread_mc(
+    spec: DissemSpec,
+    n: int = 64,
+    n_seeds: int = MC_MIN_SAMPLES,
+    engine: str = "dense",
+    fanout: int = 3,
+    rumor_slots: int = 8,
+    window: int = 32,
+    base_seed: int = 0,
+    max_ticks: Optional[int] = None,
+    conf: float = 0.95,
+) -> dict:
+    """Monte Carlo spread-time certification of one (strategy, topology)
+    cell: ``n_seeds`` independent clusters advance in FLEET windows (one
+    XLA dispatch per window for all scenarios — :mod:`..ops.fleet`), the
+    per-scenario ticks-to-full-coverage fold stays on device across
+    windows, and the single [S] readback at the end feeds the interval
+    statistics (see the module docstring for the exact formulas). Seed
+    ``s`` varies both the rumor origin row and the PRNG chain, exactly
+    as the serial spot check's seeds do."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import fleet as FL
+
+    import dataclasses as _dc
+
+    bound = theory_bound(spec, n, fanout, rumor_slots)
+    if max_ticks is None:
+        max_ticks = 4 * bound["bound_ticks"] + 4 * window
+    params, base, ops_mod = _SETUPS[engine](spec, n, fanout, rumor_slots)
+    if hasattr(params, "quiet_gates"):
+        # the fleet profile (ops/fleet.py): drop the quiet-tick lax.conds
+        # — under vmap they run both branches AND select; the ungated
+        # program is value-identical and leaner
+        params = _dc.replace(params, quiet_gates=False)
+    step = FL.make_fleet_run(params, window)
+    seeds = np.arange(n_seeds) + base_seed
+    origins = (seeds * 37 + 1) % n
+    fs = FL.fleet_broadcast(base(), n_seeds)
+    fs = FL.fleet_inject_rumor(ops_mod, fs, 0, origins)
+    keys = FL.fleet_keys(1000 + seeds)
+    hit = jnp.full((n_seeds,), -1, jnp.int32)
+    sharded = jax.device_count() > 1 and n_seeds % jax.device_count() == 0
+    if sharded:
+        # scenario-axis device parallelism (zero collectives — see
+        # fleet_mesh); the fold accumulator rides the same mesh so the
+        # whole per-window loop stays sharded end to end
+        mesh = FL.fleet_mesh()
+        fs = FL.shard_fleet(fs, mesh)
+        keys = FL.shard_fleet(keys, mesh)
+        hit = FL.shard_fleet(hit, mesh)
+    fold = jax.jit(FL.fold_first_full_coverage)
+    windows = 0
+    for w0 in range(0, max_ticks, window):
+        fs, keys, ms, _w = step(fs, keys)
+        hit = fold(hit, ms["rumor_coverage"][:, :, 0], w0)
+        windows += 1
+        # one SCALAR sync per window (bounded by windows, never by seeds)
+        if bool((hit >= 0).all()):
+            break
+    del step
+    ticks = np.asarray(hit)  # THE per-cell [S] readback
+    finished = int((ticks >= 0).sum())
+    good = np.sort(ticks[ticks >= 0])
+    within = int(((ticks >= 0) & (ticks <= bound["bound_ticks"])).sum())
+    wil = wilson_interval(within, n_seeds, conf)
+    med, med_ci = quantile_ci(good, 0.5, conf)
+    p99, p99_ci = quantile_ci(good, 0.99, conf)
+    p01, p01_ci = quantile_ci(good, 0.01, conf)
+    certified = (
+        finished == n_seeds
+        and p99_ci[1] is not None
+        and p99_ci[1] <= bound["bound_ticks"]
+        and wil[0] >= 0.99
+    )
+    if bound["lower_bound_ticks"]:
+        # the ring's linear class: even the FAST tail must exceed the
+        # linear lower bound (the comparative "genuinely slow" claim)
+        certified = certified and (
+            p01_ci[0] is not None
+            and p01_ci[0] >= bound["lower_bound_ticks"]
+        )
+    hist = {}
+    if good.size:
+        vals, counts = np.unique(good, return_counts=True)
+        hist = {int(v): int(c) for v, c in zip(vals, counts)}
+    return {
+        "strategy": spec.strategy,
+        "topology": spec.topology,
+        "engine": engine,
+        "n": n,
+        "fanout": fanout,
+        "rumor_slots": rumor_slots,
+        "n_seeds": n_seeds,
+        "sample_size": n_seeds,
+        "base_seed": base_seed,
+        "verdict_kind": (
+            "monte-carlo" if n_seeds >= MC_MIN_SAMPLES else "spot-check"
+        ),
+        "interval_method": (
+            f"Wilson {conf:.0%} on P(spread<=bound); distribution-free "
+            f"order-statistic {conf:.0%} CIs on quantiles (binomial rank "
+            "bracket, normal-approx ranks)"
+        ),
+        "confidence": conf,
+        "finished": finished,
+        "spread_ticks_min": int(good[0]) if good.size else None,
+        "spread_ticks_median": med,
+        "median_ci": list(med_ci),
+        "spread_ticks_p99": p99,
+        "p99_ci": list(p99_ci),
+        "p01_ci": list(p01_ci),
+        "spread_ticks_max": int(good[-1]) if good.size else None,
+        "within_bound": within,
+        "p_within_bound": round(within / n_seeds, 6),
+        "wilson": [round(wil[0], 6), round(wil[1], 6)],
+        "spread_histogram": hist,
+        "windows_dispatched": windows,
+        "window_ticks": window,
+        "fleet_devices": int(jax.device_count()) if sharded else 1,
+        **bound,
+        "certified": bool(certified),
+    }
+
+
+#: default MC matrix: >= 6 (strategy x topology) cells, the r15
+#: acceptance floor — the dense engine carries the statistical load (the
+#: pview fleet is proven by the bit-identity tests + audit variant and a
+#: pview cell can be requested explicitly)
+DEFAULT_MC_MATRIX = (
+    ("push", "full", "dense"),
+    ("push", "expander", "dense"),
+    ("push_pull", "full", "dense"),
+    ("push_pull", "expander", "dense"),
+    ("accelerated", "expander", "dense"),
+    ("accelerated", "ring", "dense"),
+    ("tuneable", "expander", "dense"),
+    ("pipelined", "expander", "dense"),
+)
+
+
+def mc_spread_certifier(
+    matrix=None,
+    n: int = 64,
+    n_seeds: int = MC_MIN_SAMPLES,
+    fanout: int = 3,
+    rumor_slots: int = 8,
+    window: int = 32,
+    pipeline_budget: int = 2,
+    geo_wan_delay_ticks: int = 2,
+    base_seed: int = 0,
+    bus=None,
+    log=None,
+) -> dict:
+    """Run the Monte Carlo certification matrix (the r15 twin of
+    :func:`spread_certifier`): one fleet program per cell, ``n_seeds``
+    scenarios each, Wilson + order-statistic intervals recorded per
+    entry. Returns the record ``benchmarks/config14_fleet.py`` writes
+    into FLEET_BENCH_r15.json."""
+    entries = []
+    matrix = tuple(matrix or DEFAULT_MC_MATRIX)
+    for strat, topol, engine in matrix:
+        spec = DissemSpec(
+            strategy=strat,
+            topology=topol,
+            geo_wan_delay_ticks=geo_wan_delay_ticks if topol == "geo" else 0,
+            pipeline_budget=pipeline_budget,
+        )
+        rec = certify_spread_mc(
+            spec, n=n, n_seeds=n_seeds, engine=engine, fanout=fanout,
+            rumor_slots=rumor_slots, window=window, base_seed=base_seed,
+        )
+        entries.append(rec)
+        if log:
+            log(
+                f"MC {engine}/{strat}/{topol}: {rec['finished']}/{n_seeds} "
+                f"finished, median {rec['spread_ticks_median']} "
+                f"p99 {rec['spread_ticks_p99']} "
+                f"(CI {rec['p99_ci']}) <= bound {rec['bound_ticks']}; "
+                f"P(within) wilson {rec['wilson']} "
+                f"{'OK' if rec['certified'] else 'VIOLATION'}"
+            )
+        if bus is not None:
+            bus.publish(
+                "dissemination", "spread_certified_mc",
+                strategy=strat, topology=topol, engine=engine,
+                certified=rec["certified"], n_seeds=n_seeds,
+                p99=rec["spread_ticks_p99"], p99_ci=rec["p99_ci"],
+                bound_ticks=rec["bound_ticks"], wilson=rec["wilson"],
+            )
+    return {
+        "n": n,
+        "n_seeds": n_seeds,
+        "fanout": fanout,
+        "rumor_slots": rumor_slots,
+        "window_ticks": window,
+        "entries": entries,
+        "certified_strategies": sorted(
+            {e["strategy"] for e in entries if e["certified"]}
+        ),
+        "certified_topologies": sorted(
+            {e["topology"] for e in entries if e["certified"]}
+        ),
+        "n_certified": sum(1 for e in entries if e["certified"]),
+        "n_entries": len(entries),
+        "total_trajectories": n_seeds * len(entries),
+        "ok": all(e["certified"] for e in entries),
+    }
+
+
+# -- Monte Carlo false-positive certification (the chaos sentinel, S-wide) ---
+
+#: the r14 loss-adversarial cohort layout fp_rate_mc drives (config13's
+#: scenario, minus the delay-ring SlowMember so the MC fleet stays on the
+#: loss planes only — delay rings multiply the batched state by D)
+FP_MC_COHORT = dict(asym_rows=(5, 6, 7), flaky_rows=(9,), crash_row=20)
+
+
+def fp_rate_mc(
+    n: int = 48,
+    n_seeds: int = 512,
+    loss_floor: float = 0.10,
+    adaptive: bool = False,
+    window: int = 16,
+    until: int = 200,
+    horizon: int = 240,
+    crash_at: int = 30,
+    base_seed: int = 0,
+    static_suspicion_mult: int = 3,
+    adaptive_knobs: Optional[dict] = None,
+    conf: float = 0.95,
+) -> dict:
+    """Monte Carlo false-positive certification (the r14 sentinel's check,
+    S-wide): ``n_seeds`` clusters run the loss-adversarial scenario
+    (AsymmetricLoss cohort + FlakyObserver + one true Crash) over an
+    ambient uniform-loss floor, driven by the BATCHED StateTimeline fold
+    (:func:`..ops.fleet.fleet_timeline`); per-scenario false-DEAD maxima
+    and crash-detection ticks latch on device at window boundaries (the
+    sentinel sampling-soundness argument, unchanged) and read back ONCE.
+    Reports the Wilson interval on P(any false-DEAD) — the number the
+    adaptive arm must pin to ~0 while the static control's interval sits
+    visibly above it — plus crash-detection latency quantiles against the
+    static detection budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..adaptive import AdaptiveSpec, init_adaptive_state
+    from ..chaos import events as ev
+    from ..chaos.sentinels import default_detect_budget
+    from ..ops import fleet as FL
+    from ..ops import state as S
+    from ..ops.state import SimParams
+
+    knobs = adaptive_knobs or dict(
+        min_mult=5, max_mult=10, conf_target=4, lh_max=8
+    )
+    spec = AdaptiveSpec(enabled=True, **knobs) if adaptive else AdaptiveSpec()
+    params = SimParams(
+        capacity=n, fd_every=1, sync_every=40,
+        suspicion_mult=static_suspicion_mult, rumor_slots=8, seed_rows=(0,),
+        full_metrics=False, adaptive=spec,
+        quiet_gates=False,  # the fleet profile (see certify_spread_mc)
+    )
+    cohort = FP_MC_COHORT
+    watch_rows = tuple(cohort["asym_rows"]) + tuple(cohort["flaky_rows"])
+    crash_row = cohort["crash_row"]
+    scen = ev.Scenario(
+        name="loss_adversarial_mc_r15",
+        events=(
+            ev.AsymmetricLoss(rows=list(cohort["asym_rows"]), pct=70.0,
+                              at=4, until=until, direction="in"),
+            ev.FlakyObserver(rows=list(cohort["flaky_rows"]), pct=70.0,
+                             at=4, until=until),
+            ev.Crash(rows=[crash_row], at=crash_at),
+        ),
+        horizon=horizon,
+    )
+    st0 = S.init_state(params, n, warm=True)
+    if loss_floor > 0:
+        st0 = S.set_uniform_loss(st0, loss_floor, floor=True)
+    fs = FL.fleet_broadcast(st0, n_seeds)
+    keys = FL.fleet_keys(base_seed + np.arange(n_seeds))
+    ad = (
+        FL.fleet_broadcast(init_adaptive_state(n), n_seeds)
+        if adaptive else None
+    )
+    tl = FL.fleet_timeline(scen, S, dense_links=True, horizon=horizon)
+    watch_mask = np.zeros((n,), bool)
+    watch_mask[list(watch_rows)] = True
+    watch_mask = jnp.asarray(watch_mask)
+
+    steps: dict = {}  # window length -> jitted fleet program
+
+    def _step(k: int):
+        if k not in steps:
+            steps[k] = (
+                FL.make_fleet_adaptive_run(params, k) if adaptive
+                else FL.make_fleet_run(params, k)
+            )
+        return steps[k]
+
+    fold_fp = jax.jit(FL.fleet_false_dead)
+    fold_det = jax.jit(lambda st: FL.fleet_crash_detected(st, crash_row))
+    fp_max = jnp.zeros((n_seeds,), jnp.int32)
+    det_tick = jnp.full((n_seeds,), -1, jnp.int32)
+    boundaries = set(tl.boundaries())
+    t = 0
+    while t < horizon:
+        fs, _labels = tl.apply_due(fs, t)
+        stops = [horizon, t + window] + [b for b in boundaries if b > t]
+        stop = min(s for s in stops if s > t)
+        if adaptive:
+            fs, ad, keys, _ms, _w = _step(stop - t)(fs, ad, keys)
+        else:
+            fs, keys, _ms, _w = _step(stop - t)(fs, keys)
+        t = stop
+        fp_max = jnp.maximum(fp_max, fold_fp(fs, watch_mask))
+        if t > crash_at:
+            det = fold_det(fs)
+            det_tick = jnp.where(
+                (det_tick < 0) & det, jnp.int32(t), det_tick
+            )
+    fs, _labels = tl.apply_due(fs, horizon)
+    fp_np = np.asarray(fp_max)  # the one [S] readback pair
+    det_np = np.asarray(det_tick)
+    k_fp = int((fp_np > 0).sum())
+    wil = wilson_interval(k_fp, n_seeds, conf)
+    deadline = crash_at + default_detect_budget(params)
+    detected = det_np[det_np >= 0]
+    det_sorted = np.sort(detected)
+    _p99d, p99d_ci = quantile_ci(det_sorted, 0.99, conf)
+    det_ok = (
+        int((det_np >= 0).sum()) == n_seeds
+        and int(det_np.max()) <= deadline
+    )
+    return {
+        "arm": "adaptive" if adaptive else "static",
+        "n": n,
+        "n_seeds": n_seeds,
+        "sample_size": n_seeds,
+        "verdict_kind": (
+            "monte-carlo" if n_seeds >= MC_MIN_SAMPLES else "spot-check"
+        ),
+        "loss_floor_pct": round(loss_floor * 100),
+        "scenario": scen.name,
+        "fp_watch_rows": list(watch_rows),
+        "false_dead_scenarios": k_fp,
+        "fp_rate": round(k_fp / n_seeds, 6),
+        "fp_rate_wilson": [round(wil[0], 6), round(wil[1], 6)],
+        "interval_method": f"Wilson {conf:.0%} on P(false-DEAD > 0)",
+        "crash_detected": int((det_np >= 0).sum()),
+        "crash_detect_deadline": int(deadline),
+        "crash_detect_max": int(det_np.max()) if detected.size else None,
+        "crash_detect_p99_ci": list(p99d_ci),
+        "crash_detect_window_ticks": window,
+        "detections_ok": bool(det_ok),
+        "static_suspicion_mult": static_suspicion_mult,
+        "adaptive_knobs": knobs if adaptive else None,
     }

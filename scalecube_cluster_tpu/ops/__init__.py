@@ -32,6 +32,10 @@ Modules:
 * :mod:`engine_api` — the ONE engine-interface spelling: every consumer
   (driver, telemetry, trace, chaos, monitor) resolves dense/sparse/pview
   through one :class:`~.engine_api.EngineOps` descriptor (r11).
+* :mod:`fleet`    — the scenario-batched fleet engine (r15): every
+  engine's window vmapped over a leading [S] scenario axis (one XLA
+  program advancing S×N members), the batched chaos-timeline fold, and
+  the on-device Monte Carlo reductions behind the certification service.
 """
 
 from .lattice import UNKNOWN, decode_key, precedence_key

@@ -29,6 +29,10 @@ through :func:`resolve` / :func:`of_driver`:
   compiled window programs) and ``state_shardings`` (abstract mesh
   placements, so the auditor can lower the mesh-sharded variants without
   allocating a state).
+* **fleet seam (r15)** — ``make_fleet_run`` / ``make_fleet_adaptive_run``
+  (:mod:`.fleet`): the window vmapped over a leading [S] scenario axis,
+  fleet state donated — one XLA program advancing S independent
+  clusters, the Monte Carlo certification service's engine surface.
 
 Engines: ``dense`` (:mod:`.kernel` / :mod:`.state`), ``sparse``
 (:mod:`.sparse`), ``pview`` (:mod:`.pview` — the r11 O(N·k) partial-view
@@ -100,6 +104,13 @@ class EngineContracts:
     restore_module: Optional[str] = None
     key_dtypes: tuple = ("i32",)
     strategy_variants: tuple = ()
+    #: r15 fleet variant's memory budget factor (peak / (S × one state)).
+    #: Batched windows trade the serial engines' lax.cond quiet-tick skips
+    #: for select-over-both-branches, so a fleet window legitimately stages
+    #: more live temps per scenario than the serial budget admits; None
+    #: inherits ``memory_factor``. The overhead term is shared (fixed
+    #: small-S costs amortize across the fleet).
+    fleet_memory_factor: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +146,12 @@ class EngineOps:
     #: with (state, adaptive_state) donated, argnums (0, 1)); every engine
     #: registers one — the spec on params must be enabled or it refuses
     make_adaptive_run: Optional[Callable] = None
+    #: r15 fleet builders ((params, n_ticks, donate=True) -> jitted vmapped
+    #: window over a leading [S] scenario axis, fleet state donated — see
+    #: :mod:`.fleet` for the batching rules): the scenario-batched window
+    #: and its adaptive twin ((state, ad) donated, argnums (0, 1))
+    make_fleet_run: Optional[Callable] = None
+    make_fleet_adaptive_run: Optional[Callable] = None
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -238,6 +255,8 @@ def _dense_engine() -> EngineOps:
         ),
         state_shardings=_shardings,
         make_adaptive_run=K.make_adaptive_run,
+        make_fleet_run=K.make_fleet_run,
+        make_fleet_adaptive_run=K.make_fleet_adaptive_run,
     )
 
 
@@ -291,9 +310,16 @@ def _sparse_engine() -> EngineOps:
             memory_factor=5.0,
             restore_module="scalecube_cluster_tpu.ops.sparse",
             strategy_variants=(("pipelined", "expander"),),
+            # measured fleet peak/(S × state) at N=128, S=4, 4-tick window:
+            # 5.36x — vmap turns the quiet-tick lax.conds into selects that
+            # run both branches, so the per-scenario staging sits above the
+            # serial 4.01x; 6.0 forbids a second whole-fleet copy on top
+            fleet_memory_factor=6.0,
         ),
         state_shardings=_shardings,
         make_adaptive_run=SP.make_sparse_adaptive_run,
+        make_fleet_run=SP.make_sparse_fleet_run,
+        make_fleet_adaptive_run=SP.make_sparse_fleet_adaptive_run,
     )
 
 
@@ -338,6 +364,10 @@ def _pview_engine() -> EngineOps:
             memory_factor=4.5,
             restore_module="scalecube_cluster_tpu.ops.pview",
             key_dtypes=("i32", "i16"),
+            # measured fleet peak/(S × state) at N=128, S=4, 4-tick window:
+            # 4.90x (cond→select staging, same shape as sparse); 5.5 keeps
+            # the no-second-fleet-copy rule with modest refactor headroom
+            fleet_memory_factor=5.5,
             # r13: the closed-form circulant selection must keep the
             # no-[N, N]-anywhere guarantee — forbid_wide_values is proved
             # over the strategy windows too
@@ -346,6 +376,8 @@ def _pview_engine() -> EngineOps:
             ),
         ),
         make_adaptive_run=PV.make_pview_adaptive_run,
+        make_fleet_run=PV.make_pview_fleet_run,
+        make_fleet_adaptive_run=PV.make_pview_fleet_adaptive_run,
     )
 
 

@@ -1,0 +1,325 @@
+"""The fleet engine (r15): scenario-batched vmap windows.
+
+Every engine window is a pure ``window(state, key) -> (state', key', ms)``
+program, so batching a leading SCENARIO axis is just ``vmap``: one XLA
+program advances S independent clusters of N members each — S×N member
+ticks per dispatch — and the whole Monte Carlo axis (seeds × chaos
+draws × origins) runs at device speed with zero per-scenario dispatch
+overhead. This module is the ONE spelling of that batching:
+
+* :func:`make_fleet_window` — the generic builder: ``jit(vmap(core))``
+  with the fleet state DONATED (the r6 double-buffered discipline covers
+  the stacked pytree exactly as it covers a single state — the donated
+  argnums are audited by the r12 ``fleet`` matrix variant).
+* :func:`make_fleet_run` / :func:`make_fleet_adaptive_run` — the
+  engine-resolving entry points (``SimParams`` → dense, ``SparseParams``
+  → sparse, ``PviewParams`` → pview, the historical driver contract);
+  each engine also registers its own builder on
+  :class:`~.engine_api.EngineOps` (``make_fleet_run`` /
+  ``make_fleet_adaptive_run``).
+* fleet-state plumbing — :func:`fleet_broadcast` / :func:`fleet_stack` /
+  :func:`fleet_row` / :func:`fleet_size` / :func:`fleet_keys` /
+  :func:`fleet_inject_rumor`.
+* :class:`FleetOps` + :func:`fleet_timeline` — the batched
+  ``StateTimeline`` fold: the chaos mutator surface of an engine ops
+  module, vmapped over the scenario axis, so one compiled-schedule
+  scenario replays onto ALL S clusters between fleet windows (pure
+  device ops, nothing read back — the r7 discipline, S-wide).
+
+Batching rules (the contract docs/FLEET.md spells out):
+
+* **What varies per scenario**: everything in the STATE — the PRNG key
+  chain, rumor origins/slots, up masks, loss/delay planes, view planes.
+  Each scenario's row ``s`` evolves exactly as a serial single-cluster
+  run with the same state and key would: the per-row trajectory is
+  BIT-IDENTICAL to the unbatched window (pinned by
+  ``tests/test_fleet.py`` for all three engines), because vmap batches
+  every op elementwise and the per-tick key chain
+  (``key, k = split(key)``) is a per-row function of the row's own key.
+* **What may NOT vary**: anything STATIC — capacity, fanout, dissem
+  spec, key dtype, tick counts, adaptive knobs. Those are compiled into
+  the program; a cell of the Monte Carlo matrix that changes one of
+  them is a different fleet program (the certify service builds one
+  fleet window per cell for exactly this reason).
+* **Quiet-tick caveat**: ``lax.cond`` under vmap runs BOTH branches and
+  materializes a select over every state leaf, so the serial engines'
+  quiet-tick skips (no gossip payload, no suspicion anywhere) do not
+  apply per row — a fleet window does the active-tick work for every
+  scenario every tick, plus the select traffic. The dense engine's
+  static ``SimParams.quiet_gates=False`` switch (the FLEET PROFILE)
+  drops the gates and traces the active branches alone — value-identical
+  by construction (each gated branch is a no-op when its gate is closed)
+  and what the MC certification service and config14 run. Monte Carlo
+  runs are active by construction; idle-heavy workloads belong on the
+  serial windows.
+* **Device parallelism**: scenarios are independent, so
+  :func:`fleet_mesh` + :func:`shard_fleet` split the S axis over the
+  local devices with zero collectives — still ONE XLA program per
+  window. On CPU this is what engages the cores (XLA:CPU executes a
+  single-device op stream serially; one partition per virtual device
+  runs them concurrently); on a TPU slice it is fleet-per-chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: the scenario mesh axis — orthogonal to ops/sharding.py's "members"
+#: axis: scenarios are INDEPENDENT, so sharding S over devices needs no
+#: collectives at all (GSPMD partitions every batched op cleanly)
+FLEET_AXIS = "scenarios"
+
+
+def make_fleet_window(
+    core: Callable,
+    params,
+    n_ticks: int,
+    donate: bool = True,
+    donated: tuple = (0,),
+):
+    """``jit(vmap(core))`` over a leading [S] scenario axis.
+
+    ``core`` is an engine's raw window function with the shared signature
+    ``core(*batched_args, n_ticks=, params=)`` — ``run_ticks`` /
+    ``run_sparse_ticks`` / ``run_pview_ticks`` take ``(state, key)``,
+    the adaptive cores ``(state, ad, key)``. Every positional argument
+    is mapped on axis 0; ``donated`` names the argnums donated to the
+    compiled program (the fleet state — and the adaptive state for the
+    adaptive cores), exactly the serial builders' donation discipline
+    lifted to the stacked pytree."""
+    run = functools.partial(core, n_ticks=n_ticks, params=params)
+    return jax.jit(jax.vmap(run), donate_argnums=donated if donate else ())
+
+
+def make_fleet_run(params, n_ticks: int, donate: bool = True):
+    """The engine-resolving fleet window builder: one jitted program
+    advancing ``S`` independent clusters (state pytree stacked to
+    ``[S, ...]``, keys ``[S, 2]``), fleet state donated. ``S`` is read
+    from the arrays at call time (one compile per distinct S)."""
+    from . import engine_api
+
+    eng = engine_api.resolve(params)
+    if eng.make_fleet_run is None:  # pragma: no cover — all engines register
+        raise ValueError(f"engine {eng.name!r} registers no fleet builder")
+    return eng.make_fleet_run(params, n_ticks, donate)
+
+
+def make_fleet_adaptive_run(params, n_ticks: int, donate: bool = True):
+    """Fleet twin of the engines' ``make_adaptive_run`` (r14): the
+    AdaptiveState pytree rides stacked to ``[S, ...]`` and is donated
+    alongside the fleet state (argnums 0, 1). Refuses a default spec —
+    the legacy fleet builder is the byte-identical program then."""
+    from . import engine_api
+
+    eng = engine_api.resolve(params)
+    if eng.make_fleet_adaptive_run is None:
+        raise ValueError(
+            f"engine {eng.name!r} registers no adaptive fleet builder"
+        )
+    return eng.make_fleet_adaptive_run(params, n_ticks, donate)
+
+
+# ---------------------------------------------------------------------------
+# scenario-axis sharding (the fleet's device-parallel mode)
+# ---------------------------------------------------------------------------
+
+
+def fleet_mesh(devices=None):
+    """A 1-D ``scenarios`` mesh over the local devices. Scenarios are
+    independent, so the fleet's sharded mode needs NO collectives: GSPMD
+    partitions every batched op on the leading axis and each device
+    advances its S/devices clusters — one XLA program, device-parallel.
+    (On CPU the 8-virtual-device mesh is what actually engages the cores:
+    XLA:CPU runs one partition per device thread, where the single-device
+    fleet program executes its op stream serially.)"""
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (FLEET_AXIS,))
+
+
+def shard_fleet(tree, mesh):
+    """Commit a fleet pytree (state, keys, fold accumulators) to the
+    scenario mesh: every non-empty leaf split on its leading [S] axis,
+    zero-size leaves (e.g. delay rings at delay_slots=0) replicated. S
+    must divide by the mesh size. The jitted fleet window then compiles
+    for these shardings by propagation — no in_shardings plumbing, and
+    donation covers the sharded buffers exactly as on one device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s = fleet_size(tree)
+    if s % mesh.size:
+        raise ValueError(
+            f"fleet size {s} does not divide over the {mesh.size}-device "
+            "scenario mesh"
+        )
+    shard = NamedSharding(mesh, P(FLEET_AXIS))
+    rep = NamedSharding(mesh, P())
+    return jax.device_put(
+        tree, jax.tree.map(lambda x: shard if x.size else rep, tree)
+    )
+
+
+# ---------------------------------------------------------------------------
+# fleet-state plumbing
+# ---------------------------------------------------------------------------
+
+
+def fleet_size(fleet_state) -> int:
+    """S — the scenario-axis length of a stacked state pytree."""
+    return jax.tree.leaves(fleet_state)[0].shape[0]
+
+
+def fleet_stack(states: Sequence):
+    """Stack per-scenario states (same treedef, same shapes) into one
+    fleet state — for fleets whose scenarios start from DIFFERENT
+    states. Identical starts should use :func:`fleet_broadcast`."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def fleet_broadcast(state, s: int):
+    """One state replicated to a [S, ...] fleet (the Monte Carlo start:
+    S identical clusters whose trajectories then diverge purely through
+    their per-scenario keys and injected mutations). Materialized copies
+    — the fleet state must own its buffers to be donatable."""
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (s,) + x.shape), state
+    )
+
+
+def fleet_row(fleet_state, s: int):
+    """Scenario ``s`` as an unbatched engine state (host-side slicing —
+    the bit-identity tests' decode seam; not a hot-path op)."""
+    return jax.tree.map(lambda x: x[s], fleet_state)
+
+
+def fleet_keys(seeds) -> jax.Array:
+    """[S, 2] PRNG keys, row s == ``jax.random.PRNGKey(seeds[s])`` exactly
+    (one vmapped threefry seed — the serial control of the bit-identity
+    contract uses the scalar spelling on the same seed)."""
+    seeds = jnp.asarray(seeds, jnp.int32)
+    return jax.vmap(jax.random.PRNGKey)(seeds)
+
+
+def fleet_inject_rumor(ops, fleet_state, slot: int, origins):
+    """Per-scenario ``spread_rumor`` (one vmapped host mutation): scenario
+    ``s`` starts the rumor in ``slot`` at row ``origins[s]``. ``ops`` is
+    the engine's ops module (``ops.state`` / ``ops.sparse`` /
+    ``ops.pview`` — the same mutator surface everywhere)."""
+    origins = jnp.asarray(origins, jnp.int32)
+    return jax.vmap(lambda st, o: ops.spread_rumor(st, int(slot), o))(
+        fleet_state, origins
+    )
+
+
+# ---------------------------------------------------------------------------
+# the batched StateTimeline fold
+# ---------------------------------------------------------------------------
+
+#: engine ops-module callables the chaos StateTimeline replays (the
+#: complete mutator surface chaos/engine.py dispatches to)
+_TIMELINE_MUTATORS = frozenset({
+    "crash_rows", "crash_row", "join_row", "join_rows", "begin_leave",
+    "set_link_loss", "set_link_delay", "set_uniform_loss",
+    "block_partition", "heal_partition", "spread_rumor", "update_metadata",
+})
+
+
+class FleetOps:
+    """The chaos-mutator surface of an engine ops module, vmapped over the
+    scenario axis — what makes ``StateTimeline`` (r7) a BATCHED fold:
+    every scheduled action (crash, partition, storm, degraded-cohort
+    write, restart) applies to all S scenarios in one traced device op,
+    with the event arguments broadcast (a timeline's schedule is shared
+    across the fleet; per-scenario variation enters through the PRNG
+    keys and any per-scenario state mutation applied via
+    :func:`fleet_inject_rumor` / your own ``jax.vmap``). Non-mutator
+    attributes (``GROUP_PARTITIONS`` etc.) pass through untouched."""
+
+    def __init__(self, ops):
+        self._ops = ops
+
+    def __getattr__(self, name):
+        target = getattr(self._ops, name)
+        if name not in _TIMELINE_MUTATORS or not callable(target):
+            return target
+
+        def vmapped(fleet_state, *args, **kwargs):
+            return jax.vmap(lambda st: target(st, *args, **kwargs))(
+                fleet_state
+            )
+
+        return vmapped
+
+
+def fleet_timeline(scenario, ops, dense_links: bool, horizon=None):
+    """A chaos :class:`~..chaos.engine.StateTimeline` whose compiled
+    schedule replays onto a FLEET state: same validation, same ordered
+    (tick, seq) fold, same loss-storm stash/replay semantics — each
+    action one vmapped device op over all S scenarios."""
+    from ..chaos.engine import StateTimeline
+
+    return StateTimeline(
+        scenario, FleetOps(ops), dense_links=dense_links, horizon=horizon
+    )
+
+
+# ---------------------------------------------------------------------------
+# on-device fleet reductions (the Monte Carlo folds)
+# ---------------------------------------------------------------------------
+
+
+def fold_first_full_coverage(hit_tick, coverage, window_start):
+    """Latch per-scenario first-full-coverage ticks from one fleet
+    window's stacked coverage curves. ``hit_tick`` [S] i32 (-1 = not yet),
+    ``coverage`` [S, T] (one rumor slot's curve), ``window_start`` the
+    absolute tick at window entry. Pure jnp — jit me; the accumulator
+    stays on device across windows (no per-seed readback, the r6 rule)."""
+    hit = coverage >= 1.0  # [S, T]
+    any_hit = hit.any(axis=1)
+    first = jnp.argmax(hit, axis=1).astype(jnp.int32)  # first True per row
+    cand = jnp.int32(window_start) + first + 1
+    return jnp.where((hit_tick < 0) & any_hit, cand, hit_tick)
+
+
+def fleet_false_dead(fleet_state, watch_up_mask):
+    """[S] i32: per scenario, how many WATCHED rows (degraded-but-alive
+    cohort / never-faulted members) are currently tombstoned DEAD by any
+    up observer — the chaos false-positive sentinel's core check
+    (``chaos.sentinels`` guarantee 1), vmapped. ``watch_up_mask`` [N]
+    bool is the watch cohort; rank DEAD == 3 with ``key >= 0`` excludes
+    unknown cells exactly as ``kernel.sentinel_core`` does. Dense/sparse
+    view-plane states only (the engines the MC fp service runs)."""
+
+    def one(st):
+        vk = st.view_key
+        dead = (vk >= 0) & ((vk & 3) == 3)
+        watched = watch_up_mask & st.up
+        return (
+            (dead & st.up[:, None] & watched[None, :])
+            .any(axis=0)
+            .sum()
+            .astype(jnp.int32)
+        )
+
+    return jax.vmap(one)(fleet_state)
+
+
+def fleet_crash_detected(fleet_state, crash_row: int):
+    """[S] bool: per scenario, does EVERY up observer read ``crash_row``
+    at rank DEAD (or never knew it — unknown key -1 also reads rank 3,
+    matching the reference's removal)? The detection-latency sentinel's
+    check (guarantee 2), vmapped for the MC certification fold."""
+
+    def one(st):
+        col = st.view_key[:, crash_row]
+        n = st.up.shape[0]
+        others_up = st.up & (jnp.arange(n) != crash_row)
+        return (~others_up | ((col & 3) == 3)).all()
+
+    return jax.vmap(one)(fleet_state)

@@ -520,7 +520,12 @@ def _suspicion_phase(state: SimState, params: SimParams, trace=None, ad=None):
         return st
 
     # No SUSPECT cell anywhere (the healthy steady state) -> nothing can
-    # expire; skip the timer compare + both plane writes.
+    # expire; skip the timer compare + both plane writes. The sweep with
+    # no suspects expires nothing, so the ungated spelling (quiet_gates
+    # off — the fleet profile, where a vmapped cond would run both
+    # branches AND select) is value-identical.
+    if not params.quiet_gates:
+        return _sweep(state)
     has_suspect = (
         ((state.view_key & 3) == RANK_SUSPECT).any() if recompute else suspect.any()
     )
@@ -766,6 +771,11 @@ def _gossip_phase(
             m["_ad_key"] = jnp.full((n,), NO_CANDIDATE_I32, jnp.int32)
         return state, m
 
+    # a delivery with no payload anywhere sends nothing and accepts
+    # nothing — the quiet gate is a pure dispatch-cost skip, so the
+    # ungated fleet profile traces _deliver alone (value-identical)
+    if not params.quiet_gates:
+        return _deliver(state)
     return jax.lax.cond(gossip_work, _deliver, _quiet, state)
 
 
@@ -938,7 +948,10 @@ def _sync_phase(
     return st, metrics
 
 
-def _refute_phase(state: SimState, trace=None, adaptive: bool = False):
+def _refute_phase(
+    state: SimState, trace=None, adaptive: bool = False,
+    quiet_gates: bool = True,
+):
     """A running node that finds itself SUSPECT — or even DEAD (a lingering
     cross-partition death rumor can land after a heal) — re-announces ALIVE
     with a bumped incarnation. The reference refutes ANY overriding record
@@ -978,7 +991,12 @@ def _refute_phase(state: SimState, trace=None, adaptive: bool = False):
 
     # In a healthy cluster nobody is refuting; skip the diagonal writes
     # (which force a copy-on-write of both [N, N] planes) entirely then.
-    st = jax.lax.cond(need.any(), _apply, lambda st: st, state)
+    # With need all-False the write re-sets every diagonal to itself, so
+    # the ungated fleet profile is value-identical.
+    if not quiet_gates:
+        st = _apply(state)
+    else:
+        st = jax.lax.cond(need.any(), _apply, lambda st: st, state)
     if trace is not None:
         return st, need[jnp.asarray(trace.tracer_rows, jnp.int32)]
     if adaptive:
@@ -1081,7 +1099,13 @@ def tick(
         return st, m
 
     fd_ran = (state.tick % params.fd_every) == 0
-    state, fd_m = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
+    if params.fd_every == 1 and not params.quiet_gates:
+        # the gate is vestigial when the FD round fires every tick — the
+        # fleet profile traces _fd_on directly instead of paying a vmapped
+        # cond's run-both-branches + state-wide select
+        state, fd_m = _fd_on(state)
+    else:
+        state, fd_m = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
     if trace is not None:
         state, trace_sus = _suspicion_phase(state, params, trace=trace)
     else:
@@ -1093,9 +1117,11 @@ def tick(
     if trace is not None:
         state, trace_ref = _refute_phase(state, trace=trace)
     elif armed:
-        state, refuted = _refute_phase(state, adaptive=True)
+        state, refuted = _refute_phase(
+            state, adaptive=True, quiet_gates=params.quiet_gates
+        )
     else:
-        state = _refute_phase(state)
+        state = _refute_phase(state, quiet_gates=params.quiet_gates)
     state = _rumor_sweep(state, params)
 
     trace_fd = fd_m.pop("trace_fd", None)
@@ -1504,6 +1530,32 @@ def make_adaptive_run(params: SimParams, n_ticks: int, donate: bool = True):
     return jax.jit(
         partial(run_ticks_adaptive, n_ticks=n_ticks, params=params),
         donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_fleet_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Scenario-batched :func:`run_ticks` (r15): one jitted program
+    advancing S independent clusters — the state pytree stacked to
+    ``[S, ...]``, keys ``[S, 2]``, fleet state DONATED. Row ``s``'s
+    trajectory is bit-identical to a serial :func:`run_ticks` on the same
+    (state, key); see :mod:`.fleet` for the batching rules."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(run_ticks, params, n_ticks, donate=donate)
+
+
+def make_fleet_adaptive_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Fleet twin of :func:`make_adaptive_run`: ``[S, ...]`` engine AND
+    adaptive states donated (argnums 0, 1). Refuses a default spec."""
+    from .fleet import make_fleet_window
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_fleet_adaptive_run needs an enabled AdaptiveSpec on "
+            "params — the default spec's program is make_fleet_run's"
+        )
+    return make_fleet_window(
+        run_ticks_adaptive, params, n_ticks, donate=donate, donated=(0, 1)
     )
 
 

@@ -2125,6 +2125,34 @@ def make_pview_adaptive_run(params: PviewParams, n_ticks: int,
     )
 
 
+def make_pview_fleet_run(params: PviewParams, n_ticks: int, donate: bool = True):
+    """Scenario-batched :func:`run_pview_ticks` (r15): the O(N·k) engine's
+    fleet window — every batched value is ``[S, N, k]``-proportional, so
+    the wide-value ban holds over the fleet program too (the r12 ``fleet``
+    audit variant proves it)."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(run_pview_ticks, params, n_ticks, donate=donate)
+
+
+def make_pview_fleet_adaptive_run(
+    params: PviewParams, n_ticks: int, donate: bool = True
+):
+    """Fleet twin of :func:`make_pview_adaptive_run` (argnums 0, 1
+    donated). Refuses a default spec."""
+    from .fleet import make_fleet_window
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_pview_fleet_adaptive_run needs an enabled AdaptiveSpec "
+            "on params — the default spec's program is make_pview_fleet_run's"
+        )
+    return make_fleet_window(
+        run_pview_ticks_adaptive, params, n_ticks, donate=donate,
+        donated=(0, 1),
+    )
+
+
 def make_pview_run(params: PviewParams, n_ticks: int, donate: bool = True):
     """Jitted window with the state DONATED — the pview twin of
     ``sparse.make_sparse_run`` (the one spelling the driver and every

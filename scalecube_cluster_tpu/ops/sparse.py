@@ -2458,6 +2458,35 @@ def make_sparse_adaptive_run(params: SparseParams, n_ticks: int,
     )
 
 
+def make_sparse_fleet_run(params: SparseParams, n_ticks: int, donate: bool = True):
+    """Scenario-batched :func:`run_sparse_ticks` (r15) — the sparse twin
+    of ``kernel.make_fleet_run``: state stacked to ``[S, ...]``, keys
+    ``[S, 2]``, fleet state donated; row trajectories bit-identical to
+    serial windows on the same (state, key)."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(run_sparse_ticks, params, n_ticks, donate=donate)
+
+
+def make_sparse_fleet_adaptive_run(
+    params: SparseParams, n_ticks: int, donate: bool = True
+):
+    """Fleet twin of :func:`make_sparse_adaptive_run` (argnums 0, 1
+    donated). Refuses a default spec."""
+    from .fleet import make_fleet_window
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_sparse_fleet_adaptive_run needs an enabled AdaptiveSpec "
+            "on params — the default spec's program is "
+            "make_sparse_fleet_run's"
+        )
+    return make_fleet_window(
+        run_sparse_ticks_adaptive, params, n_ticks, donate=donate,
+        donated=(0, 1),
+    )
+
+
 def make_sparse_run(params: SparseParams, n_ticks: int, donate: bool = True):
     """Jitted :func:`run_sparse_ticks` window with the state DONATED — the
     sparse twin of ``kernel.make_run``. Donation is not optional at large N

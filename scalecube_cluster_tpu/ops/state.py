@@ -126,6 +126,22 @@ class SimParams:
     # keep the reference's uniform semantics). Config spelling:
     # ClusterConfig.dissemination.
     dissem: DissemSpec = DissemSpec()
+    # Quiet-tick gates (r15). The kernel guards its rare/idle work behind
+    # ``lax.cond`` — the FD round off-ticks, the fully-quiescent gossip
+    # tick, the no-suspect suspicion sweep, the nobody-refuting diagonal
+    # write. Each guarded branch is a VALUE-IDENTICAL no-op when its gate
+    # is closed (a sweep with no suspects expires nothing, a delivery with
+    # no payload accepts nothing), so the gates are pure dispatch-cost
+    # optimizations. Under vmap (the r15 fleet engine) a batched-predicate
+    # cond runs BOTH branches and materializes a select over every state
+    # leaf — [S, N, N] copies per cond per tick that the serial engine
+    # never pays. ``quiet_gates=False`` statically traces the ACTIVE
+    # branch only: trajectories stay bit-identical (pinned by
+    # tests/test_fleet.py), and the fleet program drops the select
+    # traffic. Keep True for serial windows (the skips are why quiet
+    # steady-state ticks are nearly free); the fleet builders' callers
+    # (the MC certification service, config14) set False.
+    quiet_gates: bool = True
     # Adaptive failure detection (r14, adaptive.py): the default spec is
     # the byte-identical legacy program; an enabled spec arms the
     # Lifeguard-style local-health + confirmation-scaled suspicion plane
@@ -422,10 +438,18 @@ def init_state(
 
 
 def _roundtrip(loss: jax.Array) -> jax.Array:
-    """(1-loss)·(1-loss.T) — the derived fetch/request round-trip matrix."""
-    if loss.ndim == 0:
+    """(1-loss)·(1-lossᵀ) — the derived fetch/request round-trip matrix.
+    Transpose over the LAST TWO axes: a fleet-stacked [S, N, N] loss plane
+    (r15 batched StateTimeline fold) must transpose per scenario, and for
+    the serial [N, N] plane swapaxes(-1, -2) IS ``.T``. Anything below
+    rank 2 is the UNIFORM-loss mode — the 0-d scalar, or its
+    fleet-stacked [S] form (one uniform loss per scenario) — where the
+    round trip is symmetric and elementwise."""
+    if loss.ndim < 2:
         return ((1.0 - loss) * (1.0 - loss)).astype(jnp.float32)
-    return ((1.0 - loss) * (1.0 - loss.T)).astype(jnp.float32)
+    return ((1.0 - loss) * (1.0 - jnp.swapaxes(loss, -1, -2))).astype(
+        jnp.float32
+    )
 
 
 # ---------------------------------------------------------------------------

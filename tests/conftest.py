@@ -27,7 +27,26 @@ except ImportError:  # pure-Python protocol suites don't need jax
 else:
     jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# r15: the tier-1 suite is XLA-compile-dominated (the HEAD run sits within
+# ~4% of its own timeout budget), so wire the repo's persistent compile
+# cache (scalecube_cluster_tpu/compile_cache.py — the same feature the
+# bench/flagship runs use) at a repo-local, gitignored directory: a cold
+# run pays a few percent writing entries; every later run (CI retries, the
+# driver's verify pass, local iteration) skips recompiling unchanged
+# window programs entirely. Keyed on lowered HLO + compile options, so
+# code edits miss cleanly. SCALECUBE_COMPILE_CACHE_DIR overrides.
+try:
+    from scalecube_cluster_tpu import compile_cache as _cc
+
+    _cc.enable_persistent_compile_cache(
+        os.environ.get(_cc.ENV_VAR)
+        or os.path.join(_REPO, ".test_compile_cache")
+    )
+except Exception:  # cache is an accelerator, never a gate
+    pass
 
 
 def pytest_configure(config):

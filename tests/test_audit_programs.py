@@ -11,9 +11,11 @@ Two halves, mirroring tests/test_repo_lints.py's structure:
    variants and the full i16 column ride the ``-m slow`` lane and the
    ``tools/audit_programs.py --all`` artifact run (AUDIT_r12.json).
 
-2. **Falsifiability** — six seeded-violation programs, one per contract
-   class, each asserted CAUGHT with an actionable message naming the
-   source location:
+2. **Falsifiability** — seeded-violation programs, at least one per
+   contract class (r13 added the strategy-builder flavor, r15 the fleet
+   flavors: a vmapped fleet window dropping its donation and a fleet
+   memory-budget overflow against the per-scenario × S basis), each
+   asserted CAUGHT with an actionable message naming the source location:
 
    * missing alias (a window builder that forgot ``donate_argnums``),
    * post-donation read (donated input escaping unchanged),
@@ -138,6 +140,9 @@ def test_full_matrix_including_sharded_passes():
     names = {e["program"] for e in verdict["programs"]}
     assert {"dense/i32/sharded", "dense/i16/sharded",
             "sparse/i32/sharded"} <= names
+    # r15: the scenario-batched fleet windows ride the same matrix
+    assert {"dense/i32/fleet", "sparse/i32/fleet",
+            "pview/i32/fleet"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -365,6 +370,70 @@ def test_seeded_host_alias_restore_is_caught(tmp_path):
     assert "zero-copy" in v.message
     assert "restore" in v.message
     assert str(bad) in v.where and v.where.endswith(":6")
+
+
+def test_seeded_fleet_builder_dropping_donation_is_caught():
+    """Violation class 1, r15 flavor: a REAL scenario-batched fleet window
+    (the dense vmapped builder) built with donate=False but REGISTERED as
+    donated — the exact regression a fleet-seam refactor could introduce
+    (jit(vmap(...)) silently losing its donate_argnums). The auditor must
+    flag every dropped leaf of the stacked [S, ...] state, proving the
+    fleet windows sit behind the same gate as the serial programs."""
+    from scalecube_cluster_tpu.audit.programs import (
+        DEFAULT_FLEET_SCENARIOS, _abstract, _audit_params,
+    )
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine("dense")
+    params = _audit_params("dense", CAPACITY, "i32")
+    state = eng.init_state(params, CAPACITY - 4, True, True)
+    s = DEFAULT_FLEET_SCENARIOS
+    abs_fleet = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((s,) + x.shape, x.dtype),
+        _abstract(state),
+    )
+    keys_abs = jax.ShapeDtypeStruct((s, 2), jnp.uint32)
+    fn = eng.make_fleet_run(params, N_TICKS, False)  # <- dropped donation
+    prog = _program(
+        "seeded/fleet-dropped-donation", fn, (abs_fleet, keys_abs), (0,),
+        contracts=eng.contracts,
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the fleet builder's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)
+
+    # control: the registered donated fleet builder audits clean
+    good = _program(
+        "seeded/fleet-donated", eng.make_fleet_run(params, N_TICKS),
+        (abs_fleet, keys_abs), (0,), contracts=eng.contracts,
+    )
+    assert check_donation_alias(good) == []
+
+
+def test_seeded_fleet_budget_overflow_is_caught():
+    """Violation class 5, r15 flavor: a fleet window that keeps a second,
+    un-aliased copy of the WHOLE STACKED state alive past the budget
+    declared per-scenario × S — the fleet shape of the r12 overflow test
+    (factor 1.2 against an S×basis denominator; the duplicate [S, N, N]
+    plane must trip it)."""
+    S_FLEET = 4
+
+    def window(fleet_state, keys):
+        # aliased update PLUS a full un-aliased derived fleet plane output
+        return fleet_state.at[:, 0].add(1.0), fleet_state * 3.0
+
+    fn = jax.jit(window, donate_argnums=0)
+    leaf = jax.ShapeDtypeStruct((S_FLEET, CAPACITY, CAPACITY), jnp.float32)
+    keys = jax.ShapeDtypeStruct((S_FLEET, 2), jnp.uint32)
+    basis = S_FLEET * CAPACITY * CAPACITY * 4  # per-scenario state × S
+    tight = EngineContracts(memory_factor=1.2, memory_overhead_mib=1 / 16)
+    prog = _program(
+        "seeded/fleet-budget-overflow", fn, (leaf, keys), (0,),
+        contracts=tight, basis=basis,
+    )
+    violations = check_memory_budget(prog)
+    assert violations, "auditor missed the fleet budget overflow"
+    assert "exceeds the declared budget" in violations[0].message
 
 
 def test_unregistered_restore_module_is_flagged():

@@ -1,0 +1,457 @@
+"""The r15 fleet engine: scenario-batched vmap windows (ISSUE 12).
+
+Five gates:
+
+1. **Batched-vs-serial bit-identity** — fleet row ``s`` (same seed, same
+   start state) decodes BYTE-IDENTICAL to a serial single-cluster window
+   for all three engines at N=33, in both key layouts where the engine
+   registers them (dense i32+i16, pview i32+i16, sparse i32): every
+   state leaf, the advanced PRNG key, and the stacked metrics. This is
+   the contract that makes fleet statistics statements about the REAL
+   engines, not about a batched approximation.
+2. **Batched chaos fold** — the same compiled ``StateTimeline`` schedule
+   replays onto all S scenarios through the vmapped mutator surface
+   (crash cohorts, storm stash/floor/restore), and the on-device Monte
+   Carlo folds (false-DEAD sentinel, crash detection, first-coverage
+   latch) read the planes the serial sentinels read.
+3. **Monte Carlo service shape** — ``certify_spread_mc`` finishes every
+   seed, records the interval methods + sample size, and labels
+   sub-threshold runs "spot-check" (never "monte-carlo"); the legacy
+   serial records carry the same labeling (satellite: no silent mixing).
+4. **Audit** — the fleet variant of the r12 matrix audits clean for all
+   three engines (dense compiled; sparse/pview lowered-only here — the
+   compiled sweep rides ``tools/audit_programs.py --all``).
+5. **Transfer-freeness** — a fleet window loop performs ZERO
+   device→host transfers under the numpy-asarray spy (the r6 discipline,
+   S-wide: MC folds stay on device between windows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scalecube_cluster_tpu.ops import fleet as FL
+
+N = 33
+T = 8
+SEEDS = (0, 7)
+
+# Small-but-real protocol knobs: fanout and ping_req_k are PYTHON-unrolled
+# in the tick, so keeping them at 2/1 roughly halves the traced program —
+# tier-1 pays ~10 window compiles here and compile time is the whole cost.
+_KNOBS = dict(fanout=2, repeat_mult=3, ping_req_k=1, fd_every=2,
+              sync_every=8, suspicion_mult=3, rumor_slots=8, seed_rows=(0,))
+
+
+def _engine_case(engine: str, key_dtype: str):
+    if engine == "dense":
+        import scalecube_cluster_tpu.ops.state as S
+        from scalecube_cluster_tpu.ops.kernel import make_fleet_run, make_run
+
+        params = S.SimParams(
+            capacity=N, key_dtype=key_dtype, full_metrics=False, **_KNOBS
+        )
+        return (params, lambda: S.init_state(params, N, warm=True,
+                                             uniform_loss=0.15),
+                S, make_fleet_run, make_run, S.SimState)
+    if engine == "sparse":
+        import scalecube_cluster_tpu.ops.sparse as SP
+
+        params = SP.SparseParams(capacity=N, mr_slots=16, **_KNOBS)
+        return (params, lambda: SP.init_sparse_state(params, N, warm=True),
+                SP, SP.make_sparse_fleet_run, SP.make_sparse_run,
+                SP.SparseState)
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = PV.PviewParams(capacity=N, key_dtype=key_dtype, **_KNOBS)
+    return (params, lambda: PV.init_pview_state(params, N, warm=True),
+            PV, PV.make_pview_fleet_run, PV.make_pview_run, PV.PviewState)
+
+
+# ---------------------------------------------------------------------------
+# 1. batched-vs-serial bit-identity (the satellite's tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine,key_dtype", [
+    ("dense", "i32"), ("dense", "i16"),
+    ("sparse", "i32"),
+    ("pview", "i32"), ("pview", "i16"),
+])
+def test_fleet_row_bit_identical_to_serial_run(engine, key_dtype):
+    """Fleet row s == a serial window on the same (state, key): every
+    state leaf byte-equal, the advanced key equal, every stacked metric
+    row equal. N=33 deliberately straddles a word boundary (33 > 32) so
+    the packed planes' tail words are exercised."""
+    params, init, mod, make_fleet, make_serial, state_cls = _engine_case(
+        engine, key_dtype
+    )
+    st0 = init()
+    origins = [(s * 37 + 1) % N for s in SEEDS]
+    fs = FL.fleet_broadcast(st0, len(SEEDS))
+    fs = FL.fleet_inject_rumor(mod, fs, 0, origins)
+    keys = FL.fleet_keys(SEEDS)
+    fs2, keys2, fms, _w = make_fleet(params, T, False)(fs, keys)
+
+    serial = make_serial(params, T, donate=False)
+    for i, seed in enumerate(SEEDS):
+        st = mod.spread_rumor(st0, 0, origin=origins[i])
+        st, k, ms, _w2 = serial(st, jax.random.PRNGKey(seed))
+        row = FL.fleet_row(fs2, i)
+        for f in dataclasses.fields(state_cls):
+            a = np.asarray(getattr(row, f.name))
+            b = np.asarray(getattr(st, f.name))
+            assert np.array_equal(a, b), (
+                f"{engine}/{key_dtype} seed {seed}: state leaf {f.name} "
+                "diverged between fleet row and serial run"
+            )
+        assert np.array_equal(np.asarray(keys2[i]), np.asarray(k)), (
+            f"{engine}/{key_dtype} seed {seed}: PRNG chain diverged"
+        )
+        for name in ms:
+            assert np.array_equal(
+                np.asarray(fms[name][i]), np.asarray(ms[name])
+            ), f"{engine}/{key_dtype} seed {seed}: metric {name} diverged"
+
+
+def test_quiet_gates_off_is_bit_identical_serial_and_fleet():
+    """The fleet profile (SimParams.quiet_gates=False) traces the active
+    branches without the lax.cond gates — the trajectory must stay
+    byte-identical (every gated branch is a value-identical no-op when
+    its gate is closed), serially AND as a fleet row."""
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.ops.kernel import make_fleet_run, make_run
+
+    gated, init, mod, _mf, _ms, state_cls = _engine_case("dense", "i32")
+    ungated = dataclasses.replace(gated, quiet_gates=False)
+    st0 = mod.spread_rumor(init(), 0, origin=5)
+    key = jax.random.PRNGKey(3)
+    a, ka, ma, _ = make_run(gated, T, donate=False)(st0, key)
+    b, kb, mb, _ = make_run(ungated, T, donate=False)(st0, key)
+    for f in dataclasses.fields(state_cls):
+        assert np.array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+        ), f"quiet_gates=False diverged on {f.name}"
+    assert all(np.array_equal(np.asarray(ma[k]), np.asarray(mb[k])) for k in ma)
+
+    fs = FL.fleet_broadcast(st0, 2)
+    fs2, _k, _m, _w = make_fleet_run(ungated, T, False)(
+        fs, FL.fleet_keys([3, 3])
+    )
+    # PRNGKey(3) twice: both rows must equal the serial ungated run
+    for srow in range(2):
+        row = FL.fleet_row(fs2, srow)
+        for f in dataclasses.fields(state_cls):
+            assert np.array_equal(
+                np.asarray(getattr(row, f.name)),
+                np.asarray(getattr(b, f.name)),
+            )
+
+
+def test_sharded_fleet_rows_bit_identical_to_serial():
+    """The scenario-mesh mode (fleet_mesh + shard_fleet over the 8
+    virtual CPU devices): still one XLA program, still byte-identical
+    per row."""
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.ops.kernel import make_fleet_run, make_run
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual device mesh")
+    params, init, mod, _mf, _ms, state_cls = _engine_case("dense", "i32")
+    s = jax.device_count()
+    st0 = init()
+    origins = [(i * 37 + 1) % N for i in range(s)]
+    fs = FL.fleet_inject_rumor(mod, FL.fleet_broadcast(st0, s), 0, origins)
+    keys = FL.fleet_keys(range(s))
+    mesh = FL.fleet_mesh()
+    fs = FL.shard_fleet(fs, mesh)
+    keys = FL.shard_fleet(keys, mesh)
+    fs2, _k, _m, _w = make_fleet_run(params, T, False)(fs, keys)
+    serial = make_run(params, T, donate=False)
+    for i in (0, s - 1):
+        st = mod.spread_rumor(st0, 0, origin=origins[i])
+        st, _key, _ms2, _w2 = serial(st, jax.random.PRNGKey(i))
+        row = FL.fleet_row(fs2, i)
+        for f in dataclasses.fields(state_cls):
+            assert np.array_equal(
+                np.asarray(getattr(row, f.name)),
+                np.asarray(getattr(st, f.name)),
+            ), f"sharded fleet row {i} diverged on {f.name}"
+
+
+def test_shard_fleet_rejects_indivisible_s():
+    import scalecube_cluster_tpu.ops.state as S
+
+    if jax.device_count() < 2:
+        pytest.skip("needs the virtual device mesh")
+    params = S.SimParams(capacity=8, rumor_slots=4)
+    fs = FL.fleet_broadcast(S.init_state(params, 8, warm=True),
+                            jax.device_count() + 1)
+    with pytest.raises(ValueError, match="does not divide"):
+        FL.shard_fleet(fs, FL.fleet_mesh())
+
+
+def test_fleet_keys_match_scalar_prngkeys():
+    keys = np.asarray(FL.fleet_keys([0, 1, 12345]))
+    for i, s in enumerate((0, 1, 12345)):
+        assert np.array_equal(keys[i], np.asarray(jax.random.PRNGKey(s)))
+
+
+def test_fleet_adaptive_builder_refuses_default_spec():
+    import scalecube_cluster_tpu.ops.state as S
+
+    params = S.SimParams(capacity=8, rumor_slots=4)
+    with pytest.raises(ValueError, match="AdaptiveSpec"):
+        FL.make_fleet_adaptive_run(params, 2)
+
+
+# ---------------------------------------------------------------------------
+# 2. the batched StateTimeline fold + on-device MC folds
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_timeline_applies_schedule_to_every_scenario():
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.chaos import events as ev
+
+    n, s = 16, 3
+    params = S.SimParams(capacity=n, rumor_slots=4, seed_rows=(0,))
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    scen = ev.Scenario(
+        name="fold",
+        events=(
+            ev.Crash(rows=[3], at=2),
+            ev.LossStorm(pct=40.0, at=4, until=8),
+            ev.Partition(groups=((0, 1), tuple(range(2, n))), at=5,
+                         heal_at=9),
+        ),
+        horizon=12,
+    )
+    tl = FL.fleet_timeline(scen, S, dense_links=True, horizon=12)
+    fs, labels = tl.apply_due(fs, 4)
+    assert any("crash" in lab for lab in labels)
+    assert not np.asarray(fs.up[:, 3]).any(), "crash must hit every scenario"
+    # storm floor is live on every scenario's loss plane
+    assert np.allclose(np.asarray(fs.loss), 0.4)
+    fs, _ = tl.apply_due(fs, 5)  # partition blocks UNDER the storm
+    assert np.allclose(np.asarray(fs.loss[:, 0, 2]), 1.0)
+    fs, _ = tl.apply_due(fs, 9)  # storm ended at 8, heal at 9
+    assert np.allclose(np.asarray(fs.loss[:, 0, 2]), 0.0), (
+        "mid-storm partition must heal clean after the storm restore"
+    )
+    # fetch_rt stays the derived per-scenario round trip (batched transpose)
+    rt = np.asarray(fs.fetch_rt)
+    loss = np.asarray(fs.loss)
+    assert np.allclose(rt, (1 - loss) * (1 - np.swapaxes(loss, -1, -2)))
+
+
+def test_fleet_timeline_storm_on_scalar_loss_fleet():
+    """LossStorm stash/restore over a fleet of UNIFORM-loss states (the
+    lean dense_links=False mode): the stacked loss leaf is [S] — rank 1,
+    neither the 0-d scalar nor a plane — and the storm restore must
+    re-derive fetch_rt elementwise per scenario, not transpose it."""
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.chaos import events as ev
+
+    n, s = 12, 3
+    params = S.SimParams(capacity=n, rumor_slots=4)
+    st0 = S.init_state(params, n, warm=True, dense_links=False,
+                       uniform_loss=0.05)
+    fs = FL.fleet_broadcast(st0, s)
+    scen = ev.Scenario(
+        name="scalar-storm",
+        events=(ev.LossStorm(pct=40.0, at=2, until=6),
+                ev.Crash(rows=[3], at=4)),
+        horizon=8,
+    )
+    tl = FL.fleet_timeline(scen, S, dense_links=False, horizon=8)
+    fs, _ = tl.apply_due(fs, 4)
+    assert np.allclose(np.asarray(fs.loss), 0.4)  # floor over 0.05
+    fs, _ = tl.apply_due(fs, 6)  # storm restore on the [S] scalar leaf
+    assert np.asarray(fs.loss).shape == (s,)
+    assert np.allclose(np.asarray(fs.loss), 0.05)
+    assert np.allclose(np.asarray(fs.fetch_rt), 0.95 * 0.95)
+
+
+def test_fleet_mc_folds_read_the_sentinel_planes():
+    import scalecube_cluster_tpu.ops.state as S
+
+    n, s = 12, 2
+    params = S.SimParams(capacity=n, rumor_slots=4)
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    # scenario 1: observer 0 tombstones watched row 5 (DEAD = rank 3)
+    vk = np.asarray(fs.view_key).copy()
+    vk[1, 0, 5] = (vk[1, 0, 5] >> 2 << 2) | 3
+    fs = fs.replace(view_key=jnp.asarray(vk))
+    watch = jnp.asarray(np.arange(n) == 5)
+    fd = np.asarray(FL.fleet_false_dead(fs, watch))
+    assert fd.tolist() == [0, 1]
+    # crash detection: all observers tombstone row 7 in scenario 0 only
+    vk2 = np.asarray(fs.view_key).copy()
+    vk2[0, :, 7] = (vk2[0, :, 7] >> 2 << 2) | 3
+    fs = fs.replace(view_key=jnp.asarray(vk2), up=fs.up.at[:, 7].set(False))
+    det = np.asarray(FL.fleet_crash_detected(fs, 7))
+    assert det.tolist() == [True, False]
+
+
+def test_fold_first_full_coverage_latches_once():
+    hit = jnp.full((3,), -1, jnp.int32)
+    cov = jnp.asarray([
+        [0.5, 1.0, 1.0],   # hits at window tick 1 -> absolute 10 + 2
+        [0.2, 0.3, 0.4],   # never
+        [1.0, 1.0, 1.0],   # hits immediately -> 10 + 1
+    ])
+    hit = FL.fold_first_full_coverage(hit, cov, 10)
+    assert np.asarray(hit).tolist() == [12, -1, 11]
+    # a later window must NOT overwrite the latched ticks
+    hit = FL.fold_first_full_coverage(hit, jnp.ones((3, 3)), 13)
+    assert np.asarray(hit).tolist() == [12, 14, 11]
+
+
+# ---------------------------------------------------------------------------
+# 3. the Monte Carlo certification service
+# ---------------------------------------------------------------------------
+
+
+def test_certify_spread_mc_record_shape_and_spot_check_labeling():
+    from scalecube_cluster_tpu.dissemination import DissemSpec
+    from scalecube_cluster_tpu.dissemination.certify import (
+        MC_MIN_SAMPLES, certify_spread_mc,
+    )
+
+    rec = certify_spread_mc(
+        DissemSpec(strategy="push", topology="full"), n=16, n_seeds=16,
+        window=8,
+    )
+    assert rec["finished"] == 16
+    assert rec["sample_size"] == 16
+    # 16 seeds is NOT a Monte Carlo verdict — and can never certify (the
+    # Wilson lower bound cannot reach 0.99 below ~400 samples)
+    assert rec["verdict_kind"] == "spot-check"
+    assert rec["certified"] is False
+    assert "Wilson" in rec["interval_method"]
+    assert rec["mc_min_samples"] == MC_MIN_SAMPLES
+    assert len(rec["wilson"]) == 2 and rec["wilson"][0] <= rec["wilson"][1]
+    assert rec["median_ci"][0] <= rec["spread_ticks_median"] <= rec["median_ci"][1]
+    assert rec["p99_ci"][0] <= rec["spread_ticks_p99"] <= rec["p99_ci"][1]
+    assert sum(rec["spread_histogram"].values()) == 16
+
+
+def test_legacy_serial_verdicts_are_labeled_spot_check():
+    """Satellite: single/few-seed serial records can no longer silently
+    mix with MC verdicts — theory_bound carries the sample-size floor and
+    measure_spread stamps the verdict kind from it."""
+    from scalecube_cluster_tpu.dissemination import DissemSpec
+    from scalecube_cluster_tpu.dissemination.certify import (
+        MC_MIN_SAMPLES, certify_spread, measure_spread, theory_bound,
+    )
+
+    bound = theory_bound(DissemSpec(), 64, 3)
+    assert bound["mc_min_samples"] == MC_MIN_SAMPLES
+    rec = certify_spread(measure_spread(
+        DissemSpec(strategy="push", topology="full"), n=16, seeds=(0,),
+        window=8,
+    ))
+    assert rec["sample_size"] == 1
+    assert rec["verdict_kind"] == "spot-check"
+    assert rec["certified"] in (True, False)
+
+
+def test_wilson_and_quantile_interval_math():
+    from scalecube_cluster_tpu.dissemination.certify import (
+        quantile_ci, wilson_interval,
+    )
+
+    lo, hi = wilson_interval(1000, 1000)
+    assert 0.995 < lo < 1.0 and hi == 1.0
+    lo0, hi0 = wilson_interval(0, 1000)
+    assert lo0 <= 1e-12 and 0.0 < hi0 < 0.005
+    # the k=n lower bound crosses 0.99 only past ~380 samples — the
+    # arithmetic fact the MC sample-size floor rests on
+    assert wilson_interval(256, 256)[0] < 0.99 < wilson_interval(1000, 1000)[0]
+    xs = np.arange(1, 1001)
+    point, (qlo, qhi) = quantile_ci(xs, 0.99)
+    assert point == 990.0 and qlo < point < qhi
+    med, (mlo, mhi) = quantile_ci(xs, 0.5)
+    assert mlo <= med <= mhi
+    assert mhi - mlo < 70  # ±z·sqrt(n/4) ≈ ±31 ranks at n=1000
+
+
+# ---------------------------------------------------------------------------
+# 4. the fleet variant of the audit matrix
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_audit_variant_passes_all_contracts():
+    """All three engines' fleet windows audit clean on the traced/lowered
+    forms (the fast tier-1 mode); the compiled sweep — memory budgets and
+    the optimized alias map — rides ``tools/audit_programs.py --all``
+    (AUDIT_r12.json) and the ``-m slow`` full matrix."""
+    from scalecube_cluster_tpu.audit import run_contracts
+    from scalecube_cluster_tpu.audit.programs import build_engine_programs
+
+    for engine in ("dense", "sparse", "pview"):
+        (prog,) = build_engine_programs(engine, variants=["fleet"])
+        assert prog.variant == "fleet"
+        verdict = run_contracts(prog, compile_programs=False)
+        for contract, violations in verdict.items():
+            assert violations == [], (
+                f"{prog.name}: {contract}:\n"
+                + "\n".join(str(v) for v in violations)
+            )
+
+
+# ---------------------------------------------------------------------------
+# 5. transfer-freeness: the fleet loop under the numpy-asarray spy
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_window_loop_is_transfer_free(monkeypatch):
+    """Two fleet windows with the on-device coverage fold between them —
+    zero np.asarray transfers of device arrays until the final explicit
+    readback (the r6 proof, S-wide)."""
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.ops.kernel import make_fleet_run
+
+    n, s = 16, 4
+    params = S.SimParams(capacity=n, rumor_slots=4, seed_rows=(0,),
+                         full_metrics=False)
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    fs = FL.fleet_inject_rumor(S, fs, 0, [1, 2, 3, 4])
+    keys = FL.fleet_keys(range(s))
+    step = make_fleet_run(params, 4)
+    fold = jax.jit(FL.fold_first_full_coverage)
+    hit = jnp.full((s,), -1, jnp.int32)
+    # warm (compiles happen outside the spied span)
+    fs, keys, ms, _ = step(fs, keys)
+    hit = fold(hit, ms["rumor_coverage"][:, :, 0], 0)
+    jax.block_until_ready(hit)
+
+    counted = {"n": 0}
+    real = np.asarray
+
+    def spy(obj, *args, **kwargs):
+        if isinstance(obj, jax.Array):
+            counted["n"] += 1
+        return real(obj, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    for w in range(2):
+        fs, keys, ms, _ = step(fs, keys)
+        hit = fold(hit, ms["rumor_coverage"][:, :, 0], 4 * (w + 1))
+    jax.block_until_ready(hit)
+    assert counted["n"] == 0, (
+        f"fleet loop performed {counted['n']} device→host transfers"
+    )
+    monkeypatch.setattr(np, "asarray", real)
+    assert np.asarray(hit).shape == (s,)

@@ -44,7 +44,8 @@ if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ENGINES = ("dense", "sparse", "pview")
-VARIANTS = ("unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive")
+VARIANTS = ("unarmed", "traced", "telemetry", "sharded", "strategy",
+            "adaptive", "fleet")
 
 
 def main(argv=None) -> int:
