@@ -280,28 +280,44 @@ def _headline_rounds_pview():
     return convergence_ticks, ROUNDS * budget / dt
 
 
+def _delegate(script: str, value_flags, passthrough=(), default_out=None):
+    """Exec one benchmarks/ config as a bench.py subcommand: forward the
+    listed value flags from sys.argv (a trailing flag with no value is
+    dropped), append the listed passthrough switches, and default --out
+    to the standing artifact next to this file. Exits with the
+    delegate's return code — the ONE spelling behind --profile,
+    --strategy, --adaptive, --fleet, and --control."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(here, "benchmarks", script)]
+    for flag in value_flags:
+        if flag in sys.argv:
+            i = sys.argv.index(flag)
+            if i + 1 < len(sys.argv):
+                cmd += [flag, sys.argv[i + 1]]
+    if default_out and "--out" not in sys.argv:
+        # default: refresh the standing artifact
+        cmd += ["--out", os.path.join(here, default_out)]
+    for flag in passthrough:
+        if flag in sys.argv:
+            cmd.append(flag)
+    raise SystemExit(subprocess.call(cmd))
+
+
 def main() -> None:
     # r10: --profile records the trace-plane overhead headline + the
     # phase-split tick breakdown into TRACE_BENCH_r10.json (the config10
     # artifact shape) and prints its JSON line — the observability twin of
     # --plane-dtype/--scaling: same interleaved median-of-5 protocol.
     if "--profile" in sys.argv:
-        import os
-        import subprocess
-
-        here = os.path.dirname(os.path.abspath(__file__))
-        cmd = [
-            sys.executable,
-            os.path.join(here, "benchmarks", "config10_trace.py"),
-            "--out", os.path.join(here, "TRACE_BENCH_r10.json"),
-        ]
-        for flag in ("--n", "--windows", "--window-ticks", "--reps",
-                     "--profile-ticks"):
-            if flag in sys.argv:
-                i = sys.argv.index(flag)
-                if i + 1 < len(sys.argv):
-                    cmd += [flag, sys.argv[i + 1]]
-        raise SystemExit(subprocess.call(cmd))
+        _delegate(
+            "config10_trace.py",
+            ("--n", "--windows", "--window-ticks", "--reps",
+             "--profile-ticks", "--out"),
+            default_out="TRACE_BENCH_r10.json",
+        )
 
     # r13: --strategy/--topology run the dissemination certification
     # harness (benchmarks/config12_strategies.py — spread-time curves
@@ -310,49 +326,25 @@ def main() -> None:
     # (--strategy alone certifies it on the 'full' topology and vice
     # versa). Forwards --n/--engine/--out when present.
     if "--strategy" in sys.argv or "--topology" in sys.argv:
-        import os
-        import subprocess
-
-        here = os.path.dirname(os.path.abspath(__file__))
-        cmd = [
-            sys.executable,
-            os.path.join(here, "benchmarks", "config12_strategies.py"),
-        ]
-        for flag in ("--strategy", "--topology", "--n", "--engine", "--seeds",
-                     "--fanout", "--control-n", "--out"):
-            if flag in sys.argv:
-                i = sys.argv.index(flag)
-                if i + 1 < len(sys.argv):
-                    cmd += [flag, sys.argv[i + 1]]
-        if "--out" not in sys.argv:  # default: refresh the standing artifact
-            cmd += ["--out", os.path.join(here, "STRATEGY_BENCH_r13.json")]
-        if "--quick" in sys.argv:
-            cmd.append("--quick")
-        raise SystemExit(subprocess.call(cmd))
+        _delegate(
+            "config12_strategies.py",
+            ("--strategy", "--topology", "--n", "--engine", "--seeds",
+             "--fanout", "--control-n", "--out"),
+            passthrough=("--quick",),
+            default_out="STRATEGY_BENCH_r13.json",
+        )
 
     # r14: --adaptive runs the adaptive-FD false-positive certification
     # harness (benchmarks/config13_adaptive.py — adaptive-vs-static
     # false-DEAD curves under sweeping loss floors) through the same
     # backend-probe/retry path. Forwards --n/--seeds/--out when present.
     if "--adaptive" in sys.argv:
-        import os
-        import subprocess
-
-        here = os.path.dirname(os.path.abspath(__file__))
-        cmd = [
-            sys.executable,
-            os.path.join(here, "benchmarks", "config13_adaptive.py"),
-        ]
-        for flag in ("--n", "--seeds", "--loss-floors", "--out"):
-            if flag in sys.argv:
-                i = sys.argv.index(flag)
-                if i + 1 < len(sys.argv):
-                    cmd += [flag, sys.argv[i + 1]]
-        if "--out" not in sys.argv:  # default: refresh the standing artifact
-            cmd += ["--out", os.path.join(here, "ADAPTIVE_BENCH_r14.json")]
-        if "--quick" in sys.argv:
-            cmd.append("--quick")
-        raise SystemExit(subprocess.call(cmd))
+        _delegate(
+            "config13_adaptive.py",
+            ("--n", "--seeds", "--loss-floors", "--out"),
+            passthrough=("--quick",),
+            default_out="ADAPTIVE_BENCH_r14.json",
+        )
 
     # r15: --fleet runs the scenario-batched fleet benchmark
     # (benchmarks/config14_fleet.py — batched-vs-serial member-ticks/sec,
@@ -360,26 +352,25 @@ def main() -> None:
     # ladder) through the same backend-probe/retry path. Forwards
     # --seeds/--mc-n/--out when present.
     if "--fleet" in sys.argv:
-        import os
-        import subprocess
+        _delegate(
+            "config14_fleet.py",
+            ("--seeds", "--fp-seeds", "--mc-n", "--out"),
+            passthrough=("--quick", "--skip-ladder", "--skip-strategy-ab",
+                         "--skip-fp"),
+            default_out="FLEET_BENCH_r15.json",
+        )
 
-        here = os.path.dirname(os.path.abspath(__file__))
-        cmd = [
-            sys.executable,
-            os.path.join(here, "benchmarks", "config14_fleet.py"),
-        ]
-        for flag in ("--seeds", "--fp-seeds", "--mc-n", "--out"):
-            if flag in sys.argv:
-                i = sys.argv.index(flag)
-                if i + 1 < len(sys.argv):
-                    cmd += [flag, sys.argv[i + 1]]
-        if "--out" not in sys.argv:  # default: refresh the standing artifact
-            cmd += ["--out", os.path.join(here, "FLEET_BENCH_r15.json")]
-        for passthrough in ("--quick", "--skip-ladder", "--skip-strategy-ab",
-                            "--skip-fp"):
-            if passthrough in sys.argv:
-                cmd.append(passthrough)
-        raise SystemExit(subprocess.call(cmd))
+    # r16: --control runs the closed-loop controller certification
+    # (benchmarks/config15_control.py — controlled-vs-static Wilson
+    # separation over the shifting-chaos family, the adaptive-knob map,
+    # armed-idle overhead) through the same backend-probe/retry path.
+    if "--control" in sys.argv:
+        _delegate(
+            "config15_control.py",
+            ("--n", "--seeds", "--knob-seeds", "--out"),
+            passthrough=("--quick", "--skip-knob-map", "--skip-overhead"),
+            default_out="CONTROL_BENCH_r16.json",
+        )
 
     engine = "sparse"
     if "--engine" in sys.argv:

@@ -256,6 +256,45 @@ def collect_fleet_summary(root: pathlib.Path) -> dict:
         return {"present": True, "error": repr(exc)}
 
 
+def collect_control_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r16 controller artifact: per-cell
+    Wilson separation of the controlled arm over the best static rung,
+    the falsifiability verdicts, and the knob-map recommendations."""
+    path = root / "CONTROL_BENCH_r16.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        cert = rec.get("certification") or {}
+        knob = rec.get("adaptive_knob_map") or {}
+        return {
+            "present": True,
+            "certified": rec.get("certified"),
+            "n_seeds": cert.get("n_seeds"),
+            "cells": {
+                e["cell"]: {
+                    "certified": e.get("certified"),
+                    "controlled_wilson": e.get("controlled_wilson"),
+                    "best_static_wilson_hi": e.get("best_static_wilson_hi"),
+                    "separation": e.get("separation"),
+                    "blind_fails": e.get("blind_fails_certification"),
+                    "unclamped_fails": e.get(
+                        "unclamped_fails_certification"
+                    ),
+                }
+                for e in cert.get("entries", [])
+            },
+            "knob_map_recommended": knob.get("recommended"),
+            "armed_idle_overhead_pct": (
+                rec.get("armed_idle_overhead") or {}
+            ).get("overhead_pct"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def collect_trajectory(root: pathlib.Path) -> list:
     """Fold every per-round dense-bench artifact present on disk into one
     dense-N=4096 ticks/s trajectory (the number each round's acceptance
@@ -396,6 +435,12 @@ def main() -> None:
     # artifact run: bench.py --fleet)
     results += run([py, "benchmarks/config14_fleet.py", "--quick",
                     "--out", "FLEET_BENCH_r15.json"], timeout=3000)
+    # r16 closed-loop controller: controlled-vs-static Wilson separation
+    # over the shifting-chaos family + both falsifiability arms (the full
+    # 512-seed matrix + knob map belong to the dedicated artifact run:
+    # bench.py --control)
+    results += run([py, "benchmarks/config15_control.py", "--quick",
+                    "--out", "CONTROL_BENCH_r16.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -429,6 +474,9 @@ def main() -> None:
         # r15: fleet-engine gate + Monte Carlo certification intervals
         # (full artifact in FLEET_BENCH_r15.json, refreshed by config14)
         "fleet_bench": collect_fleet_summary(ROOT),
+        # r16: closed-loop controller certification + knob map (full
+        # artifact in CONTROL_BENCH_r16.json, refreshed by config15)
+        "control_bench": collect_control_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

@@ -1,0 +1,218 @@
+"""Driver config #15: the closed-loop control plane — controller certification.
+
+The r16 acceptance gates (ISSUE 13):
+
+1. **Controller MC certification** (``control.certify_controller_mc``):
+   over every shifting-conditions cell (``chaos.shifting``: LossStorm
+   arriving mid-run, WAN zone degrading, asymmetric loss migrating
+   between regions), >= 512 seeds per cell in scenario-batched fleet
+   windows with per-scenario crash rows AND storm floors varied (the r16
+   ``FleetVary`` condition grid), the CONTROLLED system must meet the
+   joint SLO (clean-phase detection deadline, per-phase spread deadlines,
+   zero false-DEAD of the degraded-but-alive watch cohort, mean gossip
+   cost inside the budget) better than EVERY static rung of its own
+   ladder with non-overlapping Wilson 95% intervals — and record zero
+   false-DEAD. Seeded falsifiability: the telemetry-blind controller and
+   the unclamped proportional controller must both FAIL the same
+   certification.
+2. **The offline adaptive-knob map** (``adaptive_knob_sweep``): fp_rate_mc
+   over the (min_mult x conf_target x loss-floor) grid, loss floors
+   varied PER SCENARIO inside one compiled fleet per knob pair — the map
+   the controller ladder's defaults are seeded from.
+3. **Armed-idle overhead**: a control-armed driver in clean conditions
+   (controller holds, zero actuations) must tick within noise of an
+   unarmed one — the pure-host-policy claim, measured.
+
+    python benchmarks/config15_control.py [--quick] [--seeds 512]
+        [--out CONTROL_BENCH_r16.json]
+
+One JSON line on stdout (collect_results harvests it); ``--out`` writes
+the full artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+from common import emit, log
+
+#: per-scenario storm-floor grid of the certification cells (percent) —
+#: the controller must track whichever condition its fleet row draws.
+#: FleetVary caveat (documented on the class): the varied floor applies
+#: to the storm-START write; a link event CLEARING mid-storm (the
+#: families' asym/flaky ends) re-asserts the SCHEDULED floor — 20%, the
+#: grid's minimum — on those links for the remaining storm ticks (≤8 in
+#: loss_storm/wan_zone; cohort-A's tail in migrating). All scenarios
+#: therefore hold a floor ≥ the grid minimum everywhere; the 24/28 rows
+#: run their full floor on every non-cleared link. Recorded as
+#: ``storm_grid_caveat`` in the artifact.
+STORM_GRID = (20.0, 24.0, 28.0)
+STORM_GRID_CAVEAT = (
+    "varied floors apply to the storm-start write; mid-storm link-event "
+    "clears re-assert the scheduled 20% floor (the grid minimum) on "
+    "those links for the remaining storm ticks"
+)
+
+
+def run_certification(n: int, n_seeds: int, cells=None) -> dict:
+    from scalecube_cluster_tpu.chaos import shifting as sh
+    from scalecube_cluster_tpu.control import certify_controller_mc
+
+    builders = cells if cells is not None else sh.SHIFTING_FAMILY
+    return certify_controller_mc(
+        cells=[b(n=n) for b in builders],
+        n=n, n_seeds=n_seeds, window=8,
+        vary_storm_pct=STORM_GRID,
+        log=log,
+    )
+
+
+def run_knob_map(n: int, seeds_per_floor: int, quick: bool) -> dict:
+    from scalecube_cluster_tpu.dissemination.certify import adaptive_knob_sweep
+
+    return adaptive_knob_sweep(
+        min_mults=(3, 5) if quick else (3, 5, 8),
+        conf_targets=(4,) if quick else (2, 4),
+        loss_floors=(0.0, 0.10, 0.20),
+        n=n, n_seeds_per_floor=seeds_per_floor, log=log,
+    )
+
+
+def run_overhead(n: int = 256, windows: int = 30, reps: int = 5) -> dict:
+    """Armed-idle vs unarmed driver ticks/s (interleaved median-of-reps):
+    the controller holds in clean conditions, so its cost is one ring
+    read per epoch — within noise is the pure-host-policy proof."""
+    import jax
+
+    from scalecube_cluster_tpu.control import ControlSpec
+    from scalecube_cluster_tpu.ops.state import SimParams
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    def build(arm: bool):
+        params = SimParams(capacity=n, rumor_slots=8, seed_rows=(0,),
+                           full_metrics=False)
+        d = SimDriver(params, n, seed=3)
+        if arm:
+            d.arm_control(spec=ControlSpec(epoch_windows=4))
+        d.step(8)  # compile + warm
+        d.sync()
+        return d
+
+    drivers = {"unarmed": build(False), "armed_idle": build(True)}
+    samples = {k: [] for k in drivers}
+    for _rep in range(reps):
+        for name, d in drivers.items():
+            t0 = time.perf_counter()
+            for _ in range(windows):
+                d.step(8)
+            jax.block_until_ready(d.state)
+            dt = time.perf_counter() - t0
+            samples[name].append(windows * 8 / dt)
+    out = {
+        name: round(statistics.median(v), 2) for name, v in samples.items()
+    }
+    out["overhead_pct"] = round(
+        100.0 * (1 - out["armed_idle"] / out["unarmed"]), 2
+    )
+    out["armed_actuations"] = drivers["armed_idle"].control.state.actuations
+    out["n"] = n
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=48)
+    ap.add_argument("--seeds", type=int, default=512,
+                    help="MC seeds per certification cell")
+    ap.add_argument("--knob-seeds", type=int, default=171,
+                    help="knob-map seeds per loss floor")
+    ap.add_argument("--quick", action="store_true",
+                    help="1 cell x 64 seeds, small knob grid, no overhead")
+    ap.add_argument("--skip-knob-map", action="store_true")
+    ap.add_argument("--skip-overhead", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from bench import emit_failure, probe_backend
+
+    ok, attempts = probe_backend()
+    if not ok:
+        emit_failure("backend_probe", 1, attempts, "config15 probe failed")
+        raise SystemExit(1)
+
+    n_seeds = 64 if args.quick else args.seeds
+    knob_seeds = 24 if args.quick else args.knob_seeds
+    cells = None
+    if args.quick:
+        from scalecube_cluster_tpu.chaos import shifting as sh
+
+        cells = (sh.loss_storm_midrun,)
+
+    t0 = time.perf_counter()
+    cert = run_certification(args.n, n_seeds, cells=cells)
+    knob_map = None
+    if not args.skip_knob_map:
+        knob_map = run_knob_map(args.n, knob_seeds, args.quick)
+        for floor, rec in knob_map["recommended"].items():
+            log(f"knob map @ {floor}% floor -> "
+                f"{rec and {k: rec[k] for k in ('min_mult', 'conf_target')}}")
+    overhead = None
+    if not (args.quick or args.skip_overhead):
+        overhead = run_overhead()
+        log(f"armed-idle overhead: {overhead['overhead_pct']}% "
+            f"({overhead['armed_idle']} vs {overhead['unarmed']} ticks/s)")
+
+    certified = cert["ok"]
+    import jax
+
+    record = {
+        "config": "config15_control",
+        "n": args.n,
+        "n_seeds": n_seeds,
+        "storm_grid_pct": list(STORM_GRID),
+        "storm_grid_caveat": STORM_GRID_CAVEAT,
+        "certification": cert,
+        "adaptive_knob_map": knob_map,
+        "armed_idle_overhead": overhead,
+        "certified": certified,
+        "backend": jax.default_backend(),
+        "wall_seconds": round(time.perf_counter() - t0, 1),
+    }
+
+    if args.out:
+        out = _p.Path(args.out)
+        with open(out, "w") as f:
+            json.dump({"config": "config15_control", "result": record}, f,
+                      indent=1)
+        log(f"wrote {out}")
+
+    emit({
+        "metric": "controller_certified",
+        "value": int(certified),
+        "unit": "bool",
+        "n_cells": cert["n_cells"],
+        "n_certified": cert["n_certified"],
+        "n_seeds": n_seeds,
+        "separations": [e["separation"] for e in cert["entries"]],
+        "falsifiability_ok": all(
+            e["blind_fails_certification"]
+            and e["unclamped_fails_certification"]
+            for e in cert["entries"]
+        ),
+        "backend": record["backend"],
+        "wall_seconds": record["wall_seconds"],
+    })
+    if not certified:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

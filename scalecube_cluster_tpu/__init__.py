@@ -18,6 +18,7 @@ from .compile_cache import (
 from .config import (
     ChaosConfig,
     ClusterConfig,
+    ControlConfig,
     DisseminationConfig,
     FailureDetectorConfig,
     GossipConfig,
@@ -36,6 +37,7 @@ from .version import __version__
 __all__ = [
     "ChaosConfig",
     "ClusterConfig",
+    "ControlConfig",
     "DisseminationConfig",
     "FailureDetectorConfig",
     "GossipConfig",

@@ -274,7 +274,7 @@ def build_engine_programs(
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
         "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
-        "fleet",
+        "fleet", "control",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -381,20 +381,9 @@ def build_engine_programs(
                 f"{engine_name}/{kd}/fleet", capacity,
                 {"fleet_scenarios": s_fleet},
             )
-            abs_fleet = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(
-                    (s_fleet,) + x.shape, x.dtype
-                ),
-                abs_state,
-            )
-            keys_abs = jax.ShapeDtypeStruct(
-                (s_fleet,) + key_abs.shape, key_abs.dtype
-            )
-            fleet_contracts = contracts
-            if contracts.fleet_memory_factor is not None:
-                fleet_contracts = dataclasses.replace(
-                    contracts, memory_factor=contracts.fleet_memory_factor
-                )
+            abs_fleet = _fleet_abstracts(abs_state, s_fleet)
+            keys_abs = _fleet_abstracts(key_abs, s_fleet)
+            fleet_contracts = _fleet_contracts(contracts)
             # audit the SHIPPED fleet program: every production fleet
             # consumer (the MC certification service, config14) runs the
             # quiet_gates=False fleet profile where the engine exposes it
@@ -417,12 +406,96 @@ def build_engine_programs(
                 wide_threshold=capacity,
             ))
 
+        if (
+            kd == dtypes[0] and "control" in want and engine_name == "dense"
+            and eng.make_fleet_run
+        ):
+            # r16: the CONTROLLER-EPOCH windows — the exact fleet programs
+            # the closed-loop certification harness swaps between as the
+            # controller walks its ladder (control.DEFAULT_LADDER: static
+            # clean rung + adaptive degraded/storm rungs, each a distinct
+            # static params tuple). Every rung's program must satisfy the
+            # same contracts as any production fleet window: a controller
+            # actuation that lands on an un-audited program would be a
+            # hot-swap into unproven territory.
+            programs.extend(_control_programs(
+                eng, engine_name, kd, capacity, n_ticks, contracts
+            ))
+
         if "sharded" in want and eng.supports_mesh and eng.state_shardings:
             programs.append(_sharded_program(
                 eng, engine_name, kd, sharded_capacity, n_ticks, contracts
             ))
 
     return programs
+
+
+def _fleet_abstracts(abs_tree, s_fleet: int):
+    """[S, ...]-stacked abstract twin of one scenario's abstract pytree —
+    the ONE spelling of the fleet batching rule shared by the r15 fleet
+    variant and the r16 control variant."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((s_fleet,) + x.shape, x.dtype),
+        abs_tree,
+    )
+
+
+def _fleet_contracts(contracts):
+    """Fleet variants prove the memory budget PER SCENARIO × S: swap in
+    the engine's declared ``fleet_memory_factor`` when present."""
+    if contracts.fleet_memory_factor is None:
+        return contracts
+    return dataclasses.replace(
+        contracts, memory_factor=contracts.fleet_memory_factor
+    )
+
+
+def _control_programs(
+    eng, engine_name, kd, capacity, n_ticks, contracts
+) -> List[AuditProgram]:
+    from ..adaptive import init_adaptive_state
+    from ..control import ControlSpec, _fleet_params
+
+    spec = ControlSpec()
+    s_fleet = DEFAULT_FLEET_SCENARIOS
+    fleet_contracts = _fleet_contracts(contracts)
+    keys_abs = _fleet_abstracts(_key_abstract(), s_fleet)
+    out: List[AuditProgram] = []
+    for rung in spec.ladder:
+        rp = _fleet_params(capacity, rung, spec)
+        _assert_audit_shape(
+            f"{engine_name}/{kd}/control-{rung.name}", capacity,
+            {"rumor_slots": rp.rumor_slots, "fleet_scenarios": s_fleet},
+        )
+        n_initial = max(2, (capacity * 3) // 4)
+        state = eng.init_state(rp, n_initial, True, eng.dense_links_default)
+        abs_state = _abstract(state)
+        abs_fleet = _fleet_abstracts(abs_state, s_fleet)
+        basis = s_fleet * _tree_bytes(abs_state)
+        if rung.adaptive:
+            abs_ad = _fleet_abstracts(
+                _abstract(init_adaptive_state(capacity)), s_fleet
+            )
+            fn = eng.make_fleet_adaptive_run(rp, n_ticks)
+            args = (abs_fleet, abs_ad, keys_abs)
+            donated = (0, 1)
+            basis += _tree_bytes(abs_ad)
+        else:
+            fn = eng.make_fleet_run(rp, n_ticks)
+            args = (abs_fleet, keys_abs)
+            donated = (0,)
+        out.append(AuditProgram(
+            name=f"{engine_name}/{kd}/control-{rung.name}",
+            engine=engine_name, variant="control", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=fn,
+            abstract_args=args,
+            donated_argnums=donated,
+            contracts=fleet_contracts,
+            budget_basis_bytes=basis,
+            wide_threshold=capacity,
+        ))
+    return out
 
 
 def _telemetry_programs(

@@ -46,6 +46,13 @@ from .sentinels import (
     init_sentinel_state,
     sentinel_report,
 )
+from .shifting import (
+    SHIFTING_FAMILY,
+    ShiftingScenario,
+    loss_storm_midrun,
+    migrating_asym_loss,
+    wan_zone_degrade,
+)
 
 
 def spread_certifier(*args, **kwargs):
@@ -80,4 +87,9 @@ __all__ = [
     "init_sentinel_state",
     "sentinel_report",
     "spread_certifier",
+    "ShiftingScenario",
+    "SHIFTING_FAMILY",
+    "loss_storm_midrun",
+    "wan_zone_degrade",
+    "migrating_asym_loss",
 ]

@@ -230,6 +230,31 @@ class AdaptiveConfig:
 
 
 @dataclass(frozen=True)
+class ControlConfig:
+    """Closed-loop control-plane knobs (r16; no reference analogue — the
+    telemetry-driven knob-steering controller, see ``control.py`` /
+    docs/CONTROL.md).
+
+    The loop constants only: ``epoch_windows`` windows per control epoch
+    (sensor reads + decisions run at epoch cadence), the asymmetric
+    anti-flap dwell (``dwell_up`` epochs to raise protection,
+    ``dwell_down`` to relax it), the per-epoch actuation clamp
+    (``max_step`` ladder rungs), and the downward ``hysteresis`` margin.
+    The rung LADDER itself is code (``control.DEFAULT_LADDER``, seeded
+    from the offline adaptive-knob map) — pass a custom
+    ``control.ControlSpec`` to ``SimDriver.arm_control`` to change it."""
+
+    epoch_windows: int = 4
+    dwell_up: int = 2
+    dwell_down: int = 4
+    max_step: int = 1
+    hysteresis: float = 0.6
+
+    def replace(self, **kw) -> "ControlConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Chaos scenario-engine knobs (new; no reference analogue — the sim's
     fault-injection + invariant-sentinel subsystem, see ``chaos/``).
@@ -323,6 +348,7 @@ class ClusterConfig:
     sim: SimConfig = field(default_factory=SimConfig)
     dissemination: DisseminationConfig = field(default_factory=DisseminationConfig)
     adaptive: AdaptiveConfig = field(default_factory=AdaptiveConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
@@ -384,6 +410,9 @@ class ClusterConfig:
     def with_adaptive(self, op: Lens) -> "ClusterConfig":
         return replace(self, adaptive=op(self.adaptive))
 
+    def with_control(self, op: Lens) -> "ClusterConfig":
+        return replace(self, control=op(self.control))
+
     def with_chaos(self, op: Lens) -> "ClusterConfig":
         return replace(self, chaos=op(self.chaos))
 
@@ -437,6 +466,10 @@ class ClusterConfig:
         from .adaptive import AdaptiveSpec
 
         AdaptiveSpec.from_config(self)
+        # the control spec dataclass owns the loop-constant validation
+        from .control import ControlSpec
+
+        ControlSpec.from_config(self)
         if self.chaos.check_interval_ticks <= 0:
             raise ValueError("chaos.check_interval_ticks must be > 0")
         if not (0.0 <= self.chaos.loss_storm_immunity_pct <= 100.0):

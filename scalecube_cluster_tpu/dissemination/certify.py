@@ -207,6 +207,32 @@ def _dense_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
     return params, base, S
 
 
+def _sparse_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
+    """(params, base_state_fn, ops_module) for one SPARSE certification
+    cell (r16, ROADMAP 3a): the record-queue engine enters the MC matrix
+    so the statistical load stops resting on the dense engine alone. Same
+    protocol knobs as the dense cell; the lean scalar-loss layout (the
+    spread measurement runs loss-free anyway)."""
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    if spec.topology == "geo" and spec.geo_wan_delay_ticks > 0:
+        raise ValueError(
+            "the lean sparse layout has no per-link delay plane — certify "
+            "geo WAN delay on the dense engine"
+        )
+    params = SP.SparseParams(
+        capacity=n, fanout=fanout, repeat_mult=3, ping_req_k=2, fd_every=5,
+        sync_every=64, suspicion_mult=5, rumor_slots=rumor_slots,
+        mr_slots=max(64, n * 4), announce_slots=max(32, n // 2),
+        seed_rows=(0,), dissem=spec,
+    )
+
+    def base():
+        return SP.init_sparse_state(params, n, warm=True)
+
+    return params, base, SP
+
+
 def _pview_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
     import scalecube_cluster_tpu.ops.pview as PV
 
@@ -227,7 +253,11 @@ def _pview_setup(spec: DissemSpec, n: int, fanout: int, rumor_slots: int):
     return params, base, PV
 
 
-_SETUPS = {"dense": _dense_setup, "pview": _pview_setup}
+_SETUPS = {
+    "dense": _dense_setup,
+    "pview": _pview_setup,
+    "sparse": _sparse_setup,
+}
 
 
 def _dense_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
@@ -264,7 +294,27 @@ def _pview_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
     return params, step, fresh, inject, jax
 
 
-_RUNNERS = {"dense": _dense_runner, "pview": _pview_runner}
+def _sparse_runner(spec: DissemSpec, n: int, fanout: int, rumor_slots: int,
+                   window: int):
+    import jax
+
+    params, base, SP = _sparse_setup(spec, n, fanout, rumor_slots)
+    step = SP.make_sparse_run(params, window)
+
+    def fresh(origin: int):
+        return SP.spread_rumor(base(), 0, origin=origin)
+
+    def inject(st, slot: int, origin: int):
+        return SP.spread_rumor(st, slot, origin=origin)
+
+    return params, step, fresh, inject, jax
+
+
+_RUNNERS = {
+    "dense": _dense_runner,
+    "pview": _pview_runner,
+    "sparse": _sparse_runner,
+}
 
 
 def measure_spread(
@@ -709,10 +759,10 @@ def certify_spread_mc(
     }
 
 
-#: default MC matrix: >= 6 (strategy x topology) cells, the r15
-#: acceptance floor — the dense engine carries the statistical load (the
-#: pview fleet is proven by the bit-identity tests + audit variant and a
-#: pview cell can be requested explicitly)
+#: default MC matrix: >= 6 (strategy x topology) cells. r16 (ROADMAP 3a):
+#: the PVIEW and SPARSE engines now run their own MC cells — the
+#: statistical load no longer rests on the dense engine alone (r15 proved
+#: their fleets bit-identical + audited but ran no MC matrix over them)
 DEFAULT_MC_MATRIX = (
     ("push", "full", "dense"),
     ("push", "expander", "dense"),
@@ -722,6 +772,10 @@ DEFAULT_MC_MATRIX = (
     ("accelerated", "ring", "dense"),
     ("tuneable", "expander", "dense"),
     ("pipelined", "expander", "dense"),
+    ("push", "expander", "pview"),
+    ("accelerated", "expander", "pview"),
+    ("push", "full", "sparse"),
+    ("push", "expander", "sparse"),
 )
 
 
@@ -805,7 +859,7 @@ FP_MC_COHORT = dict(asym_rows=(5, 6, 7), flaky_rows=(9,), crash_row=20)
 def fp_rate_mc(
     n: int = 48,
     n_seeds: int = 512,
-    loss_floor: float = 0.10,
+    loss_floor=0.10,
     adaptive: bool = False,
     window: int = 16,
     until: int = 200,
@@ -826,7 +880,15 @@ def fp_rate_mc(
     Reports the Wilson interval on P(any false-DEAD) — the number the
     adaptive arm must pin to ~0 while the static control's interval sits
     visibly above it — plus crash-detection latency quantiles against the
-    static detection budget."""
+    static detection budget.
+
+    ``loss_floor`` (r16, ROADMAP 3d): a scalar runs every scenario at one
+    ambient floor as before; an ARRAY of floors splits the fleet across a
+    condition grid in the SAME compiled program — scenario ``s`` runs at
+    ``loss_floor[s % len(loss_floor)]`` (tiled), and the record gains a
+    ``per_floor`` breakdown (per-floor false-DEAD Wilson intervals +
+    detection maxima). This is the loss axis of the adaptive-knob sweep
+    (:func:`adaptive_knob_sweep`)."""
     import jax
     import jax.numpy as jnp
 
@@ -861,10 +923,18 @@ def fp_rate_mc(
         ),
         horizon=horizon,
     )
+    # an ARRAY input (any length, even 1) means "grid mode": the record
+    # carries the per_floor breakdown the knob sweep indexes into; a
+    # scalar keeps the r15 record shape
+    floor_is_grid = np.ndim(loss_floor) > 0
+    floor_grid = np.atleast_1d(np.asarray(loss_floor, np.float32))
+    floors_s = floor_grid[np.arange(n_seeds) % floor_grid.size]
     st0 = S.init_state(params, n, warm=True)
-    if loss_floor > 0:
-        st0 = S.set_uniform_loss(st0, loss_floor, floor=True)
     fs = FL.fleet_broadcast(st0, n_seeds)
+    if floor_grid.max() > 0:
+        # per-scenario ambient floors (one floor when scalar) — the r16
+        # varied-condition seam, one vmapped write before the first window
+        fs = FL.fleet_uniform_loss(S, fs, floors_s)
     keys = FL.fleet_keys(base_seed + np.arange(n_seeds))
     ad = (
         FL.fleet_broadcast(init_adaptive_state(n), n_seeds)
@@ -919,6 +989,25 @@ def fp_rate_mc(
         int((det_np >= 0).sum()) == n_seeds
         and int(det_np.max()) <= deadline
     )
+    per_floor = None
+    if floor_is_grid:
+        per_floor = []
+        for f in floor_grid:
+            m = floors_s == f
+            kf, nf = int((fp_np[m] > 0).sum()), int(m.sum())
+            wf = wilson_interval(kf, nf, conf)
+            df = det_np[m]
+            per_floor.append({
+                "loss_floor_pct": round(float(f) * 100, 2),
+                "n_seeds": nf,
+                "false_dead_scenarios": kf,
+                "fp_rate": round(kf / max(nf, 1), 6),
+                "fp_rate_wilson": [round(wf[0], 6), round(wf[1], 6)],
+                "crash_detected": int((df >= 0).sum()),
+                "crash_detect_max": (
+                    int(df.max()) if (df >= 0).any() else None
+                ),
+            })
     return {
         "arm": "adaptive" if adaptive else "static",
         "n": n,
@@ -927,7 +1016,11 @@ def fp_rate_mc(
         "verdict_kind": (
             "monte-carlo" if n_seeds >= MC_MIN_SAMPLES else "spot-check"
         ),
-        "loss_floor_pct": round(loss_floor * 100),
+        "loss_floor_pct": (
+            [round(float(f) * 100, 2) for f in floor_grid] if floor_is_grid
+            else round(float(floor_grid[0]) * 100, 2)
+        ),
+        "per_floor": per_floor,
         "scenario": scen.name,
         "fp_watch_rows": list(watch_rows),
         "false_dead_scenarios": k_fp,
@@ -942,4 +1035,87 @@ def fp_rate_mc(
         "detections_ok": bool(det_ok),
         "static_suspicion_mult": static_suspicion_mult,
         "adaptive_knobs": knobs if adaptive else None,
+    }
+
+
+def adaptive_knob_sweep(
+    min_mults: Sequence[int] = (3, 5, 8),
+    conf_targets: Sequence[int] = (2, 4),
+    loss_floors: Sequence[float] = (0.0, 0.10, 0.20),
+    n: int = 48,
+    n_seeds_per_floor: int = 171,
+    window: int = 16,
+    horizon: int = 240,
+    base_seed: int = 0,
+    fp_budget: float = 0.03,
+    conf: float = 0.95,
+    log=None,
+) -> dict:
+    """The offline adaptive-knob map (r16, ROADMAP 3b): ``fp_rate_mc``
+    over a (min_mult × conf_target × loss-floor) grid.
+
+    Knobs are STATIC program properties, so each (min_mult, conf_target)
+    pair compiles its own fleet program; the LOSS axis rides the r16
+    per-scenario floor variation — one fleet per knob pair sweeps every
+    floor in the same compiled window (``n_seeds_per_floor`` scenarios
+    per floor). ``max_mult`` tracks ``2 * min_mult`` (the r14 shipped
+    ratio).
+
+    The output is the map the closed-loop controller's ladder defaults
+    are seeded from (``control.DEFAULT_LADDER``): per floor, the
+    ``recommended`` entry is the FASTEST knob (lowest ``min_mult``,
+    i.e. lowest time-to-DEAD) whose false-DEAD Wilson upper bound stays
+    within ``fp_budget`` at that floor — the exact trade the controller
+    makes on-line when the observed loss condition shifts."""
+    floors = [float(f) for f in loss_floors]
+    n_seeds = n_seeds_per_floor * len(floors)
+    cells = []
+    for mm in min_mults:
+        for ct in conf_targets:
+            knobs = dict(min_mult=int(mm), max_mult=int(2 * mm),
+                         conf_target=int(ct), lh_max=8)
+            rec = fp_rate_mc(
+                n=n, n_seeds=n_seeds, loss_floor=np.asarray(floors),
+                adaptive=True, window=window, horizon=horizon,
+                base_seed=base_seed, adaptive_knobs=knobs, conf=conf,
+            )
+            cells.append(rec)
+            if log:
+                log(
+                    f"knob map min_mult={mm} conf_target={ct}: fp/floor "
+                    + " ".join(
+                        f"{p['loss_floor_pct']}%:{p['fp_rate']:.3f}"
+                        for p in rec["per_floor"]
+                    )
+                    + f" detect_max={rec['crash_detect_max']}"
+                )
+    recommended = {}
+    for i, f in enumerate(floors):
+        best = None
+        for rec in cells:
+            p = rec["per_floor"][i]
+            if p["fp_rate_wilson"][1] <= fp_budget:
+                k = rec["adaptive_knobs"]
+                if best is None or k["min_mult"] < best["min_mult"]:
+                    best = dict(
+                        k, fp_rate=p["fp_rate"],
+                        fp_rate_wilson=p["fp_rate_wilson"],
+                        crash_detect_max=p["crash_detect_max"],
+                    )
+        recommended[str(round(f * 100, 2))] = best
+    return {
+        "n": n,
+        "n_seeds_per_floor": n_seeds_per_floor,
+        "min_mults": [int(m) for m in min_mults],
+        "conf_targets": [int(c) for c in conf_targets],
+        "loss_floor_pcts": [round(f * 100, 2) for f in floors],
+        "fp_budget": fp_budget,
+        "sample_size": n_seeds,
+        "verdict_kind": (
+            "monte-carlo" if n_seeds >= MC_MIN_SAMPLES else "spot-check"
+        ),
+        "cells": cells,
+        #: per loss-floor pct: the fastest knob within the fp budget —
+        #: what seeds control.DEFAULT_LADDER
+        "recommended": recommended,
     }

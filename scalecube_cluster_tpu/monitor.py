@@ -122,6 +122,8 @@ class MonitorServer:
         self._health: Optional[Callable[[], Dict[str, Any]]] = None
         self._dispatch: Optional[Callable[[], Dict[str, Any]]] = None
         self._chaos: Optional[Callable[[], Dict[str, Any]]] = None
+        # r16 closed-loop controller snapshot provider for /control
+        self._control: Optional[Callable[[], Dict[str, Any]]] = None
         # OpenMetrics family providers, concatenated at /metrics scrape
         # time (r8 telemetry plane); each returns a list of family dicts
         self._metric_providers: List[Callable[[], List[Dict[str, Any]]]] = []
@@ -165,6 +167,11 @@ class MonitorServer:
         # Registered alongside health because reading sentinel accumulators
         # is a sync point of exactly the same cadence contract.
         self._chaos = lambda: driver.chaos_snapshot()
+        # ``/control`` (r16): the closed-loop controller's rung, spec, and
+        # decision log. Resolved at REQUEST time (like /trace) so a plane
+        # armed after registration is served; an unarmed driver answers
+        # {"armed": false} — host values only, never a device read.
+        self._control = lambda: driver.control_snapshot()
 
     def register_telemetry(self, driver, plane=None) -> None:
         """Serve the r8 telemetry plane: ``GET /metrics`` (OpenMetrics text
@@ -281,6 +288,7 @@ class MonitorServer:
                 "health": self._health is not None,
                 "dispatch": self._dispatch is not None,
                 "chaos": self._chaos is not None,
+                "control": self._control is not None,
                 "metrics": bool(self._metric_providers),
                 "events": self._events is not None,
                 "trace": self._trace is not None,
@@ -308,6 +316,10 @@ class MonitorServer:
             if self._chaos is None:
                 return b"404 Not Found", {"error": "no chaos provider registered"}
             return b"200 OK", self._chaos()
+        if path == "/control":
+            if self._control is None:
+                return b"404 Not Found", {"error": "no control provider registered"}
+            return b"200 OK", self._control()
         if path == "/health":
             if self._health is None:
                 return b"404 Not Found", {"error": "no health provider registered"}

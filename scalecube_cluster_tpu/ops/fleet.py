@@ -62,6 +62,7 @@ Batching rules (the contract docs/FLEET.md spells out):
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Callable, Optional, Sequence
 
@@ -217,6 +218,18 @@ def fleet_inject_rumor(ops, fleet_state, slot: int, origins):
     )
 
 
+def fleet_uniform_loss(ops, fleet_state, floors, floor: bool = True):
+    """Per-scenario ambient uniform-loss write (one vmapped mutation):
+    scenario ``s`` gets floor ``floors[s]`` (a FRACTION, not percent) —
+    the r16 condition-grid seam for runs whose ambient floor is part of
+    the start state rather than a scheduled ``LossStorm`` (the
+    adaptive-knob sweep's loss axis)."""
+    floors = jnp.asarray(floors, jnp.float32)
+    return jax.vmap(lambda st, p: ops.set_uniform_loss(st, p, floor=floor))(
+        fleet_state, floors
+    )
+
+
 # ---------------------------------------------------------------------------
 # the batched StateTimeline fold
 # ---------------------------------------------------------------------------
@@ -230,6 +243,49 @@ _TIMELINE_MUTATORS = frozenset({
 })
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetVary:
+    """Per-scenario variation of a shared chaos schedule (r16, ROADMAP 3d).
+
+    The r15 batched :class:`StateTimeline` fold replays ONE compiled
+    schedule fleet-wide — only PRNG chains and injected rumor origins
+    varied per scenario. This declares the two schedule ARGUMENTS that may
+    additionally vary, which is what lets one fleet sweep a whole
+    condition grid (the r16 controller certification's loss-floor grid,
+    a crash-row sweep) in one compiled program:
+
+    * ``crash_rows`` — [S] i32: scenario ``s``'s ``Crash`` event kills row
+      ``crash_rows[s]`` instead of the scheduled row. Requires the
+      scenario to script exactly ONE ``Crash`` event naming ONE row (the
+      detection folds need one subject per scenario); validated at
+      :func:`fleet_timeline` build.
+    * ``loss_pct`` — [S] f32 PERCENT: every uniform-loss FLOOR write
+      (``LossStorm`` starts, ambient floors applied through the timeline)
+      uses ``loss_pct[s]`` instead of the scheduled pct. The non-floor
+      restore path (storm end) is untouched — it replays the stashed
+      per-scenario planes. Mid-storm link mutations still clear to the
+      SCHEDULED pct (the storm-replay ``clear`` floor is a host value);
+      keep varied-floor scenarios free of mid-storm link events, or
+      accept the scheduled floor on those writes.
+    """
+
+    crash_rows: Optional[object] = None  # [S] i32 (array-like)
+    loss_pct: Optional[object] = None  # [S] f32, percent
+
+    def validate(self, scenario) -> None:
+        from ..chaos.events import Crash, ScenarioError
+
+        if self.crash_rows is not None:
+            crashes = [e for e in scenario.events if isinstance(e, Crash)]
+            if len(crashes) != 1 or len(crashes[0].rows) != 1:
+                raise ScenarioError(
+                    "FleetVary.crash_rows needs a scenario with exactly one "
+                    "Crash event naming one row (the per-scenario subject "
+                    f"it replaces); {scenario.name!r} schedules "
+                    f"{[list(c.rows) for c in crashes]}"
+                )
+
+
 class FleetOps:
     """The chaos-mutator surface of an engine ops module, vmapped over the
     scenario axis — what makes ``StateTimeline`` (r7) a BATCHED fold:
@@ -239,15 +295,51 @@ class FleetOps:
     across the fleet; per-scenario variation enters through the PRNG
     keys and any per-scenario state mutation applied via
     :func:`fleet_inject_rumor` / your own ``jax.vmap``). Non-mutator
-    attributes (``GROUP_PARTITIONS`` etc.) pass through untouched."""
+    attributes (``GROUP_PARTITIONS`` etc.) pass through untouched.
 
-    def __init__(self, ops):
+    A :class:`FleetVary` (r16) swaps the crash-row / uniform-loss-floor
+    ARGUMENTS per scenario: the named mutators then vmap over (state,
+    per-scenario argument) instead of broadcasting the scheduled value."""
+
+    def __init__(self, ops, vary: Optional[FleetVary] = None):
         self._ops = ops
+        self._vary = vary
 
     def __getattr__(self, name):
         target = getattr(self._ops, name)
         if name not in _TIMELINE_MUTATORS or not callable(target):
             return target
+        vary = self._vary
+
+        if name == "crash_rows" and vary is not None \
+                and vary.crash_rows is not None:
+            rows_s = jnp.asarray(vary.crash_rows, jnp.int32)
+
+            def vmapped(fleet_state, _rows, **kwargs):
+                # the scheduled cohort is REPLACED by the per-scenario row
+                return jax.vmap(lambda st, r: target(st, r[None]))(
+                    fleet_state, rows_s
+                )
+
+            return vmapped
+
+        if name == "set_uniform_loss" and vary is not None \
+                and vary.loss_pct is not None:
+            frac_s = jnp.asarray(vary.loss_pct, jnp.float32) / 100.0
+
+            def vmapped(fleet_state, loss, floor=False):
+                if not floor:
+                    # restore/explicit writes keep the scheduled value —
+                    # only FLOOR writes (storm starts, ambient floors)
+                    # carry the per-scenario condition
+                    return jax.vmap(lambda st: target(st, loss, floor=floor))(
+                        fleet_state
+                    )
+                return jax.vmap(lambda st, p: target(st, p, floor=True))(
+                    fleet_state, frac_s
+                )
+
+            return vmapped
 
         def vmapped(fleet_state, *args, **kwargs):
             return jax.vmap(lambda st: target(st, *args, **kwargs))(
@@ -257,15 +349,21 @@ class FleetOps:
         return vmapped
 
 
-def fleet_timeline(scenario, ops, dense_links: bool, horizon=None):
+def fleet_timeline(scenario, ops, dense_links: bool, horizon=None,
+                   vary: Optional[FleetVary] = None):
     """A chaos :class:`~..chaos.engine.StateTimeline` whose compiled
     schedule replays onto a FLEET state: same validation, same ordered
     (tick, seq) fold, same loss-storm stash/replay semantics — each
-    action one vmapped device op over all S scenarios."""
+    action one vmapped device op over all S scenarios. ``vary`` (r16)
+    makes the crash row / uniform-loss floor per-scenario arguments
+    (:class:`FleetVary`), so one compiled fleet sweeps a condition grid."""
     from ..chaos.engine import StateTimeline
 
+    if vary is not None:
+        vary.validate(scenario)
     return StateTimeline(
-        scenario, FleetOps(ops), dense_links=dense_links, horizon=horizon
+        scenario, FleetOps(ops, vary), dense_links=dense_links,
+        horizon=horizon,
     )
 
 
@@ -310,16 +408,28 @@ def fleet_false_dead(fleet_state, watch_up_mask):
     return jax.vmap(one)(fleet_state)
 
 
+def _crash_detected_one(st, r):
+    """Scalar detection predicate (guarantee 2): every up observer reads
+    row ``r`` at rank DEAD (unknown key -1 also reads rank 3, matching
+    the reference's removal)."""
+    col = st.view_key[:, r]
+    n = st.up.shape[0]
+    others_up = st.up & (jnp.arange(n) != r)
+    return (~others_up | ((col & 3) == 3)).all()
+
+
 def fleet_crash_detected(fleet_state, crash_row: int):
     """[S] bool: per scenario, does EVERY up observer read ``crash_row``
-    at rank DEAD (or never knew it — unknown key -1 also reads rank 3,
-    matching the reference's removal)? The detection-latency sentinel's
-    check (guarantee 2), vmapped for the MC certification fold."""
+    at rank DEAD? The detection-latency sentinel's check, vmapped for
+    the MC certification fold."""
+    return jax.vmap(lambda st: _crash_detected_one(st, crash_row))(
+        fleet_state
+    )
 
-    def one(st):
-        col = st.view_key[:, crash_row]
-        n = st.up.shape[0]
-        others_up = st.up & (jnp.arange(n) != crash_row)
-        return (~others_up | ((col & 3) == 3)).all()
 
-    return jax.vmap(one)(fleet_state)
+def fleet_crash_detected_varied(fleet_state, crash_rows):
+    """[S] bool twin of :func:`fleet_crash_detected` for a
+    :class:`FleetVary`-varied fleet: scenario ``s``'s detection subject is
+    ``crash_rows[s]`` (the per-scenario row the varied timeline killed)."""
+    rows = jnp.asarray(crash_rows, jnp.int32)
+    return jax.vmap(_crash_detected_one)(fleet_state, rows)

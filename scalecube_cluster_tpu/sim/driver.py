@@ -318,6 +318,11 @@ class SimDriver:
         # window programs; None = unarmed. Same neutrality contract as
         # telemetry: bit-identical trajectory, zero per-window readbacks.
         self._trace = None
+        # armed closed-loop control plane (r16, control.ControlPlane):
+        # pure-host telemetry-driven knob steering; None = unarmed. When
+        # armed and taking no action the trajectory stays bit-identical —
+        # sensor reads are epoch-cadence sync points, never hot-path ops.
+        self._control = None
         # host-side tick shadow: lets bus records and flight dumps stamp the
         # current tick WITHOUT a device read (step() advances it; restore
         # re-seeds it from the checkpoint's host-visible tick plane)
@@ -444,6 +449,12 @@ class SimDriver:
             # one pure-jnp ring append + host wall-clock histograms — the
             # armed plane stays inside the zero-readback discipline
             self._telemetry.on_window(ms, self.state, n_ticks, dispatch_s)
+        if self._control is not None:
+            # r16 closed loop: a counter bump per window; at control-epoch
+            # boundaries the plane reads the newest ring row (one
+            # epoch-cadence readback) and may live-swap knobs — never a
+            # device op inside the window programs
+            self._control.on_window()
         self._ticks_since_flush += n_ticks
         if self._ticks_since_flush >= self.flush_ticks_cap:
             self.flush()  # i32 overflow guard — see flush_ticks_cap
@@ -1012,6 +1023,15 @@ class SimDriver:
             # host-only counters (cursor arithmetic) — the ring itself is
             # NOT read here; /trace is the ring's sync point
             out["trace"] = self._trace.stats()
+        if self._control is not None:
+            # r16: rung + loop counters (host-only); the full decision
+            # log lives on GET /control
+            snap = self._control.snapshot()
+            out["control"] = {
+                k: snap[k]
+                for k in ("rung", "rung_name", "actuated", "epoch",
+                          "actuations", "stale_epochs", "last_sensors")
+            }
         return out
 
     def enable_health_probes(self) -> None:
@@ -1080,6 +1100,11 @@ class SimDriver:
                     "trace capture and adaptive failure detection cannot "
                     "share a driver yet — use set_adaptive(None) first, or "
                     "trace a static-FD driver"
+                )
+            if self._control is not None:
+                raise ValueError(
+                    "trace capture and the control plane cannot share a "
+                    "driver (the controller may arm adaptive FD)"
                 )
             if self.mesh is not None:
                 raise ValueError(
@@ -1199,6 +1224,81 @@ class SimDriver:
         """The armed :class:`..adaptive.AdaptiveState`, or None (static FD)."""
         return self._ad
 
+    def set_protocol_knobs(self, *, fanout: int | None = None,
+                           suspicion_mult: int | None = None) -> None:
+        """Live-swap static protocol knobs (r16 control actuator): gossip
+        ``fanout`` and/or the static ``suspicion_mult``. Like the r13/r14
+        swaps these are STATIC program properties — the compiled window
+        cache is invalidated, the state itself is untouched (no knob
+        lives in a state plane), and checkpoints stay compatible. A no-op
+        when nothing changes."""
+        import dataclasses as _dc
+
+        with self._lock:
+            updates = {}
+            if fanout is not None and fanout != self.params.fanout:
+                if fanout < 1:
+                    raise ValueError("fanout must be >= 1")
+                updates["fanout"] = int(fanout)
+            if (
+                suspicion_mult is not None
+                and suspicion_mult != self.params.suspicion_mult
+            ):
+                if suspicion_mult < 1:
+                    raise ValueError("suspicion_mult must be >= 1")
+                updates["suspicion_mult"] = int(suspicion_mult)
+            if not updates:
+                return
+            self.params = _dc.replace(self.params, **updates)
+            self._step_cache.clear()
+            self._step_stats.clear()
+
+    # -- closed-loop control plane (r16: telemetry-driven knob steering) -----
+    def arm_control(self, spec=None, config=None):
+        """Arm the closed-loop control plane (r16); returns the
+        :class:`..control.ControlPlane`. ``spec`` is a
+        :class:`..control.ControlSpec` (None = defaults, or derived from
+        ``config`` — a :class:`..config.ClusterConfig`). Requires (and
+        auto-arms) the telemetry plane: the metric ring is the sensor.
+
+        Arming is knob-PASSIVE: no knob changes until the decision rule
+        fires, so an armed-but-idle driver's trajectory is bit-identical
+        to an unarmed one (tests/test_control.py pins it). Sensor reads
+        happen at control-epoch cadence and are sync points of the same
+        contract as monitor polls."""
+        from ..control import ControlPlane
+
+        with self._lock:
+            if self._control is not None:
+                return self._control
+            if self.mesh is not None:
+                raise ValueError(
+                    "the control plane steers set_adaptive, which is "
+                    "single-device for now — arm on an unsharded driver"
+                )
+            if self._trace is not None:
+                raise ValueError(
+                    "trace capture and the control plane cannot share a "
+                    "driver (the controller may arm adaptive FD, which "
+                    "traced windows do not support yet)"
+                )
+            self._control = ControlPlane(self, spec=spec, config=config)
+            return self._control
+
+    @property
+    def control(self):
+        """The armed :class:`..control.ControlPlane`, or None."""
+        return self._control
+
+    def control_snapshot(self) -> dict:
+        """Live controller view (``GET /control``): spec + rung + the
+        bounded decision log, or ``{"armed": False}``. Host values only —
+        never a device read."""
+        plane = self._control
+        if plane is None:
+            return {"armed": False}
+        return plane.snapshot()
+
     def run_scenario(
         self,
         scenario,
@@ -1315,6 +1415,12 @@ class SimDriver:
                 if self._free_rumor_slots is not None else None
             ),
         }
+        if self._control is not None:
+            # r16: controller memory (rung, dwell, decision log) follows
+            # the timeline — restoring must not replay dwell the
+            # abandoned branch accumulated (host dict key; optional, so
+            # older checkpoints and control-less drivers are unaffected)
+            host["control_state"] = self._control.state_dict()
         host_bytes = pickle.dumps(host)
         payload = dict(
             self._ops.snapshot(self.state),
@@ -1424,6 +1530,22 @@ class SimDriver:
         # (warnings from the abandoned branch must not survive a restore)
         self._segmentation_warnings = host.get("segmentation_warnings", 0)
         self._recent_joins = [tuple(j) for j in host.get("recent_joins", [])]
+        # r16: restore controller memory into an armed control plane (an
+        # actuated rung re-applies its knobs — params are construction
+        # state, not checkpoint state). A control-LESS checkpoint resets
+        # an armed controller to fresh memory (abandoned-branch decisions
+        # must not survive the timeline switch, and an actuated plane
+        # re-bases to the ladder's base rung); a control-armed checkpoint
+        # restored into a plane-less driver is ignored. ORDER MATTERS:
+        # the rung re-application runs BEFORE the adaptive planes restore
+        # below — set_adaptive's new-experiment reset must not discard
+        # the evidence the checkpoint carries (the checkpoint's planes
+        # were accumulated under the checkpoint's own rung).
+        if self._control is not None:
+            if "control_state" in host:
+                self._control.load_state_dict(host["control_state"])
+            else:
+                self._control.reset_for_restore()
         # r14 adaptive planes: optional keys, popped BEFORE the engine
         # restore (they are not engine state planes). An adaptive-armed
         # driver restoring a static-FD checkpoint starts with fresh scores.

@@ -1,0 +1,632 @@
+"""r16 closed-loop control plane: policy units, driver integration,
+fleet certification harness, and the r16 fleet/certify satellites.
+
+The load-bearing contracts:
+
+* the decision rule is pure host policy — dwell, clamp, hysteresis, and
+  the sensor-dropout hold are unit-testable without a device;
+* an armed-but-idle controller leaves the trajectory BIT-IDENTICAL to an
+  unarmed driver (the r8/r10 neutrality discipline applied to r16);
+* actuation is safe against the donated dispatch pipeline (a live swap
+  between enqueued windows must not touch in-flight buffers);
+* controller memory survives checkpoint/restore (and an actuated rung
+  re-applies its knobs to the restored driver);
+* the falsifiability controllers (telemetry-blind, unclamped) exist and
+  are refused on live drivers;
+* the r16 fleet seams (FleetVary, per-floor fp_rate_mc, sparse/pview MC
+  cells, the control audit variant) hold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.control import (
+    DEFAULT_LADDER,
+    ControllerState,
+    ControlSpec,
+    Rung,
+    advance,
+    sensors_from_window,
+    target_rung,
+)
+
+
+def _sense(miss):
+    return {"miss_rate": miss, "suspect_rate": 0.0, "probes": 1000.0}
+
+
+# ---------------------------------------------------------------------------
+# 1. the decision rule (pure policy units)
+# ---------------------------------------------------------------------------
+
+
+def test_target_rung_thresholds_and_hysteresis():
+    spec = ControlSpec()
+    assert target_rung(spec, 0.0, 0) == 0
+    assert target_rung(spec, 0.045, 0) == 1
+    assert target_rung(spec, 0.08, 0) == 2
+    # hysteresis: at rung 2, a dip below enter(2) but above
+    # enter(2) * hysteresis holds the rung
+    e2 = spec.ladder[2].enter_miss_rate
+    assert target_rung(spec, e2 * 0.8, 2) == 2
+    assert target_rung(spec, e2 * spec.hysteresis * 0.5, 2) == 0
+
+
+def test_dwell_up_then_step_clamped_one_rung_per_epoch():
+    spec = ControlSpec(dwell_up=2, max_step=1)
+    st = ControllerState()
+    assert advance(spec, st, _sense(0.10)) is None  # dwell 1/2
+    r = advance(spec, st, _sense(0.10))  # dwell 2/2 -> step, clamped
+    assert r is spec.ladder[1] and st.rung == 1
+    # the clamp left the walk mid-move: the next epoch continues
+    r = advance(spec, st, _sense(0.10))
+    assert r is spec.ladder[2] and st.rung == 2
+    assert st.actuations == 2 and st.actuated
+
+
+def test_dwell_down_is_slower_and_hysteresis_resets_pending():
+    spec = ControlSpec(dwell_up=1, dwell_down=3)
+    st = ControllerState(rung=2, actuated=True)
+    for _ in range(2):
+        assert advance(spec, st, _sense(0.0)) is None  # dwell 1,2 / 3
+    # a pressure re-spike resets the pending downshift
+    assert advance(spec, st, _sense(0.10)) is None
+    for _ in range(2):
+        assert advance(spec, st, _sense(0.0)) is None
+    r = advance(spec, st, _sense(0.0))
+    assert r is spec.ladder[1] and st.rung == 1
+
+
+def test_sensor_dropout_holds_last_setting():
+    spec = ControlSpec(dwell_up=1)
+    st = ControllerState(rung=2, actuated=True)
+    assert advance(spec, st, None) is None
+    assert st.rung == 2 and st.stale_epochs == 1
+    assert st.log[-1]["reason"] == "sensors_stale"
+    # dropout also clears any pending move (no acting on stale evidence)
+    advance(spec, st, _sense(0.0))  # pend down 1/dwell_down
+    advance(spec, st, None)
+    assert st.pend_count == 0
+
+
+def test_blind_controller_never_leaves_base_rung():
+    spec = ControlSpec(blind=True, dwell_up=1)
+    st = ControllerState()
+    for _ in range(6):
+        advance(spec, st, _sense(0.25))
+    assert st.rung == 0 and st.actuations == 0
+
+
+def test_unclamped_controller_overshoots_and_retargets():
+    spec = ControlSpec(clamped=False)
+    st = ControllerState()
+    r = advance(spec, st, _sense(0.08))
+    assert r is not None and r.fanout > max(x.fanout for x in spec.ladder)
+    r2 = advance(spec, st, _sense(0.05))  # quantization wiggle -> re-target
+    assert r2 is not None and r2.fanout != r.fanout
+    assert st.actuations == 2
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        ControlSpec(ladder=(DEFAULT_LADDER[0],))  # < 2 rungs
+    with pytest.raises(ValueError):
+        ControlSpec(ladder=(DEFAULT_LADDER[1], DEFAULT_LADDER[2]))  # base != 0
+    with pytest.raises(ValueError):
+        ControlSpec(hysteresis=0.0)
+    with pytest.raises(ValueError):
+        ControlSpec(epoch_windows=0)
+    # config block routes through the same validation
+    from scalecube_cluster_tpu.config import ClusterConfig, ControlConfig
+
+    cfg = ClusterConfig.default_sim().with_control(
+        lambda c: c.replace(dwell_up=2, epoch_windows=8)
+    )
+    assert ControlSpec.from_config(cfg).epoch_windows == 8
+    with pytest.raises(ValueError):
+        ClusterConfig.default_sim().with_control(
+            lambda c: c.replace(epoch_windows=0)
+        ).validate()
+
+
+def test_sensors_from_window_math():
+    s = sensors_from_window(
+        {"fd_probes": 400.0, "fd_failed_probes": 20.0,
+         "fd_new_suspects": 4.0}
+    )
+    assert s["miss_rate"] == pytest.approx(0.05)
+    assert s["suspect_rate"] == pytest.approx(0.01)
+    assert sensors_from_window({})["miss_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2. driver integration
+# ---------------------------------------------------------------------------
+
+
+def _driver(n=24, seed=7, **kw):
+    from scalecube_cluster_tpu.ops.state import SimParams
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    params = SimParams(capacity=n, fd_every=1, sync_every=40, rumor_slots=8,
+                       seed_rows=(0,), full_metrics=False)
+    return SimDriver(params, n, seed=seed, **kw)
+
+
+def _states_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_armed_idle_is_bit_identical_to_unarmed():
+    d1, d2 = _driver(), _driver()
+    plane = d2.arm_control(spec=ControlSpec(epoch_windows=2))
+    for _ in range(6):
+        d1.step(8)
+        d2.step(8)
+    assert _states_equal(d1.state, d2.state)
+    assert plane.state.actuations == 0
+    assert plane.state.epoch == 3  # the loop DID run and held
+    assert all(e["action"] in ("hold", "dwell") for e in plane.state.log)
+
+
+def test_controller_climbs_under_loss_and_applies_knobs():
+    import scalecube_cluster_tpu.ops.state as S
+
+    d = _driver()
+    plane = d.arm_control(spec=ControlSpec(epoch_windows=1, dwell_up=1))
+    d.state = S.set_uniform_loss(d.state, 0.25, floor=True)
+    for _ in range(4):
+        d.step(8)
+    snap = d.control_snapshot()
+    assert snap["armed"] and snap["rung"] == 2
+    assert d.params.fanout == DEFAULT_LADDER[2].fanout
+    assert d.params.dissem.strategy == "tuneable"
+    assert d.params.dissem.tuneable_mix == DEFAULT_LADDER[2].tuneable_mix
+    assert d.params.adaptive.enabled
+    assert d.params.adaptive.min_mult == DEFAULT_LADDER[2].min_mult
+    assert d.adaptive_state is not None
+    acts = [e for e in snap["decision_log"] if e["action"] == "actuate"]
+    assert len(acts) == snap["actuations"] == 2
+    # the driver keeps stepping correctly on the swapped programs
+    d.step(8)
+    assert int(np.asarray(d.state.up).sum()) == 24
+
+
+def test_driver_sensor_dropout_holds():
+    d = _driver()
+    plane = d.arm_control(spec=ControlSpec(epoch_windows=1))
+    # epoch against an EMPTY ring (no window has run)
+    plane._run_epoch()
+    assert plane.state.log[-1]["reason"] == "sensors_stale"
+    d.step(8)  # appends one ring row; on_window already ran its epoch
+    # a second epoch against the SAME ring row is also a dropout
+    plane._run_epoch()
+    assert plane.state.log[-1]["reason"] == "sensors_stale"
+    assert plane.state.stale_epochs == 2
+
+
+def test_actuation_with_windows_in_flight():
+    """A live swap between enqueued donated windows must not disturb the
+    pipeline (the r6 donation discipline: the swap only clears the
+    program cache; in-flight buffers belong to the old programs)."""
+    import scalecube_cluster_tpu.ops.state as S
+
+    d = _driver()
+    for _ in range(3):
+        d.step(8)  # enqueue donated windows, no sync
+    d.set_protocol_knobs(fanout=4, suspicion_mult=2)
+    d.set_dissemination(strategy="tuneable", topology="expander",
+                        tuneable_mix=0.4)
+    for _ in range(2):
+        d.step(8)
+    d.sync()
+    assert d.params.fanout == 4 and d.params.suspicion_mult == 2
+    assert int(np.asarray(d.state.up).sum()) == 24
+    # same through the controller's epoch path mid-flight
+    plane = d.arm_control(spec=ControlSpec(epoch_windows=1, dwell_up=1))
+    d.state = S.set_uniform_loss(d.state, 0.25, floor=True)
+    for _ in range(3):
+        d.step(8)
+    d.sync()
+    assert plane.state.actuations >= 1
+    assert int(np.asarray(d.state.up).sum()) == 24
+
+
+def test_set_protocol_knobs_validation_and_noop():
+    d = _driver()
+    with pytest.raises(ValueError):
+        d.set_protocol_knobs(fanout=0)
+    with pytest.raises(ValueError):
+        d.set_protocol_knobs(suspicion_mult=0)
+    d.step(8)
+    cached = len(d._step_cache)
+    d.set_protocol_knobs(fanout=d.params.fanout)  # no-op keeps the cache
+    assert len(d._step_cache) == cached
+
+
+def test_controller_state_restore_roundtrip(tmp_path):
+    import scalecube_cluster_tpu.ops.state as S
+
+    d = _driver()
+    plane = d.arm_control(spec=ControlSpec(epoch_windows=1, dwell_up=1))
+    d.state = S.set_uniform_loss(d.state, 0.25, floor=True)
+    for _ in range(4):
+        d.step(8)
+    assert plane.state.rung == 2
+    path = os.path.join(tmp_path, "ctl.npz")
+    d.checkpoint(path)
+    # restore into a FRESH driver: rung + log come back and the actuated
+    # rung's knobs are re-applied (params are construction state)
+    d2 = _driver()
+    p2 = d2.arm_control(spec=ControlSpec(epoch_windows=1, dwell_up=1))
+    d2.restore(path)
+    assert p2.state.rung == 2 and p2.state.actuated
+    assert p2.state.actuations == plane.state.actuations
+    assert [e["action"] for e in p2.state.log] == \
+        [e["action"] for e in plane.state.log]
+    assert d2.params.fanout == DEFAULT_LADDER[2].fanout
+    assert d2.params.adaptive.enabled
+    # the checkpointed adaptive EVIDENCE survives the rung re-application
+    # (restore applies the rung's knobs BEFORE the planes restore, so
+    # set_adaptive's new-experiment reset cannot discard them)
+    lh = np.asarray(d.adaptive_state.lh)
+    assert lh.any(), "precondition: 25% loss accrued local-health evidence"
+    assert np.array_equal(np.asarray(d2.adaptive_state.lh), lh)
+    assert np.array_equal(
+        np.asarray(d2.adaptive_state.conf), np.asarray(d.adaptive_state.conf)
+    )
+    d2.step(8)  # the restored driver steps on the re-applied programs
+    # a checkpoint WITHOUT controller state resets an armed controller:
+    # abandoned-branch memory must not survive the timeline switch, and
+    # an ACTUATED plane re-bases its knobs to the ladder's base rung
+    d3 = _driver()
+    path2 = os.path.join(tmp_path, "plain.npz")
+    d3.step(8)
+    d3.checkpoint(path2)
+    d4 = _driver()
+    d4.arm_control()
+    d4.restore(path2)
+    assert d4.control.state.actuations == 0
+    # d2 climbed to storm above; restoring the plain checkpoint resets
+    # its memory AND re-bases the knobs
+    assert d2.control.state.rung == 2 and d2.params.adaptive.enabled
+    d2.restore(path2)
+    assert d2.control.state.rung == 0
+    assert not d2.control.state.actuated and d2.control.state.log == []
+    assert d2.params.fanout == DEFAULT_LADDER[0].fanout
+    assert not d2.params.adaptive.enabled and d2.adaptive_state is None
+    d2.step(8)  # steps on the re-based programs
+
+
+def test_arm_control_exclusions_and_falsifiability_refusal():
+    d = _driver()
+    d.arm_trace()
+    with pytest.raises(ValueError, match="trace"):
+        d.arm_control()
+    d2 = _driver()
+    with pytest.raises(ValueError, match="falsifiability"):
+        d2.arm_control(spec=ControlSpec(blind=True))
+    with pytest.raises(ValueError, match="falsifiability"):
+        d2.arm_control(spec=ControlSpec(clamped=False))
+    d2.arm_control()
+    with pytest.raises(ValueError, match="control"):
+        d2.arm_trace()
+
+
+def test_monitor_control_route():
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    d = _driver()
+    mon = MonitorServer()
+    mon.register_health(d)
+    status, body = mon._route("/control")
+    assert status.startswith(b"200") and body == {"armed": False}
+    d.arm_control()
+    status, body = mon._route("/control")
+    assert status.startswith(b"200") and body["armed"] is True
+    assert body["rung_name"] == "clean" and "decision_log" in body
+    assert mon._route("/")[1]["control"] is True
+    # health snapshot carries the compact control section
+    assert d.health_snapshot()["control"]["rung"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. the r16 fleet seams (FleetVary + per-floor fp + engine MC cells)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_vary_crash_rows_and_loss_floors():
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.chaos import events as ev
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    n, s = 16, 3
+    params = S.SimParams(capacity=n, rumor_slots=4, seed_rows=(0,))
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    scen = ev.Scenario(
+        name="varied",
+        events=(ev.Crash(rows=[3], at=2),
+                ev.LossStorm(pct=40.0, at=4, until=8)),
+        horizon=12,
+    )
+    vary = FL.FleetVary(crash_rows=np.array([5, 6, 7]),
+                        loss_pct=np.array([10.0, 20.0, 30.0]))
+    tl = FL.fleet_timeline(scen, S, dense_links=True, horizon=12, vary=vary)
+    fs, _ = tl.apply_due(fs, 4)
+    up = np.asarray(fs.up)
+    # the scheduled row 3 is REPLACED by the per-scenario rows
+    assert up[:, 3].all()
+    assert not up[0, 5] and not up[1, 6] and not up[2, 7]
+    loss = np.asarray(fs.loss)
+    assert loss[0, 0, 1] == pytest.approx(0.1)
+    assert loss[2, 0, 1] == pytest.approx(0.3)
+    fs, _ = tl.apply_due(fs, 8)  # storm restore is per-scenario clean
+    assert np.allclose(np.asarray(fs.loss)[:, 0, 1], 0.0)
+    # the varied detection fold reads the per-scenario subject
+    det = np.asarray(FL.fleet_crash_detected_varied(fs, vary.crash_rows))
+    assert det.shape == (s,)
+
+
+def test_fleet_vary_requires_single_crash_event():
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.chaos import events as ev
+    from scalecube_cluster_tpu.chaos.engine import ScenarioError
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    scen = ev.Scenario(name="two", horizon=4,
+                       events=(ev.Crash(rows=[1, 2], at=0),))
+    with pytest.raises(ScenarioError, match="exactly one Crash"):
+        FL.fleet_timeline(scen, S, dense_links=True, horizon=4,
+                          vary=FL.FleetVary(crash_rows=np.array([1, 2])))
+
+
+def test_fleet_uniform_loss_per_scenario():
+    import scalecube_cluster_tpu.ops.state as S
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    params = S.SimParams(capacity=8, rumor_slots=4)
+    fs = FL.fleet_broadcast(S.init_state(params, 8, warm=True), 3)
+    fs = FL.fleet_uniform_loss(S, fs, np.array([0.0, 0.1, 0.2]))
+    assert np.asarray(fs.loss)[:, 0, 1].tolist() == pytest.approx(
+        [0.0, 0.1, 0.2]
+    )
+
+
+def test_fp_rate_mc_per_floor_breakdown():
+    from scalecube_cluster_tpu.dissemination.certify import fp_rate_mc
+
+    # all three calls share n_seeds=4 so the [S=4] fleet program
+    # compiles once (floors are DATA, not shape — the r16 seam)
+    rec = fp_rate_mc(n=24, n_seeds=4, loss_floor=np.array([0.0, 0.15]),
+                     adaptive=True, window=16, horizon=96, until=80,
+                     crash_at=16)
+    assert rec["loss_floor_pct"] == [0.0, 15.0]
+    assert len(rec["per_floor"]) == 2
+    assert sum(p["n_seeds"] for p in rec["per_floor"]) == 4
+    assert sum(
+        p["false_dead_scenarios"] for p in rec["per_floor"]
+    ) == rec["false_dead_scenarios"]
+    # scalar floors keep the r15 record shape (no breakdown)
+    rec2 = fp_rate_mc(n=24, n_seeds=4, loss_floor=0.1, adaptive=True,
+                      window=16, horizon=96, until=80, crash_at=16)
+    assert rec2["per_floor"] is None
+    assert rec2["loss_floor_pct"] == 10.0
+    # a 1-element ARRAY is grid mode, not scalar mode (the knob sweep
+    # indexes per_floor for any loss_floors length)
+    rec3 = fp_rate_mc(n=24, n_seeds=4, loss_floor=np.array([0.1]),
+                      adaptive=True, window=16, horizon=96, until=80,
+                      crash_at=16)
+    assert len(rec3["per_floor"]) == 1
+    assert rec3["loss_floor_pct"] == [10.0]
+
+
+@pytest.mark.slow
+def test_mc_cells_run_on_sparse_and_pview():
+    """ROADMAP 3a: the MC certification service runs the sparse and pview
+    engines end-to-end (tiny-seed smoke; the >=1000-seed cells ride
+    config14/15)."""
+    from scalecube_cluster_tpu.dissemination import DissemSpec
+    from scalecube_cluster_tpu.dissemination.certify import (
+        DEFAULT_MC_MATRIX,
+        certify_spread_mc,
+    )
+
+    engines = {e for _s, _t, e in DEFAULT_MC_MATRIX}
+    assert {"dense", "sparse", "pview"} <= engines
+    for engine in ("sparse", "pview"):
+        rec = certify_spread_mc(
+            DissemSpec(strategy="push", topology="expander"),
+            n=24, n_seeds=4, engine=engine, window=16,
+        )
+        assert rec["engine"] == engine
+        assert rec["finished"] == 4
+        assert rec["verdict_kind"] == "spot-check"
+
+
+@pytest.mark.slow
+def test_adaptive_knob_sweep_map_shape():
+    from scalecube_cluster_tpu.dissemination.certify import (
+        adaptive_knob_sweep,
+    )
+
+    # both sweeps land on the same [S=4] fleet shape (2 floors × 2 and
+    # 1 floor × 4 seeds) so the program compiles once
+    rec = adaptive_knob_sweep(
+        min_mults=(5,), conf_targets=(4,), loss_floors=(0.0, 0.1),
+        n=24, n_seeds_per_floor=2, window=16, horizon=96,
+    )
+    assert len(rec["cells"]) == 1
+    assert set(rec["recommended"]) == {"0.0", "10.0"}
+    cell = rec["cells"][0]
+    assert cell["adaptive_knobs"]["min_mult"] == 5
+    assert len(cell["per_floor"]) == 2
+    # a single-floor sweep works (the 1-element grid regression)
+    rec1 = adaptive_knob_sweep(
+        min_mults=(5,), conf_targets=(4,), loss_floors=(0.1,),
+        n=24, n_seeds_per_floor=4, window=16, horizon=96,
+    )
+    assert set(rec1["recommended"]) == {"10.0"}
+
+
+# ---------------------------------------------------------------------------
+# 4. shifting-conditions scenario family
+# ---------------------------------------------------------------------------
+
+
+def test_shifting_family_builders():
+    from scalecube_cluster_tpu.chaos import shifting as sh
+
+    for build in sh.SHIFTING_FAMILY:
+        cell = build(n=48)
+        assert cell.scenario.horizon % 8 == 0
+        for ev_ in cell.scenario.events:
+            assert ev_.at % 8 == 0
+        assert cell.crash_row not in cell.watch_rows
+        assert cell.crash_at < cell.shift_at
+        slots = [s for s, _t in cell.rumors]
+        assert 0 in slots and 1 in slots
+        # one rumor per side of the shift
+        ticks = dict(cell.rumors)
+        assert ticks[0] < cell.shift_at < ticks[1]
+
+
+def test_shifting_builders_validate():
+    from scalecube_cluster_tpu.chaos import shifting as sh
+    from scalecube_cluster_tpu.chaos.engine import ScenarioError
+
+    with pytest.raises(ScenarioError):
+        sh.loss_storm_midrun(n=16)  # crash row 20 out of range
+    with pytest.raises(ScenarioError):
+        sh.wan_zone_degrade(zone_rows=(20, 21))  # crash row inside zone
+    with pytest.raises(ScenarioError):
+        sh.migrating_asym_loss(cohort_a=(5, 6), cohort_b=(6, 7))
+
+
+# ---------------------------------------------------------------------------
+# 5. the fleet certification harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_run_controlled_fleet_smoke():
+    """The controlled arm tracks the condition shift end-to-end at small
+    S: climbs on the storm, zero false-DEAD, detection inside the
+    deadline, all folds present. (The 512-seed Wilson-separation matrix
+    is the bench acceptance — config15 / CONTROL_BENCH_r16.json.)"""
+    from scalecube_cluster_tpu.chaos.shifting import loss_storm_midrun
+    from scalecube_cluster_tpu.control import run_controlled_fleet
+
+    cell = loss_storm_midrun()
+    rec = run_controlled_fleet(cell, "controlled", n=48, n_seeds=8,
+                               window=8)
+    assert rec["n_seeds"] == 8
+    assert rec["verdict_kind"] == "spot-check"
+    assert rec["false_dead_scenarios"] == 0
+    assert rec["fail_detect"] == 0
+    assert rec["fail_cost"] == 0
+    # it climbed when the storm arrived and the log shows the walk
+    names = [c["to"] for c in rec["knob_changes"]]
+    assert "degraded" in names and "storm" in names
+    assert rec["knob_changes"][0]["tick"] >= cell.shift_at
+    assert len(set(rec["crash_rows_varied"])) > 1
+    assert rec["cost_mean"] <= rec["slo"]["cost_budget"]
+    # the default certification cadence is one fleet window per control
+    # epoch, and the record says so
+    assert rec["epoch_windows"] == 1 and rec["epoch_ticks"] == 8
+
+
+def test_run_controlled_fleet_honors_epoch_windows():
+    """The harness runs the decision rule at spec.epoch_windows cadence
+    (mirroring ControlPlane), not every window — pinned on a
+    short-horizon cell with an unreachable upper rung (no actuations,
+    one compiled program; the tier-1 budget is tight)."""
+    from scalecube_cluster_tpu.chaos.shifting import loss_storm_midrun
+    from scalecube_cluster_tpu.control import run_controlled_fleet
+
+    cell = loss_storm_midrun(clean_ticks=32, storm_ticks=32,
+                             relax_ticks=16, crash_at=16)
+    ladder = (
+        DEFAULT_LADDER[0],
+        dataclasses.replace(DEFAULT_LADDER[2], enter_miss_rate=0.9),
+    )
+    spec = ControlSpec(ladder=ladder, epoch_windows=2, dwell_up=1)
+    rec = run_controlled_fleet(cell, "controlled", n=48, n_seeds=2,
+                               window=8, spec=spec)
+    assert rec["epoch_windows"] == 2 and rec["epoch_ticks"] == 16
+    n_windows = cell.scenario.horizon // 8
+    assert rec["decision_log_tail"][-1]["epoch"] == n_windows // 2
+    assert rec["actuations"] == 0
+
+
+@pytest.mark.slow
+def test_run_controlled_fleet_static_arm_holds_knobs():
+    from scalecube_cluster_tpu.chaos.shifting import loss_storm_midrun
+    from scalecube_cluster_tpu.control import run_controlled_fleet
+
+    rec = run_controlled_fleet(loss_storm_midrun(), "static", n=48,
+                               n_seeds=4, window=8, static_rung=1)
+    assert rec["arm"] == "static-degraded"
+    assert rec["knob_changes"] == [] and rec["actuations"] == 0
+    # the mid rung's whole-run detection latency sits OVER the deadline —
+    # the physics the certification separates on
+    assert rec["detect_latency_p50"] > rec["slo"]["detect_deadline"]
+
+
+@pytest.mark.slow
+def test_certify_controller_mc_separates_and_falsifies():
+    """The full matrix at reduced S: controlled beats every static rung
+    with non-overlapping Wilson intervals, zero false-DEAD, and BOTH
+    falsifiability controllers fail certification."""
+    from scalecube_cluster_tpu.chaos.shifting import loss_storm_midrun
+    from scalecube_cluster_tpu.control import certify_controller_mc
+
+    rec = certify_controller_mc(
+        cells=[loss_storm_midrun()], n=48, n_seeds=32, window=8,
+        vary_storm_pct=(20.0, 24.0, 28.0),
+    )
+    (entry,) = rec["entries"]
+    assert entry["certified"], entry
+    assert entry["separation"] > 0
+    assert entry["controlled_false_dead"] == 0
+    assert entry["blind_fails_certification"]
+    assert entry["unclamped_fails_certification"]
+    assert entry["unclamped_actuations"] > entry["controlled_actuations"]
+    arms = entry["arms"]
+    assert arms["blind"]["false_dead_scenarios"] > 0
+    assert arms["unclamped"]["fail_cost"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 6. the audit variant (controller-epoch windows in the r12 matrix)
+# ---------------------------------------------------------------------------
+
+
+def test_control_audit_variant_passes_all_contracts():
+    """Every ladder rung's fleet window audits clean on the traced/
+    lowered forms (fast mode; the compiled sweep rides
+    tools/audit_programs.py --all → AUDIT_r12.json)."""
+    from scalecube_cluster_tpu.audit import run_contracts
+    from scalecube_cluster_tpu.audit.programs import build_engine_programs
+
+    progs = build_engine_programs("dense", variants=["control"])
+    assert [p.name.rsplit("-", 1)[-1] for p in progs] == \
+        [r.name for r in DEFAULT_LADDER]
+    adaptive_variants = [p for p in progs if len(p.donated_argnums) == 2]
+    assert len(adaptive_variants) == 2  # degraded + storm donate (state, ad)
+    for prog in progs:
+        verdict = run_contracts(prog, compile_programs=False)
+        for contract, violations in verdict.items():
+            assert violations == [], (
+                f"{prog.name}: {contract}:\n"
+                + "\n".join(str(v) for v in violations)
+            )
