@@ -372,6 +372,20 @@ def main() -> None:
             default_out="CONTROL_BENCH_r16.json",
         )
 
+    # r17: --fused runs the fused-window + Pallas delivery benchmark
+    # (benchmarks/config16_fused.py — bit-identity-gated unfused-vs-fused
+    # A/B at the 65536 pview point, the phase breakdown that motivated the
+    # fusion, and the 1M warm-tick wall) through the same
+    # backend-probe/retry path.
+    if "--fused" in sys.argv:
+        _delegate(
+            "config16_fused.py",
+            ("--n", "--windows", "--window-ticks", "--reps", "--check-n",
+             "--pallas-check-n", "--mega-n", "--profile-ticks", "--out"),
+            passthrough=("--quick", "--skip-mega", "--skip-profile"),
+            default_out="FUSED_BENCH_r17.json",
+        )
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

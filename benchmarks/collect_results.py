@@ -139,6 +139,24 @@ def _r15(rec):
     )
 
 
+def _r17(rec):
+    # no dense number — r17's gates are pview-side (fused speedup at the
+    # 65536 point + the 1M warm-tick wall); the row carries both verdicts
+    mega = rec.get("mega") or {}
+    norm = mega.get("r11_normalized_fused_warm_tick_s")
+    norm_note = (
+        f" ({norm}s at the r11 host class, {mega.get('host_cpus')}-cpu "
+        f"artifact host)" if norm is not None else ""
+    )
+    return None, (
+        f"fused pview windows: {rec.get('fused_ticks_per_s')} ticks/s = "
+        f"{rec.get('fused_speedup')}x unfused "
+        f"({rec.get('unfused_ticks_per_s')}) at N={rec.get('n')}; 1M warm "
+        f"tick {(mega.get('unfused') or {}).get('warm_tick_s')}s -> "
+        f"{(mega.get('fused') or {}).get('warm_tick_s')}s fused{norm_note}"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
@@ -149,6 +167,7 @@ ROUND_BENCH_FILES = [
     (13, "STRATEGY_BENCH_r13.json", _r13),
     (14, "ADAPTIVE_BENCH_r14.json", _r14),
     (15, "FLEET_BENCH_r15.json", _r15),
+    (17, "FUSED_BENCH_r17.json", _r17),
 ]
 
 
@@ -290,6 +309,49 @@ def collect_control_summary(root: pathlib.Path) -> dict:
             "armed_idle_overhead_pct": (
                 rec.get("armed_idle_overhead") or {}
             ).get("overhead_pct"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
+def collect_fused_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r17 fused-window artifact: the
+    bit-identity verdicts, both throughput gates, and the 1M wall."""
+    path = root / "FUSED_BENCH_r17.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        gate = rec.get("bit_identity") or {}
+        mega = rec.get("mega") or {}
+        return {
+            "present": True,
+            "backend": rec.get("backend"),
+            "bit_identity_ok": gate.get("ok"),
+            "pallas_mode": (gate.get("pallas") or {}).get("mode"),
+            "n": rec.get("n"),
+            "unfused_ticks_per_s": rec.get("unfused_ticks_per_s"),
+            "fused_ticks_per_s": rec.get("fused_ticks_per_s"),
+            "fused_speedup": rec.get("fused_speedup"),
+            "meets_1_25x_gate": rec.get("meets_1_25x_gate"),
+            "transfer_free": rec.get("transfer_free"),
+            "mega_n": mega.get("n"),
+            "mega_unfused_warm_tick_s": (mega.get("unfused") or {}).get(
+                "warm_tick_s"
+            ),
+            "mega_fused_warm_tick_s": (mega.get("fused") or {}).get(
+                "warm_tick_s"
+            ),
+            "mega_meets_45s_gate": mega.get("meets_45s_gate"),
+            "mega_host_cpus": mega.get("host_cpus"),
+            "mega_r11_normalized_fused_warm_tick_s": mega.get(
+                "r11_normalized_fused_warm_tick_s"
+            ),
+            "mega_meets_45s_gate_r11_normalized": mega.get(
+                "meets_45s_gate_r11_normalized"
+            ),
         }
     except Exception as exc:  # noqa: BLE001 — aggregation must not die
         return {"present": True, "error": repr(exc)}
@@ -441,6 +503,11 @@ def main() -> None:
     # bench.py --control)
     results += run([py, "benchmarks/config15_control.py", "--quick",
                     "--out", "CONTROL_BENCH_r16.json"], timeout=3000)
+    # r17 fused windows + Pallas delivery: bit-identity-gated unfused-vs-
+    # fused A/B at the 65536 pview point (the 1M wall point and the phase
+    # profile belong to the dedicated artifact run: bench.py --fused)
+    results += run([py, "benchmarks/config16_fused.py", "--quick",
+                    "--out", "FUSED_BENCH_r17.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -477,6 +544,9 @@ def main() -> None:
         # r16: closed-loop controller certification + knob map (full
         # artifact in CONTROL_BENCH_r16.json, refreshed by config15)
         "control_bench": collect_control_summary(ROOT),
+        # r17: fused-window speedup gates + the 1M wall verdict (full
+        # artifact in FUSED_BENCH_r17.json, refreshed by config16)
+        "fused_bench": collect_fused_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

@@ -1,0 +1,378 @@
+"""Driver config #16: fused-phase tick windows + the Pallas delivery kernel
+vs the r11 pview engine — the 59 s/tick 1M-member wall (ISSUE 16).
+
+Four sections, one JSON artifact (``FUSED_BENCH_r17.json``):
+
+1. **Bit-identity gate** (cheap, always on): the fused window, the
+   Pallas-delivery fused window, AND the r10 phase-split profiler must all
+   reproduce the unfused window's trajectory snapshot-for-snapshot at
+   ``--check-n`` before any speedup is recorded — a trajectory-changing
+   "optimisation" aborts the run instead of leaving a number behind.
+2. **A/B throughput** at ``--n`` (default 65536 — the pview-alone point no
+   full-plane engine can allocate): unfused vs fused donated windows,
+   interleaved median-of-``--reps`` spans so host drift hits both arms
+   alike, every timed span inside ``jax.transfer_guard("disallow")`` —
+   transfer-free by construction, not by counter. Gate: fused >= 1.25x.
+3. **Phase breakdown** at ``--n`` via the r10 phase profiler (pview
+   support, this round). The profiler runs the UNfused phase sequence —
+   the fused tick has no phase seams to time — and section 1 proves the
+   attribution transfers to the fused window's trajectory.
+4. **The 1M wall** (``--mega-n``, default the r11 verified ceiling
+   1048576): unfused vs fused warm donated 1-tick windows, same
+   methodology as config11's ceiling verify (whose r11 record is the
+   59.2 s baseline this section attacks). Gate: fused warm tick <= 45 s.
+
+The Pallas delivery kernel itself is certified here in interpret mode on
+CPU (bit-identity, section 1) — its speed claim is TPU-only and the
+artifact stamps the backend so a CPU run never masquerades as one.
+
+    python benchmarks/config16_fused.py [--n 65536] [--reps 5]
+        [--windows 1] [--window-ticks 4] [--check-n 4096]
+        [--pallas-check-n 1024] [--mega-n 1048576] [--profile-ticks 8]
+        [--skip-mega] [--skip-profile] [--quick] [--out FUSED_BENCH_r17.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+import numpy as np
+
+from common import emit, log
+
+REPO = _p.Path(__file__).parent.parent
+
+
+def _params(n: int, kd: str = "i16", **over):
+    from scalecube_cluster_tpu.ops.pview import PviewParams
+
+    base = dict(
+        capacity=n, view_slots=24, active_slots=8, fanout=3, repeat_mult=3,
+        ping_req_k=3, fd_every=5, sync_every=150, suspicion_mult=5,
+        rumor_slots=8, seed_rows=(0,), key_dtype=kd,
+    )
+    base.update(over)
+    return PviewParams(**base)
+
+
+def _busy_state(params, n: int):
+    """Warm cluster with live rumors in every slot and a crash wave — the
+    delivery/merge path (the fused stage) does real work every tick."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    st = PV.init_pview_state(params, n, warm=True)
+    for s in range(params.rumor_slots):
+        st = PV.spread_rumor(st, s, origin=(s * 997) % n)
+    st = PV.crash_rows(st, list(range(n // 2, n // 2 + max(2, n // 1024))))
+    return st
+
+
+def _snap_equal(a, b, label: str) -> bool:
+    """Field-by-field state equality (the bit-identity contract)."""
+    ok = True
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if not np.array_equal(np.asarray(va), np.asarray(vb)):
+            log(f"  {label}: MISMATCH in {f.name}")
+            ok = False
+    return ok
+
+
+def bit_identity_gate(check_n: int, check_ticks: int, pallas_n: int,
+                      kd: str) -> dict:
+    """Unfused vs fused vs fused+pallas vs phase-split profiler — all four
+    spellings of ``check_ticks`` ticks must land on the same state."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.trace.profile import profile_ticks
+
+    params = _params(check_n, kd)
+    st0 = _busy_state(params, check_n)
+    key = jax.random.PRNGKey(7)
+
+    ref = PV.make_pview_run(params, check_ticks, donate=False)
+    fused = PV.make_pview_fused_run(params, check_ticks, donate=False)
+    a, _, ms_a, _ = ref(st0, key)
+    b, _, ms_b, _ = fused(st0, key)
+    ok_fused = _snap_equal(a, b, "fused")
+    for mk in ms_a:
+        if not np.array_equal(np.asarray(ms_a[mk]), np.asarray(ms_b[mk])):
+            log(f"  fused: metric MISMATCH {mk}")
+            ok_fused = False
+
+    # phase-split profiler (r10, pview support this round): same helpers,
+    # same key chain -> same trajectory as the fused window
+    st_p, _, prof = profile_ticks(params, st0, key, n_ticks=check_ticks,
+                                  warmup_ticks=0)
+    ok_prof = _snap_equal(a, st_p, "profiler")
+
+    # Pallas delivery kernel at a smaller N (interpret mode on CPU walks
+    # the grid in emulation — correctness certification, not speed)
+    pp = _params(pallas_n, kd, delivery_kernel="pallas")
+    px = _params(pallas_n, kd)
+    stp = _busy_state(px, pallas_n)
+    xa, _, _, _ = PV.make_pview_fused_run(px, check_ticks, donate=False)(
+        stp, key
+    )
+    pa, _, _, _ = PV.make_pview_fused_run(pp, check_ticks, donate=False)(
+        stp, key
+    )
+    ok_pallas = _snap_equal(xa, pa, "pallas")
+
+    res = {
+        "n": check_n,
+        "ticks": check_ticks,
+        "fused_ok": ok_fused,
+        "profiler_ok": ok_prof,
+        "pallas": {
+            "n": pallas_n,
+            "mode": "compiled" if jax.default_backend() == "tpu"
+            else "interpret",
+            "ok": ok_pallas,
+        },
+        "ok": ok_fused and ok_prof and ok_pallas,
+    }
+    log(f"bit-identity gate: fused={ok_fused} profiler={ok_prof} "
+        f"pallas={ok_pallas} (N={check_n}, {check_ticks} ticks)")
+    return res
+
+
+def ab_throughput(n: int, windows: int, window_ticks: int, reps: int,
+                  kd: str) -> dict:
+    """Interleaved unfused/fused spans; both arms transfer-free under
+    ``jax.transfer_guard("disallow")``."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = _params(n, kd)
+    key = jax.random.PRNGKey(0)
+
+    arms = {}
+    for name, mk in (("unfused", PV.make_pview_run),
+                     ("fused", PV.make_pview_fused_run)):
+        step = mk(params, window_ticks)  # donated — the production spelling
+        st = _busy_state(params, n)
+        st, k, _ms, _ = step(st, key)  # compile + warm
+        jax.block_until_ready(st.up)
+        arms[name] = {"step": step, "st": st, "k": k, "spans": []}
+
+    def span(arm) -> float:
+        st, k = arm["st"], arm["k"]
+        t0 = time.perf_counter()
+        with jax.transfer_guard("disallow"):
+            for _ in range(windows):
+                st, k, _ms, _ = arm["step"](st, k)
+            jax.block_until_ready(st.up)
+        dt = time.perf_counter() - t0
+        arm["st"], arm["k"] = st, k
+        return dt
+
+    for rep in range(reps):  # interleaved: drift hits both arms alike
+        du = span(arms["unfused"])
+        df = span(arms["fused"])
+        arms["unfused"]["spans"].append(du)
+        arms["fused"]["spans"].append(df)
+        log(f"rep {rep}: unfused {du:.3f}s, fused {df:.3f}s "
+            f"({du / df:.2f}x)")
+    total = windows * window_ticks
+    u_med = statistics.median(arms["unfused"]["spans"])
+    f_med = statistics.median(arms["fused"]["spans"])
+    return {
+        "n": n,
+        "windows": windows,
+        "window_ticks": window_ticks,
+        "reps": reps,
+        "unfused_ticks_per_s": round(total / u_med, 3),
+        "fused_ticks_per_s": round(total / f_med, 3),
+        "fused_speedup": round(u_med / f_med, 3),
+        "meets_1_25x_gate": (u_med / f_med) >= 1.25,
+        "transfer_free": True,  # both arms ran under transfer_guard disallow
+        "spans_s": {
+            "unfused": [round(s, 4) for s in arms["unfused"]["spans"]],
+            "fused": [round(s, 4) for s in arms["fused"]["spans"]],
+        },
+    }
+
+
+def phase_profile(n: int, ticks: int, kd: str) -> dict:
+    """The r10 phase profiler over the pview tick at size ``n`` — the
+    breakdown that motivated WHICH phases to fuse (gossip delivery+merge
+    dominates)."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.trace.profile import profile_ticks
+
+    params = _params(n, kd)
+    st = _busy_state(params, n)
+    _st, _k, res = profile_ticks(params, st, jax.random.PRNGKey(3),
+                                 n_ticks=ticks, warmup_ticks=1)
+    res.pop("timeline", None)
+    top = max(res["phases_pct"].items(), key=lambda kv: kv[1])
+    log(f"profile N={n}: top phase {top[0]} {top[1]}% of "
+        f"{res['phase_sum_s']:.1f}s phase time")
+    return res
+
+
+def mega_wall(mega_n: int, kd: str) -> dict:
+    """config11 ``verify_ceiling`` methodology at the r11 verified ceiling,
+    run for BOTH window spellings: alloc the warm state, one donated
+    1-tick window (compile + first), then the warm tick that is the
+    number. The r11 artifact's warm tick (59.2 s on this method) is the
+    baseline; the gate is fused <= 45 s."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = _params(mega_n, kd)
+    out = {"n": mega_n, "key_dtype": kd}
+
+    # the r11 baseline this section attacks, when the artifact is present
+    try:
+        with open(REPO / "PVIEW_BENCH_r11.json") as fh:
+            r11 = json.load(fh)
+        v = (r11.get("result", r11).get("max_n_ladder") or {}).get("verified")
+        if v and v.get("n") == mega_n:
+            out["r11_warm_tick_s"] = v["warm_tick_s"]
+    except (OSError, KeyError, json.JSONDecodeError):
+        pass
+
+    for name, mk in (("unfused", PV.make_pview_run),
+                     ("fused", PV.make_pview_fused_run)):
+        t0 = time.perf_counter()
+        st = PV.init_pview_state(params, mega_n, warm=True)
+        jax.block_until_ready(st.up)
+        alloc_s = time.perf_counter() - t0
+        run = mk(params, 1)
+        key = jax.random.PRNGKey(0)
+        t0 = time.perf_counter()
+        st, key, ms, _ = run(st, key)
+        jax.block_until_ready(st.up)
+        first_s = time.perf_counter() - t0  # includes compile
+        t0 = time.perf_counter()
+        st, key, ms, _ = run(st, key)
+        jax.block_until_ready(st.up)
+        warm_s = time.perf_counter() - t0
+        n_up = int(np.asarray(ms["n_up"])[-1])
+        log(f"mega {name}: alloc {alloc_s:.1f}s, first {first_s:.1f}s, "
+            f"warm tick {warm_s:.2f}s (n_up {n_up})")
+        out[name] = {
+            "alloc_s": round(alloc_s, 3),
+            "first_window_s": round(first_s, 3),
+            "warm_tick_s": round(warm_s, 3),
+            "n_up_after_tick": n_up,
+        }
+        del st, ms  # free the multi-GiB state before the next arm
+
+    out["fused_speedup"] = round(
+        out["unfused"]["warm_tick_s"] / out["fused"]["warm_tick_s"], 3
+    )
+    # The 45 s gate is stated against the r11 baseline HOST CLASS. This
+    # artifact host may differ (the r11 record came from a multi-core
+    # bench host; containers here can be 1-core), so the unfused arm is
+    # re-measured back-to-back as the host yardstick and BOTH verdicts
+    # are recorded — the absolute one on this host, and the r11-host
+    # normalized one (baseline / measured same-host speedup). No silent
+    # substitution: host_cpus + the factor are stamped alongside.
+    out["host_cpus"] = os.cpu_count()
+    out["meets_45s_gate"] = out["fused"]["warm_tick_s"] <= 45.0
+    base = out.get("r11_warm_tick_s")
+    if base:
+        out["unfused_vs_r11_host_factor"] = round(
+            out["unfused"]["warm_tick_s"] / base, 3
+        )
+        out["r11_normalized_fused_warm_tick_s"] = round(
+            base / out["fused_speedup"], 3
+        )
+        out["meets_45s_gate_r11_normalized"] = (
+            out["r11_normalized_fused_warm_tick_s"] <= 45.0
+        )
+        log(
+            f"mega gate: this host runs the unfused spelling at "
+            f"{out['unfused_vs_r11_host_factor']}x the r11 record "
+            f"({out['host_cpus']} cpu(s)); fused {out['fused_speedup']}x "
+            f"=> {out['r11_normalized_fused_warm_tick_s']}s at the r11 "
+            f"host class (gate <= 45s: "
+            f"{out['meets_45s_gate_r11_normalized']})"
+        )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=65536)
+    ap.add_argument("--windows", type=int, default=1)
+    ap.add_argument("--window-ticks", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--check-n", type=int, default=4096)
+    ap.add_argument("--check-ticks", type=int, default=6)
+    ap.add_argument("--pallas-check-n", type=int, default=1024)
+    ap.add_argument("--mega-n", type=int, default=1048576)
+    ap.add_argument("--profile-ticks", type=int, default=8)
+    ap.add_argument("--key-dtype", default="i16")
+    ap.add_argument("--skip-mega", action="store_true")
+    ap.add_argument("--skip-profile", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="matrix smoke: 3 reps, no mega point, no profile")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.quick:
+        args.reps = min(args.reps, 3)
+        args.skip_mega = True
+        args.skip_profile = True
+
+    from scalecube_cluster_tpu import compile_cache
+
+    cache_dir = compile_cache.enable_persistent_compile_cache()
+    if cache_dir:
+        log(f"persistent compile cache: {cache_dir}")
+
+    gate = bit_identity_gate(args.check_n, args.check_ticks,
+                             args.pallas_check_n, args.key_dtype)
+    if not gate["ok"]:
+        raise SystemExit(
+            "bit-identity gate FAILED — refusing to record a speedup for a "
+            f"trajectory-changing window: {gate}"
+        )
+
+    log(f"A/B: N={args.n}, {args.reps} x {args.windows} windows of "
+        f"{args.window_ticks} tick(s), interleaved unfused/fused")
+    ab = ab_throughput(args.n, args.windows, args.window_ticks, args.reps,
+                       args.key_dtype)
+
+    result = {
+        "config": 16,
+        "variant": "fused_windows_pallas_delivery",
+        "engine": "pview",
+        "backend": jax.default_backend(),
+        "key_dtype": args.key_dtype,
+        "n": args.n,
+        "bit_identity": gate,
+        **ab,
+    }
+    if not args.skip_profile:
+        result["profile"] = phase_profile(args.n, args.profile_ticks,
+                                          args.key_dtype)
+    if not args.skip_mega:
+        log(f"1M wall: N={args.mega_n}, warm donated 1-tick windows, "
+            f"both spellings")
+        result["mega"] = mega_wall(args.mega_n, args.key_dtype)
+
+    if args.out:
+        path = _p.Path(args.out)
+        if not path.is_absolute():
+            path = REPO / path
+        with open(path, "w") as fh:
+            json.dump({"result": result}, fh, indent=1)
+            fh.write("\n")
+        log(f"wrote {path}")
+    emit(result)
+
+
+if __name__ == "__main__":
+    main()

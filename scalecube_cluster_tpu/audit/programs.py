@@ -274,7 +274,7 @@ def build_engine_programs(
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
         "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
-        "fleet", "control",
+        "fleet", "control", "fused",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -402,6 +402,97 @@ def build_engine_programs(
                 abstract_args=(abs_fleet, keys_abs),
                 donated_argnums=(0,),
                 contracts=fleet_contracts,
+                budget_basis_bytes=s_fleet * state_bytes,
+                wide_threshold=capacity,
+            ))
+
+        if "fused" in want and eng.make_fused_run:
+            # r17: the fused-phase windows — adjacent tick phases share
+            # intermediates (pview: packed fd→suspicion/gossip→sweep
+            # hand-offs + the delivery combine; sparse: the gossip→sweep
+            # coverage hand-off; dense: the shared tail unpack). The fused
+            # program is a DIFFERENT jaxpr from the legacy window (that is
+            # the point), so it must independently prove the same
+            # contracts: full donation aliasing, transfer-freeness, no
+            # in-scan wide-plane materialization, pview's wide-value ban
+            # over the fused IR, and the engine memory budget.
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/fused",
+                engine=engine_name, variant="fused", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fused_run(params, n_ticks),
+                abstract_args=(abs_state, key_abs),
+                donated_argnums=(0,),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes,
+                wide_threshold=capacity,
+            ))
+
+        if (
+            kd == dtypes[0] and "fused" in want and engine_name == "pview"
+            and eng.make_fused_run
+        ):
+            # the Pallas-delivery arm of the pview fused window: on CPU the
+            # kernel traces in interpret mode (same kernel body as the TPU
+            # lowering), and the surrounding program must keep every
+            # contract — in particular forbid_wide_values over everything
+            # the kernel stages ([N, Wt] payload, [F, N] inverse indices;
+            # never two capacity dims)
+            pp = dataclasses.replace(params, delivery_kernel="pallas")
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/fused-pallas",
+                engine=engine_name, variant="fused", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fused_run(pp, n_ticks),
+                abstract_args=(abs_state, key_abs),
+                donated_argnums=(0,),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes,
+                wide_threshold=capacity,
+            ))
+
+        if (
+            kd == dtypes[0] and "fused" in want
+            and eng.make_fused_adaptive_run
+        ):
+            from ..adaptive import AdaptiveSpec, init_adaptive_state
+
+            ap = dataclasses.replace(
+                params, adaptive=AdaptiveSpec(enabled=True)
+            )
+            abs_ad = _abstract(init_adaptive_state(capacity))
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/fused-adaptive",
+                engine=engine_name, variant="fused", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fused_adaptive_run(ap, n_ticks),
+                abstract_args=(abs_state, abs_ad, key_abs),
+                donated_argnums=(0, 1),
+                contracts=contracts,
+                budget_basis_bytes=state_bytes + _tree_bytes(abs_ad),
+                wide_threshold=capacity,
+            ))
+
+        if kd == dtypes[0] and "fused" in want and eng.make_fused_fleet_run:
+            s_fleet = DEFAULT_FLEET_SCENARIOS
+            _assert_audit_shape(
+                f"{engine_name}/{kd}/fused-fleet", capacity,
+                {"fleet_scenarios": s_fleet},
+            )
+            fleet_params = params
+            if hasattr(params, "quiet_gates"):
+                fleet_params = dataclasses.replace(params, quiet_gates=False)
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/fused-fleet",
+                engine=engine_name, variant="fused", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fused_fleet_run(fleet_params, n_ticks),
+                abstract_args=(
+                    _fleet_abstracts(abs_state, s_fleet),
+                    _fleet_abstracts(key_abs, s_fleet),
+                ),
+                donated_argnums=(0,),
+                contracts=_fleet_contracts(contracts),
                 budget_basis_bytes=s_fleet * state_bytes,
                 wide_threshold=capacity,
             ))

@@ -152,6 +152,19 @@ class EngineOps:
     #: and its adaptive twin ((state, ad) donated, argnums (0, 1))
     make_fleet_run: Optional[Callable] = None
     make_fleet_adaptive_run: Optional[Callable] = None
+    #: r17 fused tick windows ((params, n_ticks, donate=True) -> jitted
+    #: donated window over the engine's FUSED tick — adjacent phases share
+    #: intermediates instead of re-deriving them; trajectories are
+    #: bit-identical to the unfused windows, pinned by tests/test_fused.py).
+    #: Every engine registers all three; the adaptive twin refuses a
+    #: default spec (r13/r14 rule), the fleet twin batches scenarios.
+    make_fused_run: Optional[Callable] = None
+    make_fused_adaptive_run: Optional[Callable] = None
+    make_fused_fleet_run: Optional[Callable] = None
+    #: r17 sharded adaptive window ((mesh, params, n_ticks) -> jitted
+    #: window, (state, adaptive_state) donated, both mesh-placed). None
+    #: keeps the r14 "adaptive is single-device" refusal for the engine.
+    make_sharded_adaptive_run: Optional[Callable] = None
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -257,6 +270,9 @@ def _dense_engine() -> EngineOps:
         make_adaptive_run=K.make_adaptive_run,
         make_fleet_run=K.make_fleet_run,
         make_fleet_adaptive_run=K.make_fleet_adaptive_run,
+        make_fused_run=K.make_fused_run,
+        make_fused_adaptive_run=K.make_fused_adaptive_run,
+        make_fused_fleet_run=K.make_fused_fleet_run,
     )
 
 
@@ -320,6 +336,9 @@ def _sparse_engine() -> EngineOps:
         make_adaptive_run=SP.make_sparse_adaptive_run,
         make_fleet_run=SP.make_sparse_fleet_run,
         make_fleet_adaptive_run=SP.make_sparse_fleet_adaptive_run,
+        make_fused_run=SP.make_sparse_fused_run,
+        make_fused_adaptive_run=SP.make_sparse_fused_adaptive_run,
+        make_fused_fleet_run=SP.make_sparse_fused_fleet_run,
     )
 
 
@@ -334,14 +353,34 @@ def _pview_engine() -> EngineOps:
             )
         return PV.init_pview_state(p, n, warm=warm)
 
+    def _sharded(mesh, params, n_ticks, dense_links):
+        from .sharding import make_sharded_pview_run
+
+        return make_sharded_pview_run(mesh, params, n_ticks)
+
+    def _sharded_adaptive(mesh, params, n_ticks):
+        from .sharding import make_sharded_pview_adaptive_run
+
+        return make_sharded_pview_adaptive_run(mesh, params, n_ticks)
+
+    def _shard_state(state, mesh):
+        from .sharding import shard_pview_state
+
+        return shard_pview_state(state, mesh)
+
+    def _shardings(mesh, dense_links, delay_slots):
+        from .sharding import pview_state_shardings
+
+        return pview_state_shardings(mesh, dense_links, delay_slots)
+
     return EngineOps(
         name="pview",
         ops=PV,
         init_state=_init,
         make_run=PV.make_pview_run,
         make_traced_run=PV.make_pview_traced_run,
-        make_sharded_run=None,
-        shard_state=None,
+        make_sharded_run=_sharded,
+        shard_state=_shard_state,
         telemetry_series=tuple(PV.TELEMETRY_SERIES),
         telemetry_window_vector=PV.telemetry_window_vector,
         sentinel_init=PV.sentinel_init,
@@ -353,7 +392,7 @@ def _pview_engine() -> EngineOps:
         key_plane=lambda state: state.nbr_key,
         pool_slots=lambda params: params.mr_pool,
         dense_links_default=False,
-        supports_mesh=False,
+        supports_mesh=True,
         has_pool=True,
         # forbid_wide_values IS the engine: no value of any kind in the
         # closed jaxpr may carry two capacity-scaled dims (the r11 O(N·k)
@@ -375,9 +414,14 @@ def _pview_engine() -> EngineOps:
                 ("accelerated", "expander"), ("push_pull", "ring"),
             ),
         ),
+        state_shardings=_shardings,
         make_adaptive_run=PV.make_pview_adaptive_run,
         make_fleet_run=PV.make_pview_fleet_run,
         make_fleet_adaptive_run=PV.make_pview_fleet_adaptive_run,
+        make_fused_run=PV.make_pview_fused_run,
+        make_fused_adaptive_run=PV.make_pview_fused_adaptive_run,
+        make_fused_fleet_run=PV.make_pview_fused_fleet_run,
+        make_sharded_adaptive_run=_sharded_adaptive,
     )
 
 

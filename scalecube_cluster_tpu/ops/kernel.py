@@ -1005,7 +1005,8 @@ def _refute_phase(
     return st
 
 
-def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
+def _rumor_sweep(state: SimState, params: SimParams, *, inf_b=None,
+                 n_up=None) -> SimState:
     """Reclaim rumor slots. The reference sweeps per NODE: each holds a
     gossip for its own sweep window after ARRIVAL (getGossipsToRemove
     :350-358). The global slot therefore stays live while (a) the creation
@@ -1013,8 +1014,14 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
     up receiver is still inside its own forwarding window (a late receiver
     must get to spread what it just learned — GossipDelayTest.java:33-70's
     late node still disseminates). Lifetime stays bounded: once everyone
-    reachable is infected, the last infection + spread ends it."""
-    n_up = state.up.sum().astype(jnp.int32)
+    reachable is infected, the last infection + spread ends it.
+
+    ``inf_b`` / ``n_up`` (r17, fused tick only): the unpacked infection
+    plane and up-count the fused tail computes ONCE and shares with
+    ``state_metrics`` (neither input is written between the two reads).
+    ``None`` keeps the legacy per-phase spelling — byte-identical default
+    program."""
+    n_up = (state.up.sum() if n_up is None else n_up).astype(jnp.int32)
     sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
     keep = state.tick - state.rumor_created <= sweep
     if _packed(params):
@@ -1022,8 +1029,10 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
     else:
         sizes = _cluster_size(state)
     spread = params.repeat_mult * ceil_log2(sizes)  # [N]
+    if inf_b is None:
+        inf_b = bp.unpack_bits(state.infected, params.rumor_slots)
     forwarding = (
-        bp.unpack_bits(state.infected, params.rumor_slots)
+        inf_b
         & state.up[:, None]
         & (state.tick - state.infected_at < spread[:, None])
     ).any(axis=0)
@@ -1039,7 +1048,8 @@ def _rumor_sweep(state: SimState, params: SimParams) -> SimState:
 
 
 def tick(
-    state: SimState, key: jax.Array, params: SimParams, trace=None, ad=None
+    state: SimState, key: jax.Array, params: SimParams, trace=None, ad=None,
+    fused: bool = False,
 ) -> tuple[SimState, dict[str, Any]]:
     """Advance the whole cluster by one gossip period. Pure; jit/shard me.
 
@@ -1055,8 +1065,21 @@ def tick(
     ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) arms the adaptive
     failure-detection plane; the return becomes ``(state, ad', metrics)``.
     ``ad=None`` (the default) traces the byte-identical legacy program —
-    no adaptive op, branch, or state exists in the jaxpr then."""
+    no adaptive op, branch, or state exists in the jaxpr then.
+
+    ``fused`` (r17): the tick-tail fusion — the rumor sweep's forwarding
+    reduce and the telemetry block share ONE unpack of the packed
+    infection plane and one up-count instead of re-deriving them per
+    phase. The dense tick's phases otherwise genuinely depend on each
+    other's writes (SYNC rewrites ``view_key``, which sizes/forwarding
+    read), so the dense fusion is the tail hand-off only; bit-identical
+    trajectory (tests), ``fused=False`` traces the legacy program."""
     armed = ad is not None
+    if fused and trace is not None:
+        raise ValueError(
+            "the fused tick has no trace plane — profile/trace the "
+            "unfused tick (bit-identical trajectory)"
+        )
     if armed:
         if trace is not None:
             raise ValueError(
@@ -1122,7 +1145,13 @@ def tick(
         )
     else:
         state = _refute_phase(state, quiet_gates=params.quiet_gates)
-    state = _rumor_sweep(state, params)
+    if fused:
+        inf_b = bp.unpack_bits(state.infected, params.rumor_slots)
+        n_up = state.up.sum()
+        state = _rumor_sweep(state, params, inf_b=inf_b, n_up=n_up)
+    else:
+        inf_b = n_up = None
+        state = _rumor_sweep(state, params)
 
     trace_fd = fd_m.pop("trace_fd", None)
     trace_sync = s_m.pop("trace_sync", None)
@@ -1140,7 +1169,10 @@ def tick(
             miss=miss, succ=succ, refuted=refuted, up=state.up,
         )
         ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
-    metrics = {**fd_m, **g_m, **s_m, **state_metrics(state, params)}
+    metrics = {
+        **fd_m, **g_m, **s_m,
+        **state_metrics(state, params, inf_b=inf_b, n_up=n_up),
+    }
     if armed:
         metrics["adaptive_lh_high"] = ad.lh.max()
         metrics["adaptive_conf_high"] = ad.conf.max()
@@ -1165,10 +1197,16 @@ def tick(
     return state, metrics
 
 
-def state_metrics(state: SimState, params: SimParams) -> dict[str, Any]:
+def state_metrics(state: SimState, params: SimParams, *, inf_b=None,
+                  n_up=None) -> dict[str, Any]:
     """The tick's state-derived health metrics — factored out (r10) so the
     phase-split profiler's "telemetry" phase runs the EXACT spelling the
-    fused tick uses (one source, no drift)."""
+    fused tick uses (one source, no drift).
+
+    ``inf_b`` / ``n_up`` (r17): fused-tail hand-off from
+    :func:`_rumor_sweep` — see its docstring; ``None`` = legacy."""
+    if n_up is None:
+        n_up = state.up.sum()
     if params.full_metrics:
         up2 = state.up[:, None] & state.up[None, :]
         off_diag = ~jnp.eye(state.capacity, dtype=bool)
@@ -1177,7 +1215,6 @@ def state_metrics(state: SimState, params: SimParams) -> dict[str, Any]:
             # word-parallel health reductions: pack the pair masks once,
             # count set bits with integer popcounts (no [N, N] i32 reduce,
             # no float promotion — same integers as the bool sums)
-            n_up = state.up.sum()
             pairs = jnp.maximum(n_up * n_up - n_up, 1)
             base = up2 & off_diag
             alive_pairs = bp.popcount_total(bp.pack_bits(base & (rank == RANK_ALIVE)))
@@ -1192,10 +1229,11 @@ def state_metrics(state: SimState, params: SimParams) -> dict[str, Any]:
     else:  # static lite mode: skip the [N, N] health passes
         alive_frac = jnp.float32(0.0)
         false_suspects = jnp.int32(0)
-    inf_b = bp.unpack_bits(state.infected, params.rumor_slots)
+    if inf_b is None:
+        inf_b = bp.unpack_bits(state.infected, params.rumor_slots)
     coverage = (
         (inf_b & state.up[:, None]).sum(0).astype(jnp.float32)
-        / jnp.maximum(state.up.sum(), 1)
+        / jnp.maximum(n_up, 1)
     )
     # Gossip segmentation (the reference warns when a receiver's
     # SequenceIdCollector fragments past a threshold,
@@ -1217,7 +1255,7 @@ def state_metrics(state: SimState, params: SimParams) -> dict[str, Any]:
         .max()
     )
     return {
-        "n_up": state.up.sum(),
+        "n_up": n_up,
         "alive_view_fraction": alive_frac,
         "false_suspect_pairs": false_suspects,
         "rumor_coverage": coverage,  # [R]
@@ -1231,6 +1269,7 @@ def run_ticks(
     n_ticks: int,
     params: SimParams,
     watch_rows: jax.Array | None = None,
+    fused: bool = False,
 ) -> tuple[SimState, jax.Array, dict[str, Any], jax.Array | None]:
     """Advance ``n_ticks`` gossip periods in ONE XLA call (``lax.scan``).
 
@@ -1255,7 +1294,7 @@ def run_ticks(
     def body(carry, _):
         st, k = carry
         k, tick_key = jax.random.split(k)
-        st, m = tick(st, tick_key, params)
+        st, m = tick(st, tick_key, params, fused=fused)
         if watch_rows is not None:
             m = dict(m, _watched_keys=st.view_key[watch_rows])
         return (st, k), m
@@ -1494,6 +1533,7 @@ def run_ticks_adaptive(
     n_ticks: int,
     params: SimParams,
     watch_rows: jax.Array | None = None,
+    fused: bool = False,
 ):
     """Adaptive-armed :func:`run_ticks` (r14): the window scan threads the
     :class:`..adaptive.AdaptiveState` through the carry alongside the
@@ -1502,7 +1542,7 @@ def run_ticks_adaptive(
     def body(carry, _):
         st, a, k = carry
         k, tick_key = jax.random.split(k)
-        st, a, m = tick(st, tick_key, params, ad=a)
+        st, a, m = tick(st, tick_key, params, ad=a, fused=fused)
         if watch_rows is not None:
             m = dict(m, _watched_keys=st.view_key[watch_rows])
         return (st, a, k), m
@@ -1579,3 +1619,61 @@ def make_run(params: SimParams, n_ticks: int, donate: bool = True):
         partial(run_ticks, n_ticks=n_ticks, params=params),
         donate_argnums=0 if donate else (),
     )
+
+
+# --------------------------------------------------------------------------
+# fused tick windows (r17): the dense tail hand-off (shared infection-plane
+# unpack + up-count between sweep and telemetry) as first-class window
+# builders. Named wrappers (not lambdas/partials) so the fleet batcher and
+# the audit plane can introspect them.
+# --------------------------------------------------------------------------
+
+
+def run_ticks_fused(state, key, n_ticks, params, watch_rows=None):
+    """:func:`run_ticks` over the fused tick (bit-identical trajectory)."""
+    return run_ticks(state, key, n_ticks, params, watch_rows, fused=True)
+
+
+def run_ticks_fused_adaptive(state, ad, key, n_ticks, params, watch_rows=None):
+    """:func:`run_ticks_adaptive` over the fused tick."""
+    return run_ticks_adaptive(
+        state, ad, key, n_ticks, params, watch_rows, fused=True
+    )
+
+
+def make_fused_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Jitted fused-tick window, state DONATED — the r17 twin of
+    :func:`make_run`. The trajectory is bit-identical to the unfused
+    window (tests/test_fused.py); only the program differs (one
+    infection-plane unpack + one up-count shared across sweep and
+    telemetry instead of per-phase re-derivation)."""
+    from functools import partial
+
+    return jax.jit(
+        partial(run_ticks_fused, n_ticks=n_ticks, params=params),
+        donate_argnums=0 if donate else (),
+    )
+
+
+def make_fused_adaptive_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Fused twin of :func:`make_adaptive_run` (donates argnums 0, 1).
+    Refuses a default spec, same r13/r14 rule."""
+    from functools import partial
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_fused_adaptive_run needs an enabled AdaptiveSpec on "
+            "params — the default spec's program is make_fused_run's"
+        )
+    return jax.jit(
+        partial(run_ticks_fused_adaptive, n_ticks=n_ticks, params=params),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_fused_fleet_run(params: SimParams, n_ticks: int, donate: bool = True):
+    """Fused twin of :func:`make_fleet_run`: scenario-batched fused-tick
+    window, fleet state donated."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(run_ticks_fused, params, n_ticks, donate=donate)

@@ -1,0 +1,158 @@
+"""Hand-written delivery combine for the pview fused gossip (r17).
+
+The pview delivery step gathers, per fanout slot, each receiver's
+inverse-elected sender's payload row (membership-rumor words + packed
+user-rumor bits + infected-from lanes) and folds the F slots into the
+receiver's accumulators: OR for the rumor planes, max for the source
+lanes, a global count for the send metric. The XLA spelling
+(:func:`delivery_combine_xla` — lifted verbatim from the unfused
+``_gossip_phase``) materializes the [F, N, Wt] gathered payload and the
+[F, N, R] deliver mask; the Pallas kernel (:func:`delivery_combine`)
+walks a row block per grid step, loads each row's F sender rows with
+dynamic slices, and folds in registers — the [F, N, *] intermediates
+never exist.
+
+On CPU the kernel runs in ``interpret=True`` mode, which executes the
+same kernel logic through XLA primitives — that is the tier-1
+certification story: interpret-mode output must be bit-equal to the XLA
+spelling (tests/test_fused.py), so the TPU lowering of the *same kernel
+body* computes the same function. Block shapes are TPU-lane friendly
+(row blocks x 32-bit words / rumor lanes); the payload is presented as
+one whole-array block, so at 1M members the TPU lowering wants the
+column split documented in docs/TPU_LAYOUT_NOTES.md.
+
+No [N, N] anywhere — everything is [N, Wt], [F, N], or [N, R]
+(``forbid_wide_values`` holds over the kernel-armed program too).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .bitplane import unpack_bits
+
+
+def delivery_combine_xla(payload, inv, rumor_origin, Wm: int, R: int):
+    """The unfused tick's exact delivery-combine primitive sequence.
+
+    Args:
+      payload: [N, Wt] uint32 — ``Wm`` membership-rumor words, ``Wu``
+        packed user-rumor words, then R infected-from lanes (i32 bits).
+      inv: [F, N] int32 — per-slot inverse sender index (< 0: no sender).
+      rumor_origin: [R] int32 rumor origin rows.
+      Wm, R: static word/lane counts.
+
+    Returns ``(u_or [N, R] bool, src_max [N, R] i32, m_or [N, Wm] u32,
+    cnt i32 scalar)`` — the receiver-side fold (zeros/-1 identities), to
+    be OR/max-folded into the pending-initialized accumulators.
+    """
+    F, n = inv.shape
+    rows = jnp.arange(n)
+    Wt = payload.shape[1]
+    Wu = Wt - Wm - R
+    j_all = jnp.maximum(inv, 0)
+    has_all = (inv >= 0)[:, :, None]
+    pl_all = payload[j_all]
+    yu_all = unpack_bits(pl_all[:, :, Wm : Wm + Wu], R)
+    from_all = pl_all[:, :, Wm + Wu :].astype(jnp.int32)
+    deliver_u_all = (
+        yu_all
+        & has_all
+        & (from_all != rows[None, :, None])
+        & (rumor_origin[None, None, :] != rows[None, :, None])
+    )
+    u_or = deliver_u_all.any(axis=0)
+    src_max = jnp.where(deliver_u_all, j_all[:, :, None], -1).max(axis=0)
+    m_or = functools.reduce(
+        jnp.bitwise_or,
+        [jnp.where(has_all[s], pl_all[s, :, :Wm], jnp.uint32(0)) for s in range(F)],
+        jnp.zeros((n, Wm), jnp.uint32),
+    )
+    cnt = deliver_u_all.sum()
+    return u_or, src_max, m_or, cnt
+
+
+def _delivery_kernel(F: int, Wm: int, Wu: int, R: int, BR: int,
+                     origin_ref, inv_ref, payload_ref,
+                     u_ref, src_ref, m_ref, cnt_ref):
+    """Per-block body: fold F sender rows into each of BR receiver rows.
+
+    Refs: origin [1, R] (replicated), inv [F, BR] (column block),
+    payload [N, Wt] (whole array), outputs [BR, R]/[BR, R]/[BR, Wm]/
+    [BR, 1] row blocks."""
+    blk = pl.program_id(0)
+    origin = origin_ref[0, :]
+
+    def row(i, _):
+        rid = blk * BR + i
+        u = jnp.zeros((R,), jnp.bool_)
+        src = jnp.full((R,), -1, jnp.int32)
+        mw = jnp.zeros((Wm,), jnp.uint32)
+        cnt = jnp.int32(0)
+        for f in range(F):
+            jv = inv_ref[f, i]
+            has = jv >= 0
+            jc = jnp.maximum(jv, 0)
+            row_pl = payload_ref[pl.ds(jc, 1), :][0]
+            ym = row_pl[:Wm]
+            yu = unpack_bits(row_pl[None, Wm : Wm + Wu], R)[0]
+            frm = row_pl[Wm + Wu :].astype(jnp.int32)
+            deliver = yu & has & (frm != rid) & (origin != rid)
+            u = u | deliver
+            src = jnp.maximum(src, jnp.where(deliver, jc, -1))
+            mw = mw | jnp.where(has, ym, jnp.uint32(0))
+            cnt = cnt + deliver.sum(dtype=jnp.int32)
+        u_ref[i, :] = u
+        src_ref[i, :] = src
+        m_ref[i, :] = mw
+        cnt_ref[i, 0] = cnt
+        return 0
+
+    jax.lax.fori_loop(0, BR, row, 0)
+
+
+def delivery_combine(payload, inv, rumor_origin, Wm: int, R: int, *,
+                     block_rows: int = 256, interpret: bool | None = None):
+    """Pallas spelling of :func:`delivery_combine_xla` — bit-equal
+    outputs (certified in tier-1 via ``interpret=True``; the equality IS
+    the CPU certification of the TPU kernel body).
+
+    Receivers are padded to a multiple of ``block_rows`` with no-sender
+    lanes (``inv = -1`` → every output identity) and sliced back."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    F, n = inv.shape
+    Wt = payload.shape[1]
+    Wu = Wt - Wm - R
+    BR = min(block_rows, n)
+    n_pad = -(-n // BR) * BR
+    if n_pad != n:
+        inv = jnp.pad(inv, ((0, 0), (0, n_pad - n)), constant_values=-1)
+    kernel = functools.partial(_delivery_kernel, F, Wm, Wu, R, BR)
+    u, src, mw, cnt = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BR,),
+        in_specs=[
+            pl.BlockSpec((1, R), lambda b: (0, 0)),
+            pl.BlockSpec((F, BR), lambda b: (0, b)),
+            pl.BlockSpec(payload.shape, lambda b: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BR, R), lambda b: (b, 0)),
+            pl.BlockSpec((BR, R), lambda b: (b, 0)),
+            pl.BlockSpec((BR, Wm), lambda b: (b, 0)),
+            pl.BlockSpec((BR, 1), lambda b: (b, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, R), jnp.bool_),
+            jax.ShapeDtypeStruct((n_pad, R), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad, Wm), jnp.uint32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(rumor_origin[None, :], inv, payload)
+    return u[:n], src[:n], mw[:n], cnt[:n, 0].sum()

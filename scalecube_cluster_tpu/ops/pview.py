@@ -203,6 +203,14 @@ class PviewParams:
     # plane via make_pview_adaptive_run. All adaptive state is three [N]
     # i32 planes — forbid_wide_values holds over adaptive windows too.
     adaptive: AdaptiveSpec = AdaptiveSpec()
+    # r17 fused-path delivery backend. Consulted ONLY by the fused tick
+    # (pview_tick_fused / make_pview_fused_run): "xla" keeps the gather +
+    # masked-OR combine as XLA ops, "pallas" routes the per-fanout-slot
+    # inverse-sender delivery through ops/pallas_delivery.py (interpreted
+    # on CPU, lowered on TPU). The LEGACY tick never reads this knob, so
+    # the default path traces the byte-identical legacy program under
+    # either value (r13/r14 default-off discipline).
+    delivery_kernel: str = "xla"
 
     def __post_init__(self):
         if not (0 < self.active_slots < self.view_slots):
@@ -212,6 +220,11 @@ class PviewParams:
                 f"k={self.view_slots}"
             )
         key_np_dtype(self.key_dtype)  # validates the spelling
+        if self.delivery_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                "delivery_kernel must be 'xla' or 'pallas': got "
+                f"{self.delivery_kernel!r}"
+            )
         if self.partition_groups < 3:
             raise ValueError(
                 "partition_groups must be >= 3 (cell 0 is the unpartitioned "
@@ -978,9 +991,17 @@ def _register_sus(state: PviewState, sus_cand) -> PviewState:
 
 
 def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
-              ad=None):
+              ad=None, fused: bool = False):
     """Vectorized FD round over the active view — the sparse ``_fd_phase``
-    with slot-space target/relay selection and the self-record ACK."""
+    with slot-space target/relay selection and the self-record ACK.
+
+    ``fused=True`` (r17) additionally returns the POST-verdict i32-widened
+    key plane — the fd-verdict→suspicion-evidence hand-off: the fused
+    tick's maintenance sweep consumes it directly instead of re-widening
+    (and re-gathering) the [N, k] ``nbr_key`` plane it just wrote. The
+    hand-off value round-trips the verdict through the storage dtype
+    (``cand → kdt → i32``), so it is bit-identical to what a re-widen of
+    the written plane would read."""
     n = state.capacity
     rows = jnp.arange(n)
     ka = params.active_slots
@@ -1063,18 +1084,27 @@ def _fd_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
             "relay_valid": relay_valid,
             "relay_ok": relay_ok,
         }
+    if fused:
+        cand_rt = cand.astype(kdt).astype(jnp.int32)
+        keys_after = jnp.where(onehot, cand_rt[:, None], keys)
+        return st, proposals, metrics, keys_after
     return st, proposals, metrics
 
 
 def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None,
-                       ad=None):
+                       ad=None, keys_i32=None):
     """Every ``sweep_every`` ticks: (1) suspicion-episode expiry over the
     [N, k] tables + the self records (sparse deviation 1 semantics, static
     timeout — deviation P2), with per-subject announcer election; (2) the
     TOMBSTONE PURGE (deviation P8) every ``purge_sweeps``-th sweep; (3)
     the ACTIVE-VIEW PROMOTION sweep — each empty/DEAD active slot swaps in
     the best (max-key) live passive entry, ascending active slots first.
-    The promotion is the HyParView active-view repair, made deterministic."""
+    The promotion is the HyParView active-view repair, made deterministic.
+
+    ``keys_i32`` (r17, fused path only): the i32-widened [N, k] key plane
+    handed over by the FD phase — the expiry pass reads it instead of
+    re-widening ``nbr_key`` (the purge/promotion passes re-read the plane
+    they just rewrote, as before)."""
     n = state.capacity
     rows = jnp.arange(n)
     k = state.nbr_id.shape[1]
@@ -1088,7 +1118,7 @@ def _maintenance_sweep(state: PviewState, params: PviewParams, trace=None,
     )
 
     def _expire(st: PviewState):
-        keys = _keys_i32(st)
+        keys = _keys_i32(st) if keys_i32 is None else keys_i32
         sid = st.nbr_id
         sidc = jnp.maximum(sid, 0)
         is_sus = (keys & 3) == RANK_SUSPECT
@@ -1633,12 +1663,18 @@ def _merge_entries(
 
 
 def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
-                adaptive: bool = False):
+                adaptive: bool = False, fused: bool = False):
     """Anti-entropy + shuffle: a due caller exchanges its table (plus self
     record) with one sampled active peer — both directions merge the
     other's PRE-exchange entries (deviation P4); multiple callers on one
     peer collapse to the highest slot (deviation P6). The passive-slot
-    insertions this merge performs ARE the HyParView shuffle refresh."""
+    insertions this merge performs ARE the HyParView shuffle refresh.
+
+    ``fused=True`` (r17) routes both direction merges through
+    :func:`_merge_entries_compact` — the k + 1 accept-and-place steps run
+    over the ≤ K participating rows (there are at most K ok callers, so
+    at most K REQ receivers and K ACK receivers) instead of all N.
+    Bit-identical; see the compact merge's docstring for the argument."""
     n = state.capacity
     rows = jnp.arange(n)
     P = params.sync_announce
@@ -1721,13 +1757,18 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
         .max(jnp.where(ok, jnp.arange(K, dtype=jnp.int32), -1))
     )
     req_src = jnp.where(inv_slot >= 0, caller[jnp.maximum(inv_slot, 0)], -1)
+    merge = (
+        functools.partial(_merge_entries_compact, K=K)
+        if fused
+        else _merge_entries
+    )
     if adaptive:
-        st, req_acc_n, req_subj, req_key, req_adc, req_adk = _merge_entries(
+        st, req_acc_n, req_subj, req_key, req_adc, req_adk = merge(
             state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params,
             adaptive=True,
         )
     else:
-        st, req_acc_n, req_subj, req_key = _merge_entries(
+        st, req_acc_n, req_subj, req_key = merge(
             state, req_src, pre_id, pre_key, pre_self, SALT_SYNC_REQ, params
         )
     # ACK direction: distinct callers each merge their peer's pre-entries
@@ -1737,12 +1778,12 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
         .max(jnp.where(ok, peer, -1))
     )
     if adaptive:
-        st, ack_acc_n, ack_subj, ack_key, ack_adc, ack_adk = _merge_entries(
+        st, ack_acc_n, ack_subj, ack_key, ack_adc, ack_adk = merge(
             st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params,
             adaptive=True,
         )
     else:
-        st, ack_acc_n, ack_subj, ack_key = _merge_entries(
+        st, ack_acc_n, ack_subj, ack_key = merge(
             st, ack_src, pre_id, pre_key, pre_self, SALT_SYNC_ACK, params
         )
 
@@ -1825,6 +1866,582 @@ def _rumor_sweeps(state: PviewState, params: PviewParams) -> PviewState:
         forwarding_m = (
             (age > 0) & (age <= spread) & state.up[:, None]
         ).any(axis=0)
+        keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
+        pending_m = (
+            state.pending_minf.any(axis=(0, 1))
+            if params.delay_slots
+            else jnp.zeros_like(keep_m)
+        )
+        keep_m = keep_m | pending_m
+        if params.early_free:
+            covered = (
+                (state.minf_age > 0)
+                | ~state.up[:, None]
+                | (state.joined_at[:, None] > state.mr_created[None, :])
+            ).all(axis=0)
+            keep_m = keep_m & ~(covered & ~pending_m)
+        keep_m = keep_m & state.mr_active
+        freed = state.mr_active & ~keep_m
+        state = state.replace(
+            mr_active=keep_m,
+            mr_subject=jnp.where(freed, -1, state.mr_subject),
+            minf_age=jnp.where(freed[None, :], jnp.uint8(0), state.minf_age),
+        )
+        if params.delay_slots:
+            state = state.replace(
+                pending_minf=state.pending_minf & keep_m[None, None, :]
+            )
+        return state
+
+    return jax.lax.cond(state.mr_active.any(), _sweep_m, lambda st: st, state)
+
+
+# ---------------------------------------------------------------------------
+# fused tick path (r17) — profile-guided phase fusion
+#
+# The r10-style phase profile at N=65536 (trace/profile.py, recorded in
+# FUSED_BENCH_r17.json) puts ~75% of the pview tick in the gossip phase,
+# and inside it ~95% in the A-pass record apply: each pass argmaxes a
+# [N, M] bool `remaining` plane and clears the winner with a [N, M]
+# onehot — ~8 full-plane passes per tick over a plane whose information
+# content is the PACKED [N, M/32] word plane the delivery already
+# produced (`recv_m_p`). The fused path never unpacks it: each apply
+# pass extracts the lowest set bit per row with first-nonzero-word +
+# count-trailing-zeros + clear-lowest-bit (32x less traffic per pass,
+# identical column order, so the trajectory is bit-identical). The
+# second-tier costs fall to the same treatment: the SYNC merge scans run
+# their k+1 accept-and-place steps over the K≈N/sync_every participating
+# rows instead of all N (non-participants are provable no-ops), the FD
+# verdict hands its widened key plane to the maintenance sweep, and the
+# gossip phase hands the sweep tail a packed forwarding plane it gets for
+# free from the aging pass.
+# ---------------------------------------------------------------------------
+
+
+def _mr_apply_packed(state: PviewState, recv_m_p, zero_p, params: PviewParams,
+                     adaptive: bool):
+    """The fused twin of ``_gossip_phase._mr_apply``: A sequential apply
+    passes over the PACKED eligibility words. Per pass and per row, the
+    lowest still-eligible pool column is the lowest set bit of the first
+    non-zero word — extracted with ``v & -v`` + popcount and cleared with
+    ``v & (v - 1)`` — exactly the column the unfused argmax-and-clear
+    picks, so the record stream (and therefore the state trajectory) is
+    bit-identical while each pass touches [N, M/32] u32 words instead of
+    a [N, M] bool plane plus a [N, M] onehot write.
+
+    ``zero_p`` is ``pack_bits(minf_age == 0)`` of the POST-aging plane
+    (produced inside the fused ``_mr_pre`` while the plane is hot).
+    Returns the unfused branch's outputs plus the packed plane of bits
+    extracted this tick (for the sweep-tail hand-off)."""
+    from .bitplane import pack_bits as _pack_bits, popcount as _popcount
+
+    n = state.capacity
+    m = params.mr_pool
+    W = recv_m_p.shape[1]
+    rows = jnp.arange(n)
+    cols = jnp.arange(m)
+    ka = params.active_slots
+
+    # origin-row exclusion, packed: column c's bit lands in row
+    # mr_origin[c] (the legacy `mr_origin[None, :] != rows[:, None]`
+    # mask, built by one M-sized scatter instead of an [N, M] compare)
+    vo = (state.mr_origin >= 0) & (state.mr_origin < n)
+    excl_p = (
+        jnp.zeros((n + 1, W), jnp.uint32)
+        .at[jnp.where(vo, state.mr_origin, n), cols // 32]
+        .add(jnp.uint32(1) << (cols % 32).astype(jnp.uint32), mode="drop")[:n]
+    )
+    active_p = _pack_bits(state.mr_active[None, :])[0]
+    rem0 = recv_m_p & zero_p & ~excl_p & active_p[None, :]
+    rem0 = jnp.where(state.up[:, None], rem0, jnp.uint32(0))
+
+    def apply_pass(carry, _):
+        if adaptive:
+            st, minf, rem_p, sus_acc, adcnt, delivered, accepts = carry
+        else:
+            st, minf, rem_p, sus_acc, delivered, accepts = carry
+        nz = rem_p != 0
+        got = nz.any(axis=1)
+        w = jnp.argmax(nz, axis=1).astype(jnp.int32)
+        v = rem_p[rows, w]
+        lsb = v & (jnp.uint32(0) - v)
+        b = _popcount(lsb - jnp.uint32(1)).astype(jnp.int32)
+        col = jnp.where(got, w * 32 + b, 0)
+        rem_p = rem_p.at[rows, w].set(v & (v - jnp.uint32(1)))
+        subj = st.mr_subject[col]
+        cand = st.mr_key[col]
+        minf = minf.at[rows, col].max(
+            jnp.where(got, jnp.uint8(1), jnp.uint8(0))
+        )
+        st, acc, sus_cand = _apply_records(
+            st, subj, cand, got, SALT_GOSSIP, ka
+        )
+        sus_acc = jnp.maximum(sus_acc, sus_cand)
+        if adaptive:
+            acc_sus = acc & ((cand & 3) == RANK_SUSPECT)
+            adcnt = adcnt.at[jnp.where(acc_sus, subj, n)].add(
+                acc_sus.astype(jnp.int32), mode="drop"
+            )
+            return (
+                st, minf, rem_p, sus_acc, adcnt,
+                delivered + got.sum(), accepts + acc.sum(),
+            ), None
+        return (
+            st, minf, rem_p, sus_acc,
+            delivered + got.sum(), accepts + acc.sum(),
+        ), None
+
+    if adaptive:
+        carry0 = (
+            state, state.minf_age, rem0,
+            jnp.full((n,), NO_CANDIDATE, jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.int32(0), jnp.int32(0),
+        )
+        (state, minf, rem_f, sus_acc, adcnt, delivered, accepts), _ = (
+            jax.lax.scan(apply_pass, carry0, None, length=params.apply_slots)
+        )
+        state = _register_sus(state.replace(minf_age=minf), sus_acc)
+        return state, delivered, accepts, rem0 ^ rem_f, adcnt, sus_acc
+    carry0 = (
+        state, state.minf_age, rem0,
+        jnp.full((n,), NO_CANDIDATE, jnp.int32),
+        jnp.int32(0), jnp.int32(0),
+    )
+    (state, minf, rem_f, sus_acc, delivered, accepts), _ = jax.lax.scan(
+        apply_pass, carry0, None, length=params.apply_slots
+    )
+    state = _register_sus(state.replace(minf_age=minf), sus_acc)
+    return state, delivered, accepts, rem0 ^ rem_f
+
+
+def _gossip_phase_fused(state: PviewState, r, params: PviewParams,
+                        adaptive: bool = False):
+    """The fused spelling of :func:`_gossip_phase` — identical peer
+    selection, edge draws, and delivery semantics (the bit-identity tests
+    pin the whole trajectory), restructured so adjacent stages share
+    intermediates:
+
+    * ``_mr_pre`` also packs the post-aging ``minf_age == 0`` and
+      forwarding-window planes while the aged plane is hot;
+    * the per-fanout-slot inverse-sender delivery combine goes through
+      ``params.delivery_kernel`` ("xla" = the legacy primitive sequence,
+      "pallas" = :mod:`.pallas_delivery`, interpreted on CPU);
+    * the A-pass record apply runs on the packed words
+      (:func:`_mr_apply_packed`) instead of unpacking a [N, M] plane;
+    * returns the packed forwarding plane for the sweep tail, so the
+      rumor sweep never re-reads the [N, M] age plane.
+
+    Returns ``(state, metrics, fwd_post_p)``."""
+    n = state.capacity
+    m = params.mr_pool
+    rows = jnp.arange(n)
+    D = params.delay_slots
+    F = params.fanout
+    R = params.rumor_slots
+    spread = params.spread_ticks
+    W = (m + 31) // 32
+    from .bitplane import pack_bits as _pack_bits, unpack_bits as _unpack_bits
+
+    work = state.rumor_active.any() | state.mr_active.any()
+    if D:
+        slot_now = state.tick % D
+        work = (
+            work
+            | state.pending_inf[slot_now].any()
+            | state.pending_minf[slot_now].any()
+        )
+
+    def _deliver(state: PviewState):
+        mr_any = state.mr_active.any()
+        if D:
+            mr_any = mr_any | state.pending_minf[slot_now].any()
+        young_u = (
+            state.infected
+            & state.rumor_active[None, :]
+            & (state.tick - state.infected_at < spread)
+        )
+        spec = params.dissem
+        bmask = _dz.rumor_budget_mask(spec, young_u.shape[1], state.tick)
+        if bmask is not None:
+            young_u = young_u & bmask[None, :]
+
+        def _mr_pre(st: PviewState):
+            age = st.minf_age
+            age = jnp.where(
+                age > 0, jnp.minimum(age, jnp.uint8(254)) + jnp.uint8(1), age
+            )
+            young_m = (
+                (age > 0)
+                & st.mr_active[None, :]
+                & (age.astype(jnp.int32) <= spread)
+            )
+            fwd = (age > 0) & (age.astype(jnp.int32) <= spread)
+            return age, _pack_bits(young_m), _pack_bits(age == 0), _pack_bits(fwd)
+
+        def _mr_pre_skip(st: PviewState):
+            z = jnp.zeros((n, W), jnp.uint32)
+            return st.minf_age, z, z, z
+
+        age, ym_p, zero_p, fwd_p = jax.lax.cond(
+            mr_any, _mr_pre, _mr_pre_skip, state
+        )
+        state = state.replace(minf_age=age)
+        if spec.uniform_selection:
+            _slots, peers, peer_valid = _sample_slots(
+                state, rows, r.gossip_try, F, params.sample_tries,
+                params.active_slots,
+            )
+        else:
+            peers, peer_valid = _dz.structured_peers(
+                spec, n, state.tick,
+                _dz.try_stride_uniforms(r.gossip_try, params.sample_tries),
+            )
+
+        yu_p = _pack_bits(young_u)
+        Wm, Wu = ym_p.shape[1], yu_p.shape[1]
+        payload = jnp.concatenate(
+            [ym_p, yu_p, state.infected_from.astype(jnp.uint32)], axis=1
+        )
+        if D:
+            recv_u = state.pending_inf[slot_now]
+            recv_src = state.pending_src[slot_now]
+            recv_m_p = _pack_bits(state.pending_minf[slot_now])
+            pend_u = state.pending_inf
+            pend_src = state.pending_src
+            pend_m = state.pending_minf
+        else:
+            recv_u = jnp.zeros_like(state.infected)
+            recv_src = jnp.full_like(state.infected_from, -1)
+            recv_m_p = jnp.zeros_like(ym_p)
+
+        sender_has = young_u.any(axis=1) | (ym_p != 0).any(axis=1)
+        p_all = peers.T  # [F, N]
+        rows_b = jnp.broadcast_to(rows, (F, n))
+        ok_all = (
+            peer_valid.T
+            & sender_has[None, :]
+            & state.up[None, :]
+            & state.up[p_all]
+            & (r.gossip_edge.T < (1.0 - _loss_at(state, rows_b, p_all)))
+        )
+        sent = ok_all.sum()
+        if D:
+            qd = jnp.broadcast_to(state.delay_q, (F, n))
+            d_all = jnp.zeros((F, n), jnp.int32)
+            qpow = qd
+            for _ in range(1, D):
+                d_all = d_all + (r.gossip_delay.T < qpow)
+                qpow = qpow * qd
+            ok_now_all = ok_all & (d_all == 0)
+        else:
+            ok_now_all = ok_all
+        inv = (
+            jnp.full((F, n), -1, jnp.int32)
+            .at[jnp.arange(F)[:, None], p_all]
+            .max(jnp.where(ok_now_all, rows[None, :], -1))
+        )
+        from .pallas_delivery import delivery_combine, delivery_combine_xla
+
+        if params.delivery_kernel == "pallas":
+            u_or, src_max, m_or, cnt = delivery_combine(
+                payload, inv, state.rumor_origin, Wm, R
+            )
+        else:
+            u_or, src_max, m_or, cnt = delivery_combine_xla(
+                payload, inv, state.rumor_origin, Wm, R
+            )
+        recv_u = recv_u | u_or
+        recv_src = jnp.maximum(recv_src, src_max)
+        recv_m_p = recv_m_p | m_or
+        rumor_sent = cnt
+        if spec.wants_pull:
+            for s in range(F):
+                p_s = p_all[s]
+                rev_u = fetch_uniform(state.tick, _dz.pull_salt(s), rows, p_s)
+                rev_ok = ok_now_all[s] & (
+                    rev_u < (1.0 - _loss_at(state, p_s, rows))
+                )
+                pl_rev = payload[p_s]
+                yu_rev = _unpack_bits(pl_rev[:, Wm : Wm + Wu], R)
+                from_rev = pl_rev[:, Wm + Wu :].astype(jnp.int32)
+                reply_u = (
+                    yu_rev
+                    & rev_ok[:, None]
+                    & (from_rev != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                recv_u = recv_u | reply_u
+                recv_src = jnp.maximum(
+                    recv_src, jnp.where(reply_u, p_s[:, None], -1)
+                )
+                recv_m_p = recv_m_p | jnp.where(
+                    rev_ok[:, None], pl_rev[:, :Wm], jnp.uint32(0)
+                )
+                sent = sent + rev_ok.sum()
+                rumor_sent = rumor_sent + reply_u.sum()
+        if D:
+            no_sender = jnp.full((n,), -1, jnp.int32)
+            for s in range(F):
+                ok_late = ok_all[s] & (d_all[s] > 0)
+                inv_l = no_sender.at[p_all[s]].max(jnp.where(ok_late, rows, -1))
+                jl = jnp.maximum(inv_l, 0)
+                hasl = (inv_l >= 0)[:, None]
+                pll = payload[jl]
+                young_u_l = _unpack_bits(pll[:, Wm : Wm + Wu], R)
+                lfrom = pll[:, Wm + Wu :].astype(jnp.int32)
+                slot_d = (state.tick + d_all[s][jl]) % D
+                late_u = (
+                    young_u_l
+                    & hasl
+                    & (lfrom != rows[:, None])
+                    & (state.rumor_origin[None, :] != rows[:, None])
+                )
+                pend_u = pend_u.at[slot_d, rows].max(late_u)
+                pend_src = pend_src.at[slot_d, rows].max(
+                    jnp.where(late_u, jl[:, None], -1)
+                )
+                pend_m = pend_m.at[slot_d, rows].max(
+                    _unpack_bits(pll[:, :Wm], m)
+                    & hasl
+                    & (state.mr_origin[None, :] != rows[:, None])
+                )
+
+        newly_u = recv_u & ~state.infected & state.up[:, None] & state.rumor_active[None, :]
+        state = state.replace(
+            infected=state.infected | newly_u,
+            infected_at=jnp.where(newly_u, state.tick, state.infected_at),
+            infected_from=jnp.where(newly_u, recv_src, state.infected_from),
+        )
+
+        def _mr_apply(st: PviewState):
+            out = _mr_apply_packed(st, recv_m_p, zero_p, params, adaptive)
+            if adaptive:
+                st, delivered, accepts, extracted, adcnt, sus_acc = out
+                return st, delivered, accepts, fwd_p | extracted, adcnt, sus_acc
+            st, delivered, accepts, extracted = out
+            return st, delivered, accepts, fwd_p | extracted
+
+        if adaptive:
+            def _mr_skip(st: PviewState):
+                return (
+                    st, jnp.int32(0), jnp.int32(0), fwd_p,
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.full((n,), NO_CANDIDATE, jnp.int32),
+                )
+
+            state, n_mr_deliveries, n_mr_accepts, fwd_post_p, g_ad_cnt, g_ad_key = (
+                jax.lax.cond(mr_any, _mr_apply, _mr_skip, state)
+            )
+        else:
+            state, n_mr_deliveries, n_mr_accepts, fwd_post_p = jax.lax.cond(
+                mr_any, _mr_apply,
+                lambda st: (st, jnp.int32(0), jnp.int32(0), fwd_p),
+                state,
+            )
+        if D:
+            state = state.replace(
+                pending_inf=pend_u.at[slot_now].set(False),
+                pending_src=pend_src.at[slot_now].set(-1),
+                pending_minf=pend_m.at[slot_now].set(False),
+            )
+        mets = {
+            "gossip_msgs": sent,
+            "rumor_sends": rumor_sent,
+            "rumor_deliveries": newly_u.sum(),
+            "mr_deliveries": n_mr_deliveries,
+            "mr_accepts": n_mr_accepts,
+        }
+        if adaptive:
+            mets["_ad_cnt"] = g_ad_cnt
+            mets["_ad_key"] = g_ad_key
+        return state, mets, fwd_post_p
+
+    def _quiet(state: PviewState):
+        mets = {
+            "gossip_msgs": jnp.int32(0),
+            "rumor_sends": jnp.int32(0),
+            "rumor_deliveries": jnp.int32(0),
+            "mr_deliveries": jnp.int32(0),
+            "mr_accepts": jnp.int32(0),
+        }
+        if adaptive:
+            mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        return state, mets, jnp.zeros((n, W), jnp.uint32)
+
+    return jax.lax.cond(work, _deliver, _quiet, state)
+
+
+def _merge_entries_compact(
+    state: PviewState,
+    src_rows,
+    pre_id,
+    pre_key_i32,
+    pre_self,
+    salt: int,
+    params: PviewParams,
+    K: int,
+    adaptive: bool = False,
+):
+    """The fused twin of :func:`_merge_entries`: the k + 1 accept-and-place
+    steps run over the COMPACTED [K] participating rows (``src_rows >= 0``)
+    instead of all N. Non-participating rows are provable no-ops in the
+    unfused scan (``valid=False`` never writes state and contributes
+    NO_CANDIDATE everywhere), and at most K rows can participate by
+    construction (both SYNC direction maps are built from the K-compacted
+    caller list), so gathering the [K, k] sub-tables, scanning, and
+    scattering back is bit-identical at ~N/K times less work per step."""
+    n = state.capacity
+    kdt = _kdt(state)
+    P = params.sync_announce
+    ka = params.active_slots
+    k = pre_id.shape[1]
+    (pidx,) = jnp.nonzero(src_rows >= 0, size=K, fill_value=n)
+    ridx = jnp.minimum(pidx, n - 1)
+    has = (pidx < n) & (src_rows[ridx] >= 0)
+    src = jnp.maximum(src_rows[ridx], 0)
+    subj_steps = jnp.concatenate([pre_id[src].T, src[None, :]], axis=0)
+    cand_steps = jnp.concatenate(
+        [pre_key_i32[src].T, pre_self[src][None, :]], axis=0
+    )
+    sub_id0 = state.nbr_id[ridx]
+    sub_key0 = _keys_i32(state)[ridx]
+    sub_self0 = state.self_key[ridx]
+    karange = jnp.arange(K)
+    krange = jnp.arange(k)
+
+    def body(carry, xs):
+        if adaptive:
+            sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj, sus_acc, adcnt = carry
+        else:
+            sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj, sus_acc = carry
+        subj, cand = xs
+        valid = has & (subj >= 0)
+        subj_c = jnp.clip(subj, 0, n - 1)
+        to_self = valid & (subj == ridx)
+        to_tab = valid & ~to_self & (subj >= 0)
+        match = sub_id == subj[:, None]
+        present = (match & to_tab[:, None]).any(axis=1)
+        slot_p = jnp.argmax(match, axis=1).astype(jnp.int32)
+        own_tab = jnp.where(present, sub_key[karange, slot_p], UNKNOWN_KEY)
+        own = jnp.where(to_self, sub_self, own_tab)
+        needs_fetch = (cand & 3) == RANK_ALIVE
+        u = fetch_uniform(state.tick, salt, ridx, subj_c)
+        fetch_ok = ~needs_fetch | (
+            state.up[subj_c] & (u < _rt_at(state, ridx, subj_c))
+        )
+        accept = (
+            (to_self | to_tab)
+            & (cand > own)
+            & ((own >= 0) | ((cand & 3) <= RANK_LEAVING))
+            & fetch_ok
+        )
+        sub_self = jnp.where(accept & to_self, cand, sub_self)
+        acc_t = accept & to_tab
+        empty = sub_id < 0
+        has_empty = empty.any(axis=1)
+        slot_e = jnp.argmax(empty, axis=1).astype(jnp.int32)
+        p_keys = sub_key[:, ka:]
+        slot_v = (ka + jnp.argmin(p_keys, axis=1)).astype(jnp.int32)
+        slot_w = jnp.where(present, slot_p, jnp.where(has_empty, slot_e, slot_v))
+        onehot = acc_t[:, None] & (krange[None, :] == slot_w[:, None])
+        # round-trip through the storage dtype — the unfused scan narrows
+        # the accepted key into nbr_key and re-widens it next step
+        cand_rt = cand.astype(kdt).astype(jnp.int32)
+        sub_id = jnp.where(onehot, subj[:, None], sub_id)
+        sub_key = jnp.where(onehot, cand_rt[:, None], sub_key)
+        sus_in = jnp.where(
+            accept & ((cand & 3) == RANK_SUSPECT), cand, NO_CANDIDATE
+        )
+        sus_acc = sus_acc.at[jnp.where(accept, subj_c, n)].max(
+            sus_in, mode="drop"
+        )
+        if adaptive:
+            acc_sus = accept & ((cand & 3) == RANK_SUSPECT)
+            adcnt = adcnt.at[jnp.where(acc_sus, jnp.maximum(subj, 0), n)].add(
+                acc_sus.astype(jnp.int32), mode="drop"
+            )
+        acc_cnt = acc_cnt + accept.astype(jnp.int32)
+        ins_k = jnp.where(accept, cand, NO_CANDIDATE)
+        ins_s = subj
+        for p in range(P):
+            take = ins_k > best_key[:, p]
+            old_k, old_s = best_key[:, p], best_subj[:, p]
+            best_key = best_key.at[:, p].set(jnp.where(take, ins_k, old_k))
+            best_subj = best_subj.at[:, p].set(jnp.where(take, ins_s, old_s))
+            ins_k = jnp.where(take, old_k, ins_k)
+            ins_s = jnp.where(take, old_s, ins_s)
+        if adaptive:
+            return (sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj,
+                    sus_acc, adcnt), None
+        return (sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj,
+                sus_acc), None
+
+    carry0 = (
+        sub_id0,
+        sub_key0,
+        sub_self0,
+        jnp.zeros((K,), jnp.int32),
+        jnp.full((K, P), NO_CANDIDATE, jnp.int32),
+        jnp.zeros((K, P), jnp.int32),
+        jnp.full((n + 1,), NO_CANDIDATE, jnp.int32),
+    )
+    if adaptive:
+        carry0 = carry0 + (jnp.zeros((n,), jnp.int32),)
+        (sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj, sus_acc,
+         adcnt), _ = jax.lax.scan(body, carry0, (subj_steps, cand_steps))
+    else:
+        (sub_id, sub_key, sub_self, acc_cnt, best_key, best_subj,
+         sus_acc), _ = jax.lax.scan(body, carry0, (subj_steps, cand_steps))
+    state = state.replace(
+        nbr_id=state.nbr_id.at[pidx].set(sub_id, mode="drop"),
+        nbr_key=state.nbr_key.at[pidx].set(
+            sub_key.astype(kdt), mode="drop"
+        ),
+        self_key=state.self_key.at[pidx].set(sub_self, mode="drop"),
+    )
+    state = _register_sus(state, sus_acc[:n])
+    acc_full = jnp.zeros((n,), jnp.int32).at[pidx].set(acc_cnt, mode="drop")
+    subj_full = jnp.zeros((n, P), jnp.int32).at[pidx].set(
+        best_subj, mode="drop"
+    )
+    key_full = jnp.full((n, P), NO_CANDIDATE, jnp.int32).at[pidx].set(
+        best_key, mode="drop"
+    )
+    if adaptive:
+        return state, acc_full, subj_full, key_full, adcnt, sus_acc[:n]
+    return state, acc_full, subj_full, key_full
+
+
+def _rumor_sweeps_fused(state: PviewState, params: PviewParams,
+                        fwd_post_p) -> PviewState:
+    """The fused spelling of :func:`_rumor_sweeps`: the membership-rumor
+    forwarding reduction reads the PACKED forwarding plane the gossip
+    phase handed over (produced for free from its aging pass + the bits
+    its apply passes extracted) instead of re-reading the [N, M] u8 age
+    plane from the scan carry — same booleans, 1/8 the plane traffic."""
+    sweep = params.sweep_ticks
+    m = params.mr_pool
+    from .bitplane import unpack_bits as _unpack_bits
+
+    keep_u = state.tick - state.rumor_created <= sweep
+    forwarding_u = (
+        state.infected
+        & state.up[:, None]
+        & (state.tick - state.infected_at < params.spread_ticks)
+    ).any(axis=0)
+    keep_u = keep_u | forwarding_u
+    if params.delay_slots:
+        keep_u = keep_u | state.pending_inf.any(axis=(0, 1))
+    state = state.replace(rumor_active=state.rumor_active & keep_u)
+
+    def _sweep_m(state: PviewState):
+        fwd_up = jnp.where(state.up[:, None], fwd_post_p, jnp.uint32(0))
+        fwd_words = jax.lax.reduce(
+            fwd_up, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+        )
+        forwarding_m = _unpack_bits(fwd_words[None, :], m)[0]
         keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
         pending_m = (
             state.pending_minf.any(axis=(0, 1))
@@ -2170,6 +2787,177 @@ def make_pview_traced_run(params: PviewParams, n_ticks: int, trace, donate: bool
         ),
         donate_argnums=(0, 2) if donate else (),
     )
+
+
+def pview_tick_fused(state: PviewState, key: jax.Array, params: PviewParams,
+                     ad=None):
+    """The fused-phase spelling of :func:`pview_tick` (r17): same phase
+    ORDER and per-phase semantics — the bit-identity tests pin the whole
+    trajectory against the unfused tick — but adjacent phases hand each
+    other the intermediates the unfused tick re-derives:
+
+    * FD → maintenance: the post-verdict i32 key plane;
+    * gossip: packed A-pass apply + ``delivery_kernel`` combine
+      (:func:`_gossip_phase_fused`);
+    * SYNC: compacted K-row merges (:func:`_merge_entries_compact`);
+    * gossip → sweep: the packed forwarding plane.
+
+    No trace support (the r10 capture is a phase-boundary instrument —
+    profile the unfused tick instead). ``ad`` arms the adaptive plane as
+    in :func:`pview_tick`."""
+    armed = ad is not None
+    if armed and params.adaptive.is_default:
+        raise ValueError(
+            "adaptive tick needs an enabled AdaptiveSpec on params"
+        )
+    state = state.replace(tick=state.tick + 1)
+    fd_key, round_key = split_tick_key(key)
+    r = draw_sparse_round(round_key, state.capacity, params.fanout, params.sample_tries)
+
+    n = state.capacity
+    rows = jnp.arange(n)
+    no_props = (
+        jnp.zeros((n,), jnp.int32),
+        jnp.zeros((n,), jnp.int32),
+        rows,
+        jnp.zeros((n,), bool),
+    )
+
+    def _fd_on(st: PviewState):
+        fd_r = draw_sparse_fd(fd_key, n, params.ping_req_k, params.sample_tries)
+        return _fd_phase(st, fd_r, params, ad=ad, fused=True)
+
+    def _fd_off(st: PviewState):
+        m = {
+            "fd_probes": jnp.int32(0),
+            "fd_failed_probes": jnp.int32(0),
+            "fd_new_suspects": jnp.int32(0),
+        }
+        if armed:
+            m["_ad_miss"] = jnp.zeros((n,), bool)
+            m["_ad_succ"] = jnp.zeros((n,), bool)
+            m["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
+            m["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        # off-tick hand-off: nothing was written, so the plane the
+        # maintenance sweep would re-widen IS the current one
+        return st, no_props, m, _keys_i32(st)
+
+    fd_ran = (state.tick % params.fd_every) == 0
+    state, props_fd, fd_m, keys_h = jax.lax.cond(fd_ran, _fd_on, _fd_off, state)
+    state, props_exp = _maintenance_sweep(state, params, ad=ad, keys_i32=keys_h)
+    state, g_m, fwd_post_p = _gossip_phase_fused(state, r, params, adaptive=armed)
+    state, props_sync, s_m = _sync_phase(
+        state, r, params, adaptive=armed, fused=True
+    )
+    state, props_ref = _refute_phase(state, params)
+    state = _rumor_sweeps_fused(state, params, fwd_post_p)
+    state, a_m = _alloc_phase(
+        state, (props_fd, props_exp, props_ref, props_sync), params
+    )
+
+    if armed:
+        miss = fd_m.pop("_ad_miss")
+        succ = fd_m.pop("_ad_succ")
+        acc_cnt = fd_m.pop("_ad_cnt") + g_m.pop("_ad_cnt") + s_m.pop("_ad_cnt")
+        acc_key = jnp.maximum(
+            jnp.maximum(fd_m.pop("_ad_key"), g_m.pop("_ad_key")),
+            s_m.pop("_ad_key"),
+        )
+        lh2, ck2, cf2 = _adp.fold(
+            params.adaptive, ad.lh, ad.conf_key, ad.conf,
+            acc_key=acc_key, acc_cnt=acc_cnt,
+            miss=miss, succ=succ, refuted=props_ref[3], up=state.up,
+        )
+        ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
+    metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    if armed:
+        metrics["adaptive_lh_high"] = ad.lh.max()
+        metrics["adaptive_conf_high"] = ad.conf.max()
+        return state, ad, metrics
+    return state, metrics
+
+
+def run_pview_ticks_fused(
+    state: PviewState,
+    key: jax.Array,
+    n_ticks: int,
+    params: PviewParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Fused-window twin of :func:`run_pview_ticks` — same signature and
+    return contract, bit-identical trajectory."""
+
+    def body(carry, _):
+        st, k = carry
+        k, tick_key = jax.random.split(k)
+        st, m = pview_tick_fused(st, tick_key, params)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=view_rows(st, watch_rows))
+        return (st, k), m
+
+    (state, key), ms = jax.lax.scan(body, (state, key), None, length=n_ticks)
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, key, ms, watched
+
+
+def run_pview_ticks_fused_adaptive(
+    state: PviewState,
+    ad,
+    key: jax.Array,
+    n_ticks: int,
+    params: PviewParams,
+    watch_rows: jax.Array | None = None,
+):
+    """Adaptive-armed :func:`run_pview_ticks_fused`."""
+
+    def body(carry, _):
+        st, a, k = carry
+        k, tick_key = jax.random.split(k)
+        st, a, m = pview_tick_fused(st, tick_key, params, ad=a)
+        if watch_rows is not None:
+            m = dict(m, _watched_keys=view_rows(st, watch_rows))
+        return (st, a, k), m
+
+    (state, ad, key), ms = jax.lax.scan(
+        body, (state, ad, key), None, length=n_ticks
+    )
+    watched = ms.pop("_watched_keys") if watch_rows is not None else None
+    return state, ad, key, ms, watched
+
+
+def make_pview_fused_run(params: PviewParams, n_ticks: int, donate: bool = True):
+    """Jitted fused window, state donated — the drop-in fast spelling of
+    :func:`make_pview_run` (same signature, bit-identical trajectory)."""
+    return jax.jit(
+        functools.partial(run_pview_ticks_fused, n_ticks=n_ticks, params=params),
+        donate_argnums=0 if donate else (),
+    )
+
+
+def make_pview_fused_adaptive_run(params: PviewParams, n_ticks: int,
+                                  donate: bool = True):
+    """Fused twin of :func:`make_pview_adaptive_run` (argnums 0, 1
+    donated). Refuses a default spec."""
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_pview_fused_adaptive_run needs an enabled AdaptiveSpec on "
+            "params — the default spec's program is make_pview_fused_run's"
+        )
+    return jax.jit(
+        functools.partial(
+            run_pview_ticks_fused_adaptive, n_ticks=n_ticks, params=params
+        ),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_pview_fused_fleet_run(params: PviewParams, n_ticks: int,
+                               donate: bool = True):
+    """Fused twin of :func:`make_pview_fleet_run` — vmap over the fused
+    window; the wide-value ban holds over the fused fleet program too."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(run_pview_ticks_fused, params, n_ticks, donate=donate)
 
 
 # ---------------------------------------------------------------------------

@@ -227,6 +227,152 @@ def make_sharded_sparse_run(mesh: Mesh, params, n_ticks: int):
     return jax.jit(fn, donate_argnums=0)
 
 
+def pview_state_shardings(mesh: Mesh, dense_links: bool = False, delay_slots: int = 0):
+    """PviewState-shaped pytree of NamedShardings (r17): every [N, ...]
+    tensor row-sharded on the member axis; the [M]/[R] pool vectors, the
+    scalar link model, and the [G, G] partition-cell loss replicated;
+    [D, N, ...] pending rings sharded on dim 1. Same placement logic as
+    the sparse engine — the pview tick's cross-shard traffic is the
+    delivery gather (each receiver's elected senders' payload rows) and
+    the table-merge scatters, which GSPMD lowers to collectives; the
+    bounded pools need no communication at all. ``dense_links`` is
+    accepted for seam parity and must be falsy (pview has no [N, N] link
+    plane — by construction, ``forbid_wide_values``)."""
+    from .pview import PviewState
+
+    if dense_links:
+        raise ValueError(
+            "the pview engine has no [N, N] link plane (dense_links must "
+            "be False/None)"
+        )
+    row = NamedSharding(mesh, P(MEMBER_AXIS))
+    row2d = NamedSharding(mesh, P(MEMBER_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    ring = NamedSharding(mesh, P(None, MEMBER_AXIS, None)) if delay_slots else rep
+    return PviewState(
+        tick=rep,
+        up=row,
+        epoch=row,
+        joined_at=row,
+        self_key=row,
+        nbr_id=row2d,
+        nbr_key=row2d,
+        sus_key=row,
+        sus_since=row,
+        force_sync=row,
+        leaving=row,
+        mr_active=rep,
+        mr_subject=rep,
+        mr_key=rep,
+        mr_created=rep,
+        mr_origin=rep,
+        minf_age=row2d,
+        rumor_active=rep,
+        rumor_origin=rep,
+        rumor_created=rep,
+        infected=row2d,
+        infected_at=row2d,
+        infected_from=row2d,
+        loss=rep,
+        delay_q=rep,
+        part_id=row,
+        part_loss=rep,
+        pending_minf=ring,
+        pending_inf=ring,
+        pending_src=ring,
+    )
+
+
+def shard_pview_state(state, mesh: Mesh):
+    """Place an existing (host/single-device) pview state onto the mesh."""
+    return jax.device_put(
+        state,
+        pview_state_shardings(mesh, False, state.pending_minf.shape[0]),
+    )
+
+
+def _check_pview_word_alignment(mesh: Mesh, params) -> None:
+    """Pview-tick mesh preconditions: plain row divisibility always, and
+    the 32-row word rule in every mode — the pview tick packs member-axis
+    bit planes into u32 words unconditionally (the fd/suspicion masks,
+    the delivery payload's user-rumor words, the r17 fused tick's packed
+    membership-delivery planes), so row shards must stay word-aligned or
+    GSPMD pads the word axis and the packed sweeps regress into
+    per-phase all-gathers (the sparse builders' rule, applied to both
+    key layouts)."""
+    if params.capacity % mesh.size != 0:
+        raise ValueError(
+            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+        )
+    if params.capacity % (32 * mesh.size) != 0:
+        raise ValueError(
+            f"capacity {params.capacity} must be divisible by 32 * mesh size "
+            f"({32 * mesh.size}): the pview packed bit planes must align "
+            "with the row shards (pad capacity up and leave the extra rows "
+            "up=False — masks make padding free)"
+        )
+
+
+def make_sharded_pview_run(mesh: Mesh, params, n_ticks: int):
+    """jit the batched ``run_pview_ticks`` window over ``mesh`` (r17).
+
+    Input state must already be placed via :func:`shard_pview_state`;
+    GSPMD propagates the row sharding through the scan. The carried state
+    is donated like every window builder. The Pallas delivery kernel is
+    single-device-only for now — refuse it up front rather than letting
+    a whole-payload BlockSpec silently all-gather the table."""
+    _check_pview_word_alignment(mesh, params)
+    if getattr(params, "delivery_kernel", "xla") != "xla":
+        raise ValueError(
+            "delivery_kernel='pallas' is single-device for now — the "
+            "kernel's whole-payload block would all-gather the table "
+            "under GSPMD; use delivery_kernel='xla' on meshes"
+        )
+    from .pview import run_pview_ticks
+
+    return jax.jit(
+        partial(run_pview_ticks, n_ticks=n_ticks, params=params),
+        donate_argnums=0,
+    )
+
+
+def make_sharded_pview_adaptive_run(mesh: Mesh, params, n_ticks: int):
+    """Sharded adaptive pview window (r17 — the lift of the r14
+    "adaptive is single-device for now" refusal, for this engine): the
+    AdaptiveState's three [N] planes ride the donated carry row-sharded
+    like every other member-axis tensor (place them with
+    :func:`shard_adaptive_state`); argnums (0, 1) donated. Refuses a
+    default spec (the legacy sharded window is the byte-identical
+    program for that case)."""
+    _check_pview_word_alignment(mesh, params)
+    if getattr(params, "delivery_kernel", "xla") != "xla":
+        raise ValueError(
+            "delivery_kernel='pallas' is single-device for now — use "
+            "delivery_kernel='xla' on meshes"
+        )
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_sharded_pview_adaptive_run needs an enabled AdaptiveSpec "
+            "on params — the default spec's program is "
+            "make_sharded_pview_run's"
+        )
+    from .pview import run_pview_ticks_adaptive
+
+    return jax.jit(
+        partial(run_pview_ticks_adaptive, n_ticks=n_ticks, params=params),
+        donate_argnums=(0, 1),
+    )
+
+
+def shard_adaptive_state(ad, mesh: Mesh):
+    """Place an AdaptiveState onto the mesh: all three planes are [N]
+    member-axis tensors, so they row-shard like ``up``."""
+    from ..adaptive import AdaptiveState
+
+    row = NamedSharding(mesh, P(MEMBER_AXIS))
+    return jax.device_put(ad, AdaptiveState(lh=row, conf_key=row, conf=row))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated placement on ``mesh`` — the home of every telemetry
     tensor (the [ring_len, n_metrics] metric ring, its append vectors, the

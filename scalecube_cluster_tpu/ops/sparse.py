@@ -1247,12 +1247,21 @@ def _suspicion_sweep(state: SparseState, params: SparseParams, trace=None,
 
 
 def _gossip_phase(state: SparseState, r, params: SparseParams,
-                  adaptive: bool = False):
+                  adaptive: bool = False, fused: bool = False):
     """Infection-style dissemination of user rumors ([N, R], full fidelity)
     and membership rumors ([N, M], origin-filter — deviation 2). One message
     per (sender, peer) edge carries both payloads, as the reference's single
     GOSSIP_REQ does. Quiescent clusters (no active rumor, nothing pending)
-    skip the whole phase."""
+    skip the whole phase.
+
+    ``fused`` (r17): additionally returns the sweep's early-free coverage
+    vector ([M] bool) computed from THIS phase's post-apply pool planes —
+    the gossip→sweep hand-off. Valid because nothing between gossip and
+    the sweeps writes ``minf_age``/``up``/``joined_at``/``mr_created``
+    (sync/refute touch only ``n_live`` and the view planes), and the
+    sweep's [N, M] gate (``mr_active.any()``) implies this phase's
+    ``mr_any`` gate — whenever the sweep would need coverage, the fused
+    hand-off computed it under the live branch."""
     n = state.capacity
     m = params.mr_slots
     rows = jnp.arange(n)
@@ -1711,6 +1720,21 @@ def _gossip_phase(state: SparseState, r, params: SparseParams,
         if adaptive:
             mets["_ad_cnt"] = g_ad_cnt
             mets["_ad_key"] = g_ad_key
+        if fused:
+            if params.early_free:
+                covered = jax.lax.cond(
+                    mr_any,
+                    lambda st: (
+                        (st.minf_age > 0)
+                        | ~st.up[:, None]
+                        | (st.joined_at[:, None] > st.mr_created[None, :])
+                    ).all(axis=0),
+                    lambda st: jnp.zeros((m,), bool),
+                    state,
+                )
+            else:
+                covered = jnp.zeros((m,), bool)
+            return state, mets, covered
         return state, mets
 
     def _quiet(state: SparseState):
@@ -1724,6 +1748,10 @@ def _gossip_phase(state: SparseState, r, params: SparseParams,
         if adaptive:
             mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
             mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
+        if fused:
+            # work==False implies mr_active is all-false, so the sweep's
+            # [N, M] branch (the only coverage consumer) is skipped too
+            return state, mets, jnp.zeros((m,), bool)
         return state, mets
 
     return jax.lax.cond(work, _deliver, _quiet, state)
@@ -1994,11 +2022,17 @@ def _refute_phase(state: SparseState, params: SparseParams):
     return st, (rows, new_diag, rows, eff)
 
 
-def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
+def _rumor_sweeps(state: SparseState, params: SparseParams, *,
+                  covered=None, n_up=None) -> SparseState:
     """Slot reclamation. User rumors: dense-kernel semantics. Membership
     rumors: same age/forwarder/pending rules on the u8 plane, plus the
-    early full-coverage free (deviation 5)."""
-    n_up = state.up.sum().astype(jnp.int32)
+    early full-coverage free (deviation 5).
+
+    ``covered``/``n_up`` (r17, fused tick): pre-computed early-free
+    coverage ([M] bool, from the gossip phase's hand-off) and up-count —
+    bit-identical to the in-phase derivations (nothing in between writes
+    the planes they read); ``None`` traces the legacy spelling."""
+    n_up = (state.up.sum() if n_up is None else n_up).astype(jnp.int32)
     sweep = 2 * (params.repeat_mult * ceil_log2(n_up) + 1)
     spread = params.repeat_mult * ceil_log2(state.n_live)  # [N]
 
@@ -2040,12 +2074,16 @@ def _rumor_sweeps(state: SparseState, params: SparseParams) -> SparseState:
             # one-joiner-short, early-free never fires, and residency
             # degrades to the full age sweep — the measured r4
             # pool-saturation mechanism at N=49,152.
-            covered = (
-                (state.minf_age > 0)
-                | ~state.up[:, None]
-                | (state.joined_at[:, None] > state.mr_created[None, :])
-            ).all(axis=0)
-            keep_m = keep_m & ~(covered & ~pending_m)
+            cov = (
+                (
+                    (state.minf_age > 0)
+                    | ~state.up[:, None]
+                    | (state.joined_at[:, None] > state.mr_created[None, :])
+                ).all(axis=0)
+                if covered is None
+                else covered
+            )
+            keep_m = keep_m & ~(cov & ~pending_m)
         keep_m = keep_m & state.mr_active
         freed = state.mr_active & ~keep_m
         state = state.replace(
@@ -2160,7 +2198,7 @@ def _alloc_phase(state: SparseState, proposals, params: SparseParams):
 
 
 def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
-                trace=None, ad=None):
+                trace=None, ad=None, fused: bool = False):
     """One gossip period for all N members, sparse mode. Pure; jit/shard me.
 
     ``trace`` (a :class:`..trace.schema.TraceSpec`, static) arms the causal
@@ -2171,8 +2209,19 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
 
     ``ad`` (an :class:`..adaptive.AdaptiveState`, r14) arms the adaptive
     failure-detection plane; the return becomes ``(state, ad', metrics)``.
-    ``ad=None`` traces the byte-identical legacy program."""
+    ``ad=None`` traces the byte-identical legacy program.
+
+    ``fused`` (r17): the gossip→sweep hand-off — the sweep's early-free
+    coverage vector comes from the gossip phase's post-apply planes and
+    ONE up-count is shared between sweep and telemetry, instead of each
+    phase re-deriving them. Bit-identical trajectory (tests);
+    ``fused=False`` traces the legacy program."""
     armed = ad is not None
+    if fused and trace is not None:
+        raise ValueError(
+            "the fused tick has no trace plane — profile/trace the "
+            "unfused tick (bit-identical trajectory)"
+        )
     if armed:
         if trace is not None:
             raise ValueError(
@@ -2222,12 +2271,23 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
         state, props_exp, trace_sus = _suspicion_sweep(state, params, trace=trace)
     else:
         state, props_exp = _suspicion_sweep(state, params, ad=ad)
-    state, g_m = _gossip_phase(state, r, params, adaptive=armed)
+    if fused:
+        state, g_m, covered = _gossip_phase(
+            state, r, params, adaptive=armed, fused=True
+        )
+    else:
+        state, g_m = _gossip_phase(state, r, params, adaptive=armed)
+        covered = None
     state, props_sync, s_m = _sync_phase(
         state, r, params, trace=trace is not None, adaptive=armed
     )
     state, props_ref = _refute_phase(state, params)
-    state = _rumor_sweeps(state, params)
+    if fused:
+        n_up = state.up.sum()
+        state = _rumor_sweeps(state, params, covered=covered, n_up=n_up)
+    else:
+        n_up = None
+        state = _rumor_sweeps(state, params)
     # allocation compaction takes the first E valid proposals in this order:
     # refutations rank BEFORE the sync re-gossip flood (sync proposals are
     # mostly pool duplicates; a crowded-out refutation is a lingering zombie)
@@ -2251,7 +2311,10 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
             miss=miss, succ=succ, refuted=props_ref[3], up=state.up,
         )
         ad = _adp.AdaptiveState(lh=lh2, conf_key=ck2, conf=cf2)
-    metrics = {**fd_m, **g_m, **s_m, **a_m, **state_metrics(state, params)}
+    metrics = {
+        **fd_m, **g_m, **s_m, **a_m,
+        **state_metrics(state, params, n_up=n_up),
+    }
     if armed:
         metrics["adaptive_lh_high"] = ad.lh.max()
         metrics["adaptive_conf_high"] = ad.conf.max()
@@ -2278,14 +2341,20 @@ def sparse_tick(state: SparseState, key: jax.Array, params: SparseParams,
     return state, metrics
 
 
-def state_metrics(state: SparseState, params: SparseParams) -> dict:
+def state_metrics(state: SparseState, params: SparseParams, *,
+                  n_up=None) -> dict:
     """The sparse tick's state-derived health metrics — factored out (r10)
     so the phase-split profiler's "telemetry" phase runs the EXACT spelling
-    the fused tick uses (see ``kernel.state_metrics``)."""
+    the fused tick uses (see ``kernel.state_metrics``). ``n_up`` (r17):
+    pre-computed up-count from the fused tick (``up`` is not written
+    between the sweeps and here — the alloc phase touches only the rumor
+    pool); ``None`` re-derives it (legacy)."""
     n = state.capacity
+    if n_up is None:
+        n_up = state.up.sum()
     coverage = (
         (state.infected & state.up[:, None]).sum(0).astype(jnp.float32)
-        / jnp.maximum(state.up.sum(), 1)
+        / jnp.maximum(n_up, 1)
     )
     # segmentation over BOTH pools (user rumors + membership rumors): holes
     # in a node's receive stream — see kernel.tick's metric of the same name
@@ -2320,14 +2389,14 @@ def state_metrics(state: SparseState, params: SparseParams) -> dict:
         state,
     )
     metrics = {
-        "n_up": state.up.sum(),
+        "n_up": n_up,
         "mr_active_count": state.mr_active.sum(),
         "rumor_coverage": coverage,
         "gossip_segmentation": (seg_u + seg_m).max(),
     }
     if params.full_metrics:
         up2 = state.up[:, None] & state.up[None, :]
-        pairs = jnp.maximum(up2.sum() - state.up.sum(), 1)
+        pairs = jnp.maximum(up2.sum() - n_up, 1)
         off_diag = ~jnp.eye(n, dtype=bool)
         rank = state.view_key & 3
         metrics["alive_view_fraction"] = (
@@ -2346,6 +2415,7 @@ def run_sparse_ticks(
     n_ticks: int,
     params: SparseParams,
     watch_rows: jax.Array | None = None,
+    fused: bool = False,
 ):
     """Batched scan window — same contract as ``kernel.run_ticks`` (same
     per-tick key chain as host-side splitting; watched rows' view keys
@@ -2354,7 +2424,7 @@ def run_sparse_ticks(
     def body(carry, _):
         st, k = carry
         k, tick_key = jax.random.split(k)
-        st, m = sparse_tick(st, tick_key, params)
+        st, m = sparse_tick(st, tick_key, params, fused=fused)
         if watch_rows is not None:
             m = dict(m, _watched_keys=st.view_key[watch_rows])
         return (st, k), m
@@ -2419,6 +2489,7 @@ def run_sparse_ticks_adaptive(
     n_ticks: int,
     params: SparseParams,
     watch_rows: jax.Array | None = None,
+    fused: bool = False,
 ):
     """Adaptive-armed :func:`run_sparse_ticks` (r14): the AdaptiveState
     rides the scan carry alongside the engine state; same key chain."""
@@ -2426,7 +2497,7 @@ def run_sparse_ticks_adaptive(
     def body(carry, _):
         st, a, k = carry
         k, tick_key = jax.random.split(k)
-        st, a, m = sparse_tick(st, tick_key, params, ad=a)
+        st, a, m = sparse_tick(st, tick_key, params, ad=a, fused=fused)
         if watch_rows is not None:
             m = dict(m, _watched_keys=st.view_key[watch_rows])
         return (st, a, k), m
@@ -2500,4 +2571,72 @@ def make_sparse_run(params: SparseParams, n_ticks: int, donate: bool = True):
     return jax.jit(
         functools.partial(run_sparse_ticks, n_ticks=n_ticks, params=params),
         donate_argnums=0 if donate else (),
+    )
+
+
+# --------------------------------------------------------------------------
+# fused tick windows (r17): gossip→sweep coverage hand-off + shared
+# up-count as first-class window builders (see sparse_tick's ``fused``).
+# --------------------------------------------------------------------------
+
+
+def run_sparse_ticks_fused(state, key, n_ticks, params, watch_rows=None):
+    """:func:`run_sparse_ticks` over the fused tick (bit-identical
+    trajectory)."""
+    return run_sparse_ticks(state, key, n_ticks, params, watch_rows,
+                            fused=True)
+
+
+def run_sparse_ticks_fused_adaptive(state, ad, key, n_ticks, params,
+                                    watch_rows=None):
+    """:func:`run_sparse_ticks_adaptive` over the fused tick."""
+    return run_sparse_ticks_adaptive(state, ad, key, n_ticks, params,
+                                     watch_rows, fused=True)
+
+
+def make_sparse_fused_run(params: SparseParams, n_ticks: int,
+                          donate: bool = True):
+    """Jitted fused-tick window, state DONATED — the r17 twin of
+    :func:`make_sparse_run`. Bit-identical trajectory to the unfused
+    window (tests/test_fused.py); the program drops the sweep's own
+    [N, M] coverage reduce (it reuses the gossip phase's) and one
+    up-count."""
+    import functools
+
+    return jax.jit(
+        functools.partial(
+            run_sparse_ticks_fused, n_ticks=n_ticks, params=params
+        ),
+        donate_argnums=0 if donate else (),
+    )
+
+
+def make_sparse_fused_adaptive_run(params: SparseParams, n_ticks: int,
+                                   donate: bool = True):
+    """Fused twin of :func:`make_sparse_adaptive_run` (donates argnums
+    0, 1). Refuses a default spec."""
+    import functools
+
+    if params.adaptive.is_default:
+        raise ValueError(
+            "make_sparse_fused_adaptive_run needs an enabled AdaptiveSpec "
+            "on params — the default spec's program is "
+            "make_sparse_fused_run's"
+        )
+    return jax.jit(
+        functools.partial(
+            run_sparse_ticks_fused_adaptive, n_ticks=n_ticks, params=params
+        ),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def make_sparse_fused_fleet_run(params: SparseParams, n_ticks: int,
+                                donate: bool = True):
+    """Fused twin of :func:`make_sparse_fleet_run`: scenario-batched
+    fused-tick window, fleet state donated."""
+    from .fleet import make_fleet_window
+
+    return make_fleet_window(
+        run_sparse_ticks_fused, params, n_ticks, donate=donate
     )

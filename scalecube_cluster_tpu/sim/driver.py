@@ -205,6 +205,15 @@ class SimDriver:
                 f"the {self.engine} engine is single-device (no sharded "
                 "window builders) — construct without mesh="
             )
+        # refuse pallas x mesh at construction, not at the first (lazy)
+        # window build — the kernel presents the whole payload as one
+        # block and is single-device until the column split lands
+        # (docs/TPU_LAYOUT_NOTES.md)
+        if mesh is not None and getattr(params, "delivery_kernel", "xla") == "pallas":
+            raise ValueError(
+                "delivery_kernel='pallas' is single-device for now — "
+                "construct without mesh="
+            )
         if dense_links is None:
             dense_links = self._eng.dense_links_default
         # r14 adaptive failure detection: an ENABLED AdaptiveSpec on params
@@ -212,14 +221,22 @@ class SimDriver:
         # pytree and threads it through the adaptive window programs
         aspec = getattr(params, "adaptive", None)
         if aspec is not None and not aspec.is_default:
-            if mesh is not None:
+            # r17: engines that register a sharded adaptive window builder
+            # (pview) run the adaptive plane on meshes — the AdaptiveState's
+            # [N] planes row-shard like every other member-axis tensor
+            if mesh is not None and self._eng.make_sharded_adaptive_run is None:
                 raise ValueError(
-                    "adaptive failure detection is single-device for now — "
-                    "construct without mesh= or use the default AdaptiveSpec"
+                    f"adaptive failure detection is single-device for the "
+                    f"{self.engine} engine — construct without mesh= or use "
+                    "the default AdaptiveSpec"
                 )
             from ..adaptive import init_adaptive_state
 
             self._ad = init_adaptive_state(params.capacity)
+            if mesh is not None:
+                from ..ops.sharding import shard_adaptive_state
+
+                self._ad = shard_adaptive_state(self._ad, mesh)
         else:
             self._ad = None
         init = self._eng.init_state(params, n_initial, warm, dense_links)
@@ -370,9 +387,16 @@ class SimDriver:
                     self.params, n_ticks, self._trace.spec
                 )
             elif adaptive:
-                self._step_cache[cache_key] = self._eng.make_adaptive_run(
-                    self.params, n_ticks
-                )
+                if self.mesh is not None:
+                    self._step_cache[cache_key] = (
+                        self._eng.make_sharded_adaptive_run(
+                            self.mesh, self.params, n_ticks
+                        )
+                    )
+                else:
+                    self._step_cache[cache_key] = self._eng.make_adaptive_run(
+                        self.params, n_ticks
+                    )
             elif self.mesh is not None:
                 self._step_cache[cache_key] = self._eng.make_sharded_run(
                     self.mesh, self.params, n_ticks, self._dense_links
@@ -1202,9 +1226,11 @@ class SimDriver:
             if spec == cur and (self._ad is not None) == (not spec.is_default):
                 return
             if not spec.is_default:
-                if self.mesh is not None:
+                if (self.mesh is not None
+                        and self._eng.make_sharded_adaptive_run is None):
                     raise ValueError(
-                        "adaptive failure detection is single-device for now"
+                        "adaptive failure detection is single-device for "
+                        f"the {self.engine} engine"
                     )
                 if self._trace is not None:
                     raise ValueError(
@@ -1212,10 +1238,14 @@ class SimDriver:
                         "share a driver yet"
                     )
             self.params = _dc.replace(self.params, adaptive=spec)
-            self._ad = (
-                None if spec.is_default
-                else init_adaptive_state(self.params.capacity)
-            )
+            if spec.is_default:
+                self._ad = None
+            else:
+                self._ad = init_adaptive_state(self.params.capacity)
+                if self.mesh is not None:
+                    from ..ops.sharding import shard_adaptive_state
+
+                    self._ad = shard_adaptive_state(self._ad, self.mesh)
             self._step_cache.clear()
             self._step_stats.clear()
 

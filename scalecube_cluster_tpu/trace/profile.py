@@ -39,6 +39,10 @@ SPARSE_PHASES = (
     "rand", "fd", "suspicion", "gossip", "sync", "refute", "sweep", "alloc",
     "telemetry",
 )
+#: pview shares the sparse phase list — its "suspicion" phase is the
+#: maintenance sweep (expiry + tombstone purge + active-view promotion)
+#: and its "alloc" phase is the imported sparse pool machinery
+PVIEW_PHASES = SPARSE_PHASES
 
 
 def _annotation(name: str):
@@ -75,7 +79,19 @@ class _Timer:
         })
 
 
-def _dense_phase_fns(params) -> Dict[str, Callable]:
+def _wrap_phase_fns(fns: Dict[str, Callable], fleet: bool) -> Dict[str, Callable]:
+    """jit each phase callable; ``fleet=True`` vmaps it over a leading
+    [S] scenario axis first (jit∘vmap — the ops/fleet.py window spelling,
+    phase by phase, so the composition is bit-identical to the fleet
+    window exactly as the serial split is to the serial window)."""
+    import jax
+
+    if fleet:
+        return {k: jax.jit(jax.vmap(v)) for k, v in fns.items()}
+    return {k: jax.jit(v) for k, v in fns.items()}
+
+
+def _dense_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
     import jax
     import jax.numpy as jnp
 
@@ -106,16 +122,16 @@ def _dense_phase_fns(params) -> Dict[str, Callable]:
 
         return jax.lax.cond((st.tick % params.fd_every) == 0, on, off, st)
 
-    return {
-        "rand": jax.jit(_rand),
-        "fd": jax.jit(_fd),
-        "suspicion": jax.jit(lambda st: K._suspicion_phase(st, params)),
-        "gossip": jax.jit(lambda st, r: K._gossip_phase(st, r, params)),
-        "sync": jax.jit(lambda st, r: K._sync_phase(st, r, params)),
-        "refute": jax.jit(K._refute_phase),
-        "sweep": jax.jit(lambda st: K._rumor_sweep(st, params)),
-        "telemetry": jax.jit(lambda st: K.state_metrics(st, params)),
-    }
+    return _wrap_phase_fns({
+        "rand": _rand,
+        "fd": _fd,
+        "suspicion": lambda st: K._suspicion_phase(st, params),
+        "gossip": lambda st, r: K._gossip_phase(st, r, params),
+        "sync": lambda st, r: K._sync_phase(st, r, params),
+        "refute": K._refute_phase,
+        "sweep": lambda st: K._rumor_sweep(st, params),
+        "telemetry": lambda st: K.state_metrics(st, params),
+    }, fleet)
 
 
 def _run_dense_tick(fns, timer: _Timer, state, key, t: int):
@@ -146,7 +162,7 @@ def _run_dense_tick(fns, timer: _Timer, state, key, t: int):
     return state, key
 
 
-def _sparse_phase_fns(params) -> Dict[str, Callable]:
+def _sparse_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
     import jax
     import jax.numpy as jnp
 
@@ -181,17 +197,17 @@ def _sparse_phase_fns(params) -> Dict[str, Callable]:
 
         return jax.lax.cond((st.tick % params.fd_every) == 0, on, off, st)
 
-    return {
-        "rand": jax.jit(_rand),
-        "fd": jax.jit(_fd),
-        "suspicion": jax.jit(lambda st: SP._suspicion_sweep(st, params)),
-        "gossip": jax.jit(lambda st, r: SP._gossip_phase(st, r, params)),
-        "sync": jax.jit(lambda st, r: SP._sync_phase(st, r, params)),
-        "refute": jax.jit(lambda st: SP._refute_phase(st, params)),
-        "sweep": jax.jit(lambda st: SP._rumor_sweeps(st, params)),
-        "alloc": jax.jit(lambda st, props: SP._alloc_phase(st, props, params)),
-        "telemetry": jax.jit(lambda st: SP.state_metrics(st, params)),
-    }
+    return _wrap_phase_fns({
+        "rand": _rand,
+        "fd": _fd,
+        "suspicion": lambda st: SP._suspicion_sweep(st, params),
+        "gossip": lambda st, r: SP._gossip_phase(st, r, params),
+        "sync": lambda st, r: SP._sync_phase(st, r, params),
+        "refute": lambda st: SP._refute_phase(st, params),
+        "sweep": lambda st: SP._rumor_sweeps(st, params),
+        "alloc": lambda st, props: SP._alloc_phase(st, props, params),
+        "telemetry": lambda st: SP.state_metrics(st, params),
+    }, fleet)
 
 
 def _run_sparse_tick(fns, timer: _Timer, state, key, t: int):
@@ -227,6 +243,100 @@ def _run_sparse_tick(fns, timer: _Timer, state, key, t: int):
     return state, key
 
 
+def _pview_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pview as PV
+    from ..ops.rand import draw_sparse_fd, draw_sparse_round, split_tick_key
+
+    n = params.capacity
+
+    def _rand(st, key):
+        key, tick_key = jax.random.split(key)
+        fd_key, round_key = split_tick_key(tick_key)
+        r = draw_sparse_round(round_key, n, params.fanout, params.sample_tries)
+        return st.replace(tick=st.tick + 1), key, fd_key, r
+
+    def _fd(st, fd_key):
+        rows = jnp.arange(n)
+        no_props = (
+            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+            rows, jnp.zeros((n,), bool),
+        )
+
+        def on(s):
+            fd_r = draw_sparse_fd(
+                fd_key, n, params.ping_req_k, params.sample_tries
+            )
+            return PV._fd_phase(s, fd_r, params)
+
+        def off(s):
+            return s, no_props, {
+                "fd_probes": jnp.int32(0),
+                "fd_failed_probes": jnp.int32(0),
+                "fd_new_suspects": jnp.int32(0),
+            }
+
+        return jax.lax.cond((st.tick % params.fd_every) == 0, on, off, st)
+
+    return _wrap_phase_fns({
+        "rand": _rand,
+        "fd": _fd,
+        "suspicion": lambda st: PV._maintenance_sweep(st, params),
+        "gossip": lambda st, r: PV._gossip_phase(st, r, params),
+        "sync": lambda st, r: PV._sync_phase(st, r, params),
+        "refute": lambda st: PV._refute_phase(st, params),
+        "sweep": lambda st: PV._rumor_sweeps(st, params),
+        "alloc": lambda st, props: PV._alloc_phase(st, props, params),
+        "telemetry": lambda st: PV.state_metrics(st, params),
+    }, fleet)
+
+
+def _run_pview_tick(fns, timer: _Timer, state, key, t: int):
+    with timer.phase("rand", t) as o:
+        state, key, fd_key, r = fns["rand"](state, key)
+        o["v"] = (state, key, fd_key, r)
+    with timer.phase("fd", t) as o:
+        state, props_fd, _m = fns["fd"](state, fd_key)
+        o["v"] = (state, props_fd)
+    with timer.phase("suspicion", t) as o:
+        state, props_exp = fns["suspicion"](state)
+        o["v"] = (state, props_exp)
+    with timer.phase("gossip", t) as o:
+        state, _g_m = fns["gossip"](state, r)
+        o["v"] = state
+    with timer.phase("sync", t) as o:
+        state, props_sync, _s_m = fns["sync"](state, r)
+        o["v"] = (state, props_sync)
+    with timer.phase("refute", t) as o:
+        state, props_ref = fns["refute"](state)
+        o["v"] = (state, props_ref)
+    with timer.phase("sweep", t) as o:
+        state = fns["sweep"](state)
+        o["v"] = state
+    with timer.phase("alloc", t) as o:
+        state, _a_m = fns["alloc"](
+            state, (props_fd, props_exp, props_ref, props_sync)
+        )
+        o["v"] = state
+    with timer.phase("telemetry", t) as o:
+        metrics = fns["telemetry"](state)
+        o["v"] = metrics
+    return state, key
+
+
+def _engine_fns_and_runner(params, fleet: bool = False):
+    from ..ops.pview import PviewParams
+    from ..ops.sparse import SparseParams
+
+    if isinstance(params, PviewParams):
+        return "pview", _pview_phase_fns(params, fleet), _run_pview_tick
+    if isinstance(params, SparseParams):
+        return "sparse", _sparse_phase_fns(params, fleet), _run_sparse_tick
+    return "dense", _dense_phase_fns(params, fleet), _run_dense_tick
+
+
 def profile_ticks(
     params, state, key, n_ticks: int, warmup_ticks: int = 1
 ) -> Tuple[object, object, Dict]:
@@ -237,11 +347,7 @@ def profile_ticks(
     the fused window's bit-for-bit — tests/test_trace.py pins it. The first
     ``warmup_ticks`` compile every phase program and are EXCLUDED from the
     per-phase totals and the wall measurement."""
-    from ..ops.sparse import SparseParams
-
-    sparse = isinstance(params, SparseParams)
-    fns = _sparse_phase_fns(params) if sparse else _dense_phase_fns(params)
-    run = _run_sparse_tick if sparse else _run_dense_tick
+    engine, fns, run = _engine_fns_and_runner(params)
     for t in range(warmup_ticks):
         state, key = run(fns, _Timer(), state, key, t)
     timer = _Timer()
@@ -251,7 +357,7 @@ def profile_ticks(
     wall = time.perf_counter() - wall0
     phase_sum = sum(timer.totals.values())
     result = {
-        "engine": "sparse" if sparse else "dense",
+        "engine": engine,
         "n": params.capacity,
         "ticks": n_ticks,
         "warmup_ticks": warmup_ticks,
@@ -269,6 +375,47 @@ def profile_ticks(
         "timeline": timer.timeline,
     }
     return state, key, result
+
+
+def profile_fleet_ticks(
+    params, fleet_state, keys, n_ticks: int, warmup_ticks: int = 1
+) -> Tuple[object, object, Dict]:
+    """Phase-split profile of a FLEET window (r15's ``jit(vmap(core))``):
+    each phase program is ``jit(vmap(phase))`` over the leading [S]
+    scenario axis, so the composition is bit-identical to the fleet
+    window exactly as the serial split is to the serial one (vmap
+    composes phase-wise; ``lax.cond`` under vmap runs both branches in
+    BOTH spellings). Same result schema as :func:`profile_ticks` plus
+    the scenario count ``s``; engine name suffixed ``-fleet``."""
+    from ..ops.fleet import fleet_size
+
+    engine, fns, run = _engine_fns_and_runner(params, fleet=True)
+    for t in range(warmup_ticks):
+        fleet_state, keys = run(fns, _Timer(), fleet_state, keys, t)
+    timer = _Timer()
+    wall0 = time.perf_counter()
+    for t in range(n_ticks):
+        fleet_state, keys = run(fns, timer, fleet_state, keys, t)
+    wall = time.perf_counter() - wall0
+    phase_sum = sum(timer.totals.values())
+    result = {
+        "engine": f"{engine}-fleet",
+        "n": params.capacity,
+        "s": fleet_size(fleet_state),
+        "ticks": n_ticks,
+        "warmup_ticks": warmup_ticks,
+        "wall_s": round(wall, 6),
+        "phase_sum_s": round(phase_sum, 6),
+        "phase_coverage": round(phase_sum / wall, 4) if wall else None,
+        "split_ticks_per_s": round(n_ticks / wall, 2) if wall else None,
+        "phases_s": {k: round(v, 6) for k, v in sorted(timer.totals.items())},
+        "phases_pct": {
+            k: round(100.0 * v / phase_sum, 2)
+            for k, v in sorted(timer.totals.items())
+        } if phase_sum else {},
+        "timeline": timer.timeline,
+    }
+    return fleet_state, keys, result
 
 
 def profile_driver(driver, n_ticks: int = 32, warmup_ticks: int = 1) -> Dict:

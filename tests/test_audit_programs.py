@@ -143,6 +143,12 @@ def test_full_matrix_including_sharded_passes():
     # r15: the scenario-batched fleet windows ride the same matrix
     assert {"dense/i32/fleet", "sparse/i32/fleet",
             "pview/i32/fleet"} <= names
+    # r17: the fused windows (incl. the Pallas-delivery arm and the pview
+    # sharded pair) are first-class audit citizens
+    assert {"dense/i32/fused", "sparse/i32/fused", "pview/i32/fused",
+            "pview/i32/fused-pallas", "pview/i32/fused-adaptive",
+            "pview/i32/fused-fleet", "pview/i32/sharded",
+            "pview/i16/sharded"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -406,6 +412,62 @@ def test_seeded_fleet_builder_dropping_donation_is_caught():
     good = _program(
         "seeded/fleet-donated", eng.make_fleet_run(params, N_TICKS),
         (abs_fleet, keys_abs), (0,), contracts=eng.contracts,
+    )
+    assert check_donation_alias(good) == []
+
+
+def test_fused_pview_window_audits_clean_lowered():
+    """r17 tier-1 gate: the pview fused window AND its Pallas-delivery arm
+    audit clean at the lowered level (donation aliasing, transfer-
+    freeness, the O(N·k) wide-value ban over the kernel-armed program).
+    The compiled matrix (memory budgets, alias maps) lives in the -m slow
+    full matrix and AUDIT_r12.json."""
+    programs = build_engine_programs(
+        "pview", capacity=CAPACITY, n_ticks=N_TICKS,
+        key_dtypes=["i32"], variants=["fused"],
+    )
+    names = {p.name for p in programs}
+    assert {"pview/i32/fused", "pview/i32/fused-pallas"} <= names
+    for prog in programs:
+        verdict = run_contracts(prog, compile_programs=False)
+        for contract, violations in verdict.items():
+            assert violations == [], (
+                f"{prog.name}: {contract}:\n"
+                + "\n".join(str(v) for v in violations)
+            )
+
+
+def test_seeded_fused_builder_dropping_donation_is_caught():
+    """Violation class 1, r17 flavor: a REAL fused window builder (the
+    pview fused run — the engine the fusion was built for) constructed
+    with donate=False but REGISTERED as donated — the exact regression a
+    phase-fusion refactor could introduce (the fused spelling silently
+    losing the unfused builder's donate_argnums). The auditor must flag
+    every dropped state leaf, proving the fused windows sit behind the
+    same gate as the legacy programs."""
+    from scalecube_cluster_tpu.audit.programs import (
+        _abstract, _audit_params, _key_abstract,
+    )
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine("pview")
+    params = _audit_params("pview", CAPACITY, "i32")
+    # dense_links=False: the pview engine refuses the [N, N] link plane
+    state = eng.init_state(params, CAPACITY - 4, True, False)
+    abs_state = _abstract(state)
+    fn = eng.make_fused_run(params, N_TICKS, donate=False)  # <- dropped
+    prog = _program(
+        "seeded/fused-dropped-donation", fn, (abs_state, _key_abstract()),
+        (0,), contracts=eng.contracts,
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the fused builder's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)
+
+    # control: the registered donated fused builder audits clean
+    good = _program(
+        "seeded/fused-donated", eng.make_fused_run(params, N_TICKS),
+        (abs_state, _key_abstract()), (0,), contracts=eng.contracts,
     )
     assert check_donation_alias(good) == []
 

@@ -1,0 +1,363 @@
+"""Fused-phase tick windows + the Pallas delivery kernel (ISSUE 16, r17).
+
+The fused windows restructure the tick so adjacent phases share
+intermediates (the dense sweep/metrics tail, the sparse gossip→sweep
+covered hand-off, the pview delivery→merge chain) and, for pview, route
+the per-fanout-slot delivery+merge through a hand-written Pallas kernel.
+None of that is allowed to change a single bit of the trajectory — the
+fused spelling is a compiler-visible reorganization, not a new protocol.
+These tests pin that contract:
+
+1. **Window bit-identity, all three engines** — unfused vs fused windows
+   over the same (state, key), through a mid-stream host-mutation batch
+   (crash + join + fresh rumor), every state leaf, the advanced PRNG key,
+   and every stacked metric byte-equal. N=33 straddles a word boundary so
+   the packed planes' tail words are exercised; dense/pview run both key
+   dtypes.
+2. **The Pallas kernel** — ``delivery_combine`` (interpret mode: the SAME
+   kernel body the TPU lowering compiles, executed through XLA
+   primitives) vs the unfused tick's exact primitive sequence
+   (``delivery_combine_xla``), across fanout/lane/tail shapes including
+   N % block_rows != 0 and N % 32 != 0, and then the whole
+   ``delivery_kernel="pallas"`` fused tick vs the XLA fused tick.
+3. **Composition seams** — the r10 phase-split profiler, the fused fleet
+   window, and the fused adaptive window each reproduce their unfused
+   twin exactly (the profiler attribution and the fleet/adaptive planes
+   stay valid for fused windows).
+4. **Refusals** — fused + trace is a loud error (the fused tick has no
+   phase seams to time), and the fused adaptive builders refuse a
+   default spec exactly like their unfused twins.
+
+The donation-alias side of the fused builders is proved in the static
+audit plane (tests/test_audit_programs.py seeds a fused builder that
+drops its donation and asserts it is CAUGHT; AUDIT_r12.json carries the
+clean verdicts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N = 33
+T = 8
+
+# fanout/ping_req_k are python-unrolled in the ticks — small knobs keep
+# the ~14 window compiles this module pays inside the tier-1 budget
+_KNOBS = dict(fanout=2, repeat_mult=3, ping_req_k=1, fd_every=3,
+              sync_every=8, suspicion_mult=3, rumor_slots=4,
+              seed_rows=(0, 1))
+
+
+def _engine_case(engine: str, key_dtype: str):
+    """(params, module, make_run, make_fused_run) at the shared N=33
+    shape — mirrors tests/test_fleet.py's engine table."""
+    if engine == "dense":
+        import scalecube_cluster_tpu.ops.state as S
+        from scalecube_cluster_tpu.ops.kernel import make_fused_run, make_run
+
+        params = S.SimParams(capacity=N, key_dtype=key_dtype, **_KNOBS)
+        return params, S, make_run, make_fused_run
+    if engine == "sparse":
+        import scalecube_cluster_tpu.ops.sparse as SP
+
+        params = SP.SparseParams(capacity=N, mr_slots=16, announce_slots=8,
+                                 delay_slots=2, **_KNOBS)
+        return params, SP, SP.make_sparse_run, SP.make_sparse_fused_run
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = PV.PviewParams(capacity=N, key_dtype=key_dtype, mr_slots=16,
+                            announce_slots=8, delay_slots=2, **_KNOBS)
+    return params, PV, PV.make_pview_run, PV.make_pview_fused_run
+
+
+@functools.lru_cache(maxsize=None)
+def _window(engine: str, key_dtype: str, fused: bool):
+    """Module-cached jitted window at the shared (N, T) shape — the
+    pview/i32 fused window alone is needed by three tests, and re-tracing
+    it per test is pure tier-1 budget burn (the persistent compile cache
+    only skips the XLA compile, not tracing/lowering)."""
+    params, _mod, make_run, make_fused = _engine_case(engine, key_dtype)
+    return (make_fused if fused else make_run)(params, T, donate=False)
+
+
+def _scenario(mod, params):
+    """A busy small cluster: live rumors, a crash pair, a leaver — every
+    fused hand-off (delivery, covered-sweep, metrics tail) does work."""
+    kw = dict(uniform_loss=0.05)
+    if getattr(params, "delay_slots", 0):
+        kw["uniform_delay"] = 0.7
+    st = mod_init(mod, params, 29, **kw)
+    st = mod.spread_rumor(st, 0, 3)
+    st = mod.spread_rumor(st, 1, 7)
+    st = mod.crash_rows(st, [6, 17])
+    st = mod.begin_leave(st, 9)
+    return st
+
+
+def mod_init(mod, params, n, **kw):
+    for name in ("init_state", "init_sparse_state", "init_pview_state"):
+        if hasattr(mod, name):
+            return getattr(mod, name)(params, n, **kw)
+    raise AssertionError("no init in module")
+
+
+def _mutate(mod, st, params):
+    st = mod.crash_rows(st, [3])
+    st = mod.join_row(st, 30, params.seed_rows)
+    return mod.spread_rumor(st, 2, 12)
+
+
+def _assert_same(a_st, b_st, a_ms, b_ms, label):
+    for f in dataclasses.fields(a_st):
+        va = np.asarray(getattr(a_st, f.name))
+        vb = np.asarray(getattr(b_st, f.name))
+        assert np.array_equal(va, vb), (
+            f"{label}: state leaf {f.name} diverged between unfused and "
+            f"fused windows"
+        )
+    for mk in a_ms:
+        assert np.array_equal(np.asarray(a_ms[mk]), np.asarray(b_ms[mk])), (
+            f"{label}: stacked metric {mk} diverged"
+        )
+
+
+# ---------------------------------------------------------------------------
+# 1. window bit-identity, all three engines
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine,key_dtype", [
+    ("dense", "i32"), ("dense", "i16"),
+    ("sparse", "i32"),
+    ("pview", "i32"), ("pview", "i16"),
+])
+def test_fused_window_bit_identical(engine, key_dtype):
+    """Two windows with a host-mutation batch between them: the fused
+    window's trajectory, advanced key, and stacked metrics all byte-equal
+    the unfused window's."""
+    params, mod, _mk, _mf = _engine_case(engine, key_dtype)
+    label = f"{engine}/{key_dtype}"
+    ref = _window(engine, key_dtype, False)
+    fused = _window(engine, key_dtype, True)
+
+    a, b = _scenario(mod, params), _scenario(mod, params)
+    key = jax.random.PRNGKey(0)
+    a, ka, ms_a, _ = ref(a, key)
+    b, kb, ms_b, _ = fused(b, key)
+    _assert_same(a, b, ms_a, ms_b, f"{label} window 1")
+    assert np.array_equal(np.asarray(ka), np.asarray(kb)), (
+        f"{label}: PRNG chain diverged"
+    )
+
+    a, b = _mutate(mod, a, params), _mutate(mod, b, params)
+    a, ka, ms_a, _ = ref(a, ka)
+    b, kb, ms_b, _ = fused(b, kb)
+    _assert_same(a, b, ms_a, ms_b, f"{label} window 2 (post-mutation)")
+
+
+# ---------------------------------------------------------------------------
+# 2. the Pallas delivery kernel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,f,r,block_rows", [
+    (33, 2, 4, 8),     # tail: 33 % 8 != 0, 33 % 32 != 0
+    (64, 3, 8, 32),    # even grid, multi-slot fold
+    (100, 2, 33, 256), # BR clamps to n; R > 32 -> two packed rumor words
+    (256, 4, 1, 64),   # single-lane rumors, 4-slot fold
+])
+def test_pallas_delivery_combine_matches_xla(n, f, r, block_rows):
+    """The kernel primitive vs the unfused tick's exact XLA sequence, over
+    adversarial shapes: every output (u_or, src_max, m_or, cnt) bit-equal
+    under interpret mode — the CPU certification of the TPU kernel body."""
+    from scalecube_cluster_tpu.ops.pallas_delivery import (
+        delivery_combine, delivery_combine_xla,
+    )
+
+    rng = np.random.default_rng(n * 1000 + f * 100 + r)
+    wm = 3
+    wu = -(-r // 32)
+    wt = wm + wu + r
+    payload = rng.integers(0, 2 ** 32, size=(n, wt), dtype=np.uint32)
+    # infected-from lanes hold row ids (i32 bit patterns in u32 words)
+    payload[:, wm + wu:] = rng.integers(-1, n, size=(n, r)).astype(
+        np.int32
+    ).view(np.uint32)
+    inv = rng.integers(-1, n, size=(f, n)).astype(np.int32)
+    origin = rng.integers(-1, n, size=(r,)).astype(np.int32)
+
+    ref = delivery_combine_xla(payload, inv, origin, wm, r)
+    ker = delivery_combine(payload, inv, origin, wm, r,
+                           block_rows=block_rows, interpret=True)
+    for name, va, vb in zip(("u_or", "src_max", "m_or", "cnt"), ref, ker):
+        assert np.array_equal(np.asarray(va), np.asarray(vb)), (
+            f"delivery_combine {name} diverged at n={n} f={f} r={r} "
+            f"block_rows={block_rows}"
+        )
+
+
+def test_pallas_fused_window_bit_identical_to_xla_fused():
+    """The whole delivery_kernel="pallas" fused window vs the XLA fused
+    window — the kernel slots into the tick without moving a bit."""
+    import dataclasses as dc
+
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params, mod, _mk, _mf = _engine_case("pview", "i32")
+    pallas_params = dc.replace(params, delivery_kernel="pallas")
+    a, b = _scenario(mod, params), _scenario(mod, params)
+    key = jax.random.PRNGKey(1)
+    a, ka, ms_a, _ = _window("pview", "i32", True)(a, key)
+    b, kb, ms_b, _ = PV.make_pview_fused_run(pallas_params, T,
+                                             donate=False)(b, key)
+    _assert_same(a, b, ms_a, ms_b, "pview pallas-vs-xla fused")
+
+
+# ---------------------------------------------------------------------------
+# 3. composition seams: profiler, fleet, adaptive
+# ---------------------------------------------------------------------------
+
+
+def test_phase_split_profiler_matches_fused_window():
+    """The r10 profiler's phase-split pview tick (the tool that says WHICH
+    phase dominates) lands on the same state as the fused window — the
+    attribution measured on the seams transfers to the seamless program."""
+    from scalecube_cluster_tpu.trace.profile import profile_ticks
+
+    params, mod, _mk, _mf = _engine_case("pview", "i32")
+    a, b = _scenario(mod, params), _scenario(mod, params)
+    key = jax.random.PRNGKey(2)
+    a, _, prof = profile_ticks(params, a, key, n_ticks=T, warmup_ticks=0)
+    b, _, _ms, _ = _window("pview", "i32", True)(b, key)
+    for f in dataclasses.fields(a):
+        assert np.array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+        ), f"profiler-vs-fused: state leaf {f.name} diverged"
+    assert set(prof["phases_s"]) == {
+        "rand", "fd", "suspicion", "gossip", "sync", "refute", "sweep",
+        "alloc", "telemetry",
+    }
+
+
+def test_fused_fleet_window_bit_identical():
+    """jit(vmap(fused window)) == jit(vmap(unfused window)) — the fusion
+    composes with the r15 scenario batching."""
+    from scalecube_cluster_tpu.ops import fleet as FL
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params, mod, _mk, _mf = _engine_case("pview", "i32")
+    st0 = _scenario(mod, params)
+    fs = FL.fleet_broadcast(st0, 2)
+    fs = FL.fleet_inject_rumor(mod, fs, 3, [5, 11])
+    keys = FL.fleet_keys((0, 7))
+    fa, ka, ms_a, _ = PV.make_pview_fleet_run(params, T, False)(fs, keys)
+    fb, kb, ms_b, _ = PV.make_pview_fused_fleet_run(params, T, False)(
+        fs, keys
+    )
+    _assert_same(fa, fb, ms_a, ms_b, "pview fused fleet")
+    assert np.array_equal(np.asarray(ka), np.asarray(kb))
+
+
+def test_fused_adaptive_window_bit_identical():
+    """The fused adaptive window advances state AND the adaptive plane
+    exactly like the unfused one."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.adaptive import AdaptiveSpec, init_adaptive_state
+
+    params, mod, _mk, _mf = _engine_case("pview", "i32")
+    armed = dataclasses.replace(
+        params, adaptive=AdaptiveSpec(enabled=True, lh_max=8, conf_target=2)
+    )
+    a, b = _scenario(mod, armed), _scenario(mod, armed)
+    ad = init_adaptive_state(N)
+    key = jax.random.PRNGKey(3)
+    a, ad_a, ka, ms_a, _ = PV.make_pview_adaptive_run(armed, T, False)(
+        a, ad, key
+    )
+    b, ad_b, kb, ms_b, _ = PV.make_pview_fused_adaptive_run(armed, T, False)(
+        b, ad, key
+    )
+    _assert_same(a, b, ms_a, ms_b, "pview fused adaptive")
+    for f in ("lh", "conf_key", "conf"):
+        assert np.array_equal(
+            np.asarray(getattr(ad_a, f)), np.asarray(getattr(ad_b, f))
+        ), f"adaptive plane {f} diverged"
+
+
+# ---------------------------------------------------------------------------
+# 4. refusals
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+def test_fused_tick_refuses_trace_plane(engine):
+    """fused + trace is a contradiction (no phase seams to time) — loud
+    ValueError, not a silently-untraced window."""
+    params, mod, _mk, _mf = _engine_case(engine, "i32")
+    st = _scenario(mod, params)
+    tick = (mod.sparse_tick if engine == "sparse"
+            else __import__("scalecube_cluster_tpu.ops.kernel",
+                            fromlist=["tick"]).tick)
+    with pytest.raises(ValueError, match="no trace plane"):
+        tick(st, jax.random.PRNGKey(0), params, trace=object(), fused=True)
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "pview"])
+def test_fused_adaptive_builder_refuses_default_spec(engine):
+    """Default-spec refusal parity with the unfused adaptive builders."""
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine(engine)
+    params, _mod, _mk, _mf = _engine_case(engine, "i32")
+    assert eng.make_fused_adaptive_run is not None
+    with pytest.raises(ValueError, match="AdaptiveSpec"):
+        eng.make_fused_adaptive_run(params, 2)
+
+
+def test_delivery_kernel_default_off_jaxpr():
+    """r13/r14 default-off discipline, jaxpr-compared: the unfused window
+    traces the byte-identical program under EITHER delivery_kernel value
+    (the knob lives inside the fused gossip phase only), and the fused
+    pair genuinely differs — the pallas program carries a pallas_call."""
+    import dataclasses as dc
+
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params, mod, _mk, _mf = _engine_case("pview", "i32")
+    pallas = dc.replace(params, delivery_kernel="pallas")
+    st = _scenario(mod, params)
+    key = jax.random.PRNGKey(5)
+
+    def jx(p, fused):
+        mk = PV.make_pview_fused_run if fused else PV.make_pview_run
+        return str(jax.make_jaxpr(lambda s, k: mk(p, 2, donate=False)(s, k))(
+            st, key
+        ))
+
+    assert jx(params, False) == jx(pallas, False)
+    j_xla, j_pal = jx(params, True), jx(pallas, True)
+    assert j_xla != j_pal
+    assert "pallas_call" in j_pal and "pallas_call" not in j_xla
+
+
+def test_engine_registry_carries_fused_builders():
+    """The fused trio is first-class EngineOps surface on every engine —
+    drivers and the audit matrix reach it through the registry, not
+    per-engine imports."""
+    from scalecube_cluster_tpu.ops import engine_api
+
+    for name in ("dense", "sparse", "pview"):
+        eng = engine_api.engine(name)
+        assert eng.make_fused_run is not None, name
+        assert eng.make_fused_adaptive_run is not None, name
+        assert eng.make_fused_fleet_run is not None, name

@@ -175,6 +175,9 @@ def test_pview_state_has_no_nxn_plane():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # r17 tier-1 relief: heaviest smoke in the suite (73s);
+# the cross-engine convergence contract also runs in test_sparse_kernel's
+# convergence-rounds test and the dissemination convergence oracle
 def test_pview_converges_to_same_membership_as_dense():
     """Seeded join + crash + partition scenario on BOTH engines: each must
     re-converge (its own sentinel) and the decoded steady-state membership
@@ -364,6 +367,8 @@ def test_pview_checkpoint_refuses_foreign_engine(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # r17 tier-1 relief: the partition+heal contract keeps
+# fast variants in test_chaos (dense/sparse) and test_dissemination
 def test_pview_chaos_partition_crash_heal_sentinels_green():
     """Partition + Crash + heal + restart on the pview engine: every
     sentinel green — detection, post-heal re-convergence (tombstone purge
@@ -411,14 +416,22 @@ def test_pview_rejects_dense_links():
         SimDriver(_params(16), 12, warm=True, dense_links=True)
 
 
-def test_pview_rejects_mesh():
+def test_pview_mesh_lifted_pallas_still_refused():
+    """r17 lifts the pview x mesh refusal (the sharded window is pinned
+    bit-identical in tests/test_sharding.py): construction on a mesh
+    succeeds and row-shards the state. The Pallas delivery kernel stays
+    single-device, and the driver refuses it at CONSTRUCTION — not at
+    the first lazy window build."""
     import scalecube_cluster_tpu.ops.sharding as SH
 
     if len(jax.devices()) < 2:
         pytest.skip("needs >= 2 (virtual) devices")
     mesh = SH.make_mesh(jax.devices()[:2])
+    drv = SimDriver(_params(64), 32, warm=True, mesh=mesh)
+    assert drv.mesh is mesh
     with pytest.raises(ValueError, match="single-device"):
-        SimDriver(_params(64), 32, warm=True, mesh=mesh)
+        SimDriver(_params(64, delivery_kernel="pallas"), 32, warm=True,
+                  mesh=mesh)
 
 
 def test_pview_rejects_per_link_delay():

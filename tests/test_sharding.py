@@ -90,6 +90,97 @@ def test_capacity_divisibility_enforced(mesh):
         SH.make_sharded_tick(mesh, S.SimParams(capacity=30))
 
 
+def test_pview_sharded_window_matches_single_device(mesh):
+    """r17: the pview engine joins the mesh plane — the row-sharded
+    donated window's trajectory AND stacked metrics stay bit-identical to
+    the single-device window (alignment: capacity % (32·mesh) == 0 holds
+    at 256; the member-axis bit planes pack whole words per shard)."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = PV.PviewParams(
+        capacity=256, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+        fd_every=3, sync_every=16, rumor_slots=4, seed_rows=(0, 1),
+    )
+
+    def mk_state():
+        st = PV.init_pview_state(params, n_initial=200, uniform_loss=0.05)
+        st = PV.spread_rumor(st, 0, 5)
+        return PV.crash_rows(st, [6, 17])
+
+    key = jax.random.PRNGKey(3)
+    single = PV.make_pview_run(params, 6, donate=False)
+    sharded = SH.make_sharded_pview_run(mesh, params, 6)
+    a, _, ms_a, _ = single(mk_state(), key)
+    # the donated sharded window CONSUMES its input; on a same-host CPU
+    # mesh device_put is zero-copy, so feed it a fresh state rather than
+    # aliasing the single-device arm's buffers
+    b, _, ms_b, _ = sharded(SH.shard_pview_state(mk_state(), mesh), key)
+    # GSPMD may spell the row sharding with or without the trailing
+    # replicated dim — both mean P('members', None)
+    spec = tuple(b.nbr_key.sharding.spec)
+    assert spec in ((SH.MEMBER_AXIS,), (SH.MEMBER_AXIS, None)), spec
+    for name, arr in PV.snapshot(a).items():
+        assert np.array_equal(arr, np.asarray(PV.snapshot(b)[name])), name
+    for mk in ms_a:
+        assert np.array_equal(np.asarray(ms_a[mk]), np.asarray(ms_b[mk])), mk
+
+
+def test_pview_sharded_adaptive_window_matches_single_device(mesh):
+    """r17 lifts the r14 adaptive×mesh refusal for pview: the sharded
+    adaptive window (state donated, [N] adaptive planes row-sharded)
+    matches the single-device adaptive window bit-for-bit."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.adaptive import AdaptiveSpec, init_adaptive_state
+
+    params = PV.PviewParams(
+        capacity=256, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+        fd_every=3, sync_every=16, rumor_slots=4, seed_rows=(0, 1),
+        adaptive=AdaptiveSpec(enabled=True, lh_max=8, conf_target=2),
+    )
+
+    def mk_state():
+        st = PV.init_pview_state(params, n_initial=200, uniform_loss=0.05)
+        return PV.crash_rows(st, [6, 17])
+
+    key = jax.random.PRNGKey(4)
+    single = PV.make_pview_adaptive_run(params, 6, donate=False)
+    sharded = SH.make_sharded_pview_adaptive_run(mesh, params, 6)
+    a, ad_a, _, ms_a, _ = single(mk_state(), init_adaptive_state(256), key)
+    b, ad_b, _, ms_b, _ = sharded(
+        SH.shard_pview_state(mk_state(), mesh),
+        SH.shard_adaptive_state(init_adaptive_state(256), mesh), key,
+    )
+    for name, arr in PV.snapshot(a).items():
+        assert np.array_equal(arr, np.asarray(PV.snapshot(b)[name])), name
+    for f in ("lh", "conf_key", "conf"):
+        assert np.array_equal(
+            np.asarray(getattr(ad_a, f)), np.asarray(getattr(ad_b, f))
+        ), f
+    for mk in ms_a:
+        assert np.array_equal(np.asarray(ms_a[mk]), np.asarray(ms_b[mk])), mk
+
+
+def test_pview_sharded_refuses_misaligned_capacity_and_pallas(mesh):
+    """Alignment rule (capacity % (32·mesh) == 0 in BOTH key modes — the
+    pview engine packs member-axis bit planes unconditionally) and the
+    Pallas delivery kernel's single-device-for-now refusal are loud."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    with pytest.raises(ValueError, match="32"):
+        SH.make_sharded_pview_run(
+            mesh,
+            PV.PviewParams(capacity=192, view_slots=8, active_slots=4),
+            2,
+        )
+    with pytest.raises(ValueError, match="single-device"):
+        SH.make_sharded_pview_run(
+            mesh,
+            PV.PviewParams(capacity=256, view_slots=8, active_slots=4,
+                           delivery_kernel="pallas"),
+            2,
+        )
+
+
 def test_dryrun_multichip_entrypoint(mesh):
     import __graft_entry__ as g
 

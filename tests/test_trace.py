@@ -137,6 +137,7 @@ def _lockstep(make_driver):
     assert np.array_equal(np.asarray(plain._key), np.asarray(armed._key))
 
 
+@pytest.mark.slow  # r17 tier-1 relief: sparse variant stays fast below
 def test_trace_armed_driver_is_bit_identical_dense():
     _lockstep(lambda seed: SimDriver(_dense_params(), 32, warm=True, seed=seed))
 
@@ -145,6 +146,7 @@ def test_trace_armed_driver_is_bit_identical_sparse():
     _lockstep(lambda seed: SimDriver(_sparse_params(), 32, warm=True, seed=seed))
 
 
+@pytest.mark.slow  # r17 tier-1 relief: sparse variant stays fast above
 def test_trace_armed_packed_i16_driver_is_bit_identical():
     """The r9 packed engine traces too: the capture path widens i16 keys
     to i32 before diffing, so the same spec serves both layouts."""
