@@ -386,6 +386,20 @@ def main() -> None:
             default_out="FUSED_BENCH_r17.json",
         )
 
+    # r18: --replay runs the incident-replay + counterfactual what-if
+    # benchmark (benchmarks/config17_replay.py — flight-dump round-trip
+    # gate, then ≥256-seed fleet arms with Wilson CI separation) through
+    # the same backend-probe/retry path. --dump replays a real incident's
+    # artifact instead of manufacturing the canonical one.
+    if "--replay" in sys.argv:
+        _delegate(
+            "config17_replay.py",
+            ("--n", "--seeds", "--detect-budget", "--horizon", "--dump",
+             "--out"),
+            passthrough=("--quick",),
+            default_out="REPLAY_BENCH_r18.json",
+        )
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

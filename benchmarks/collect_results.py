@@ -157,6 +157,20 @@ def _r17(rec):
     )
 
 
+def _r18(rec):
+    # no dense number — r18's gates are the round-trip reproduction and
+    # the counterfactual CI separation; the row carries both verdicts
+    rt = rec.get("round_trip") or {}
+    wi = rec.get("whatif") or {}
+    sep = [a["arm"] for a in wi.get("arms", []) if a.get("separated")]
+    return None, (
+        f"incident replay: round-trip reproduced={rt.get('reproduced')} "
+        f"(recorded {rt.get('recorded')}); whatif {wi.get('n_arms')} arms x "
+        f"{wi.get('seeds_per_arm')} seeds, {wi.get('n_separated')} "
+        f"CI-separated from as-recorded ({', '.join(sep) or 'none'})"
+    )
+
+
 ROUND_BENCH_FILES = [
     (6, "DISPATCH_BENCH_r06.json", _r6),
     (7, "CHAOS_BENCH_r07.json", _r7),
@@ -168,6 +182,7 @@ ROUND_BENCH_FILES = [
     (14, "ADAPTIVE_BENCH_r14.json", _r14),
     (15, "FLEET_BENCH_r15.json", _r15),
     (17, "FUSED_BENCH_r17.json", _r17),
+    (18, "REPLAY_BENCH_r18.json", _r18),
 ]
 
 
@@ -357,6 +372,43 @@ def collect_fused_summary(root: pathlib.Path) -> dict:
         return {"present": True, "error": repr(exc)}
 
 
+def collect_replay_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r18 incident-replay artifact: the
+    round-trip reproduction gate plus every arm's Wilson interval and its
+    separation verdict against the as-recorded arm."""
+    path = root / "REPLAY_BENCH_r18.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        rt = rec.get("round_trip") or {}
+        wi = rec.get("whatif") or {}
+        return {
+            "present": True,
+            "ok": rec.get("ok"),
+            "backend": rec.get("backend"),
+            "quick": rec.get("quick"),
+            "reproduced": rt.get("reproduced"),
+            "recorded": rt.get("recorded"),
+            "n_arms": wi.get("n_arms"),
+            "seeds_per_arm": wi.get("seeds_per_arm"),
+            "n_separated": wi.get("n_separated"),
+            "arms": {
+                a["arm"]: {
+                    "p_green": a.get("p_green"),
+                    "wilson": a.get("wilson"),
+                    "zero_false_dead": a.get("zero_false_dead"),
+                    "separated": a.get("separated"),
+                }
+                for a in wi.get("arms", [])
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def collect_trajectory(root: pathlib.Path) -> list:
     """Fold every per-round dense-bench artifact present on disk into one
     dense-N=4096 ticks/s trajectory (the number each round's acceptance
@@ -508,6 +560,12 @@ def main() -> None:
     # profile belong to the dedicated artifact run: bench.py --fused)
     results += run([py, "benchmarks/config16_fused.py", "--quick",
                     "--out", "FUSED_BENCH_r17.json"], timeout=3000)
+    # r18 incident replay + counterfactual what-if: round-trip a flight
+    # dump through replay.incident_from_flight and CI-separate >=1 knob
+    # arm from the as-recorded run (32 seeds/arm on --quick; the 256-seed
+    # certified record belongs to the dedicated run: bench.py --replay)
+    results += run([py, "benchmarks/config17_replay.py", "--quick",
+                    "--out", "REPLAY_BENCH_r18.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -547,6 +605,10 @@ def main() -> None:
         # r17: fused-window speedup gates + the 1M wall verdict (full
         # artifact in FUSED_BENCH_r17.json, refreshed by config16)
         "fused_bench": collect_fused_summary(ROOT),
+        # r18: incident-replay round-trip + counterfactual separation
+        # verdicts (full artifact in REPLAY_BENCH_r18.json, refreshed by
+        # the config17 run above)
+        "replay_bench": collect_replay_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

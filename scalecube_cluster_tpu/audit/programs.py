@@ -274,7 +274,7 @@ def build_engine_programs(
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
         "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
-        "fleet", "control", "fused",
+        "fleet", "control", "fused", "replay",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -403,6 +403,41 @@ def build_engine_programs(
                 donated_argnums=(0,),
                 contracts=fleet_contracts,
                 budget_basis_bytes=s_fleet * state_bytes,
+                wide_threshold=capacity,
+            ))
+
+        if "replay" in want:
+            # r18: the incident-replay fleet window — the program
+            # ``replay.whatif`` compiles when an incident's scenario
+            # carries delay events (SlowEpoch/SlowMember): delay rings
+            # armed (delay_slots > 0), quiet gates off, vmapped over the
+            # seed axis. The rings add per-link pending planes the plain
+            # fleet audit never shapes, so the variant proves the same
+            # contracts over the delay-armed IR against a budget basis
+            # measured from the delay-armed state.
+            s_fleet = DEFAULT_FLEET_SCENARIOS
+            replay_params = dataclasses.replace(params, delay_slots=2)
+            if hasattr(replay_params, "quiet_gates"):
+                replay_params = dataclasses.replace(
+                    replay_params, quiet_gates=False
+                )
+            replay_state = eng.init_state(
+                replay_params, n_initial, True, eng.dense_links_default
+            )
+            abs_replay = _abstract(replay_state)
+            replay_bytes = _tree_bytes(abs_replay)
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/replay",
+                engine=engine_name, variant="replay", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=eng.make_fleet_run(replay_params, n_ticks),
+                abstract_args=(
+                    _fleet_abstracts(abs_replay, s_fleet),
+                    _fleet_abstracts(_key_abstract(), s_fleet),
+                ),
+                donated_argnums=(0,),
+                contracts=_fleet_contracts(contracts),
+                budget_basis_bytes=s_fleet * replay_bytes,
                 wide_threshold=capacity,
             ))
 

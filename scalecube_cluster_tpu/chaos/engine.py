@@ -26,7 +26,9 @@ import numpy as np
 
 from .events import (
     AsymmetricLoss,
+    ChurnStorm,
     Crash,
+    DroppedRefute,
     FlakyObserver,
     LinkFlap,
     LossStorm,
@@ -34,9 +36,20 @@ from .events import (
     Restart,
     Scenario,
     ScenarioError,
+    SlowEpoch,
     SlowMember,
+    ZoneOutage,
 )
 from .sentinels import build_spec, sentinel_report
+
+
+def _state_capacity(st) -> int:
+    """Member capacity N of a serial OR fleet-stacked state: the up mask's
+    LAST axis. ``st.capacity`` reads ``up.shape[0]``, which is the SCENARIO
+    count S on an [S, N]-stacked fleet state — closures that enumerate
+    "everyone" from it would silently touch only the first S rows (or, when
+    S > N, mask the bug entirely behind clamped scatter writes)."""
+    return st.up.shape[-1]
 
 
 @dataclass(frozen=True)
@@ -97,7 +110,7 @@ def _validate_degraded_composition(scenario: Scenario) -> None:
         if isinstance(ev, Partition)
     ] + [
         (ev, _window(ev, "until")) for ev in scenario.events
-        if isinstance(ev, LinkFlap)
+        if isinstance(ev, (LinkFlap, ZoneOutage))
     ]
     for d in deg:
         d0, d1 = _window(d, "until")
@@ -109,6 +122,59 @@ def _validate_degraded_composition(scenario: Scenario) -> None:
                     "writes would overwrite (and its teardown lift) the "
                     "block plane on shared links — stagger the events"
                 )
+    # r18 SlowEpoch writes the WHOLE delay plane; any overlapping SlowMember
+    # (or second SlowEpoch) shares links with it and the earlier teardown
+    # zeroes delay the later event still owns — same refusal as above
+    slows = [e for e in scenario.events
+             if isinstance(e, (SlowEpoch, SlowMember))]
+    for i in range(len(slows)):
+        if not isinstance(slows[i], SlowEpoch):
+            continue
+        a0, a1 = _window(slows[i], "until")
+        for j in range(len(slows)):
+            if j == i:
+                continue
+            b0, b1 = _window(slows[j], "until")
+            if a0 < b1 and b0 < a1:
+                raise ScenarioError(
+                    f"SlowEpoch@{slows[i].at} overlaps "
+                    f"{type(slows[j]).__name__}@{slows[j].at} in time — both "
+                    "write the delay plane and the earlier teardown would "
+                    "zero the later event's links; stagger the windows"
+                )
+
+
+def _restart_actions(scenario: Scenario):
+    """Every (tick, rows) restart action, whether from a ``Restart`` event
+    or a ``ChurnStorm`` wave — shared by composition checks and budgets."""
+    out = []
+    for ev in scenario.events:
+        if isinstance(ev, Restart):
+            out.append((ev.at, ev.rows))
+        elif isinstance(ev, ChurnStorm):
+            for _, r_tick, chunk in ev.wave_schedule():
+                out.append((r_tick, chunk))
+    return out
+
+
+def _validate_refute_composition(scenario: Scenario) -> None:
+    """A restart inside an active ``DroppedRefute`` window on the same row
+    would have its fresh-identity epoch bump squashed back by the drop (the
+    drop cannot tell a refute's inc bump from a restart's epoch bump) —
+    refuse the composition loudly instead of silently un-restarting."""
+    drops = [e for e in scenario.events if isinstance(e, DroppedRefute)]
+    if not drops:
+        return
+    for t, rows in _restart_actions(scenario):
+        for d in drops:
+            hit = set(rows) & set(d.rows)
+            if hit and d.at <= t < d.until:
+                raise ScenarioError(
+                    f"restart of rows {sorted(hit)} at tick {t} lands inside "
+                    f"DroppedRefute{list(d.rows)}@[{d.at},{d.until}) — the "
+                    "drop would squash the fresh identity's epoch bump; "
+                    "restart after the drop window ends"
+                )
 
 
 def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
@@ -118,6 +184,7 @@ def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
     events (r14) that would compose silently-wrong with block events are
     refused at compile time (:func:`_validate_degraded_composition`)."""
     _validate_degraded_composition(scenario)
+    _validate_refute_composition(scenario)
     steps: List[_Step] = []
     seq = itertools.count()
     for ev in scenario.events:
@@ -166,6 +233,40 @@ def schedule(scenario: Scenario, horizon: Optional[int] = None) -> List[_Step]:
             steps.append(_Step(ev.at, next(seq), "restart",
                                f"restart{list(ev.rows)}@{ev.at}",
                                (ev.rows, ev.seed_rows)))
+        elif isinstance(ev, ZoneOutage):
+            steps.append(_Step(ev.at, next(seq), "zone_down",
+                               f"zone_down{list(ev.rows)}@{ev.at}", (ev.rows,)))
+            if ev.until is not None:
+                steps.append(_Step(ev.until, next(seq), "zone_up",
+                                   f"zone_up{list(ev.rows)}@{ev.until}",
+                                   (ev.rows,)))
+        elif isinstance(ev, ChurnStorm):
+            # a churn storm compiles PURELY into the existing crash/restart
+            # vocabulary — every runner (device timeline, driver identity
+            # bookkeeping, emulator isolation) handles it with zero new kinds
+            for w, (c_tick, r_tick, chunk) in enumerate(ev.wave_schedule()):
+                steps.append(_Step(c_tick, next(seq), "crash",
+                                   f"churn_crash[w{w}]{list(chunk)}@{c_tick}",
+                                   (chunk,)))
+                steps.append(_Step(r_tick, next(seq), "restart",
+                                   f"churn_restart[w{w}]{list(chunk)}@{r_tick}",
+                                   (chunk, ev.seed_rows)))
+        elif isinstance(ev, SlowEpoch):
+            steps.append(_Step(ev.at, next(seq), "slow_epoch_start",
+                               f"slow_epoch({ev.mean_delay_ticks}t)@{ev.at}",
+                               (ev.mean_delay_ticks,)))
+            steps.append(_Step(ev.until, next(seq), "slow_epoch_end",
+                               f"slow_epoch_end@{ev.until}", ()))
+        elif isinstance(ev, DroppedRefute):
+            # per-tick expansion (the LinkFlap precedent): a refute bumped
+            # during tick t cannot spread before t+1 (the refute phase runs
+            # AFTER gossip/sync inside a tick), so squashing at every
+            # between-window seam in [at, until) suppresses every refute
+            # before it disseminates
+            for t in range(ev.at, ev.until):
+                steps.append(_Step(t, next(seq), "refute_drop",
+                                   f"refute_drop{list(ev.rows)}@{t}",
+                                   (ev.rows,)))
     steps.sort(key=lambda s: (s.tick, s.seq))
     return steps
 
@@ -211,9 +312,18 @@ class StateTimeline:
         # run Partition events without an [N, N] link plane; per-PAIR flaps
         # still need one
         group_parts = getattr(ops, "GROUP_PARTITIONS", False)
+        for s in self._steps:
+            if s.kind == "refute_drop" and not hasattr(ops, "drop_refutes"):
+                raise ScenarioError(
+                    "refute_drop (DroppedRefute) needs the dense [N, N] "
+                    "view/changed_at planes (ops.drop_refutes); this "
+                    "engine does not expose them — run the scenario on "
+                    "the dense engine"
+                )
         if not dense_links:
             for s in self._steps:
-                if s.kind in ("partition_block", "partition_heal") and not group_parts:
+                if s.kind in ("partition_block", "partition_heal",
+                              "zone_down", "zone_up") and not group_parts:
                     raise ScenarioError(
                         f"{s.kind} needs per-link (dense) links; this engine "
                         "runs scalar uniform loss — construct the driver "
@@ -225,9 +335,10 @@ class StateTimeline:
                         "has no per-pair link plane"
                     )
                 if s.kind in ("slow_start", "slow_end", "asym_start",
-                              "asym_end"):
+                              "asym_end", "slow_epoch_start",
+                              "slow_epoch_end"):
                     raise ScenarioError(
-                        f"{s.kind} (r14 loss-adversarial family) needs "
+                        f"{s.kind} (loss-adversarial family) needs "
                         "per-link (dense) links; this engine has no "
                         "per-pair link plane — run these scenarios on the "
                         "dense engine (dense_links=True)"
@@ -266,8 +377,7 @@ class StateTimeline:
 
             def fn(st, groups=groups, clear=0.0):
                 for a, b in itertools.combinations(groups, 2):
-                    st = ops.set_link_loss(st, list(a), list(b), clear)
-                    st = ops.set_link_loss(st, list(b), list(a), clear)
+                    st = self._heal_pair(st, list(a), list(b), clear)
                 return st
 
         elif step.kind == "flap_down":
@@ -293,7 +403,7 @@ class StateTimeline:
                 # exponential-mean delay on every link touching the cohort
                 # (both directions) — ops.set_link_delay validates that the
                 # engine's delay rings are armed (params.delay_slots > 0)
-                n = st.capacity
+                n = _state_capacity(st)
                 everyone = list(range(n))
                 st = ops.set_link_delay(st, everyone, list(rows), float(delay))
                 return ops.set_link_delay(st, list(rows), everyone, float(delay))
@@ -302,7 +412,7 @@ class StateTimeline:
             (rows,) = step.payload
 
             def fn(st, rows=rows):
-                n = st.capacity
+                n = _state_capacity(st)
                 everyone = list(range(n))
                 st = ops.set_link_delay(st, everyone, list(rows), 0.0)
                 return ops.set_link_delay(st, list(rows), everyone, 0.0)
@@ -317,7 +427,7 @@ class StateTimeline:
                 # contract) — apply max(pct, floor); the clean variant
                 # replays on the restored matrix at storm end
                 eff = p if clear is None else max(p, clear)
-                n = st.capacity
+                n = _state_capacity(st)
                 everyone = list(range(n))
                 if d in ("in", "both"):
                     st = ops.set_link_loss(st, everyone, list(rows), eff)
@@ -329,7 +439,7 @@ class StateTimeline:
             rows, direction = step.payload
 
             def fn(st, rows=rows, d=direction, clear=0.0):
-                n = st.capacity
+                n = _state_capacity(st)
                 everyone = list(range(n))
                 if d in ("in", "both"):
                     st = ops.set_link_loss(st, everyone, list(rows), clear)
@@ -354,6 +464,43 @@ class StateTimeline:
                         st = ops.join_row(st, r, list(seed_rows))
                 return st
 
+        elif step.kind == "zone_down":
+            (rows,) = step.payload
+
+            def fn(st, rows=rows, clear=0.0):
+                rest = [r for r in range(_state_capacity(st)) if r not in set(rows)]
+                if not rest:
+                    return st
+                return ops.block_partition(st, list(rows), rest)
+
+        elif step.kind == "zone_up":
+            (rows,) = step.payload
+
+            def fn(st, rows=rows, clear=0.0):
+                rest = [r for r in range(_state_capacity(st)) if r not in set(rows)]
+                if not rest:
+                    return st
+                return self._heal_pair(st, list(rows), rest, clear)
+
+        elif step.kind == "slow_epoch_start":
+            (delay,) = step.payload
+
+            def fn(st, delay=delay):
+                everyone = list(range(_state_capacity(st)))
+                return ops.set_link_delay(st, everyone, everyone, float(delay))
+
+        elif step.kind == "slow_epoch_end":
+
+            def fn(st):
+                everyone = list(range(_state_capacity(st)))
+                return ops.set_link_delay(st, everyone, everyone, 0.0)
+
+        elif step.kind == "refute_drop":
+            (rows,) = step.payload
+
+            def fn(st, rows=rows):
+                return ops.drop_refutes(st, list(rows))
+
         elif step.kind == "storm_start":
             (pct,) = step.payload
             return self._storm_start(state, pct)
@@ -364,7 +511,7 @@ class StateTimeline:
 
         if self._storm_stash is not None and step.kind in (
             "partition_block", "partition_heal", "flap_down", "flap_up",
-            "asym_start", "asym_end",
+            "asym_start", "asym_end", "zone_down", "zone_up",
         ):
             # the CLEAN variant replays on the restored matrix at storm end;
             # during the storm, links that clear only drop to the storm
@@ -373,6 +520,18 @@ class StateTimeline:
             self._storm_replay.append(fn)
             return fn(state, clear=self._storm_pct)
         return fn(state)
+
+    def _heal_pair(self, st, a, b, clear):
+        """Heal the directed block between row groups ``a`` and ``b``. Routes
+        through ``ops.heal_partition_pair`` when the ops module names the
+        operation (the fleet layer intercepts it to vary per-scenario
+        partition assignments); the fallback is the value-identical legacy
+        spelling, two directed ``set_link_loss`` writes."""
+        heal = getattr(self._ops, "heal_partition_pair", None)
+        if heal is not None:
+            return heal(st, list(a), list(b), clear)
+        st = self._ops.set_link_loss(st, list(a), list(b), clear)
+        return self._ops.set_link_loss(st, list(b), list(a), clear)
 
     def _storm_start(self, state, pct: float):
         import jax.numpy as jnp
@@ -431,6 +590,8 @@ class DriverChaosRunner:
             for ev in scenario.events:
                 if isinstance(ev, Crash):
                     crash_rows.extend(int(r) for r in ev.rows)
+                elif isinstance(ev, ChurnStorm):
+                    crash_rows.extend(int(r) for r in ev.rows)
             uniq = tuple(dict.fromkeys(crash_rows))
             if driver._trace is None:
                 # auto-attach (r10): the scenario's crashed rows are the
@@ -477,6 +638,7 @@ class DriverChaosRunner:
         self._check = jax.jit(eng.sentinel_reduce)
         self.events_applied: List[Tuple[int, str]] = []
         self.rel_tick = 0
+        self.max_window = 32
         self.done = False
         self.last_report: Optional[dict] = None
         driver._chaos = self
@@ -513,6 +675,7 @@ class DriverChaosRunner:
         ``max_window`` ticks each (the jit cache keys on window length, so a
         scenario reuses a handful of compiled window programs)."""
         d = self.driver
+        self.max_window = max_window  # recorded for incident reconstruction
         horizon = self.spec.horizon
         check_every = self.spec.check_interval
         next_check = check_every if self._sent is not None else horizon + 1
@@ -587,6 +750,10 @@ class DriverChaosRunner:
     def report(self) -> dict:
         """Structured scenario report. Reading it is a sync point (the
         sentinel accumulators come to host here)."""
+        import os
+
+        import jax
+
         events = list(self.events_applied)  # monitor thread vs sim appends
         rep = {
             "scenario": self.scenario.name,
@@ -594,6 +761,12 @@ class DriverChaosRunner:
             "t0": self.t0,
             "horizon": self.spec.horizon,
             "ticks_run": self.rel_tick,
+            # provenance stamps (the r13 backend-stamp rule, applied to the
+            # chaos surface): which backend ran the scenario, on how many
+            # host CPUs, over which absolute tick range
+            "backend": jax.default_backend(),
+            "host_cpus": os.cpu_count(),
+            "tick_range": [self.t0, self.t0 + self.rel_tick],
             "events_applied": [{"tick": t, "event": lab} for t, lab in events],
         }
         if self._sent is not None:
@@ -665,8 +838,16 @@ class EmulatorChaosRunner:
         # engines compose these correctly; run composed scenarios there)
         from .events import DEGRADED_EVENT_TYPES
 
+        for ev in scenario.events:
+            if isinstance(ev, DroppedRefute):
+                raise ScenarioError(
+                    "DroppedRefute manipulates the device view planes "
+                    "(refute squashing); the emulator runner's members own "
+                    "their real gossip state — run the scenario on the "
+                    "dense engine"
+                )
         deg = [e for e in scenario.events
-               if isinstance(e, DEGRADED_EVENT_TYPES)]
+               if isinstance(e, (SlowEpoch,) + DEGRADED_EVENT_TYPES)]
         storms = [e for e in scenario.events if isinstance(e, LossStorm)]
         for d in deg:
             d0, d1 = _window(d, "until")
@@ -674,10 +855,11 @@ class EmulatorChaosRunner:
                 s0, s1 = _window(s, "until")
                 if d0 < s1 and s0 < d1:
                     raise ScenarioError(
-                        f"{type(d).__name__}{list(d.rows)} overlaps a "
-                        "LossStorm: the emulator runner's single default-"
-                        "outbound slot cannot hold both — stagger them, or "
-                        "run the composed scenario on a device engine"
+                        f"{type(d).__name__}{list(getattr(d, 'rows', ()))} "
+                        "overlaps a LossStorm: the emulator runner's single "
+                        "default-outbound slot cannot hold both — stagger "
+                        "them, or run the composed scenario on a device "
+                        "engine"
                     )
         self.scenario = scenario
         self._emus = list(emulators)
@@ -777,6 +959,23 @@ class EmulatorChaosRunner:
             if direction in ("out", "both"):
                 for r in rows:
                     self._emus[r].set_default_outbound_settings(0.0, 0.0)
+        elif step.kind == "zone_down":
+            (rows,) = step.payload
+            rest = [i for i in range(len(self._emus)) if i not in set(rows)]
+            if rest:
+                self._block(list(rows), rest)
+        elif step.kind == "zone_up":
+            (rows,) = step.payload
+            rest = [i for i in range(len(self._emus)) if i not in set(rows)]
+            if rest:
+                self._unblock(list(rows), rest)
+        elif step.kind == "slow_epoch_start":
+            (delay,) = step.payload
+            for emu in self._emus:
+                emu.set_default_outbound_settings(0.0, delay)
+        elif step.kind == "slow_epoch_end":
+            for emu in self._emus:
+                emu.set_default_outbound_settings(0.0, 0.0)
         elif step.kind == "crash":
             (rows,) = step.payload
             for r in rows:

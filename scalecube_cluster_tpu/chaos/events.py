@@ -212,9 +212,146 @@ class Restart:
             raise ScenarioError("Restart needs at least one row")
 
 
+@dataclass(frozen=True)
+class ZoneOutage:
+    """Correlated group failure (r18): the whole ``rows`` zone loses
+    connectivity to EVERY other member in [at, until) — a rack/AZ cut.
+
+    Unlike :class:`Partition` the complement is implicit ("everyone else"),
+    so the event compiles against any capacity without naming the rest of
+    the cluster; there are no bystanders. Rides the dense link planes, and
+    the pview ``GROUP_PARTITIONS`` capability on the 1M-member engine.
+    ``until`` (None = never heals inside the scenario) restores the cut
+    links to clear (or the active storm's floor, like every heal).
+    """
+
+    rows: Sequence[int]
+    at: int
+    until: Optional[int] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("ZoneOutage needs at least one row")
+        if self.until is not None and self.until <= self.at:
+            raise ScenarioError("ZoneOutage.until must be > at")
+
+
+@dataclass(frozen=True)
+class ChurnStorm:
+    """Batched crash/restart waves (r18): ``rows`` split into ``waves``
+    contiguous chunks; chunk ``k`` hard-crashes at ``at + k*period`` and
+    restarts (fresh identity, epoch bump) ``down_for`` ticks later via
+    ``seed_rows`` — the scalecube testlib rolling-churn archetype.
+
+    Waves may overlap (``down_for > period`` keeps several chunks down at
+    once), which is why ``seed_rows`` must be disjoint from ``rows``: the
+    bootstrap contact has to stay up through the whole storm.
+    """
+
+    rows: Sequence[int]
+    at: int
+    waves: int = 2
+    period: int = 8
+    down_for: int = 4
+    seed_rows: Sequence[int] = (0,)
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        object.__setattr__(self, "seed_rows", _rows(self.seed_rows))
+        if not self.rows:
+            raise ScenarioError("ChurnStorm needs at least one row")
+        if self.waves < 1:
+            raise ScenarioError("ChurnStorm.waves must be >= 1")
+        if len(self.rows) < self.waves:
+            raise ScenarioError(
+                "ChurnStorm needs at least one row per wave "
+                f"({len(self.rows)} rows < {self.waves} waves)"
+            )
+        if self.period < 1:
+            raise ScenarioError("ChurnStorm.period must be >= 1")
+        if self.down_for < 1:
+            raise ScenarioError("ChurnStorm.down_for must be >= 1")
+        if set(self.rows) & set(self.seed_rows):
+            raise ScenarioError(
+                "ChurnStorm.seed_rows must be disjoint from rows (the "
+                "bootstrap contact must survive the storm)"
+            )
+
+    def wave_schedule(self) -> Tuple[Tuple[int, int, Tuple[int, ...]], ...]:
+        """``(crash_tick, restart_tick, chunk_rows)`` per wave, in order."""
+        n = len(self.rows)
+        per = -(-n // self.waves)  # ceil division
+        out = []
+        for k in range(self.waves):
+            chunk = self.rows[k * per:(k + 1) * per]
+            if not chunk:
+                break
+            t = self.at + k * self.period
+            out.append((t, t + self.down_for, chunk))
+        return tuple(out)
+
+    def last_tick(self) -> int:
+        return max(r for _, r, _ in self.wave_schedule())
+
+
+@dataclass(frozen=True)
+class SlowEpoch:
+    """Time-boxed slow-network epoch (r18): EVERY link gains
+    ``mean_delay_ticks`` of exponential-mean delay in [at, until) — the
+    cluster-wide analogue of :class:`SlowMember` (whole-fabric congestion,
+    not one slow host). Needs the dense delay model (``delay_slots > 0``);
+    ``until`` is required (an unbounded slow epoch has no horizon) and
+    restores every link to zero delay.
+    """
+
+    mean_delay_ticks: float
+    at: int
+    until: int
+
+    def __post_init__(self):
+        if self.mean_delay_ticks <= 0:
+            raise ScenarioError("SlowEpoch.mean_delay_ticks must be > 0")
+        if self.until is None or self.until <= self.at:
+            raise ScenarioError("SlowEpoch.until must be > at")
+
+
+@dataclass(frozen=True)
+class DroppedRefute:
+    """Byzantine-adjacent refute suppression (r18): in [at, until) every
+    self-refutation ``rows`` issue is squashed before it can disseminate —
+    the member keeps running (it probes, acks, gossips other rumors) but
+    its alive-again counter-evidence never leaves the host, as if an
+    adversary dropped exactly those packets.
+
+    Mechanically the timeline rewinds each row's OWN self-record to the
+    strongest record the rest of the cluster holds whenever the row has
+    refuted (inc-bumped over) a SUSPECT/DEAD verdict, every tick of the
+    window — exercising the r14 suspicion/refutation race from the losing
+    side. The rows stay alive, so any DEAD verdict about them inside the
+    window is a *true* suppression casualty, not a detector bug: they join
+    the false-positive watch cohort only via explicit ``fp_watch_rows``.
+    ``until`` is required; after it, normal refutation resumes and the rows
+    must converge back to ALIVE (the heal obligation the sentinels check).
+    Dense engines only (needs the [N, N] view planes + changed_at).
+    """
+
+    rows: Sequence[int]
+    at: int
+    until: int
+
+    def __post_init__(self):
+        object.__setattr__(self, "rows", _rows(self.rows))
+        if not self.rows:
+            raise ScenarioError("DroppedRefute needs at least one row")
+        if self.until is None or self.until <= self.at:
+            raise ScenarioError("DroppedRefute.until must be > at")
+
+
 EVENT_TYPES = (
     Partition, LossStorm, LinkFlap, Crash, Restart,
     SlowMember, AsymmetricLoss, FlakyObserver,
+    ZoneOutage, ChurnStorm, SlowEpoch, DroppedRefute,
 )
 
 #: the r14 loss-adversarial family: events that DEGRADE members without
@@ -312,6 +449,8 @@ class Scenario:
                 v = getattr(ev, attr, None)
                 if v is not None:
                     last = max(last, v)
+            if isinstance(ev, ChurnStorm):
+                last = max(last, ev.last_tick())
         return last
 
     def fault_touched_rows(
@@ -326,11 +465,18 @@ class Scenario:
         protects."""
         touched: set = set()
         for ev in self.events:
-            if isinstance(ev, (Crash, Restart)):
+            if isinstance(ev, (Crash, Restart, ChurnStorm, DroppedRefute)):
+                # a DroppedRefute row can legitimately age to DEAD while its
+                # refutes are suppressed — that is the fault, not a detector
+                # bug, so the false-DEAD sentinel must not vouch for it
                 touched.update(ev.rows)
             elif isinstance(ev, Partition):
                 for g in ev.groups:
                     touched.update(g)
+            elif isinstance(ev, (ZoneOutage, SlowEpoch)):
+                # a zone cut severs links on BOTH sides (no bystanders), and
+                # a slow epoch delays every link — nobody is vouched-for
+                touched.update(range(capacity))
             elif isinstance(ev, LinkFlap):
                 for s, d in ev.pairs:
                     touched.update((s, d))
@@ -347,3 +493,69 @@ class Scenario:
 
     def replace(self, **kw) -> "Scenario":
         return dataclasses.replace(self, **kw)
+
+
+# -- (de)serialization --------------------------------------------------------
+# Flight dumps (telemetry/flight.py schema >= 2) embed the armed scenario so
+# replay.py can rebuild it without inference. Events round-trip through plain
+# dicts: {"type": <class name>, ...fields} — JSON-safe, no pickle.
+
+_EVENT_BY_NAME = {cls.__name__: cls for cls in EVENT_TYPES}
+
+
+def event_to_dict(ev) -> dict:
+    """JSON-safe dict for one timeline event (round-trips via
+    :func:`event_from_dict`)."""
+    if not isinstance(ev, EVENT_TYPES):
+        raise ScenarioError(f"cannot serialize unknown event {ev!r}")
+    doc = dataclasses.asdict(ev)
+    doc["type"] = type(ev).__name__
+    return doc
+
+
+def event_from_dict(doc: dict):
+    """Inverse of :func:`event_to_dict`; raises ``ScenarioError`` on an
+    unknown type name or bad fields (future-vocabulary dumps fail LOUDLY)."""
+    if not isinstance(doc, dict) or "type" not in doc:
+        raise ScenarioError(f"malformed event doc {doc!r}")
+    cls = _EVENT_BY_NAME.get(doc["type"])
+    if cls is None:
+        raise ScenarioError(
+            f"unknown event type {doc['type']!r} (from a newer fault "
+            f"vocabulary?) — known: {sorted(_EVENT_BY_NAME)}"
+        )
+    kw = {k: v for k, v in doc.items() if k != "type"}
+    try:
+        return cls(**kw)
+    except TypeError as e:
+        raise ScenarioError(f"bad fields for {doc['type']}: {e}") from e
+
+
+def scenario_to_dict(scenario: "Scenario") -> dict:
+    """JSON-safe dict for a full scenario (events + budgets)."""
+    return {
+        "name": scenario.name,
+        "events": [event_to_dict(ev) for ev in scenario.events],
+        "horizon": scenario.horizon,
+        "detect_budget": scenario.detect_budget,
+        "converge_budget": scenario.converge_budget,
+        "check_interval": scenario.check_interval,
+        "fp_watch_rows": list(scenario.fp_watch_rows),
+        "fp_enforce": scenario.fp_enforce,
+    }
+
+
+def scenario_from_dict(doc: dict) -> "Scenario":
+    """Inverse of :func:`scenario_to_dict`."""
+    if not isinstance(doc, dict) or "name" not in doc or "events" not in doc:
+        raise ScenarioError(f"malformed scenario doc: {sorted(doc) if isinstance(doc, dict) else doc!r}")
+    return Scenario(
+        name=doc["name"],
+        events=tuple(event_from_dict(e) for e in doc["events"]),
+        horizon=doc.get("horizon"),
+        detect_budget=doc.get("detect_budget"),
+        converge_budget=doc.get("converge_budget"),
+        check_interval=doc.get("check_interval"),
+        fp_watch_rows=tuple(doc.get("fp_watch_rows", ())),
+        fp_enforce=bool(doc.get("fp_enforce", True)),
+    )

@@ -34,7 +34,18 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .events import Crash, LinkFlap, LossStorm, Partition, Restart, Scenario
+from .events import (
+    ChurnStorm,
+    Crash,
+    DroppedRefute,
+    LinkFlap,
+    LossStorm,
+    Partition,
+    Restart,
+    Scenario,
+    SlowEpoch,
+    ZoneOutage,
+)
 
 
 def _ceil_log2(n: int) -> int:
@@ -81,6 +92,35 @@ _STRATEGY_SCALE = {
 _TOPOLOGY_SCALE = {
     "full": 1.0, "expander": 1.0, "ring": 1.5, "torus": 1.25, "geo": 2.0,
 }
+
+
+def scenario_budget_scale(scenario: Scenario) -> tuple:
+    """(detect_scale, converge_scale) the r18 fault vocabulary applies on
+    top of the protocol-math defaults — scenario-content-driven slack,
+    multiplicative with the r13 dissemination scaling:
+
+    * ``SlowEpoch`` inflates every gossip/anti-entropy hop by the scripted
+      mean delay, so both budgets stretch with it (capped — a sentinel
+      budget is generous by design, not a bound proof);
+    * ``ChurnStorm`` leaves one wave's death rumors still in flight at the
+      next wave's restart, so re-convergence stretches with the wave count;
+    * ``DroppedRefute`` forces the squashed rows to out-gossip a fully
+      disseminated suspicion (or DEAD tombstone) after the window ends.
+
+    Explicit ``Scenario.detect_budget`` / ``converge_budget`` are never
+    scaled — a scripted budget wins verbatim.
+    """
+    d_scale = c_scale = 1.0
+    for ev in scenario.events:
+        if isinstance(ev, SlowEpoch):
+            s = min(3.0, 1.0 + ev.mean_delay_ticks / 8.0)
+            d_scale = max(d_scale, s)
+            c_scale = max(c_scale, s)
+        elif isinstance(ev, ChurnStorm):
+            c_scale = max(c_scale, 1.0 + 0.25 * (ev.waves - 1))
+        elif isinstance(ev, DroppedRefute):
+            c_scale = max(c_scale, 1.5)
+    return d_scale, c_scale
 
 
 def dissemination_budget_scale(params) -> float:
@@ -160,6 +200,11 @@ def build_spec(
     converge = scenario.converge_budget or getattr(
         chaos_cfg, "converge_budget_ticks", 0
     ) or default_converge_budget(params)
+    d_scale, c_scale = scenario_budget_scale(scenario)
+    if not scenario.detect_budget:
+        detect = max(1, int(round(detect * d_scale)))
+    if not scenario.converge_budget:
+        converge = max(1, int(round(converge * c_scale)))
     check = scenario.check_interval or getattr(
         chaos_cfg, "check_interval_ticks", 0
     ) or 32
@@ -209,6 +254,27 @@ def build_spec(
         elif isinstance(ev, LinkFlap) and ev.until is not None:
             conv_from.append(ev.until)
             conv_labels.append(f"flap_end@{ev.until}")
+        elif isinstance(ev, ZoneOutage) and ev.until is not None:
+            conv_from.append(ev.until)
+            conv_labels.append(f"zone_up@{ev.until}")
+        elif isinstance(ev, ChurnStorm):
+            # each wave is a crash obligation (lapsing at its own restart,
+            # like a Crash/Restart pair) and each restart a convergence point
+            for w, (c_tick, r_tick, chunk) in enumerate(ev.wave_schedule()):
+                for r in chunk:
+                    crash_rows.append(r)
+                    crash_at.append(c_tick)
+                    crash_until.append(r_tick)
+                conv_from.append(r_tick)
+                conv_labels.append(f"churn_restart[w{w}]@{r_tick}")
+        elif isinstance(ev, SlowEpoch):
+            conv_from.append(ev.until)
+            conv_labels.append(f"slow_epoch_end@{ev.until}")
+        elif isinstance(ev, DroppedRefute):
+            # after the drop window the rows must out-refute whatever
+            # verdict accumulated and the cluster must re-converge
+            conv_from.append(ev.until)
+            conv_labels.append(f"refute_resume@{ev.until}")
 
     spec = SentinelSpec(
         capacity=n,

@@ -124,6 +124,10 @@ class MonitorServer:
         self._chaos: Optional[Callable[[], Dict[str, Any]]] = None
         # r16 closed-loop controller snapshot provider for /control
         self._control: Optional[Callable[[], Dict[str, Any]]] = None
+        # r18 incident-replay what-if provider for /whatif (serves the
+        # NEWEST computed counterfactual record — the MC itself is a
+        # bench-cadence compute step, never an HTTP-GET one)
+        self._whatif: Optional[Callable[[], Dict[str, Any]]] = None
         # OpenMetrics family providers, concatenated at /metrics scrape
         # time (r8 telemetry plane); each returns a list of family dicts
         self._metric_providers: List[Callable[[], List[Dict[str, Any]]]] = []
@@ -137,6 +141,13 @@ class MonitorServer:
 
     def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
         self._providers[name] = provider
+
+    def register_whatif(self, service) -> None:
+        """Serve the r18 counterfactual what-if service at ``GET /whatif``:
+        the newest :func:`.replay.whatif` record (arms, Wilson intervals,
+        CI-separation verdicts). ``service`` is a
+        :class:`.replay.WhatifService` (or any object with ``snapshot()``)."""
+        self._whatif = service.snapshot
 
     def register_cluster(self, cluster) -> None:
         self.register(cluster.member().id, lambda: cluster_snapshot(cluster))
@@ -289,6 +300,7 @@ class MonitorServer:
                 "dispatch": self._dispatch is not None,
                 "chaos": self._chaos is not None,
                 "control": self._control is not None,
+                "whatif": self._whatif is not None,
                 "metrics": bool(self._metric_providers),
                 "events": self._events is not None,
                 "trace": self._trace is not None,
@@ -320,6 +332,10 @@ class MonitorServer:
             if self._control is None:
                 return b"404 Not Found", {"error": "no control provider registered"}
             return b"200 OK", self._control()
+        if path == "/whatif":
+            if self._whatif is None:
+                return b"404 Not Found", {"error": "no whatif service registered"}
+            return b"200 OK", self._whatif()
         if path == "/health":
             if self._health is None:
                 return b"404 Not Found", {"error": "no health provider registered"}

@@ -240,6 +240,12 @@ _TIMELINE_MUTATORS = frozenset({
     "crash_rows", "crash_row", "join_row", "join_rows", "begin_leave",
     "set_link_loss", "set_link_delay", "set_uniform_loss",
     "block_partition", "heal_partition", "spread_rumor", "update_metadata",
+    # r18 additions: the named partition-heal seam, the precomputed-q delay
+    # write, the assign-vector partition spellings, and the byzantine
+    # refute squash — all pure state->state, so the default vmap treatment
+    # is exactly right when no FleetVary intercepts them
+    "heal_partition_pair", "set_link_delay_q",
+    "block_partition_assign", "heal_partition_assign", "drop_refutes",
 })
 
 
@@ -267,13 +273,37 @@ class FleetVary:
       SCHEDULED pct (the storm-replay ``clear`` floor is a host value);
       keep varied-floor scenarios free of mid-storm link events, or
       accept the scheduled floor on those writes.
+    * ``delay_ticks`` — [S] f32 mean delay in TICKS (r18, the named r16
+      leftover): every scheduled POSITIVE link-delay write (``SlowMember``
+      / ``SlowEpoch`` starts) uses scenario ``s``'s mean instead of the
+      scripted one. The mean→q transcendental runs on HOST here, once per
+      scenario at build time; the timeline then vmaps the precomputed [S]
+      q vector through ``ops.set_link_delay_q``. Teardown writes (mean 0)
+      stay broadcast. Dense delay engines only — refused loudly otherwise.
+    * ``partition_assign`` — [S, N] i32 (r18, the other named leftover):
+      scenario ``s``'s ``Partition`` uses GROUP ASSIGNMENT
+      ``partition_assign[s]`` (``-1`` = bystander keeps links) instead of
+      the scripted groups — block and heal both ride it, so one compiled
+      fleet sweeps partition SHAPES (minority/majority cuts, moved
+      bridges). Requires exactly one ``Partition`` event, no ``ZoneOutage``
+      (its block would be intercepted too), and an ops module with the
+      assign-vector spellings (dense links) — refused loudly otherwise.
     """
 
     crash_rows: Optional[object] = None  # [S] i32 (array-like)
     loss_pct: Optional[object] = None  # [S] f32, percent
+    delay_ticks: Optional[object] = None  # [S] f32, mean delay in ticks
+    partition_assign: Optional[object] = None  # [S, N] i32, -1 = bystander
 
     def validate(self, scenario) -> None:
-        from ..chaos.events import Crash, ScenarioError
+        from ..chaos.events import (
+            Crash,
+            Partition,
+            ScenarioError,
+            SlowEpoch,
+            SlowMember,
+            ZoneOutage,
+        )
 
         if self.crash_rows is not None:
             crashes = [e for e in scenario.events if isinstance(e, Crash)]
@@ -283,6 +313,26 @@ class FleetVary:
                     "Crash event naming one row (the per-scenario subject "
                     f"it replaces); {scenario.name!r} schedules "
                     f"{[list(c.rows) for c in crashes]}"
+                )
+        if self.delay_ticks is not None:
+            slows = [e for e in scenario.events
+                     if isinstance(e, (SlowMember, SlowEpoch))]
+            if not slows:
+                raise ScenarioError(
+                    "FleetVary.delay_ticks varies the scheduled link-delay "
+                    f"writes, but {scenario.name!r} schedules no SlowMember/"
+                    "SlowEpoch event — nothing to vary"
+                )
+        if self.partition_assign is not None:
+            parts = [e for e in scenario.events if isinstance(e, Partition)]
+            zones = [e for e in scenario.events if isinstance(e, ZoneOutage)]
+            if len(parts) != 1 or zones:
+                raise ScenarioError(
+                    "FleetVary.partition_assign needs a scenario with "
+                    "exactly one Partition event and no ZoneOutage (every "
+                    "block/heal in the schedule is replaced by the "
+                    f"per-scenario assignment); {scenario.name!r} schedules "
+                    f"{len(parts)} Partition + {len(zones)} ZoneOutage"
                 )
 
 
@@ -323,6 +373,49 @@ class FleetOps:
 
             return vmapped
 
+        if name == "set_link_delay" and vary is not None \
+                and vary.delay_ticks is not None:
+            from .state import delay_mean_to_q
+
+            q_s = jnp.asarray(
+                [delay_mean_to_q(float(m)) for m in vary.delay_ticks],
+                jnp.float32,
+            )
+            target_q = getattr(self._ops, "set_link_delay_q")
+
+            def vmapped(fleet_state, src, dst, mean, **kwargs):
+                if float(mean) > 0:
+                    # a scheduled delay START carries the per-scenario mean
+                    # (as its host-precomputed q); teardown (mean 0) stays
+                    # the broadcast zero write
+                    return jax.vmap(
+                        lambda st, q: target_q(st, src, dst, q)
+                    )(fleet_state, q_s)
+                return jax.vmap(lambda st: target(st, src, dst, mean))(
+                    fleet_state
+                )
+
+            return vmapped
+
+        if name in ("block_partition", "heal_partition_pair") \
+                and vary is not None and vary.partition_assign is not None:
+            assign_s = jnp.asarray(vary.partition_assign, jnp.int32)
+            if name == "block_partition":
+                block = getattr(self._ops, "block_partition_assign")
+
+                def vmapped(fleet_state, _a, _b, **kwargs):
+                    return jax.vmap(block)(fleet_state, assign_s)
+
+            else:
+                heal = getattr(self._ops, "heal_partition_assign")
+
+                def vmapped(fleet_state, _a, _b, clear=0.0, **kwargs):
+                    return jax.vmap(
+                        lambda st, g: heal(st, g, clear=clear)
+                    )(fleet_state, assign_s)
+
+            return vmapped
+
         if name == "set_uniform_loss" and vary is not None \
                 and vary.loss_pct is not None:
             frac_s = jnp.asarray(vary.loss_pct, jnp.float32) / 100.0
@@ -360,7 +453,30 @@ def fleet_timeline(scenario, ops, dense_links: bool, horizon=None,
     from ..chaos.engine import StateTimeline
 
     if vary is not None:
+        from ..chaos.events import ScenarioError
+
         vary.validate(scenario)
+        if vary.delay_ticks is not None and (
+            not dense_links or not hasattr(ops, "set_link_delay_q")
+        ):
+            raise ScenarioError(
+                "FleetVary.delay_ticks needs the dense delay plane and an "
+                "ops module with set_link_delay_q (the precomputed-q "
+                f"write); {getattr(ops, '__name__', ops)!r} with "
+                f"dense_links={dense_links} cannot batch per-scenario "
+                "delays"
+            )
+        if vary.partition_assign is not None and (
+            not dense_links or not hasattr(ops, "block_partition_assign")
+        ):
+            raise ScenarioError(
+                "FleetVary.partition_assign needs dense [N, N] links and "
+                "an ops module with the assign-vector partition spellings "
+                f"(block/heal_partition_assign); "
+                f"{getattr(ops, '__name__', ops)!r} with "
+                f"dense_links={dense_links} cannot batch per-scenario "
+                "partition shapes"
+            )
     return StateTimeline(
         scenario, FleetOps(ops, vary), dense_links=dense_links,
         horizon=horizon,

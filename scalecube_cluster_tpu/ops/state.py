@@ -696,6 +696,121 @@ def heal_partition(state: SimState, group_a, group_b) -> SimState:
     return set_link_loss(s, group_b, group_a, 0.0)
 
 
+def heal_partition_pair(
+    state: SimState, group_a, group_b, clear: float = 0.0
+) -> SimState:
+    """Heal the symmetric block between two groups down to ``clear`` (the
+    active storm's floor during a LossStorm, else 0). Value-identical to two
+    directed :func:`set_link_loss` writes — it exists as a NAMED operation so
+    the chaos timeline's partition heals are interceptable per-mutator (the
+    fleet layer varies partition assignment per scenario by capturing this
+    name; a bare ``set_link_loss`` spelling is indistinguishable from an
+    asym-loss teardown)."""
+    s = set_link_loss(state, group_a, group_b, clear)
+    return set_link_loss(s, group_b, group_a, clear)
+
+
+def set_link_delay_q(state: SimState, src, dst, q) -> SimState:
+    """Traceable sibling of :func:`set_link_delay`: writes an ALREADY
+    CONVERTED geometric parameter ``q`` (device scalar or traced value) on
+    directed link(s) src->dst. The mean→q transcendental stays on host
+    (:func:`delay_mean_to_q`); this entry point exists so the fleet layer
+    can vmap a per-scenario [S] vector of precomputed q values over the
+    batched delay plane."""
+    if state.delay_q.ndim == 0:
+        raise ValueError(
+            "per-link delay needs dense links; init_state(dense_links=True)"
+        )
+    if state.pending_key.shape[0] == 0:
+        raise ValueError("link delay requires params.delay_slots > 0")
+    src = jnp.atleast_1d(jnp.asarray(src))
+    dst = jnp.atleast_1d(jnp.asarray(dst))
+    return state.replace(
+        delay_q=state.delay_q.at[src[:, None], dst[None, :]].set(
+            jnp.asarray(q, jnp.float32)
+        )
+    )
+
+
+def block_partition_assign(state: SimState, assign) -> SimState:
+    """Partition from a per-row GROUP ASSIGNMENT vector instead of explicit
+    row lists: ``assign[i]`` is row i's group id, ``-1`` = bystander (keeps
+    all links). Blocks every cross-group link; value-identical to
+    :func:`block_partition` over the corresponding groups. Fully traceable
+    in ``assign`` — the fleet layer vmaps an [S, N] assignment plane to give
+    every scenario its own partition shape."""
+    if state.loss.ndim == 0:
+        raise ValueError(
+            "per-link loss needs dense links; init_state(dense_links=True)"
+        )
+    assign = jnp.asarray(assign, jnp.int32)
+    cross = (
+        (assign[:, None] != assign[None, :])
+        & (assign[:, None] >= 0)
+        & (assign[None, :] >= 0)
+    )
+    new_loss = jnp.where(cross, jnp.float32(1.0), state.loss)
+    return state.replace(loss=new_loss, fetch_rt=_roundtrip(new_loss))
+
+
+def heal_partition_assign(state: SimState, assign, clear=0.0) -> SimState:
+    """Inverse of :func:`block_partition_assign`: every cross-group link
+    drops to ``clear`` (the storm floor during a LossStorm, else 0)."""
+    if state.loss.ndim == 0:
+        raise ValueError(
+            "per-link loss needs dense links; init_state(dense_links=True)"
+        )
+    assign = jnp.asarray(assign, jnp.int32)
+    cross = (
+        (assign[:, None] != assign[None, :])
+        & (assign[:, None] >= 0)
+        & (assign[None, :] >= 0)
+    )
+    new_loss = jnp.where(cross, jnp.float32(clear), state.loss)
+    return state.replace(loss=new_loss, fetch_rt=_roundtrip(new_loss))
+
+
+def drop_refutes(state: SimState, rows) -> SimState:
+    """Byzantine-adjacent refute suppression (chaos ``DroppedRefute`` site):
+    for each row in ``rows``, if the row's OWN self record has refuted — its
+    diagonal key exceeds the strongest record the REST of the cluster holds
+    for it, and that external record is SUSPECT/DEAD — rewind the diagonal
+    to the external record, as if the refutation message never existed.
+
+    Sound as a between-window squash because the refute phase runs AFTER
+    gossip/SYNC inside a tick: a refute bumped during tick t cannot reach any
+    peer before tick t+1, so squashing at the t/t+1 seam suppresses it
+    completely. Each later refute re-bumps from the squashed record, so the
+    incarnation never runs away. The squashed cell is re-stamped at the
+    current tick — the row keeps gossiping the *suspicion about itself* (it
+    accepted the verdict it could not refute), and its own suspicion timer
+    restarts, so the row never self-transitions to DEAD while squashed.
+    Dense state only (needs the [N, N] view + changed_at planes)."""
+    from .lattice import RANK_DEAD, RANK_SUSPECT
+
+    rows = jnp.asarray(rows, jnp.int32)
+    vk = state.view_key
+    n = state.capacity
+    col = vk[:, rows]  # [N, K]: every observer's record for each target
+    is_self = jnp.arange(n)[:, None] == rows[None, :]
+    ext = jnp.max(
+        jnp.where(is_self, no_candidate(vk.dtype), col), axis=0
+    )  # [K] strongest EXTERNAL record per target
+    diag = vk[rows, rows]
+    ext_rank = (ext & 3).astype(jnp.int32)
+    squash = (
+        (diag > ext)
+        & (ext >= 0)  # someone actually holds a record
+        & ((ext_rank == RANK_SUSPECT) | (ext_rank == RANK_DEAD))
+    )
+    return state.replace(
+        view_key=vk.at[rows, rows].set(jnp.where(squash, ext, diag)),
+        changed_at=state.changed_at.at[rows, rows].set(
+            jnp.where(squash, state.tick, state.changed_at[rows, rows])
+        ),
+    )
+
+
 def snapshot(state: SimState) -> dict[str, np.ndarray]:
     """Host checkpoint: the full state as numpy arrays (SURVEY.md §5.4 —
     checkpoint/resume is an addition over the reference, whose state is soft)."""

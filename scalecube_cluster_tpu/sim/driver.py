@@ -256,6 +256,11 @@ class SimDriver:
         # dispatch wall time (first dispatch includes the jit compile, or
         # the persistent-cache load when one hits)
         self._step_stats: Dict[tuple, dict] = {}
+        # r18: construction seed + warm flag kept host-side — the flight
+        # recorder's reconstruction section embeds them so an incident dump
+        # can rebuild a bit-identical replay driver (replay.py)
+        self.seed = int(seed)
+        self._init_warm = bool(warm)
         self._key = jax.random.PRNGKey(seed)
         self._rng = np.random.default_rng(seed ^ 0x5EED)  # host-side (transport) draws
         self.n_initial = n_initial

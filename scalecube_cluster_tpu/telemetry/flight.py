@@ -20,12 +20,23 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
-FLIGHT_SCHEMA = 1
+FLIGHT_SCHEMA = 2
 
 
 class FlightRecorderError(RuntimeError):
     """A flight dump that cannot be loaded (truncated, corrupt, or from a
     future schema) — the checkpoint-error analogue for post-mortems."""
+
+
+def _backend_name() -> str:
+    """``jax.default_backend()`` without making a jax-less load path crash
+    (the loader/replay tooling imports this module host-side)."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
 
 
 def write_flight_dump(
@@ -37,6 +48,8 @@ def write_flight_dump(
     bus_tail: List[dict],
     context: Optional[dict] = None,
     trace: Optional[dict] = None,
+    reconstruction: Optional[dict] = None,
+    tick_range: Optional[List[int]] = None,
 ) -> str:
     """Atomically write one flight artifact; returns the final path.
 
@@ -48,13 +61,25 @@ def write_flight_dump(
     contributes (``TracePlane.flight_section``): the trace-ring tail plus
     the sewn span tree for each violating member — post-mortems carry
     causality, not just the how-much series. Optional, so pre-r10 dumps
-    and unarmed drivers keep the schema (readers treat it as absent)."""
+    and unarmed drivers keep the schema (readers treat it as absent).
+
+    ``reconstruction`` (r18, schema 2) embeds everything
+    :func:`..replay.scenario_from_flight` needs to rebuild and RE-RUN the
+    incident: engine + params doc + seed + the armed scenario's event
+    timeline + the recorded verdict. When the writer has no armed chaos
+    runner to describe, pass ``None`` — the loader then marks the dump
+    ``reconstruction: "partial"`` (same as every pre-r18 artifact)."""
     rows = ring_snapshot["rows"]
     doc = {
         "_schema": FLIGHT_SCHEMA,
         "ts": time.time(),
         "reason": reason,
         "engine": engine,
+        # provenance stamps (the r13 backend-stamp rule, applied to the
+        # post-mortem surface): which backend the dying sim ran on, how
+        # many host CPUs, and the absolute tick span the artifact covers
+        "backend": _backend_name(),
+        "host_cpus": os.cpu_count(),
         "ring": {
             "names": list(ring_snapshot["names"]),
             "windows_total": int(ring_snapshot["windows"]),
@@ -63,6 +88,10 @@ def write_flight_dump(
         "events": list(bus_tail),
         "context": context or {},
     }
+    if tick_range is not None:
+        doc["tick_range"] = [int(tick_range[0]), int(tick_range[1])]
+    if reconstruction is not None:
+        doc["reconstruction"] = reconstruction
     if trace is not None:
         doc["trace"] = trace
     target = os.path.abspath(path)
@@ -105,6 +134,12 @@ def load_flight_dump(path: str) -> dict:
             raise FlightRecorderError(
                 f"flight dump {path!r} is missing {key!r} (truncated?)"
             )
+    # versioned upgrade (r18): schema-1 artifacts — and schema-2 dumps whose
+    # writer had no armed chaos runner to describe — carry no reconstruction
+    # inputs. Mark that EXPLICITLY so replay tooling refuses with "this dump
+    # predates/lacks reconstruction" instead of a KeyError.
+    if "reconstruction" not in doc:
+        doc["reconstruction"] = "partial"
     return doc
 
 

@@ -145,6 +145,46 @@ class TelemetryPlane:
         return None
 
     # -- flight recorder -------------------------------------------------------
+    def _reconstruction_section(self) -> Optional[dict]:
+        """The schema-2 reconstruction block: everything ``replay.py`` needs
+        to rebuild a fresh driver + scenario and RE-RUN the incident. Only
+        an armed chaos runner makes a dump reconstructable — without one
+        there is no event timeline to replay, and the loader marks the
+        artifact ``reconstruction: "partial"`` instead."""
+        import dataclasses
+
+        runner = getattr(self.driver, "_chaos", None)
+        if runner is None:
+            return None
+        from ..chaos.events import scenario_to_dict
+
+        d = self.driver
+        last = runner.last_report
+        verdict = None
+        if last is not None and last.get("sentinels") is not None:
+            verdict = {
+                "ok": bool(last.get("ok", True)),
+                "violations": int(last.get("violations", 0)),
+                "ticks_run": int(last.get("ticks_run", runner.rel_tick)),
+            }
+        return {
+            "engine": d.engine,
+            "n_initial": int(d.n_initial),
+            "capacity": int(d.params.capacity),
+            # seed is None on drivers older than the r18 stamp (a restored
+            # pickle, a hand-built harness) — replay then refuses loudly
+            "seed": getattr(d, "seed", None),
+            "warm": bool(getattr(d, "_init_warm", True)),
+            "dense_links": bool(d._dense_links),
+            "params": dataclasses.asdict(d.params),
+            "scenario": scenario_to_dict(runner.scenario),
+            "t0": int(runner.t0),
+            "max_window": int(runner.max_window),
+            "ticks_run": int(runner.rel_tick),
+            "sentinels_armed": runner._sent is not None,
+            "verdict": verdict,
+        }
+
     def flight_record(self, reason: str, context: Optional[dict] = None,
                       path: Optional[str] = None) -> str:
         """Dump the last K ring windows + the bus tail atomically; returns
@@ -169,6 +209,9 @@ class TelemetryPlane:
             ] or list(tplane.spec.tracer_rows)
             trace_doc = tplane.flight_section(bad)
         target = path or default_dump_path(self.config.flight_dir, reason)
+        recon = self._reconstruction_section()
+        tick_hi = int(self.driver._host_tick)
+        tick_lo = int(recon["t0"]) if recon is not None else 0
         out = write_flight_dump(
             target,
             reason=reason,
@@ -177,6 +220,8 @@ class TelemetryPlane:
             bus_tail=[r.as_dict() for r in self.bus.tail()],
             context=context,
             trace=trace_doc,
+            reconstruction=recon,
+            tick_range=[tick_lo, tick_hi],
         )
         self.flight_dumps.append(out)
         return out

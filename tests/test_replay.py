@@ -1,0 +1,640 @@
+"""Incident replay + counterfactual what-if + the r18 fault vocabulary
+(ISSUE 17).
+
+Five properties, mirroring the tentpole's acceptance gates:
+
+1. **Round-trip**: a telemetry-armed chaos run whose violation is encoded
+   IN the scenario writes a schema-2 flight dump; the reconstructed
+   incident re-runs serially on a fresh driver and REPRODUCES the recorded
+   verdict (same key chain — ``key, k = split(key)`` once per tick — so a
+   same-seed replay walks the same PRNG path, even across a t0 pre-roll).
+2. **Versioned load**: pre-r18 dumps load with ``reconstruction:
+   "partial"`` and the replay surface refuses them loudly; future schemas
+   are refused at the loader; hand-edited params docs are refused at the
+   rebuild.
+3. **The grown fault vocabulary** (ZoneOutage / ChurnStorm / SlowEpoch /
+   DroppedRefute): each event keeps the scalar oracle in lockstep with
+   the kernel through its whole injected window at N=33, runs
+   all-sentinels-green when the scenario heals, and is FALSIFIABLE — a
+   scenario variant that genuinely cannot meet its budget violates.
+4. **What-if arms**: the counterfactual fleet separates a knob change
+   that fixes the incident from the as-recorded arm (disjoint Wilson
+   intervals on a paired seed vector), smoke-sized in tier-1; the full
+   ≥256-seed matrix is the ``bench.py --replay`` artifact (reduced copy
+   under ``-m slow``).
+5. **Batched timeline args** (r18 FleetVary growth): per-scenario delay
+   means and partition assignments batch through one compiled fleet
+   schedule, and incapable engines refuse loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import scalecube_cluster_tpu.ops.kernel as K
+import scalecube_cluster_tpu.ops.oracle as O
+import scalecube_cluster_tpu.ops.state as S
+from scalecube_cluster_tpu import replay as R
+from scalecube_cluster_tpu.chaos import StateTimeline
+from scalecube_cluster_tpu.chaos.events import (
+    ChurnStorm,
+    Crash,
+    DroppedRefute,
+    Restart,
+    Scenario,
+    ScenarioError,
+    SlowEpoch,
+    ZoneOutage,
+)
+from scalecube_cluster_tpu.config import TelemetryConfig
+from scalecube_cluster_tpu.sim import SimDriver
+from scalecube_cluster_tpu.telemetry import FlightRecorderError
+from scalecube_cluster_tpu.telemetry.flight import load_flight_dump
+
+
+def _dense_params(n=12, seeds=(0, 6), **kw):
+    base = dict(
+        capacity=n, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, rumor_slots=2, seed_rows=seeds,
+    )
+    base.update(kw)
+    return S.SimParams(**base)
+
+
+# the genuine-violation incident every round-trip assertion leans on: the
+# crash's detect budget is 1 tick — below any suspicion math — so the
+# violation lives in the SCENARIO, and a faithful replay must reproduce it
+# (a timeline mutated behind the scenario's back would NOT replay).
+def _unmeetable_crash(horizon=48):
+    return Scenario(
+        name="unmeetable-deadline",
+        events=[Crash(rows=[4], at=4)],
+        horizon=horizon, detect_budget=1, converge_budget=horizon,
+        check_interval=4,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. round-trip: dump -> incident -> serial replay reproduces the verdict
+# ---------------------------------------------------------------------------
+
+
+def test_flight_roundtrip_reproduces_recorded_verdict(tmp_path):
+    d = SimDriver(_dense_params(), 12, warm=True, seed=5)
+    d.arm_telemetry(TelemetryConfig(
+        ring_len=64, flight_windows=32, flight_dir=str(tmp_path)
+    ))
+    rep = d.run_scenario(_unmeetable_crash())
+    assert not rep["ok"] and rep["violations"] >= 1
+    # the chaos report carries the r18 provenance stamps
+    assert rep["backend"] == jax.default_backend()
+    assert rep["host_cpus"] == os.cpu_count()
+    assert rep["tick_range"] == [0, rep["ticks_run"]]
+
+    doc = load_flight_dump(rep["flight_dump"])
+    assert doc["_schema"] == 2
+    assert doc["backend"] == jax.default_backend()
+    assert doc["host_cpus"] == os.cpu_count()
+    assert doc["tick_range"][1] >= doc["tick_range"][0]
+    rec = doc["reconstruction"]
+    assert rec["engine"] == "dense" and rec["seed"] == 5
+
+    # scenario-only rebuild round-trips the event timeline
+    scn = R.scenario_from_flight(rep["flight_dump"])
+    assert scn.name == "unmeetable-deadline"
+    assert scn.events == _unmeetable_crash().events
+
+    incident = R.incident_from_flight(rep["flight_dump"])
+    assert incident.engine == "dense"
+    assert incident.seed == 5 and incident.t0 == 0
+    assert incident.verdict["ok"] is False
+    assert incident.verdict["violations"] == rep["violations"]
+
+    validation = R.validate_incident(incident)
+    assert validation["replayed"]["ok"] is False
+    assert validation["reproduced"] is True, validation
+
+
+def test_roundtrip_survives_pre_arm_stepping(tmp_path):
+    """A driver that ran BEFORE the scenario armed (t0 > 0) still replays:
+    the key chain depends only on tick count, and the reconstruction
+    records t0 so the replay pre-rolls the same number of ticks."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=9)
+    d.arm_telemetry(TelemetryConfig(
+        ring_len=64, flight_windows=32, flight_dir=str(tmp_path)
+    ))
+    d.step(7)
+    d.sync()
+    rep = d.run_scenario(_unmeetable_crash())
+    assert rep["violations"] >= 1
+    incident = R.incident_from_flight(rep["flight_dump"])
+    assert incident.t0 == 7
+    assert R.validate_incident(incident)["reproduced"] is True
+
+
+def test_pre_r18_dump_is_partial_and_refused(tmp_path):
+    """Versioned load: a schema-1 artifact loads with ``reconstruction:
+    "partial"`` (explicit, not a KeyError) and every replay entry point
+    refuses it with the predates-r18 story."""
+    v1 = tmp_path / "old.json"
+    v1.write_text(json.dumps({
+        "_schema": 1, "reason": "sentinel_violation", "engine": "dense",
+        "ring": {"names": ["tick"], "rows": []}, "events": [],
+    }))
+    doc = load_flight_dump(str(v1))
+    assert doc["reconstruction"] == "partial"
+    with pytest.raises(R.ReplayError, match="partial"):
+        R.scenario_from_flight(str(v1))
+    with pytest.raises(R.ReplayError, match="partial"):
+        R.incident_from_flight(str(v1))
+    # future schema: refused at the loader, propagated by replay
+    future = tmp_path / "future.json"
+    future.write_text(json.dumps({"_schema": 99}))
+    with pytest.raises(FlightRecorderError, match="newer"):
+        R.incident_from_flight(str(future))
+
+
+def test_hand_edited_params_doc_is_refused():
+    with pytest.raises(R.ReplayError, match="bogus_knob"):
+        R.params_from_doc("dense", {"capacity": 8, "bogus_knob": 3})
+    with pytest.raises(R.ReplayError, match="unknown engine"):
+        R.params_from_doc("quantum", {"capacity": 8})
+
+
+# ---------------------------------------------------------------------------
+# 2. the r18 fault vocabulary: oracle lockstep at N=33
+# ---------------------------------------------------------------------------
+
+_N33 = 33
+
+_LOCKSTEP_CASES = {
+    "zone_outage": (
+        dict(),
+        Scenario(
+            name="zone-lockstep",
+            events=[ZoneOutage(rows=[3, 4, 5], at=6, until=24)],
+            horizon=40,
+        ),
+    ),
+    "churn_storm": (
+        dict(),
+        Scenario(
+            name="churn-lockstep",
+            events=[ChurnStorm(rows=[5, 6, 7, 8], at=6, waves=2, period=8,
+                               down_for=4, seed_rows=(0,))],
+            horizon=40,
+        ),
+    ),
+    "slow_epoch": (
+        dict(delay_slots=4),
+        Scenario(
+            name="slow-lockstep",
+            events=[SlowEpoch(mean_delay_ticks=2.0, at=6, until=20)],
+            horizon=40,
+        ),
+    ),
+    "dropped_refute": (
+        dict(),
+        Scenario(
+            # the outage gets row 4 suspected; the drop then squashes its
+            # refutes for the rest of the window — the squash must mutate
+            # kernel and oracle state identically every tick
+            name="refute-lockstep",
+            events=[ZoneOutage(rows=[4], at=4, until=12),
+                    DroppedRefute(rows=[4], at=8, until=32)],
+            horizon=40,
+        ),
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_LOCKSTEP_CASES))
+def test_new_event_keeps_scalar_oracle_in_lockstep(case):
+    """Each r18 event's injection site mutates state identically for the
+    kernel and the scalar oracle: apply the timeline, step both, demand
+    bit-equivalence — through the event window AND its teardown."""
+    extra, scn = _LOCKSTEP_CASES[case]
+    params = _dense_params(n=_N33, seeds=(0, 11), **extra)
+    tl = StateTimeline(scn, S, dense_links=True)
+    st = S.init_state(params, _N33, warm=True)
+    step = jax.jit(partial(K.tick, params=params))
+    key = jax.random.PRNGKey(13)
+    for t in range(scn.horizon):
+        st, _labels = tl.apply_due(st, t)
+        key, k = jax.random.split(key)
+        st_next, _m = step(st, k)
+        oracle = O.oracle_tick(st, k, params)
+        O.assert_equivalent(st_next, oracle)
+        st = st_next
+
+
+# ---------------------------------------------------------------------------
+# 3. the r18 fault vocabulary: sentinels green under heal + falsifiability
+# ---------------------------------------------------------------------------
+
+
+_HEAL_SCENARIOS = {
+    "zone_outage": (
+        dict(),
+        Scenario(
+            name="zone-heal",
+            events=[ZoneOutage(rows=[8, 9, 10, 11], at=10, until=60)],
+            horizon=280, check_interval=8,
+        ),
+    ),
+    "churn_storm": (
+        dict(),
+        Scenario(
+            name="churn-heal",
+            events=[ChurnStorm(rows=[4, 5, 7, 8], at=10, waves=2, period=12,
+                               down_for=6, seed_rows=(0,))],
+            horizon=300, check_interval=8,
+        ),
+    ),
+    "slow_epoch": (
+        dict(delay_slots=4),
+        Scenario(
+            name="slow-heal",
+            events=[SlowEpoch(mean_delay_ticks=1.5, at=10, until=40)],
+            horizon=240, check_interval=8,
+        ),
+    ),
+    "dropped_refute": (
+        dict(),
+        Scenario(
+            name="refute-heal",
+            events=[ZoneOutage(rows=[5], at=10, until=20),
+                    DroppedRefute(rows=[5], at=12, until=44)],
+            horizon=320, check_interval=8,
+        ),
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_HEAL_SCENARIOS))
+def test_new_event_heals_with_all_sentinels_green(case):
+    """Each r18 event, healed inside the scenario, re-converges with a
+    clean report under its (scenario-scaled) sentinel budgets."""
+    extra, scn = _HEAL_SCENARIOS[case]
+    d = SimDriver(_dense_params(**extra), 12, warm=True, seed=0)
+    rep = d.run_scenario(scn)
+    assert rep["ok"], (case, rep)
+    assert rep["violations"] == 0
+    assert rep["sentinels"]["false_dead_members_max"] == 0
+    assert all(c["ok"] for c in rep["sentinels"]["convergence"])
+
+
+def test_unhealed_zone_outage_is_caught_as_violation():
+    """Falsifiability, genuinely scenario-encoded: a long zone cut whose
+    converge budget cannot be met MUST violate — and because the violation
+    lives in the scenario (not a mutated timeline), the flight round-trip
+    reproduces it too."""
+    d = SimDriver(_dense_params(), 12, warm=True, seed=0)
+    scn = Scenario(
+        name="zone-too-late",
+        events=[ZoneOutage(rows=[6, 7, 8, 9, 10, 11], at=10, until=100)],
+        horizon=112, converge_budget=4, check_interval=4,
+    )
+    rep = d.run_scenario(scn)
+    assert not rep["ok"]
+    conv = rep["sentinels"]["convergence"]
+    assert any(not c["ok"] for c in conv)
+
+
+def test_new_event_dsl_validation_and_engine_refusals():
+    with pytest.raises(ScenarioError, match="at least one row"):
+        ZoneOutage(rows=[], at=2)
+    with pytest.raises(ScenarioError, match="until"):
+        ZoneOutage(rows=[1], at=5, until=5)
+    with pytest.raises(ScenarioError, match="disjoint"):
+        ChurnStorm(rows=[1, 2], at=0, seed_rows=(1,))
+    with pytest.raises(ScenarioError, match="per wave"):
+        ChurnStorm(rows=[1], at=0, waves=3)
+    with pytest.raises(ScenarioError, match="> 0"):
+        SlowEpoch(mean_delay_ticks=0.0, at=2, until=8)
+    with pytest.raises(ScenarioError, match="until"):
+        DroppedRefute(rows=[1], at=4, until=4)
+    # a restart inside an active drop window would be squashed — refused
+    with pytest.raises(ScenarioError, match="epoch bump"):
+        SimDriver(_dense_params(), 12, warm=True, seed=0).run_scenario(
+            Scenario(
+                name="drop-vs-restart",
+                events=[Crash(rows=[3], at=2),
+                        DroppedRefute(rows=[3], at=4, until=20),
+                        Restart(rows=[3], at=10)],
+                horizon=40,
+            )
+        )
+    # scalar-loss sparse driver: zone cuts need per-link planes
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    sp = SP.SparseParams(
+        capacity=12, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=2,
+        sync_every=6, suspicion_mult=2, sweep_every=2, rumor_slots=2,
+        mr_slots=24, announce_slots=8, seed_rows=(0, 6),
+    )
+    d = SimDriver(sp, 12, warm=True, seed=0)  # dense_links=False
+    with pytest.raises(ScenarioError, match="dense"):
+        d.run_scenario(Scenario(
+            name="zone-sparse",
+            events=[ZoneOutage(rows=[3], at=2, until=8)], horizon=20,
+        ))
+    # DroppedRefute manipulates the [N, N] view planes: dense engine only
+    with pytest.raises(ScenarioError, match="dense"):
+        SimDriver(sp, 12, warm=True, seed=0, dense_links=True).run_scenario(
+            Scenario(name="drop-sparse",
+                     events=[DroppedRefute(rows=[3], at=2, until=8)],
+                     horizon=20)
+        )
+
+
+def test_scenario_dict_roundtrip_covers_new_vocabulary():
+    from scalecube_cluster_tpu.chaos.events import (
+        scenario_from_dict,
+        scenario_to_dict,
+    )
+
+    scn = Scenario(
+        name="vocab",
+        events=[
+            ZoneOutage(rows=[1, 2], at=2, until=10),
+            ChurnStorm(rows=[4, 5], at=4, waves=2, period=6, down_for=3,
+                       seed_rows=(0,)),
+            SlowEpoch(mean_delay_ticks=1.5, at=12, until=20),
+            DroppedRefute(rows=[6], at=22, until=30),
+        ],
+        horizon=64, detect_budget=40, converge_budget=50, check_interval=4,
+    )
+    back = scenario_from_dict(scenario_to_dict(scn))
+    assert back == scn
+
+
+# ---------------------------------------------------------------------------
+# 4. what-if arms: paired-seed Wilson separation
+# ---------------------------------------------------------------------------
+
+
+def _calibrated_incident():
+    """The config17 incident, built directly (no telemetry round trip —
+    that is section 1's job): slow FD knobs miss a 60-tick detect budget
+    by ~2x at N=24; fast knobs beat it by ~3x. Deterministically separable
+    even at smoke seed counts."""
+    params = S.SimParams(
+        capacity=24, fanout=3, ping_req_k=2, fd_every=4, sync_every=40,
+        suspicion_mult=5, rumor_slots=8, seed_rows=(0,),
+    )
+    scn = Scenario(
+        name="slow-fd-missed-deadline",
+        events=[Crash(rows=[7], at=8)],
+        horizon=96, detect_budget=60, converge_budget=96, check_interval=4,
+    )
+    return R.Incident(
+        engine="dense", params=params, scenario=scn, seed=11, n_initial=24,
+        dense_links=True, warm=True, t0=0, max_window=32,
+        sentinels_armed=True,
+        verdict={"ok": False, "violations": 1, "ticks_run": 96},
+    )
+
+
+def test_whatif_smoke_separates_the_fixing_arm():
+    incident = _calibrated_incident()
+    record = R.whatif(
+        incident, [{"name": "fast-fd", "fd_every": 1, "suspicion_mult": 2}],
+        seeds_per_arm=8,
+    )
+    assert record["n_arms"] == 2  # as-recorded + the counterfactual
+    assert record["seeds_per_arm"] == 8
+    by_name = {a["arm"]: a for a in record["arms"]}
+    base, fast = by_name["as-recorded"], by_name["fast-fd"]
+    # paired comparison: every arm ran the same seed vector
+    assert base["n_seeds"] == fast["n_seeds"] == 8
+    # the as-recorded arm reproduces the incident (all seeds violate);
+    # the fast-FD arm fixes it at every seed — intervals disjoint
+    assert base["p_green"] == 0.0 and fast["p_green"] == 1.0
+    assert fast["wilson"][0] > base["wilson"][1]
+    assert fast["separated"] == "better"
+    assert record["n_separated"] == 1 and record["any_arm_separated"]
+    # no knob change forged a DEAD verdict about a healthy member
+    assert base["zero_false_dead"] and fast["zero_false_dead"]
+    # detection latency orders the arms the calibration predicts
+    assert fast["detect_latency_max"] <= 60
+    # provenance stamps ride the record (the monitor serves it verbatim)
+    assert record["backend"] == jax.default_backend()
+    assert record["tick_range"] == [0, 96]
+
+
+def test_whatif_refuses_malformed_arms():
+    incident = _calibrated_incident()
+    with pytest.raises(R.ReplayError, match="unknown knob"):
+        R.arm_params(incident, {"name": "x", "bogus": 3})
+    with pytest.raises(R.ReplayError, match="reserved"):
+        R.whatif(incident, [{"name": "as-recorded", "fanout": 4}],
+                 seeds_per_arm=1)
+    with pytest.raises(R.ReplayError, match="duplicate"):
+        R.whatif(incident, [{"name": "a", "fanout": 4},
+                            {"name": "a", "fanout": 5}], seeds_per_arm=1)
+    # strategy/topology/adaptive overrides rebuild the nested specs
+    p = R.arm_params(incident, {"name": "s", "strategy": "push_pull",
+                                "topology": "ring"})
+    assert p.dissem.strategy == "push_pull" and p.dissem.topology == "ring"
+
+
+def test_whatif_service_and_monitor_endpoint():
+    """GET /whatif serves the last computed record — the MC never runs
+    inside a GET handler."""
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    mon = MonitorServer()
+    status, body = mon._route("/whatif")
+    assert status.startswith(b"404")
+    svc = R.WhatifService()
+    mon.register_whatif(svc)
+    status, body = mon._route("/whatif")
+    assert status.startswith(b"200") and body["computed"] is False
+    svc.run(_calibrated_incident(),
+            [{"name": "fast-fd", "fd_every": 1, "suspicion_mult": 2}],
+            seeds_per_arm=2)
+    status, body = mon._route("/whatif")
+    assert status.startswith(b"200")
+    assert body["computed"] is True and body["n_arms"] == 2
+    assert mon._route("/")[1]["whatif"] is True
+
+
+@pytest.mark.slow
+def test_whatif_full_arm_matrix():
+    """The bench.py --replay shape at reduced seeds: all three scripted
+    counterfactuals against the as-recorded arm; the two FD-cadence arms
+    separate, the fanout arm (FD-cadence-bound incident) must not."""
+    incident = _calibrated_incident()
+    record = R.whatif(
+        incident,
+        [{"name": "fast-fd", "fd_every": 1, "suspicion_mult": 2},
+         {"name": "moderate-fd", "fd_every": 2, "suspicion_mult": 3},
+         {"name": "wider-fanout", "fanout": 6}],
+        seeds_per_arm=64,
+    )
+    by_name = {a["arm"]: a for a in record["arms"]}
+    assert by_name["fast-fd"]["separated"] == "better"
+    assert by_name["moderate-fd"]["separated"] == "better"
+    assert by_name["wider-fanout"]["separated"] is None
+    assert record["n_separated"] == 2
+
+
+# ---------------------------------------------------------------------------
+# 5. batched timeline args: FleetVary delay_ticks / partition_assign
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_vary_delay_ticks_batches_slow_epoch():
+    from scalecube_cluster_tpu.ops import fleet as FL
+    from scalecube_cluster_tpu.ops.state import delay_mean_to_q
+
+    n, s = 8, 3
+    params = _dense_params(n=n, seeds=(0,), delay_slots=4)
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    scn = Scenario(
+        name="varied-slow",
+        events=[SlowEpoch(mean_delay_ticks=2.0, at=2, until=8)],
+        horizon=12,
+    )
+    means = np.asarray([1.0, 2.0, 4.0], np.float32)
+    tl = FL.fleet_timeline(scn, S, dense_links=True, horizon=12,
+                           vary=FL.FleetVary(delay_ticks=means))
+    fs, _ = tl.apply_due(fs, 2)
+    q = np.asarray(fs.delay_q)
+    for i, m in enumerate(means):
+        assert q[i, 0, 1] == pytest.approx(delay_mean_to_q(float(m)),
+                                           abs=1e-6), i
+    fs, _ = tl.apply_due(fs, 8)  # teardown stays broadcast: all clear
+    assert (np.asarray(fs.delay_q) == 0.0).all()
+
+
+def test_fleet_vary_partition_assign_batches_partition_shapes():
+    from scalecube_cluster_tpu.chaos.events import Partition
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    n, s = 8, 2
+    params = _dense_params(n=n, seeds=(0,))
+    fs = FL.fleet_broadcast(S.init_state(params, n, warm=True), s)
+    scn = Scenario(
+        name="varied-split",
+        events=[Partition(groups=[range(0, 4), range(4, 8)], at=2,
+                          heal_at=6)],
+        horizon=12,
+    )
+    assign = np.asarray([
+        [0, 0, 1, 1, 1, 1, 1, 1],   # minority cut {0,1}
+        [0, 1, 0, 1, 0, 1, -1, -1],  # interleaved, rows 6/7 bystanders
+    ], np.int32)
+    tl = FL.fleet_timeline(scn, S, dense_links=True, horizon=12,
+                           vary=FL.FleetVary(partition_assign=assign))
+    fs, _ = tl.apply_due(fs, 2)
+    loss = np.asarray(fs.loss)
+    # scenario 0: {0,1} cut from everyone else, intra-group links clear
+    assert loss[0, 0, 2] == 1.0 and loss[0, 5, 1] == 1.0
+    assert loss[0, 0, 1] == 0.0 and loss[0, 4, 5] == 0.0
+    # scenario 1: even/odd split; bystanders keep every link
+    assert loss[1, 0, 1] == 1.0 and loss[1, 0, 2] == 0.0
+    assert loss[1, 6, 0] == 0.0 and loss[1, 3, 7] == 0.0
+    fs, _ = tl.apply_due(fs, 6)  # the heal rides the same assignment
+    assert (np.asarray(fs.loss) == 0.0).all()
+
+
+def test_fleet_vary_new_args_refuse_incapable_engines():
+    from scalecube_cluster_tpu.chaos.events import Partition
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    slow_scn = Scenario(
+        name="slow",
+        events=[SlowEpoch(mean_delay_ticks=1.0, at=2, until=6)], horizon=8,
+    )
+    split_scn = Scenario(
+        name="split",
+        events=[Partition(groups=[[0, 1], [2, 3]], at=2, heal_at=6)],
+        horizon=8,
+    )
+    # nothing to vary: no slow event / no (single) partition event
+    with pytest.raises(ScenarioError, match="nothing to vary"):
+        FL.fleet_timeline(split_scn, S, dense_links=True, horizon=8,
+                          vary=FL.FleetVary(delay_ticks=np.ones(2)))
+    with pytest.raises(ScenarioError, match="exactly one Partition"):
+        FL.fleet_timeline(slow_scn, S, dense_links=True, horizon=8,
+                          vary=FL.FleetVary(
+                              partition_assign=np.zeros((2, 4), np.int32)))
+    # incapable engines: scalar-loss fleets have no per-link planes
+    with pytest.raises(ScenarioError, match="set_link_delay_q"):
+        FL.fleet_timeline(slow_scn, S, dense_links=False, horizon=8,
+                          vary=FL.FleetVary(delay_ticks=np.ones(2)))
+    with pytest.raises(ScenarioError, match="assign-vector"):
+        FL.fleet_timeline(split_scn, S, dense_links=False, horizon=8,
+                          vary=FL.FleetVary(
+                              partition_assign=np.zeros((2, 4), np.int32)))
+
+
+# ---------------------------------------------------------------------------
+# 6. the replay audit variant (delay-armed fleet window) stays falsifiable
+# ---------------------------------------------------------------------------
+
+
+def test_replay_audit_variant_builds_and_passes():
+    """The r18 'replay' audit matrix entry: a delay-armed (delay_slots=2),
+    gate-loud fleet window per engine — the exact program shape whatif
+    compiles — audits clean at the lowered level (the compiled matrix
+    lives in AUDIT_r12.json / tools/audit_programs.py --all)."""
+    from scalecube_cluster_tpu.audit import run_contracts
+    from scalecube_cluster_tpu.audit.programs import build_engine_programs
+
+    programs = build_engine_programs(
+        "dense", capacity=128, n_ticks=4, key_dtypes=["i32"],
+        variants=["replay"],
+    )
+    (prog,) = programs
+    assert prog.name == "dense/i32/replay"
+    verdict = run_contracts(prog, compile_programs=False)
+    for contract, violations in verdict.items():
+        assert violations == [], f"{prog.name}: {contract}: {violations}"
+
+
+def test_seeded_replay_fleet_dropping_donation_is_caught():
+    """Falsifiability for the new matrix entry: the SAME delay-armed fleet
+    window built with donate=False but registered as donated — the
+    auditor must flag every dropped leaf of the stacked state (including
+    the delay rings only the replay variant shapes)."""
+    import dataclasses as _dc
+
+    from scalecube_cluster_tpu.audit import AuditProgram, check_donation_alias
+    from scalecube_cluster_tpu.audit.programs import (
+        DEFAULT_FLEET_SCENARIOS,
+        _abstract,
+        _audit_params,
+    )
+    from scalecube_cluster_tpu.ops import engine_api
+
+    eng = engine_api.engine("dense")
+    params = _dc.replace(_audit_params("dense", 128, "i32"), delay_slots=2)
+    state = eng.init_state(params, 124, True, True)
+    s = DEFAULT_FLEET_SCENARIOS
+    abs_fleet = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct((s,) + x.shape, x.dtype),
+        _abstract(state),
+    )
+    keys_abs = jax.ShapeDtypeStruct((s, 2), jax.numpy.uint32)
+    fn = eng.make_fleet_run(params, 4, False)  # <- dropped donation
+    prog = AuditProgram(
+        name="seeded/replay-dropped-donation", engine="seeded",
+        variant="seeded", key_dtype="i32", capacity=128, n_ticks=4,
+        fn=fn, abstract_args=(abs_fleet, keys_abs), donated_argnums=(0,),
+        contracts=eng.contracts, budget_basis_bytes=0, wide_threshold=128,
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the replay fleet's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)

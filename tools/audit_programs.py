@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ENGINES = ("dense", "sparse", "pview")
 VARIANTS = ("unarmed", "traced", "telemetry", "sharded", "strategy",
-            "adaptive", "fleet", "control", "fused")
+            "adaptive", "fleet", "control", "fused", "replay")
 
 
 def main(argv=None) -> int:
