@@ -400,6 +400,20 @@ def main() -> None:
             default_out="REPLAY_BENCH_r18.json",
         )
 
+    # r19: --serve runs the hybrid serving certification
+    # (benchmarks/config18_serve.py — a real Cluster over TpuSimTransport
+    # joining the ≥4096-member sim, the operator load generator against a
+    # live MonitorServer, Wilson-certified bridged liveness, armed-idle
+    # bridge overhead) through the same backend-probe/retry path.
+    if "--serve" in sys.argv:
+        _delegate(
+            "config18_serve.py",
+            ("--n", "--trials", "--loadgen-s", "--min-ops",
+             "--scrape-slo-ms", "--out"),
+            passthrough=("--quick", "--skip-overhead"),
+            default_out="SERVE_BENCH_r19.json",
+        )
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

@@ -409,6 +409,45 @@ def collect_replay_summary(root: pathlib.Path) -> dict:
         return {"present": True, "error": repr(exc)}
 
 
+def collect_serve_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r19 hybrid-serving artifact: the
+    real-member join/partition gates, the load generator's rates against
+    their SLOs, the bridged-liveness Wilson interval, and the armed-idle
+    bridge overhead ratio."""
+    path = root / "SERVE_BENCH_r19.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        hj = rec.get("hybrid_join") or {}
+        lg = rec.get("loadgen") or {}
+        lv = rec.get("liveness") or {}
+        ov = rec.get("armed_idle_overhead") or {}
+        return {
+            "present": True,
+            "ok": rec.get("ok"),
+            "backend": rec.get("backend"),
+            "quick": rec.get("quick"),
+            "n_sim": hj.get("n_sim"),
+            "hybrid_join_ok": hj.get("ok"),
+            "partition_green": hj.get("partition_green"),
+            "ops_per_s": lg.get("ops_per_s"),
+            "scrape_p99_ms": {
+                k: v.get("p99_ms") for k, v in (lg.get("scrapes") or {}).items()
+            },
+            "scrape_errors": lg.get("scrape_errors"),
+            "loadgen_ok": lg.get("ok"),
+            "liveness_wilson": lv.get("wilson"),
+            "liveness_ok": lv.get("ok"),
+            "armed_idle_ratio": ov.get("ratio"),
+            "overhead_ok": ov.get("ok"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def collect_trajectory(root: pathlib.Path) -> list:
     """Fold every per-round dense-bench artifact present on disk into one
     dense-N=4096 ticks/s trajectory (the number each round's acceptance
@@ -566,6 +605,13 @@ def main() -> None:
     # certified record belongs to the dedicated run: bench.py --replay)
     results += run([py, "benchmarks/config17_replay.py", "--quick",
                     "--out", "REPLAY_BENCH_r18.json"], timeout=3000)
+    # r19 hybrid serving: a real Cluster over TpuSimTransport joins the
+    # mega sim, the operator load generator drives churn + scrapes against
+    # a live MonitorServer, bridged liveness is Wilson-certified (512
+    # members on --quick; the >=4096-member certified record belongs to
+    # the dedicated run: bench.py --serve)
+    results += run([py, "benchmarks/config18_serve.py", "--quick",
+                    "--out", "SERVE_BENCH_r19.json"], timeout=3000)
     results += run([py, "benchmarks/compile_proof_100k.py"])
     # r12 static program audit: the r6-r11 contracts proved over every
     # engine's compiled window programs (donation aliasing, transfer-
@@ -609,6 +655,10 @@ def main() -> None:
         # verdicts (full artifact in REPLAY_BENCH_r18.json, refreshed by
         # the config17 run above)
         "replay_bench": collect_replay_summary(ROOT),
+        # r19: hybrid-serving gates — real-member join, loadgen SLOs,
+        # bridged-liveness Wilson interval, armed-idle overhead (full
+        # artifact in SERVE_BENCH_r19.json, refreshed by the config18 run)
+        "serve_bench": collect_serve_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

@@ -1,0 +1,380 @@
+"""Driver config #18: hybrid serving subsystem (ISSUE 18).
+
+Four sections, one JSON artifact (``SERVE_BENCH_r19.json``):
+
+1. **Hybrid join demo**: a real ``Cluster`` process over
+   ``TpuSimTransport`` joins a >=4096-member simulated cluster (sparse
+   engine, per-link planes armed). Gates: the initial SYNC hands the full
+   sim table to the real member, the bridged row reaches ALIVE in every
+   sampled sim view inside the convergence budget, and the hybrid
+   membership survives a Partition+heal chaos scenario with the sentinel
+   suite green (the bridged row rides as the bystander cohort the
+   false-DEAD sentinel watches).
+2. **Operator load generator**: ``bridge.LoadGenerator`` drives sustained
+   join/leave/metadata/rumor churn plus concurrent /metrics + /trace +
+   /whatif scrapes against a live ``MonitorServer`` serving the SAME mega
+   sim. Gates: >=``--min-ops``/s member-facing ops, zero scrape errors,
+   scrape p99 under ``--scrape-slo-ms``.
+3. **Wilson-certified bridged liveness**: ``--trials`` windows stepped
+   after the heal, each trial checking the bridged row ALIVE in every
+   sampled view AND the real member's table still holding the sim seed.
+   The record carries the Wilson interval on P(trial green); gate: lower
+   bound >= ``--liveness-floor``.
+4. **Armed-idle bridge overhead**: median window wall-time of a small
+   driver with an ATTACHED but idle bridge endpoint (watch armed, no
+   traffic) vs an identical plain driver — the serving plane's standing
+   cost, gated within noise (``--overhead-budget`` ratio).
+
+    python benchmarks/config18_serve.py [--n 4096] [--trials 128]
+        [--loadgen-s 4] [--quick] [--out SERVE_BENCH_r19.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+import jax
+
+from common import emit, log
+
+REPO = _p.Path(__file__).parent.parent
+
+
+def _sparse_params(capacity: int):
+    from scalecube_cluster_tpu.ops.sparse import SparseParams
+
+    return SparseParams(
+        capacity=capacity, fanout=3, ping_req_k=2, fd_every=2,
+        sync_every=24, suspicion_mult=3, sweep_every=4,
+        rumor_slots=16, mr_slots=256, announce_slots=64,
+        seed_rows=(0, 1),
+    )
+
+
+def _serve_config(seeds=("sim://0",)):
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    return (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(
+            seed_members=list(seeds), sync_interval=2.0, sync_timeout=3.0,
+        ))
+        .with_failure_detector(lambda f: f.replace(
+            ping_interval=0.5, ping_timeout=0.4, ping_req_members=1,
+        ))
+        .with_gossip(lambda g: g.replace(gossip_interval=0.2))
+    )
+
+
+async def _drive(driver, predicate, timeout, window=8):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await loop.run_in_executor(None, driver.step, window)
+        await asyncio.sleep(0)
+    return predicate()
+
+
+def _alive_in_views(driver, row, sample_rows):
+    from scalecube_cluster_tpu.models.member import MemberStatus
+
+    return all(
+        driver.status_of(r, row) == MemberStatus.ALIVE
+        for r in sample_rows
+        if r != row and driver.is_up(r)
+    )
+
+
+async def hybrid_sections(args, artifact):
+    """Sections 1-3 share one mega sim + one real bridged member."""
+    from scalecube_cluster_tpu.bridge import LoadGenerator, SimBridge
+    from scalecube_cluster_tpu.chaos.events import Partition, Scenario
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.config import TelemetryConfig
+    from scalecube_cluster_tpu.dissemination.certify import wilson_interval
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.replay import WhatifService
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    n = args.n
+    params = _sparse_params(n + 64)  # headroom: bridge row + churn pool
+    log(f"[serve] building sparse mega sim N={n} (dense links for the "
+        "partition) …")
+    t0 = time.time()
+    d = SimDriver(params, n, warm=True, seed=19, dense_links=True)
+    d.arm_telemetry(TelemetryConfig(ring_len=64))
+    d.arm_trace()
+    bridge = SimBridge(d, seed_rows=params.seed_rows)
+    loop = asyncio.get_running_loop()
+
+    mon = MonitorServer()
+    mon.register_telemetry(d)
+    mon.register_whatif(WhatifService())
+    await mon.start()
+
+    sample_rows = sorted({0, 1, n // 3, n // 2, (2 * n) // 3, n - 1})
+    join = {"n_sim": n, "engine": "sparse"}
+    try:
+        t_join = time.time()
+        a = await (
+            new_cluster(_serve_config())
+            .transport_factory(bridge.transport_factory("real-0"))
+            .start()
+        )
+        try:
+            ep = bridge._endpoints["real-0"]
+            join["initial_table"] = len(a.members())
+            join["table_full"] = join["initial_table"] >= n - 1
+            log(f"[serve] real member joined: table={join['initial_table']} "
+                f"row={ep.row} ({time.time() - t_join:.1f}s)")
+
+            converged = await _drive(
+                d, lambda: _alive_in_views(d, ep.row, sample_rows),
+                timeout=args.converge_s,
+            )
+            join["alive_in_sampled_views"] = bool(converged)
+            join["join_s"] = round(time.time() - t_join, 2)
+            log(f"[serve] bridged row ALIVE in sampled views: {converged} "
+                f"({join['join_s']}s)")
+
+            # Partition+heal with the sentinel suite armed; the bridged
+            # row belongs to NO group (bystander cohort)
+            half = n // 2
+            scenario = Scenario(
+                name="serve-partition-heal",
+                events=[Partition(
+                    groups=[range(0, half), range(half, n)],
+                    at=8, heal_at=40,
+                )],
+                horizon=120,
+                detect_budget=100,
+                converge_budget=120,
+                check_interval=8,
+            )
+            t_chaos = time.time()
+            report = await loop.run_in_executor(
+                None, lambda: d.run_scenario(scenario, max_window=8)
+            )
+            join["partition_violations"] = report.get("violations") or []
+            join["partition_green"] = not join["partition_violations"]
+            join["partition_s"] = round(time.time() - t_chaos, 2)
+            log(f"[serve] partition+heal: green={join['partition_green']} "
+                f"({join['partition_s']}s)")
+            post_heal = await _drive(
+                d, lambda: _alive_in_views(d, ep.row, sample_rows),
+                timeout=args.converge_s,
+            )
+            join["alive_after_heal"] = bool(post_heal)
+            join["ok"] = bool(
+                join["table_full"] and converged
+                and join["partition_green"] and post_heal
+            )
+            artifact["hybrid_join"] = join
+
+            # -- section 2: the load generator against the live monitor --
+            gen = LoadGenerator(
+                d, monitor_url=mon.url, seed=7,
+                seed_rows=params.seed_rows, max_churn_pool=32,
+            )
+            log(f"[serve] loadgen: {args.loadgen_s}s churn + scrapes …")
+            # stepper cadence scales with N: a mega-sim window holds the
+            # driver lock for its whole compute, so its duty cycle is the
+            # serving plane's main contention knob
+            step_window, step_interval = (4, 0.1) if args.n <= 1024 else (1, 0.5)
+            # untimed pass: mutator/window compiles + connection setup land
+            # here, so the timed run below measures steady-state serving
+            await gen.warmup(step_window=step_window)
+            rep = await gen.run(
+                duration_s=args.loadgen_s,
+                churn_workers=3, scrape_workers=2,
+                step_window=step_window, step_interval_s=step_interval,
+            )
+            lg = rep.as_dict()
+            # the scrape SLO budgets ONE in-flight mega-window collision on
+            # top of the base render budget: the scrape paths are lock-free
+            # (retained-row /metrics, cached /trace, host-dict /whatif), so
+            # a colliding scrape no longer waits on the driver lock — but a
+            # single-core host still runs the window's XLA compute on the
+            # same core, and at N>1024 one window is ~0.5 s of it. Ops and
+            # throughput keep their scale-independent gates.
+            scrape_slo = args.scrape_slo_ms
+            lg["min_ops_per_s"] = args.min_ops
+            lg["scrape_slo_ms"] = scrape_slo
+            lg["ok"] = bool(
+                rep.ops_per_s >= args.min_ops
+                and rep.scrape_errors == 0
+                and all(
+                    h["p99_ms"] <= scrape_slo
+                    for h in rep.scrapes.values() if h["count"]
+                )
+            )
+            artifact["loadgen"] = lg
+            log(f"[serve] loadgen: {rep.ops_per_s:.0f} ops/s, scrapes "
+                + json.dumps({k: v["p99_ms"] for k, v in rep.scrapes.items()})
+                + f" ok={lg['ok']}")
+
+            # -- section 3: Wilson-certified bridged liveness -------------
+            ok_trials = 0
+            for _ in range(args.trials):
+                await loop.run_in_executor(None, d.step, 4)
+                green = _alive_in_views(d, ep.row, sample_rows) and any(
+                    m.address == "sim://0" for m in a.members()
+                )
+                ok_trials += bool(green)
+            lo, hi = wilson_interval(ok_trials, args.trials, 0.95)
+            live = {
+                "trials": args.trials, "green": ok_trials,
+                "wilson": [round(lo, 6), round(hi, 6)],
+                "floor": args.liveness_floor,
+                "ok": lo >= args.liveness_floor,
+            }
+            artifact["liveness"] = live
+            log(f"[serve] liveness: {ok_trials}/{args.trials} green, "
+                f"wilson=[{lo:.4f}, {hi:.4f}] ok={live['ok']}")
+        finally:
+            await a.shutdown()
+    finally:
+        await mon.stop()
+
+
+async def overhead_section(args, artifact):
+    """Section 4: armed-idle bridge overhead vs a plain twin driver."""
+    from scalecube_cluster_tpu.bridge import SimBridge
+    from scalecube_cluster_tpu.cluster import new_cluster
+    from scalecube_cluster_tpu.config import ClusterConfig
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    n = args.overhead_n
+    loop = asyncio.get_running_loop()
+
+    def interleaved(plain, armed, reps):
+        # alternate the twins rep-by-rep so drift on the shared host (GC,
+        # leftover shutdown tasks from the hybrid section, page cache)
+        # lands on both lanes instead of biasing whichever ran second
+        tp, ta = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            plain.step(8)
+            plain.flush()
+            tp.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            armed.step(8)
+            armed.flush()
+            ta.append(time.perf_counter() - t0)
+        return statistics.median(tp), statistics.median(ta)
+
+    plain = SimDriver(_sparse_params(n + 8), n, warm=True, seed=3)
+    armed = SimDriver(_sparse_params(n + 8), n, warm=True, seed=3)
+    bridge = SimBridge(armed)
+    idle_cfg = (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(
+            seed_members=["sim://0"], sync_interval=30.0,
+        ))
+        .with_failure_detector(lambda f: f.replace(ping_interval=30.0))
+        .with_gossip(lambda g: g.replace(gossip_interval=5.0))
+    )
+    a = await (
+        new_cluster(idle_cfg)
+        .transport_factory(bridge.transport_factory("idle"))
+        .start()
+    )
+    try:
+        await asyncio.sleep(1.0)  # let join-time traffic fully drain
+        plain.step(8)  # compile
+        armed.step(8)  # compile the watched window variant
+        t_plain, t_armed = await loop.run_in_executor(
+            None, interleaved, plain, armed, args.reps
+        )
+    finally:
+        await a.shutdown()
+
+    ratio = t_armed / t_plain if t_plain > 0 else float("inf")
+    artifact["armed_idle_overhead"] = {
+        "n": n, "reps": args.reps,
+        "plain_window_ms": round(t_plain * 1e3, 3),
+        "armed_window_ms": round(t_armed * 1e3, 3),
+        "ratio": round(ratio, 4),
+        "budget": args.overhead_budget,
+        "ok": ratio <= args.overhead_budget,
+    }
+    log(f"[serve] armed-idle: plain={t_plain * 1e3:.2f}ms "
+        f"armed={t_armed * 1e3:.2f}ms ratio={ratio:.3f} "
+        f"ok={ratio <= args.overhead_budget}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n", type=int, default=4096,
+                    help="simulated members (>=4096 for the certified record)")
+    ap.add_argument("--trials", type=int, default=128,
+                    help="liveness-certification trials (Wilson interval)")
+    ap.add_argument("--loadgen-s", type=float, default=4.0)
+    ap.add_argument("--min-ops", type=float, default=1000.0,
+                    help="member-facing ops/s floor")
+    ap.add_argument("--scrape-slo-ms", type=float, default=None,
+                    help="scrape p99 budget (default 250, +350 at N>1024 — "
+                         "one mega-window collision)")
+    ap.add_argument("--liveness-floor", type=float, default=0.95)
+    ap.add_argument("--converge-s", type=float, default=60.0)
+    ap.add_argument("--overhead-n", type=int, default=512)
+    ap.add_argument("--overhead-budget", type=float, default=1.5,
+                    help="armed-idle / plain median window ratio budget")
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--skip-overhead", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="512-member smoke (never a certified record)")
+    ap.add_argument("--out", default=str(REPO / "SERVE_BENCH_r19.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.n = min(args.n, 512)
+        args.trials = min(args.trials, 24)
+        args.loadgen_s = min(args.loadgen_s, 2.0)
+        args.reps = min(args.reps, 12)
+        # 24 trials cap the Wilson lower bound at ~0.86 even when all green
+        args.liveness_floor = min(args.liveness_floor, 0.8)
+    if args.scrape_slo_ms is None:
+        args.scrape_slo_ms = 250.0 if args.n <= 1024 else 600.0
+
+    t_start = time.time()
+    artifact = {
+        "config": "config18_serve",
+        "backend": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "quick": bool(args.quick),
+    }
+
+    async def run():
+        await hybrid_sections(args, artifact)
+        if not args.skip_overhead:
+            await overhead_section(args, artifact)
+
+    asyncio.run(run())
+
+    gates = [artifact.get(k, {}).get("ok") for k in
+             ("hybrid_join", "loadgen", "liveness")]
+    if not args.skip_overhead:
+        gates.append(artifact.get("armed_idle_overhead", {}).get("ok"))
+    artifact["elapsed_s"] = round(time.time() - t_start, 2)
+    artifact["ok"] = all(bool(g) for g in gates)
+    emit(artifact)
+    with open(args.out, "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    log(f"[serve] wrote {args.out} ok={artifact['ok']}")
+    return 0 if artifact["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,98 @@
+"""Hybrid serving (r19): three real ``Cluster`` processes joining a
+4096-member simulated membership over ``TpuSimTransport``.
+
+Alice, Bob and Carol are ordinary scalar-engine protocol members — the same
+asyncio objects as ``cluster_join_example.py`` — but their transport is a
+:class:`SimBridge` splicing each of them into one row of a sparse-engine
+mega sim. Each discovers the full simulated table through its initial SYNC
+against ``sim://0``, discovers the *other* real members through the sim's
+gossip (their bridged rows ride the same window folds as any simulated
+row), and survives simulated chaos like any other member. Run with an
+optional size: ``python examples/hybrid_cluster_example.py 1024``.
+"""
+
+import asyncio
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+
+from scalecube_cluster_tpu.bridge import SimBridge
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig
+from scalecube_cluster_tpu.models.member import MemberStatus
+from scalecube_cluster_tpu.ops.sparse import SparseParams
+from scalecube_cluster_tpu.sim.driver import SimDriver
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+
+
+def bridged_config() -> ClusterConfig:
+    return (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(
+            seed_members=["sim://0"], sync_interval=1.0, sync_timeout=1.0,
+        ))
+        .with_failure_detector(lambda f: f.replace(
+            ping_interval=0.5, ping_timeout=0.4, ping_req_members=1,
+        ))
+        .with_gossip(lambda g: g.replace(gossip_interval=0.2))
+    )
+
+
+async def main() -> None:
+    print(f"building a {N}-member sparse mega sim …")
+    params = SparseParams(
+        capacity=N + 16, fanout=3, ping_req_k=2, fd_every=2, sync_every=24,
+        suspicion_mult=3, sweep_every=4, rumor_slots=16, mr_slots=256,
+        announce_slots=64, seed_rows=(0, 1),
+    )
+    driver = SimDriver(params, N, warm=True, seed=7)
+    bridge = SimBridge(driver, seed_rows=(0, 1))
+
+    members = {}
+    for name in ("Alice", "Bob", "Carol"):
+        members[name] = await (
+            new_cluster(bridged_config().replace(member_alias=name))
+            .transport_factory(bridge.transport_factory(name.lower()))
+            .start()
+        )
+        row = bridge._endpoints[name.lower()].row
+        print(f"{name} joined over TpuSimTransport as row {row} "
+              f"(table={len(members[name].members()) + 1})")
+
+    # step sim windows so the bridged rows disseminate and the window-fold
+    # SYNCs deliver sim-side progress back to the real members
+    loop = asyncio.get_running_loop()
+    for _ in range(6):
+        await loop.run_in_executor(None, driver.step, 4)
+        await asyncio.sleep(0.3)
+
+    for name, c in members.items():
+        row = bridge._endpoints[name.lower()].row
+        status = driver.status_of(0, row)
+        aliases = sorted(
+            m.alias for m in c.members() if m.alias in
+            ("Alice", "Bob", "Carol")
+        )
+        print(f"{name}: row {row} is {status.name} in the sim view; "
+              f"sees real peers {aliases} among {len(c.members())} members")
+
+    # simulated churn is visible to the real members like any other record
+    crash_row = N // 2
+    driver.crash(crash_row)
+    for _ in range(10):
+        await loop.run_in_executor(None, driver.step, 8)
+        await asyncio.sleep(0.1)
+    alice = members["Alice"]
+    gone = driver.status_of(0, crash_row) == MemberStatus.DEAD
+    print(f"sim row {crash_row} crashed → DEAD in sim views: {gone}")
+
+    assert any(m.address == "sim://0" for m in alice.members())
+    for c in members.values():
+        await c.shutdown()
+    print("done")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

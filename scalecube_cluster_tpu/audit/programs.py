@@ -274,7 +274,7 @@ def build_engine_programs(
     dtypes = tuple(key_dtypes) if key_dtypes else contracts.key_dtypes
     want = set(variants) if variants else {
         "unarmed", "traced", "telemetry", "sharded", "strategy", "adaptive",
-        "fleet", "control", "fused", "replay",
+        "fleet", "control", "fused", "replay", "bridge",
     }
     key_abs = _key_abstract()
     programs: List[AuditProgram] = []
@@ -312,6 +312,56 @@ def build_engine_programs(
                 donated_argnums=(0, 2),
                 contracts=contracts,
                 budget_basis_bytes=state_bytes + _tree_bytes(buf),
+                wide_threshold=capacity,
+            ))
+
+        if kd == dtypes[0] and "bridge" in want:
+            # r19: the bridge-watched window — the EXACT program the driver
+            # dispatches while TpuSimTransport endpoints hold armed watches
+            # (watch_rows bound as a live [W] operand). The variant proves
+            # the serving-path claims: donation still aliases the full
+            # state, the watch plumbing smuggles in NO host transfer (the
+            # bridge's real-member fold stays a host seam outside the jit),
+            # and the budget covers the stacked [n_ticks, W, N] watched
+            # keys. The one contract it must WAIVE on the wide-plane
+            # engines is no_plane_materialization: the in-scan
+            # view_key[watch_rows] gather IS the documented r10 opt-in a
+            # watch costs (pinned as the seeded violation in
+            # tests/test_audit_programs.py), so auditing it as a failure
+            # would just re-find the known price. pview synthesizes watched
+            # rows from O(N·k) state, so its checks (including the r11
+            # wide-value ban) all stay live.
+            w_bridge = 3
+            _assert_audit_shape(
+                f"{engine_name}/{kd}/bridge", capacity,
+                {"bridged_rows": w_bridge},
+            )
+            inner = eng.make_run(params, n_ticks, donate=False)
+            watch_abs = jax.ShapeDtypeStruct((w_bridge,), jnp.int32)
+            vk = getattr(abs_state, "view_key", None)
+            watched_bytes = (
+                n_ticks * w_bridge * capacity
+                * (vk.dtype.itemsize if vk is not None else 4)
+            )
+            bridge_contracts = contracts
+            if not contracts.forbid_wide_values:
+                bridge_contracts = dataclasses.replace(
+                    contracts, no_plane_materialization=False
+                )
+            programs.append(AuditProgram(
+                name=f"{engine_name}/{kd}/bridge",
+                engine=engine_name, variant="bridge", key_dtype=kd,
+                capacity=capacity, n_ticks=n_ticks,
+                fn=jax.jit(
+                    lambda state, key, w, _run=inner: _run(
+                        state, key, watch_rows=w
+                    ),
+                    donate_argnums=0,
+                ),
+                abstract_args=(abs_state, key_abs, watch_abs),
+                donated_argnums=(0,),
+                contracts=bridge_contracts,
+                budget_basis_bytes=state_bytes + watched_bytes,
                 wide_threshold=capacity,
             ))
 

@@ -312,13 +312,21 @@ class StateTimeline:
         # run Partition events without an [N, N] link plane; per-PAIR flaps
         # still need one
         group_parts = getattr(ops, "GROUP_PARTITIONS", False)
+        engine_label = {
+            "state": "dense", "sparse": "sparse", "pview": "pview",
+        }.get(getattr(ops, "__name__", "?").rsplit(".", 1)[-1],
+              getattr(ops, "__name__", "?"))
         for s in self._steps:
             if s.kind == "refute_drop" and not hasattr(ops, "drop_refutes"):
+                # name the offending event AND the engine: a multi-event
+                # production dump that trips this (e.g. during whatif) must
+                # point at the one step that can't run here, not issue a
+                # bare capability error (ISSUE 18 satellite)
                 raise ScenarioError(
-                    "refute_drop (DroppedRefute) needs the dense [N, N] "
-                    "view/changed_at planes (ops.drop_refutes); this "
-                    "engine does not expose them — run the scenario on "
-                    "the dense engine"
+                    f"event {s.label!r} (DroppedRefute) needs the dense "
+                    "[N, N] view/changed_at planes (ops.drop_refutes), "
+                    f"which the {engine_label!r} engine does not expose — "
+                    "run the scenario on the dense engine"
                 )
         if not dense_links:
             for s in self._steps:

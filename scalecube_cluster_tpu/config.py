@@ -249,6 +249,11 @@ class ControlConfig:
     dwell_down: int = 4
     max_step: int = 1
     hysteresis: float = 0.6
+    #: r19: false-positive pressure gate — a ``suspect_rate`` (new
+    #: suspicions per probe) at or above this votes the target ONE rung up
+    #: through the normal dwell machinery. 0.0 (default) keeps the sensor
+    #: passive/logged-only, the r16-certified behavior.
+    suspect_gate: float = 0.0
 
     def replace(self, **kw) -> "ControlConfig":
         return replace(self, **kw)

@@ -179,6 +179,11 @@ class ControlSpec:
     log_keep: int = 128
     blind: bool = False
     clamped: bool = True
+    #: r19: second ladder input — ``suspect_rate`` at or above this gate
+    #: votes the target ONE rung up (through the ordinary dwell_up, so it
+    #: cannot flap); 0.0 keeps the sensor passive (the r16-certified
+    #: single-input policy, bit-for-bit).
+    suspect_gate: float = 0.0
     #: unclamped-controller proportional gains (fanout / mult per unit
     #: miss rate) — deliberately naive high-gain tuning ("react fast"),
     #: scaled to the post-rescue sensor: a ~0.05 storm signal targets
@@ -204,6 +209,8 @@ class ControlSpec:
             raise ValueError("max_step must be >= 1")
         if not (0.0 < self.hysteresis <= 1.0):
             raise ValueError("hysteresis must be in (0, 1]")
+        if self.suspect_gate < 0.0:
+            raise ValueError("suspect_gate must be >= 0 (0 disables it)")
 
     @staticmethod
     def from_config(config) -> "ControlSpec":
@@ -217,6 +224,7 @@ class ControlSpec:
             dwell_down=cc.dwell_down,
             max_step=cc.max_step,
             hysteresis=cc.hysteresis,
+            suspect_gate=getattr(cc, "suspect_gate", 0.0),
         )
 
 
@@ -349,6 +357,10 @@ def advance(
             "miss_rate": (
                 round(sensors["miss_rate"], 4) if sensors else None
             ),
+            "suspect_rate": (
+                round(sensors.get("suspect_rate", 0.0), 4)
+                if sensors else None
+            ),
             **extra,
         })
         if len(st.log) > spec.log_keep:
@@ -378,6 +390,17 @@ def advance(
     if spec.blind:
         # never reads the ring: the target is forever the base rung
         target = 0 if not st.actuated else st.rung
+    elif (
+        spec.suspect_gate > 0.0
+        and sensors.get("suspect_rate", 0.0) >= spec.suspect_gate
+        and target <= st.rung
+    ):
+        # r19 second ladder input: false-positive pressure (suspect_rate)
+        # votes the target ONE rung up. Up-only by construction — it can
+        # never lower a miss-rate target — and the vote still rides the
+        # ordinary dwell_up/pend machinery, so a transient suspicion burst
+        # cannot flap a certified rung (test_control pins this).
+        target = min(st.rung + 1, len(spec.ladder) - 1)
     if target == st.rung:
         st.pend_target, st.pend_count = None, 0
         log("hold", "at_target")

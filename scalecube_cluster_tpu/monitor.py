@@ -128,6 +128,11 @@ class MonitorServer:
         # NEWEST computed counterfactual record — the MC itself is a
         # bench-cadence compute step, never an HTTP-GET one)
         self._whatif: Optional[Callable[[], Dict[str, Any]]] = None
+        # r19 operator entry: POST /whatif runs an operator-supplied arm
+        # ladder against the service's LIVE incident (the compute is
+        # synchronous and minutes-scale at production seed counts — the
+        # operator owns the wait; refusals come back as 400s)
+        self._whatif_post: Optional[Callable[[dict], Dict[str, Any]]] = None
         # OpenMetrics family providers, concatenated at /metrics scrape
         # time (r8 telemetry plane); each returns a list of family dicts
         self._metric_providers: List[Callable[[], List[Dict[str, Any]]]] = []
@@ -146,8 +151,13 @@ class MonitorServer:
         """Serve the r18 counterfactual what-if service at ``GET /whatif``:
         the newest :func:`.replay.whatif` record (arms, Wilson intervals,
         CI-separation verdicts). ``service`` is a
-        :class:`.replay.WhatifService` (or any object with ``snapshot()``)."""
+        :class:`.replay.WhatifService` (or any object with ``snapshot()``).
+        When the service also exposes ``run_operator`` (r19), ``POST
+        /whatif`` accepts an operator-supplied arm ladder against the
+        service's live incident — validated with the same unknown-knob /
+        reserved-name refusals as :func:`.replay.whatif`."""
         self._whatif = service.snapshot
+        self._whatif_post = getattr(service, "run_operator", None)
 
     def register_cluster(self, cluster) -> None:
         self.register(cluster.member().id, lambda: cluster_snapshot(cluster))
@@ -270,10 +280,27 @@ class MonitorServer:
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
             request = await reader.readline()
-            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
-                pass  # drain headers
-            path = request.split()[1].decode() if len(request.split()) > 1 else "/"
-            status, body = self._route(path.split("?", 1)[0])
+            content_length = 0
+            while True:  # drain headers, keeping Content-Length (r19: POST)
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    try:
+                        content_length = int(line.split(b":", 1)[1])
+                    except ValueError:
+                        content_length = 0
+            parts = request.split()
+            method = parts[0].decode().upper() if parts else "GET"
+            path = (parts[1].decode() if len(parts) > 1 else "/").split("?", 1)[0]
+            payload_in = (
+                await reader.readexactly(content_length)
+                if content_length > 0 else b""
+            )
+            if method == "POST":
+                status, body = self._route_post(path, payload_in)
+            else:
+                status, body = self._route(path)
             if isinstance(body, bytes):  # pre-rendered (OpenMetrics text)
                 ctype, payload = self._text_content_type, body
             else:
@@ -352,6 +379,29 @@ class MonitorServer:
                 return b"200 OK", self._providers[name]()
             return b"404 Not Found", {"error": f"unknown node {name!r}"}
         return b"404 Not Found", {"error": f"no route {path!r}"}
+
+    def _route_post(self, path: str, body: bytes) -> tuple[bytes, Any]:
+        """POST routes (r19). ``/whatif`` runs an operator arm ladder
+        against the registered service's live incident; replay-grammar
+        refusals (unknown knob, reserved/duplicate arm name, no incident)
+        surface as 400s that quote the refusal verbatim."""
+        if path != "/whatif":
+            return b"404 Not Found", {"error": f"no POST route {path!r}"}
+        if self._whatif_post is None:
+            return b"404 Not Found", {
+                "error": "whatif service accepts no operator arms — "
+                         "register a replay.WhatifService(incident=...)"
+            }
+        try:
+            doc = json.loads(body.decode("utf-8") or "null")
+        except (UnicodeDecodeError, ValueError):
+            return b"400 Bad Request", {"error": "body is not valid JSON"}
+        from .replay import ReplayError
+
+        try:
+            return b"200 OK", self._whatif_post(doc)
+        except ReplayError as exc:
+            return b"400 Bad Request", {"error": str(exc)}
 
 
 # -- structured per-tick log -------------------------------------------------

@@ -641,8 +641,19 @@ def join_row(state: SparseState, row: int, seed_rows) -> SparseState:
     seed's sync-accept re-gossip spreads a joiner; the self-announce plus the
     SYNC participants' ``sync_announce`` cover both paths)."""
     seed_rows = jnp.asarray(seed_rows, jnp.int32)
+    # The epoch bump is staged FIRST so the ``was_used`` view_key gather
+    # escapes only into the epoch scatter (r19): a pre-scatter read of the
+    # [N, N] plane flowing into later outputs pins the old buffer and
+    # forces the jitted donated spelling to copy the whole plane (~70 MB
+    # per interactive join at the 4096-member point) instead of updating
+    # it in place. Everything downstream re-derives from the BUMPED epoch.
     was_used = state.view_key[row, row] >= 0
-    new_epoch = jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
+    state = state.replace(
+        epoch=state.epoch.at[row].set(
+            jnp.where(was_used, (state.epoch[row] + 1) & 0xFF, state.epoch[row])
+        )
+    )
+    new_epoch = state.epoch[row]
     self_key = precedence_key(jnp.int32(ALIVE), jnp.int32(0), new_epoch)
     seed_keys = precedence_key(
         jnp.full(seed_rows.shape, ALIVE, jnp.int32),
@@ -659,7 +670,6 @@ def join_row(state: SparseState, row: int, seed_rows) -> SparseState:
     n_live_row = ((row_key & 3) != RANK_DEAD).sum().astype(jnp.int32)
     state = state.replace(
         up=state.up.at[row].set(True),
-        epoch=state.epoch.at[row].set(new_epoch),
         joined_at=state.joined_at.at[row].set(state.tick),
         view_key=state.view_key.at[row].set(row_key),
         n_live=state.n_live.at[row].set(n_live_row),
@@ -749,21 +759,29 @@ def begin_leave(state: SparseState, row: int) -> SparseState:
     """Graceful leave: LEAVING self-record + announcement rumor (the
     reference's leaveCluster LEAVING gossip,
     ``MembershipProtocolImpl.java:233-242``)."""
-    own = state.view_key[row, row]
-    leaving_key = ((own >> 2) << 2) | RANK_LEAVING
+    # scatter first, re-gather after (r19): a pre-scatter ``own`` gather
+    # escaping into the announce would force the jitted donated spelling
+    # to copy the whole [N, N] plane (see update_metadata below)
     state = state.replace(
-        view_key=state.view_key.at[row, row].set(leaving_key),
+        view_key=state.view_key.at[row, row].set(
+            ((state.view_key[row, row] >> 2) << 2) | RANK_LEAVING
+        ),
         leaving=state.leaving.at[row].set(True),
     )
-    return announce(state, row, leaving_key, row)
+    return announce(state, row, state.view_key[row, row], row)
 
 
 def update_metadata(state: SparseState, row: int) -> SparseState:
     """Metadata update = own-incarnation bump re-announced ALIVE
-    (``ClusterImpl.updateMetadata``, ``ClusterImpl.java:497-501``)."""
-    new_key = state.view_key[row, row] + 4
-    state = state.replace(view_key=state.view_key.at[row, row].set(new_key))
-    return announce(state, row, new_key, row)
+    (``ClusterImpl.updateMetadata``, ``ClusterImpl.java:497-501``).
+
+    The bump scatters FIRST and the announce key is re-gathered from the
+    updated plane (r19): a pre-scatter gather that escapes into the
+    announce would pin a read of the old ``view_key``, forcing the jitted
+    donated spelling to copy the whole [N, N] plane instead of updating
+    in place (~70 MB per interactive op at the 4096-member point)."""
+    state = state.replace(view_key=state.view_key.at[row, row].add(4))
+    return announce(state, row, state.view_key[row, row], row)
 
 
 def spread_rumor(state: SparseState, slot: int, origin: int) -> SparseState:

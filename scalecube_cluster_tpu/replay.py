@@ -322,9 +322,19 @@ def _run_arm_fleet(
         from .adaptive import init_adaptive_state
 
         ad = FL.fleet_broadcast(init_adaptive_state(params.capacity), n_seeds)
-    tl = FL.fleet_timeline(
-        scenario, eng.ops, dense_links=incident.dense_links, horizon=horizon
-    )
+    try:
+        tl = FL.fleet_timeline(
+            scenario, eng.ops, dense_links=incident.dense_links,
+            horizon=horizon,
+        )
+    except ScenarioError as exc:
+        # engine-capability refusal (e.g. DroppedRefute off-dense): surface
+        # as a ReplayError carrying the incident context — the underlying
+        # message already names the offending event and engine
+        raise ReplayError(
+            f"incident {scenario.name!r} cannot replay on the "
+            f"{incident.engine!r} engine: {exc}"
+        ) from exc
     sent = jax.vmap(lambda st: eng.sentinel_init(st, spec))(fs)
     spec_dev = spec.device_arrays(0)
     check_fn = jax.jit(jax.vmap(eng.sentinel_reduce, in_axes=(0, 0, None)))
@@ -530,9 +540,16 @@ class WhatifService:
     ``/chaos`` serves the last report), and :meth:`run` is the explicit
     compute step an operator (or bench harness) invokes."""
 
-    def __init__(self):
+    def __init__(self, incident: Optional[Incident] = None):
         self.record: dict = {"computed": False}
         self.history: List[dict] = []
+        #: r19: the LIVE incident operator-submitted arm ladders run
+        #: against (``POST /whatif``); None keeps the service GET-only
+        self.incident = incident
+
+    def attach_incident(self, incident: Incident) -> None:
+        """Arm ``POST /whatif`` with a (new) live incident."""
+        self.incident = incident
 
     def run(self, incident: Incident, arms: Sequence[dict] = (), **kw) -> dict:
         rec = whatif(incident, arms, **kw)
@@ -540,6 +557,42 @@ class WhatifService:
         self.record = rec
         self.history.append(rec)
         return rec
+
+    def run_operator(self, doc: dict) -> dict:
+        """Operator entry behind ``POST /whatif``: an arm ladder document
+        ``{"arms": [{"name": ..., <knob>: ...}, ...], "seeds_per_arm"?,
+        "conf"?}`` validated EAGERLY with the existing refusal grammar —
+        every arm passes through :func:`arm_params` (unknown-knob refusal)
+        before a single MC seed is paid, and :func:`whatif` applies its own
+        reserved-name / duplicate-name refusals — then run against the live
+        incident."""
+        if self.incident is None:
+            raise ReplayError(
+                "no live incident attached — construct "
+                "WhatifService(incident=...) or call attach_incident() "
+                "before POSTing arm ladders"
+            )
+        if not isinstance(doc, dict):
+            raise ReplayError("POST /whatif body must be a JSON object")
+        arms = doc.get("arms")
+        if not isinstance(arms, list) or not arms:
+            raise ReplayError(
+                "POST /whatif needs a non-empty 'arms' list of "
+                "{'name': ..., <knob>: ...} objects"
+            )
+        for arm in arms:
+            if not isinstance(arm, dict):
+                raise ReplayError(f"arm {arm!r} is not an object")
+            if isinstance(arm.get("name"), str) and arm["name"]:
+                # eager unknown-knob refusal: a typo'd knob must refuse
+                # BEFORE the as-recorded baseline fleet runs
+                arm_params(self.incident, arm)
+        kw: dict = {}
+        if "seeds_per_arm" in doc:
+            kw["seeds_per_arm"] = int(doc["seeds_per_arm"])
+        if "conf" in doc:
+            kw["conf"] = float(doc["conf"])
+        return self.run(self.incident, arms, **kw)
 
     def snapshot(self) -> dict:
         return self.record

@@ -256,6 +256,13 @@ class SimDriver:
         # dispatch wall time (first dispatch includes the jit compile, or
         # the persistent-cache load when one hits)
         self._step_stats: Dict[tuple, dict] = {}
+        # r19: jitted+donated spellings of the interactive host mutators
+        # (join/leave/metadata/rumor). The eager spellings dispatch each
+        # ``.at[].set`` as its own device op — 100-300 ms per announce
+        # chain at serving shapes, far below the loadgen's sustained
+        # member-facing op rate. Row/slot operands are passed as traced
+        # i32 scalars so ONE compile per mutator serves every row.
+        self._mutator_jits: Dict[str, Callable] = {}
         # r18: construction seed + warm flag kept host-side — the flight
         # recorder's reconstruction section embeds them so an incident dump
         # can rebuild a bit-identical replay driver (replay.py)
@@ -773,6 +780,24 @@ class SimDriver:
                 w.stream.emit(ev)
 
     # -- lifecycle / churn --------------------------------------------------
+    def _mutator(self, name: str, static_argnums=()) -> Callable:
+        """One jitted+donated program per interactive host mutator (r19).
+
+        The serving plane's sustained op rate cannot pay the eager
+        spelling (each ``.at[].set`` is a separate device dispatch and a
+        full copy-on-write of every touched plane); jitting the whole
+        mutator makes each op one async dispatch updating the donated
+        state in place, exactly like the window programs."""
+        fn = self._mutator_jits.get(name)
+        if fn is None:
+            fn = jax.jit(
+                getattr(self._ops, name),
+                static_argnums=static_argnums,
+                donate_argnums=0,
+            )
+            self._mutator_jits[name] = fn
+        return fn
+
     def join(self, seed_rows: Sequence[int] = (0,)) -> int:
         """Activate a free row as a fresh member; returns its row.
 
@@ -793,7 +818,9 @@ class SimDriver:
         )
         forgotten = free[~remembered[free]]
         row = int(forgotten[0]) if len(forgotten) else int(free[0])
-        self.state = self._ops.join_row(self.state, row, list(seed_rows))
+        self.state = self._mutator("join_row", static_argnums=2)(
+            self.state, jnp.int32(row), tuple(seed_rows)
+        )
         # a restart reuses the row but is a NEW member identity (reference:
         # rejoin after restart gets a fresh member id)
         self.members[row] = Member(
@@ -836,14 +863,44 @@ class SimDriver:
 
     def leave(self, row: int, crash_after_ticks: int = 0) -> None:
         with self._lock:
-            self.state = self._ops.begin_leave(self.state, row)
+            self.state = self._mutator("begin_leave")(
+                self.state, jnp.int32(row)
+            )
             self._publish("driver", "leave", row=row)
         if crash_after_ticks:
             self.step(crash_after_ticks)
             self.crash(row)
 
     def update_metadata(self, row: int) -> None:
-        self.state = self._ops.update_metadata(self.state, row)
+        with self._lock:
+            self.state = self._mutator("update_metadata")(
+                self.state, jnp.int32(row)
+            )
+
+    def update_metadata_batch(self, rows: Sequence[int]) -> None:
+        """Metadata bumps for a whole batch of rows in ONE dispatch (r19).
+
+        At sustained serving rates the per-call overhead (pytree flatten,
+        executable launch) dominates the sub-millisecond mutator itself, so
+        operator consoles batch their bumps; a ``fori_loop`` threads the
+        donated state through the batch on-device. One compile per batch
+        length (use a fixed batch size)."""
+        with self._lock:
+            fn = self._mutator_jits.get("update_metadata_batch")
+            if fn is None:
+                ops = self._ops
+
+                def _batch(state, batch_rows):
+                    def body(i, s):
+                        return ops.update_metadata(s, batch_rows[i])
+
+                    return jax.lax.fori_loop(
+                        0, batch_rows.shape[0], body, state
+                    )
+
+                fn = jax.jit(_batch, donate_argnums=0)
+                self._mutator_jits["update_metadata_batch"] = fn
+            self.state = fn(self.state, jnp.asarray(rows, jnp.int32))
 
     # -- rumors (spreadGossip) ----------------------------------------------
     def spread_rumor(self, origin: int, payload: object) -> int:
@@ -858,7 +915,19 @@ class SimDriver:
         rumor sweep has since freed."""
         with self._lock:
             slot = self._claim_rumor_slot_locked()
-            self.state = self._ops.spread_rumor(self.state, slot, origin)
+            if self.engine == "dense":
+                # slot stays STATIC here (the dense engine's packed infection
+                # plane resolves it to a bit-word index at trace time); the
+                # pool is bounded, so the per-slot compiles are too
+                self.state = self._mutator("spread_rumor", static_argnums=1)(
+                    self.state, slot, jnp.int32(origin)
+                )
+            else:
+                # sparse/pview spreads are pure scatter updates, so the slot
+                # can ride as a traced operand: one compile serves the pool
+                self.state = self._mutator("spread_rumor")(
+                    self.state, jnp.int32(slot), jnp.int32(origin)
+                )
             self._rumor_payloads[slot] = payload
             self._rumor_cov_dirty = True  # cached coverage predates this rumor
             self._rumor_spread_pending[slot] = self._host_tick

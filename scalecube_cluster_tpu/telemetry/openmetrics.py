@@ -200,11 +200,12 @@ def driver_families(driver, plane) -> List[dict]:
     ]
     # newest ring row -> per-series gauges (the live window values; the
     # full retained series rides the flight recorder, not the scrape).
-    # Ring reads must hold the driver lock: the sim thread's per-window
-    # append DONATES the ring buffer, and an unsynchronized monitor-thread
-    # read can hit the deleted pre-append array (the r6 RLock discipline).
-    with driver._lock:
-        latest = plane.ring.latest_values()
+    # NO driver lock (r19): latest_values reads the ring's RETAINED last
+    # row — a never-donated buffer — not the donated ring itself, so the
+    # scrape cannot hit the deleted pre-append array (the r6 hazard) and
+    # never queues behind a mega-sim window's compute. Full-ring reads
+    # (flight dumps, plane.snapshot) still take the lock.
+    latest = plane.ring.latest_values()
     fams.append(
         family(
             f"{PREFIX}_window", "gauge",

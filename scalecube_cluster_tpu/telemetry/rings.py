@@ -67,6 +67,11 @@ class MetricRing:
             buf = place_replicated(buf, mesh)
         self._buf = buf
         self._windows = 0  # host-side append count (cursor = windows % len)
+        # the newest appended row, RETAINED (r19): the append donates only
+        # the ring buffer — the row itself is the fresh output of an
+        # undonated jit, immutable once created, so holding a reference
+        # gives scrapes a lock-free read of the newest complete window
+        self._last_row = None
         # donated in-place row write: the ring must never force a copy of
         # itself per window (it is carried across every step of a run)
         self._append = jax.jit(
@@ -84,6 +89,7 @@ class MetricRing:
         import jax.numpy as jnp
 
         idx = jnp.int32(self._windows % self.ring_len)
+        self._last_row = row  # not donated below — safe to retain
         self._buf = self._append(self._buf, row, idx)
         self._windows += 1
 
@@ -104,11 +110,16 @@ class MetricRing:
         }
 
     def latest_values(self) -> Dict[str, float]:
-        """name -> value of the newest row ({} before the first append)."""
-        rows = self.last(1)
-        if rows.shape[0] == 0:
+        """name -> value of the newest row ({} before the first append).
+
+        Lock-free by design (r19): reads the retained last-appended row,
+        never the donated ring buffer — a ``/metrics`` scrape landing while
+        a mega-sim window holds the driver lock serves the newest COMPLETE
+        window immediately instead of waiting out the window's compute."""
+        if self._last_row is None:
             return {}
-        return {n: float(v) for n, v in zip(self.names, rows[-1])}
+        vals = np.asarray(self._last_row)
+        return {n: float(v) for n, v in zip(self.names, vals)}
 
     def series(self, name: str, k: Optional[int] = None) -> List[float]:
         """One named column of the retained window series, oldest first."""

@@ -106,6 +106,11 @@ class TracePlane:
             lambda state: eng.tracer_view_cols(state, tracer_rows_arr)
         )
         self._cols = self._gather_cols(driver.state)
+        # per-k snapshot cache keyed by append counters (r19): appends only
+        # happen inside the lock-holding window dispatch, so between window
+        # boundaries the ring cannot change and a scrape can serve the
+        # retained host copy without touching the driver lock
+        self._snap_cache: Dict[object, tuple] = {}
 
     # -- the per-window device path (called under the driver lock) -----------
     def on_window(self, state) -> None:
@@ -148,10 +153,24 @@ class TracePlane:
 
     # -- sync points (driver lock + readback bookkeeping) ---------------------
     def snapshot(self, k: Optional[int] = None) -> Dict:
-        """Raw ring readback, oldest first — THE trace-ring sync point."""
+        """Raw ring readback, oldest first — THE trace-ring sync point.
+
+        Cached per (append-count, k): a ``/trace`` scrape landing while a
+        mega-sim window holds the driver lock serves the newest COMPLETE
+        window's host copy immediately (r19 serving SLO) instead of
+        waiting out the window's compute; only the first read after a
+        window boundary pays the lock + transfer. ``records`` joins
+        ``records_total`` in the key so the restore-path ``clear()``
+        (which rewinds ``records`` but not the lifetime total)
+        invalidates retained pre-restore rows."""
+        key = (self.ring.records_total, self.ring.records, k)
+        hit = self._snap_cache.get(k)
+        if hit is not None and hit[0] == key:
+            return hit[1]
         with self.driver._lock:
             snap = self.ring.snapshot(k)
         self.driver._note_readback(1)
+        self._snap_cache[k] = (key, snap)
         return snap
 
     def events(self, k: Optional[int] = None) -> List[Dict]:

@@ -329,6 +329,62 @@ def test_seeded_in_scan_wide_gather_is_caught():
     assert check_no_plane_materialization(clean) == []
 
 
+def test_bridge_variant_passes_matrix():
+    """r19 serving path: the bridge-watched window (watch_rows live, W=3)
+    audits clean on every engine — donation aliased, transfer-free (the
+    real-member fold is a host seam outside the jit), budget covering the
+    stacked watched keys. The wide-plane engines WAIVE only the r10
+    materialization check (the watch gather is the pinned opt-in above);
+    pview keeps every check live including the r11 wide-value ban."""
+    for engine in ("dense", "sparse", "pview"):
+        programs = build_engine_programs(
+            engine, capacity=CAPACITY, n_ticks=N_TICKS,
+            key_dtypes=["i32"], variants=["bridge"],
+        )
+        assert [p.name for p in programs] == [f"{engine}/i32/bridge"]
+        prog = programs[0]
+        results = run_contracts(prog, compile_programs=True)
+        flat = [v for vs in results.values() for v in vs]
+        assert not flat, "\n".join(str(v) for v in flat)
+        assert {"donation_alias", "transfer_free", "memory_budget"} <= set(
+            results
+        )
+        if engine == "pview":
+            assert "no_plane_materialization" in results
+            assert "forbid_wide_values" in results
+        else:
+            # the waiver is exactly the seeded r10 opt-in, nothing more
+            assert "no_plane_materialization" not in results
+
+
+def test_seeded_bridge_dropped_donation_is_caught():
+    """Falsifiability for the r19 bridge variant: the same watched window
+    jitted WITHOUT donate_argnums but registered as donated — the auditor
+    must flag every state leaf as a dropped alias (a bridge deploy whose
+    serving window silently copies the view plane each dispatch)."""
+    from scalecube_cluster_tpu.ops import engine_api
+    from scalecube_cluster_tpu.audit.programs import (
+        _abstract, _audit_params, _key_abstract, _tree_bytes,
+    )
+
+    eng = engine_api.engine("dense")
+    params = _audit_params("dense", CAPACITY, "i32")
+    state = eng.init_state(params, 96, True, True)
+    abs_state = _abstract(state)
+    inner = eng.make_run(params, N_TICKS, donate=False)
+    fn = jax.jit(lambda s, k, w: inner(s, k, watch_rows=w))  # no donation
+    prog = _program(
+        "seeded/bridge-dropped-donation", fn,
+        (abs_state, _key_abstract(), jax.ShapeDtypeStruct((3,), jnp.int32)),
+        (0,), basis=_tree_bytes(abs_state),
+    )
+    violations = check_donation_alias(prog)
+    assert violations, "auditor missed the dropped bridge donation"
+    assert any("aliasing_output" in v.message or "buffer_donor" in v.message
+               for v in violations)
+    assert any("view_key" in v.message for v in violations)
+
+
 def test_seeded_budget_overflow_is_caught():
     """Violation class 5: a window that keeps a second, un-aliased copy of
     the state alive past its declared budget (factor 1.2 + 64 KiB here —

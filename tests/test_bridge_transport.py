@@ -1,0 +1,297 @@
+"""Hybrid bridge (r19): real ``Cluster`` processes over ``TpuSimTransport``.
+
+Tier-1 coverage of the bridge plane at small N: join-to-ALIVE in both
+directions, proxy FD semantics (DEST_OK / DEST_GONE / silence), sim-side
+death surfacing through the window fold, the ``"tpusim"`` factory sibling,
+and the satellite-4 reconnect story: a bridged member dropping mid-window
+emits ``reconnect_backoff`` / ``reconnect_giveup`` TransportEvents on
+``transport_events()`` (asserted against the bus) and re-joins via the
+forced initial SYNC after ``heal_link``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _helpers import await_until  # noqa: E402
+
+from scalecube_cluster_tpu.bridge import BridgeError, SimBridge
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig, TransportConfig
+from scalecube_cluster_tpu.models.member import MemberStatus
+from scalecube_cluster_tpu.ops.state import SimParams
+from scalecube_cluster_tpu.sim.driver import SimDriver
+from scalecube_cluster_tpu.telemetry.bus import TelemetryBus
+from scalecube_cluster_tpu.transport.api import (
+    PeerUnavailableError,
+    transport_factories,
+)
+
+N_INITIAL = 48
+CAPACITY = 64
+
+
+def make_driver(seed: int = 7) -> SimDriver:
+    params = SimParams(
+        capacity=CAPACITY, fanout=3, ping_req_k=2, fd_every=1,
+        sync_every=8, suspicion_mult=2, rumor_slots=8, seed_rows=(0,),
+    )
+    return SimDriver(params, N_INITIAL, warm=True, seed=seed)
+
+
+def fast_config(seeds=("sim://0",)) -> ClusterConfig:
+    return (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(
+            seed_members=list(seeds), sync_interval=0.3, sync_timeout=0.5,
+        ))
+        .with_failure_detector(lambda f: f.replace(
+            ping_interval=0.15, ping_timeout=0.1, ping_req_members=1,
+        ))
+        .with_gossip(lambda g: g.replace(gossip_interval=0.05))
+    )
+
+
+async def drive(driver, predicate, timeout=8.0, window=2):
+    """Step sim windows on the loop until ``predicate`` holds — serving and
+    simulation share the loop exactly like the loadgen's stepper."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        driver.step(window)
+        await asyncio.sleep(0.03)
+    return predicate()
+
+
+def alive_ids(cluster):
+    return {m.id for m in cluster.members()}
+
+
+def test_bridged_members_join_and_reach_alive():
+    """Two real processes join the simulated membership: each learns the sim
+    table via the initial SYNC, each sees the OTHER real process ALIVE via
+    the window fold, and the sim marks both rows ALIVE."""
+    d = make_driver()
+    bridge = SimBridge(d)
+
+    async def run():
+        a = await (
+            new_cluster(fast_config())
+            .transport_factory(bridge.transport_factory("alpha"))
+            .start()
+        )
+        ep_a = bridge._endpoints["alpha"]
+        try:
+            # the initial SYNC alone hands over the warm sim table
+            assert len(a.members()) >= N_INITIAL - 1
+            # sim-side: seed's view shows the bridged row ALIVE once the
+            # join disseminates through stepped windows
+            assert await drive(
+                d, lambda: d.status_of(0, ep_a.row) == MemberStatus.ALIVE
+            )
+
+            b = await (
+                new_cluster(fast_config())
+                .transport_factory(bridge.transport_factory("beta"))
+                .start()
+            )
+            ep_b = bridge._endpoints["beta"]
+            try:
+                assert await drive(
+                    d, lambda: d.status_of(0, ep_b.row) == MemberStatus.ALIVE
+                )
+                # each bridged member reaches ALIVE in the other's view —
+                # b learned a from the seed table, a learns b from its
+                # window-boundary fold
+                assert await drive(
+                    d,
+                    lambda: b.member().id in alive_ids(a)
+                    and a.member().id in alive_ids(b),
+                    timeout=12.0,
+                )
+            finally:
+                await b.shutdown()
+        finally:
+            await a.shutdown()
+
+    asyncio.run(run())
+
+
+def test_sim_crash_surfaces_to_bridged_member():
+    """A sim member dying mid-run surfaces as DEAD/REMOVED through the
+    window fold — the bridged member's table drops it."""
+    d = make_driver(seed=13)
+    bridge = SimBridge(d)
+
+    async def run():
+        a = await (
+            new_cluster(fast_config())
+            .transport_factory(bridge.transport_factory("watcher"))
+            .start()
+        )
+        try:
+            victim = d._member_handle(5).id
+            assert await drive(d, lambda: victim in alive_ids(a))
+            d.crash(5)
+            assert await drive(
+                d, lambda: victim not in alive_ids(a), timeout=12.0,
+            )
+        finally:
+            await a.shutdown()
+
+    asyncio.run(run())
+
+
+def test_reconnect_backoff_events_and_rejoin_via_forced_sync():
+    """Satellite 4: a bridged member dropping mid-window backs off with
+    TransportEvents on transport_events() (bus-asserted), is crashed out of
+    the sim, and re-joins via the forced initial SYNC on heal."""
+    d = make_driver(seed=23)
+    bridge = SimBridge(d, config=TransportConfig(
+        reconnect_max_retries=2, reconnect_base_delay=0.01,
+        reconnect_max_delay=0.02,
+    ))
+    bus = TelemetryBus(capacity=256)
+
+    async def run():
+        a = await (
+            new_cluster(fast_config())
+            .transport_factory(bridge.transport_factory("flaky"))
+            .start()
+        )
+        bus.attach_cluster(a)
+        ep = bridge._endpoints["flaky"]
+        seen = []
+        a.transport_events().subscribe(lambda ev: seen.append(ev))
+        try:
+            assert await drive(
+                d, lambda: d.status_of(0, ep.row) == MemberStatus.ALIVE
+            )
+            old_row = ep.row
+            table_before = len(a.members())
+            assert table_before >= N_INITIAL - 1
+
+            bridge.fail_link(ep)
+            # the crash is a host mutation: the next window realizes it
+            assert not d.is_up(old_row)
+            with pytest.raises(PeerUnavailableError):
+                await ep.send("sim://0", _noise_message())
+            kinds = [ev.kind for ev in seen]
+            assert "connection_lost" in kinds
+            assert "reconnect_backoff" in kinds
+            assert "reconnect_giveup" in kinds
+            giveup = next(ev for ev in seen if ev.kind == "reconnect_giveup")
+            assert giveup.attempts == 3  # 2 retries + the final refusal
+            # the same events landed on the bus as ("transport", kind)
+            bus_kinds = {
+                rec.kind for rec in bus.tail() if rec.source == "transport"
+            }
+            assert {"connection_lost", "reconnect_backoff",
+                    "reconnect_giveup"} <= bus_kinds
+
+            bridge.heal_link(ep)
+            assert ep._link_up and d.is_up(ep.row)
+            # forced initial SYNC restocks the table without a restart …
+            await asyncio.sleep(0.1)
+            assert len(a.members()) >= N_INITIAL - 1
+            # … and the re-joined row converges back to ALIVE sim-side
+            assert await drive(
+                d, lambda: d.status_of(0, ep.row) == MemberStatus.ALIVE,
+                timeout=12.0,
+            )
+        finally:
+            await a.shutdown()
+
+    asyncio.run(run())
+
+
+def _noise_message():
+    from scalecube_cluster_tpu.models.message import Message
+    return Message.with_data({"noise": True}, qualifier="user/noise")
+
+
+def test_tpusim_factory_is_registered_sibling():
+    """The ``"tpusim"`` factory stands next to tcp/websocket in the registry
+    and resolves through ``ClusterConfig`` once a default bridge is set."""
+    assert "tpusim" in transport_factories()
+    d = make_driver(seed=31)
+    bridge = SimBridge(d)
+    bridge.set_default()
+    try:
+        cfg = fast_config().with_transport(
+            lambda t: t.replace(transport_factory="tpusim")
+        )
+
+        async def run():
+            a = await new_cluster(cfg).start()
+            try:
+                assert a.address.startswith("tpusim://")
+                assert len(a.members()) >= N_INITIAL - 1
+            finally:
+                await a.shutdown()
+
+        asyncio.run(run())
+    finally:
+        SimBridge._default = None
+
+
+def test_duplicate_endpoint_name_refused():
+    d = make_driver(seed=41)
+    bridge = SimBridge(d)
+
+    async def run():
+        t1 = bridge.transport("solo")
+        await t1.start()
+        with pytest.raises(BridgeError):
+            bridge.transport("solo")
+        await t1.stop()
+
+    asyncio.run(run())
+
+
+def test_proxy_ping_semantics_dest_gone_and_silence():
+    """The proxy speaks reference FD: matching id acks DEST_OK, a re-occupied
+    row acks DEST_GONE (identity mismatch), a down row stays silent."""
+    from scalecube_cluster_tpu.cluster.failure_detector import AckType, PingData
+    from scalecube_cluster_tpu.models.message import (
+        Message, Q_PING, Q_PING_ACK, new_correlation_id,
+    )
+
+    d = make_driver(seed=53)
+    bridge = SimBridge(d)
+
+    async def run():
+        ep = await bridge.transport("prober").start()
+        inbox = []
+        ep.listen().subscribe(lambda m: inbox.append(m))
+        me = d._member_handle(3)
+
+        async def ping(member, row):
+            cid = new_correlation_id("t")
+            await ep.send(f"sim://{row}", Message.with_data(
+                PingData(None, member), qualifier=Q_PING, cid=cid,
+            ))
+            await asyncio.sleep(0.01)
+            return [m for m in inbox if m.correlation_id == cid]
+
+        acks = await ping(me, 3)
+        assert acks and acks[0].qualifier == Q_PING_ACK
+        assert acks[0].data.ack_type == AckType.DEST_OK
+
+        # wrong id for the row (a restart elsewhere) -> DEST_GONE
+        stranger = d._member_handle(9)
+        acks = await ping(stranger, 3)
+        assert acks and acks[0].data.ack_type == AckType.DEST_GONE
+
+        # a down row answers nothing: the caller's timeout drives SUSPECT
+        d.crash(11)
+        assert await ping(d._member_handle(11), 11) == []
+        await ep.stop()
+
+    asyncio.run(run())

@@ -114,6 +114,69 @@ def test_unclamped_controller_overshoots_and_retargets():
     assert st.actuations == 2
 
 
+def _sense2(miss, suspect):
+    return {"miss_rate": miss, "suspect_rate": suspect, "probes": 1000.0}
+
+
+def test_suspect_gate_default_off_matches_r16_policy():
+    """suspect_gate=0.0 (the default) keeps the sensor passive: the decision
+    trace under heavy suspect pressure is identical to the r16 single-input
+    policy — the certified rungs cannot move on suspect_rate alone."""
+    spec = ControlSpec()  # gate off
+    st_hot, st_ref = ControllerState(), ControllerState()
+    for _ in range(8):
+        r_hot = advance(spec, st_hot, _sense2(0.0, 0.9))
+        r_ref = advance(spec, st_ref, _sense2(0.0, 0.0))
+        assert r_hot is None and r_ref is None
+    assert st_hot.rung == st_ref.rung == 0
+    assert [e["action"] for e in st_hot.log] == [
+        e["action"] for e in st_ref.log
+    ]
+    # the pressure is still visible: logged, not acted on (ROADMAP item 4)
+    assert st_hot.log[-1]["suspect_rate"] == 0.9
+
+
+def test_suspect_gate_votes_up_through_dwell_and_cannot_flap():
+    """An armed gate rides the ordinary dwell machinery: a one-epoch
+    suspicion burst resets pending (no actuation — no flap of the certified
+    rung), a sustained burst climbs exactly one rung per dwell_up, and the
+    vote is up-only (it never relaxes protection)."""
+    spec = ControlSpec(suspect_gate=0.5, dwell_up=2, dwell_down=4)
+    st = ControllerState()
+    # one-epoch burst, then quiet: pending resets, rung pinned at 0
+    assert advance(spec, st, _sense2(0.0, 0.8)) is None   # dwell 1/2
+    assert advance(spec, st, _sense2(0.0, 0.0)) is None   # back at target
+    assert st.rung == 0 and st.actuations == 0
+    # sustained pressure: exactly one rung after dwell_up epochs
+    assert advance(spec, st, _sense2(0.0, 0.8)) is None   # dwell 1/2
+    r = advance(spec, st, _sense2(0.0, 0.8))              # dwell 2/2 -> step
+    assert r is spec.ladder[1] and st.rung == 1
+    # pressure gone: relaxing still pays the full (slower) dwell_down —
+    # an alternating burst pattern can never flap the rung
+    for _ in range(spec.dwell_down - 1):
+        assert advance(spec, st, _sense2(0.0, 0.0)) is None
+        assert st.rung == 1
+    assert advance(spec, st, _sense2(0.0, 0.8)) is None   # burst resets pend
+    assert st.rung == 1
+    # blind controller ignores the gate entirely (falsifiability contract)
+    blind = ControlSpec(suspect_gate=0.5, blind=True)
+    stb = ControllerState()
+    for _ in range(6):
+        assert advance(blind, stb, _sense2(0.0, 0.9)) is None
+    assert stb.rung == 0
+
+
+def test_suspect_gate_config_roundtrip_and_validation():
+    from scalecube_cluster_tpu.config import ClusterConfig
+
+    cfg = ClusterConfig.default_sim().with_control(
+        lambda c: c.replace(suspect_gate=0.25)
+    )
+    assert ControlSpec.from_config(cfg).suspect_gate == 0.25
+    with pytest.raises(ValueError):
+        ControlSpec(suspect_gate=-0.1)
+
+
 def test_spec_validation():
     with pytest.raises(ValueError):
         ControlSpec(ladder=(DEFAULT_LADDER[0],))  # < 2 rungs

@@ -469,6 +469,160 @@ def test_whatif_service_and_monitor_endpoint():
     assert mon._route("/")[1]["whatif"] is True
 
 
+def test_post_whatif_operator_arm_ladder_refusals_and_run():
+    """Satellite (ISSUE 18): POST /whatif accepts an operator-supplied arm
+    ladder against a live incident, refusing with the existing replay
+    grammar — unknown knob, reserved name, duplicate name — as 400s."""
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    mon = MonitorServer()
+    # no service at all -> 404
+    status, body = mon._route_post("/whatif", b"{}")
+    assert status.startswith(b"404")
+    # GET-only service (no live incident) -> 400 naming the fix
+    svc = R.WhatifService()
+    mon.register_whatif(svc)
+    status, body = mon._route_post("/whatif", b'{"arms": [{"name": "x"}]}')
+    assert status.startswith(b"400") and "live incident" in body["error"]
+
+    svc.attach_incident(_calibrated_incident())
+    post = lambda doc: mon._route_post("/whatif", json.dumps(doc).encode())
+
+    status, body = mon._route_post("/whatif", b"not json")
+    assert status.startswith(b"400") and "JSON" in body["error"]
+    status, body = post({"arms": []})
+    assert status.startswith(b"400") and "'arms'" in body["error"]
+    # unknown knob refuses EAGERLY with the arm_params grammar
+    status, body = post({"arms": [{"name": "typo", "fanouts": 9}],
+                         "seeds_per_arm": 2})
+    assert status.startswith(b"400")
+    assert "'typo'" in body["error"] and "'fanouts'" in body["error"]
+    # reserved + duplicate names refuse through whatif's own checks
+    status, body = post({"arms": [{"name": "as-recorded", "fd_every": 1}],
+                         "seeds_per_arm": 2})
+    assert status.startswith(b"400") and "as-recorded" in body["error"]
+    status, body = post({"arms": [{"name": "a", "fd_every": 1},
+                                  {"name": "a", "fd_every": 2}],
+                         "seeds_per_arm": 2})
+    assert status.startswith(b"400")
+    # a valid ladder runs and the record lands on GET /whatif too
+    status, body = post({"arms": [{"name": "fast-fd", "fd_every": 1,
+                                   "suspicion_mult": 2}],
+                         "seeds_per_arm": 2})
+    assert status.startswith(b"200")
+    assert body["n_arms"] == 2 and body["seeds_per_arm"] == 2
+    assert mon._route("/whatif")[1]["computed"] is True
+
+
+def test_post_whatif_over_live_http():
+    """The live-socket path: method + Content-Length body parse in
+    MonitorServer._handle, 200 on a real ladder, 400 on a refusal."""
+    import urllib.error
+    import urllib.request
+
+    from scalecube_cluster_tpu.monitor import MonitorServer
+
+    async def run():
+        mon = MonitorServer()
+        svc = R.WhatifService(incident=_calibrated_incident())
+        mon.register_whatif(svc)
+        await mon.start()
+
+        def post(doc):
+            req = urllib.request.Request(
+                mon.url + "/whatif", data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+
+        loop = __import__("asyncio").get_running_loop()
+        try:
+            status, body = await loop.run_in_executor(
+                None, post,
+                {"arms": [{"name": "fast-fd", "fd_every": 1}],
+                 "seeds_per_arm": 2},
+            )
+            assert status == 200 and body["n_arms"] == 2
+
+            def bad():
+                try:
+                    post({"arms": [{"name": "typo", "nope": 1}],
+                          "seeds_per_arm": 2})
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+                raise AssertionError("expected 400")
+
+            code, body = await loop.run_in_executor(None, bad)
+            assert code == 400 and "'nope'" in body["error"]
+        finally:
+            await mon.stop()
+
+    import asyncio
+    asyncio.run(run())
+
+
+def _sparse_incident(events, name="sparse-incident", horizon=48,
+                     detect_budget=0, verdict=None):
+    import scalecube_cluster_tpu.ops.sparse as SP
+
+    sp = SP.SparseParams(
+        capacity=16, fanout=3, repeat_mult=2, ping_req_k=2, fd_every=1,
+        sync_every=6, suspicion_mult=2, sweep_every=2, rumor_slots=2,
+        mr_slots=32, announce_slots=8, seed_rows=(0, 6),
+    )
+    scn = Scenario(
+        name=name, events=events, horizon=horizon,
+        detect_budget=detect_budget or horizon, converge_budget=horizon,
+        check_interval=4,
+    )
+    return R.Incident(
+        engine="sparse", params=sp, scenario=scn, seed=5, n_initial=16,
+        dense_links=False, warm=True, t0=0, max_window=16,
+        sentinels_armed=True, verdict=verdict,
+    )
+
+
+def test_whatif_dropped_refute_refusal_names_event_and_engine():
+    """Satellite (ISSUE 18): a multi-event production dump carrying a
+    DroppedRefute cannot replay on sparse/pview — the refusal must name the
+    OFFENDING event (label with rows + tick) and the engine, wrapped as a
+    ReplayError with the incident context, not a bare capability error."""
+    incident = _sparse_incident(
+        [Crash(rows=[3], at=2),
+         DroppedRefute(rows=[3], at=4, until=20),
+         Restart(rows=[3], at=24)],
+        name="prod-multi-event",
+    )
+    with pytest.raises(R.ReplayError) as exc_info:
+        R.whatif(incident, [{"name": "fast", "fd_every": 2}], seeds_per_arm=2)
+    msg = str(exc_info.value)
+    assert "'prod-multi-event'" in msg          # the incident
+    assert "'sparse'" in msg                    # the engine
+    assert "refute_drop[3]@4" in msg            # the offending event
+    assert "dense engine" in msg                # the way out
+
+
+def test_whatif_sparse_multi_event_round_trip():
+    """The events sparse DOES support round-trip through whatif: a
+    crash+restart churn incident replays as a scenario-batched sparse
+    fleet and the record comes back with paired per-arm intervals."""
+    incident = _sparse_incident(
+        [Crash(rows=[3], at=4), Crash(rows=[9], at=8),
+         Restart(rows=[3], at=16)],
+        name="sparse-churn", horizon=48,
+    )
+    validation = R.validate_incident(incident)
+    assert validation["replayed"] is not None
+    record = R.whatif(
+        incident, [{"name": "wide", "fanout": 5}], seeds_per_arm=4,
+    )
+    assert record["n_arms"] == 2
+    by_name = {a["arm"]: a for a in record["arms"]}
+    assert by_name["as-recorded"]["n_seeds"] == 4
+    assert by_name["wide"]["wilson"] is not None
+
+
 @pytest.mark.slow
 def test_whatif_full_arm_matrix():
     """The bench.py --replay shape at reduced seeds: all three scripted

@@ -1,0 +1,173 @@
+"""Long-horizon hybrid serving soak (r19) — the ``-m slow`` serving lane.
+
+Millions of member-ticks against ONE mega sim with the full serving stack
+armed at once: telemetry + flight recorder (r8), the r16 closed-loop
+controller, and a real bridged member riding along over ``TpuSimTransport``
+while chaos lands mid-soak — a Partition+heal (the bridged row is the
+bystander cohort the false-DEAD sentinel watches) followed by a shifting-
+conditions storm (``chaos.shifting.loss_storm_midrun``: a true crash to
+detect fast, then the loss-adversarial false-positive cohort). The lane
+gates on serving SLOs, not just sentinel cleanliness:
+
+* detection latency — the storm's true crash reaches DEAD within budget;
+* false-DEAD — the loss-adversarial cohort is never declared DEAD;
+* op latency — a member-facing churn burst lands under p99 SLO while
+  windows keep stepping;
+* liveness — the bridged member stays ALIVE in sim views and keeps the
+  sim seed in its own table through both scenarios;
+* post-mortem readiness — the armed flight recorder round-trips a dump.
+
+Tier-1 (`-m 'not slow'`) deselects this file; ``pytest -m slow
+tests/test_serve_soak.py`` runs it (~3-5 min on a single CPU).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.bridge import LoadGenerator, SimBridge
+from scalecube_cluster_tpu.chaos import shifting as sh
+from scalecube_cluster_tpu.chaos.events import Partition, Scenario
+from scalecube_cluster_tpu.cluster import new_cluster
+from scalecube_cluster_tpu.config import ClusterConfig, TelemetryConfig
+from scalecube_cluster_tpu.control import ControlSpec
+from scalecube_cluster_tpu.models.member import MemberStatus
+from scalecube_cluster_tpu.ops.sparse import SparseParams
+from scalecube_cluster_tpu.sim.driver import SimDriver
+from scalecube_cluster_tpu.telemetry.flight import load_flight_dump
+
+pytestmark = pytest.mark.slow
+
+N = 4096
+MEMBER_TICK_FLOOR = 1_000_000  # "millions of member-ticks" across scenarios
+DETECT_SLO_TICKS = 96          # storm's true crash -> DEAD within this budget
+FALSE_DEAD_SLO = 0             # adversarial cohort: zero false DEAD verdicts
+OP_P99_SLO_MS = 250.0          # member-facing op p99 under live windows
+
+
+def _params(capacity: int) -> SparseParams:
+    return SparseParams(
+        capacity=capacity, fanout=3, ping_req_k=2, fd_every=2,
+        sync_every=24, suspicion_mult=3, sweep_every=4, rumor_slots=16,
+        mr_slots=256, announce_slots=64, seed_rows=(0, 1),
+    )
+
+
+def _soak_config() -> ClusterConfig:
+    # long-horizon cadence: the real member stays live through minutes of
+    # scenario stepping without flooding the lock-holding windows with
+    # per-ping host readbacks
+    return (
+        ClusterConfig.default_local()
+        .with_membership(lambda m: m.replace(
+            seed_members=["sim://0"], sync_interval=5.0, sync_timeout=4.0,
+        ))
+        .with_failure_detector(lambda f: f.replace(
+            ping_interval=2.0, ping_timeout=1.5, ping_req_members=1,
+        ))
+        .with_gossip(lambda g: g.replace(gossip_interval=0.5))
+    )
+
+
+def test_hybrid_soak_chaos_shifting_controller_slo(tmp_path):
+    d = SimDriver(_params(N + 64), N, warm=True, seed=23, dense_links=True)
+    d.arm_telemetry(TelemetryConfig(
+        ring_len=64, flight_windows=16, flight_dir=str(tmp_path),
+    ))
+    plane = d.arm_control(spec=ControlSpec(epoch_windows=4))
+    bridge = SimBridge(d, seed_rows=(0, 1))
+
+    async def run() -> None:
+        loop = asyncio.get_running_loop()
+        a = await (
+            new_cluster(_soak_config())
+            .transport_factory(bridge.transport_factory("soak-0"))
+            .start()
+        )
+        try:
+            ep = bridge._endpoints["soak-0"]
+            # the initial SYNC hands over the full mega table
+            assert len(a.members()) >= N
+
+            # -- chaos: Partition+heal, bridged row in the bystander cohort
+            half = N // 2
+            part = Scenario(
+                name="soak-partition-heal",
+                events=[Partition(
+                    groups=[range(0, half), range(half, N)],
+                    at=8, heal_at=40,
+                )],
+                horizon=120,
+                detect_budget=100,
+                converge_budget=120,
+                check_interval=8,
+            )
+            rep1 = await loop.run_in_executor(
+                None, lambda: d.run_scenario(part, max_window=8)
+            )
+            assert not rep1.get("violations"), rep1
+
+            # -- shifting conditions: clean -> storm (true crash + the
+            # loss-adversarial cohort) -> relax, controller steering live
+            ss = sh.loss_storm_midrun(n=N)
+            rep2 = await loop.run_in_executor(
+                None, lambda: d.run_scenario(ss.scenario, max_window=8)
+            )
+
+            # detection-latency SLO on the storm's true crash
+            det = {
+                int(x["row"]): x
+                for x in rep2["sentinels"]["detections"]
+            }
+            crash = det[ss.crash_row]
+            assert crash["detected_at"] is not None, crash
+            latency = crash["detected_at"] - crash["crashed_at"]
+            assert latency <= DETECT_SLO_TICKS, crash
+
+            # false-DEAD SLO: the loss-adversarial cohort never crashed —
+            # an observer outside the cohort must not hold it DEAD
+            false_dead = [
+                r for r in ss.watch_rows
+                if d.status_of(0, r) == MemberStatus.DEAD
+            ]
+            assert len(false_dead) <= FALSE_DEAD_SLO, false_dead
+
+            # bridged liveness through BOTH scenarios: ALIVE in the sim
+            # view, sim seed still in the real member's table
+            assert d.status_of(0, ep.row) == MemberStatus.ALIVE
+            assert any(m.address == "sim://0" for m in a.members())
+
+            # -- serving burst under live windows: op-latency SLO
+            gen = LoadGenerator(d, seed=11, seed_rows=(0, 1),
+                                max_churn_pool=16)
+            await gen.warmup(step_window=1)
+            burst = await gen.run(
+                duration_s=3.0, churn_workers=2, scrape_workers=0,
+                step_window=1, step_interval_s=0.5,
+            )
+            assert burst.ops > 0
+            md = burst.op_latency.get("metadata")
+            assert md is not None and md["p99_ms"] <= OP_P99_SLO_MS, (
+                burst.as_dict()
+            )
+
+            # -- the r16 controller actually ran epochs over the soak
+            snap = plane.snapshot()
+            assert snap["armed"] and snap["windows"] > 0, snap
+
+            # -- flight recorder armed AND live: a manual post-soak dump
+            # round-trips through the loader
+            dump = d.telemetry.flight_record("soak-complete")
+            doc = load_flight_dump(dump)
+            assert doc["_schema"] == 2
+
+            # -- the headline scale claim: millions of member-ticks
+            ticks = rep1["ticks_run"] + rep2["ticks_run"]
+            assert ticks * N >= MEMBER_TICK_FLOOR, (ticks, N)
+        finally:
+            await a.shutdown()
+            SimBridge._default = None
+
+    asyncio.run(run())

@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ENGINES = ("dense", "sparse", "pview")
 VARIANTS = ("unarmed", "traced", "telemetry", "sharded", "strategy",
-            "adaptive", "fleet", "control", "fused", "replay")
+            "adaptive", "fleet", "control", "fused", "replay", "bridge")
 
 
 def main(argv=None) -> int:
