@@ -414,6 +414,19 @@ def main() -> None:
             default_out="SERVE_BENCH_r19.json",
         )
 
+    # r20: --shard runs the sharded pview weak-scaling lane
+    # (benchmarks/scaling_efficiency.py --shard — the mesh-size ladder on
+    # the 8-virtual-device mesh + the 2-process gloo hosts-double cell)
+    # through the same backend-probe/retry path; the artifact defaults to
+    # SHARD_BENCH_r20.json next to this file.
+    if "--shard" in sys.argv:
+        _delegate(
+            "scaling_efficiency.py",
+            ("--shard-out",),
+            passthrough=("--shard",),
+            default_out="SHARD_BENCH_r20.json",
+        )
+
     engine = "sparse"
     if "--engine" in sys.argv:
         i = sys.argv.index("--engine")

@@ -448,6 +448,42 @@ def collect_serve_summary(root: pathlib.Path) -> dict:
         return {"present": True, "error": repr(exc)}
 
 
+def collect_shard_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r20 sharded weak-scaling artifact:
+    the mesh-ladder gate (projected aggregate at mesh=4 vs mesh=1), the
+    per-cell raw/projected rates, and the two-process gloo cell's
+    per-chip gate."""
+    path = root / "SHARD_BENCH_r20.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        ladder = data.get("ladder") or {}
+        twop = data.get("two_process") or {}
+        gate = ladder.get("gate_mesh4_vs_mesh1") or {}
+        gate2 = twop.get("gate_within_25pct_of_single_process") or {}
+        return {
+            "present": True,
+            "backend": data.get("backend"),
+            "host_cpus": data.get("host_cpus"),
+            "ladder": {
+                str(r.get("mesh")): {
+                    "raw": r.get("raw_member_ticks_per_s"),
+                    "projected": r.get("projected_member_ticks_per_s"),
+                    "per_chip": r.get("projected_members_per_s_per_chip"),
+                }
+                for r in ladder.get("ladder") or []
+            },
+            "gate_mesh4_vs_mesh1": gate.get("measured"),
+            "ladder_ok": gate.get("ok"),
+            "two_process_ratio": gate2.get("measured_ratio"),
+            "two_process_ok": gate2.get("ok"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def collect_trajectory(root: pathlib.Path) -> list:
     """Fold every per-round dense-bench artifact present on disk into one
     dense-N=4096 ticks/s trajectory (the number each round's acceptance
@@ -623,6 +659,12 @@ def main() -> None:
                     "--out", "AUDIT_r12.json"])
     results += run([py, "benchmarks/scaling_efficiency.py"], timeout=3000)
     results += run([py, "bench.py", "--scaling"], timeout=3000)
+    # r20: the sharded pview weak-scaling lane — the 8-virtual-device
+    # mesh-size ladder + the 2-process gloo hosts-double cell. Refreshes
+    # the standing SHARD_BENCH_r20.json artifact and rides the round
+    # artifact as config entries (gate verdicts fold below).
+    results += run([py, "benchmarks/scaling_efficiency.py", "--shard",
+                    "--shard-out", "SHARD_BENCH_r20.json"], timeout=3000)
 
     artifact = {
         "round": args.round,
@@ -659,6 +701,10 @@ def main() -> None:
         # bridged-liveness Wilson interval, armed-idle overhead (full
         # artifact in SERVE_BENCH_r19.json, refreshed by the config18 run)
         "serve_bench": collect_serve_summary(ROOT),
+        # r20: sharded pview weak-scaling gates — mesh-ladder projected
+        # aggregate + two-process gloo per-chip cell (full artifact in
+        # SHARD_BENCH_r20.json, refreshed by the --shard run above)
+        "shard_bench": collect_shard_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

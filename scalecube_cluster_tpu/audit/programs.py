@@ -602,6 +602,15 @@ def build_engine_programs(
             programs.append(_sharded_program(
                 eng, engine_name, kd, sharded_capacity, n_ticks, contracts
             ))
+            # r20: the sharded twins registered through the descriptor —
+            # the FUSED tick over the member mesh and the fleet window on
+            # the 2-D scenarios×members mesh ride the same contracts
+            # (donation covers the mesh-placed carry, budgets are
+            # PER-SHARD) as the base sharded window
+            programs.extend(_sharded_r20_programs(
+                eng, engine_name, kd, sharded_capacity, n_ticks, contracts,
+                mesh2d=kd == dtypes[0],
+            ))
 
     return programs
 
@@ -768,6 +777,87 @@ def _sharded_program(
         wide_threshold=capacity,
         mesh_size=mesh.size,
     )
+
+
+def _sharded_r20_programs(
+    eng, engine_name, kd, capacity, n_ticks, contracts, mesh2d: bool = True
+) -> List[AuditProgram]:
+    """The r20 sharded twins: ``{engine}/{kd}/sharded-fused`` (the FUSED
+    tick over the member mesh — same ragged delivery exchange, same
+    donated carry) and ``{engine}/{kd}/sharded-mesh2d`` (the r15 fleet
+    axis composed with the member axis on a 2-D scenarios×members mesh).
+    Both lower on abstract mesh-placed inputs; the memory budget basis is
+    PER SHARD (the 2-D program's basis is one scenario-row's shard set ×
+    S scenarios, matching the fleet per-scenario × S convention)."""
+    from ..ops.sharding import make_mesh
+
+    out: List[AuditProgram] = []
+    if eng.make_sharded_fused_run is None and eng.make_sharded_fleet_run is None:
+        return out
+    mesh = make_mesh()
+    params = _audit_params(engine_name, capacity, kd)
+    n_initial = max(2, (capacity * 3) // 4)
+    dense_links = eng.dense_links_default
+    state = eng.init_state(params, n_initial, True, dense_links)
+    shardings = eng.state_shardings(mesh, dense_links, params.delay_slots)
+
+    if eng.make_sharded_fused_run is not None:
+        abs_state = _abstract(state, shardings)
+        out.append(AuditProgram(
+            name=f"{engine_name}/{kd}/sharded-fused",
+            engine=engine_name, variant="sharded", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=eng.make_sharded_fused_run(mesh, params, n_ticks),
+            abstract_args=(abs_state, _key_abstract()),
+            donated_argnums=(0,),
+            contracts=contracts,
+            budget_basis_bytes=_tree_bytes(abs_state, per_device=True),
+            wide_threshold=capacity,
+            mesh_size=mesh.size,
+        ))
+
+    if (mesh2d and eng.make_sharded_fleet_run is not None
+            and len(mesh.devices.ravel()) >= 2):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.fleet import FLEET_AXIS
+        from ..ops.sharding import make_pview_mesh2d
+
+        devices = list(mesh.devices.ravel())
+        s_sc = 2
+        mesh2d = make_pview_mesh2d(s_sc, devices)
+        shard2d = eng.state_shardings(mesh2d, dense_links, params.delay_slots)
+
+        def lift(x, sh):
+            spec = P() if not x.size else P(FLEET_AXIS, *sh.spec)
+            return jax.ShapeDtypeStruct(
+                (s_sc,) + x.shape, x.dtype,
+                sharding=NamedSharding(mesh2d, spec),
+            )
+
+        abs_fleet = jax.tree.map(lift, state, shard2d)
+        k = jax.random.PRNGKey(0)
+        keys_abs = jax.ShapeDtypeStruct(
+            (s_sc,) + k.shape, k.dtype,
+            sharding=NamedSharding(mesh2d, P(FLEET_AXIS, None)),
+        )
+        _assert_audit_shape(
+            f"{engine_name}/{kd}/sharded-mesh2d", capacity,
+            {"fleet_scenarios": s_sc},
+        )
+        out.append(AuditProgram(
+            name=f"{engine_name}/{kd}/sharded-mesh2d",
+            engine=engine_name, variant="sharded", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=eng.make_sharded_fleet_run(mesh2d, params, n_ticks),
+            abstract_args=(abs_fleet, keys_abs),
+            donated_argnums=(0,),
+            contracts=_fleet_contracts(contracts),
+            budget_basis_bytes=_tree_bytes(abs_fleet, per_device=True),
+            wide_threshold=capacity,
+            mesh_size=mesh2d.size,
+        ))
+    return out
 
 
 def build_matrix(

@@ -161,3 +161,29 @@ def make_global_state(
         )
 
     return jax.tree_util.tree_map(_globalize, host_state, shardings)
+
+
+def make_global_pview_state(params, n_initial: int, mesh, **init_kwargs):
+    """The pview twin of :func:`make_global_state` (r20): build the
+    initial ``PviewState`` as GLOBAL arrays over a (possibly multi-host)
+    mesh — every process computes the same deterministic host init and
+    contributes only the row shards its own devices hold. The per-host
+    init cost is O(N·k), not O(N²), so host RAM stops being the scale
+    ceiling long before the dense engine's upgrade path matters."""
+    import numpy as np
+
+    from .pview import init_pview_state
+    from .sharding import pview_state_shardings
+
+    host_state = init_pview_state(params, n_initial, **init_kwargs)
+    shardings = pview_state_shardings(
+        mesh, False, host_state.pending_minf.shape[0]
+    )
+
+    def _globalize(leaf, sharding):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree_util.tree_map(_globalize, host_state, shardings)

@@ -165,6 +165,18 @@ class EngineOps:
     #: window, (state, adaptive_state) donated, both mesh-placed). None
     #: keeps the r14 "adaptive is single-device" refusal for the engine.
     make_sharded_adaptive_run: Optional[Callable] = None
+    #: r20 sharded twins ((mesh, params, n_ticks) -> jitted window):
+    #: ``make_sharded_fused_run`` runs the FUSED tick over the mesh;
+    #: ``make_sharded_traced_run`` ((mesh, params, n_ticks, trace)) lifts
+    #: the r14 "trace capture is single-device" refusal — the ring rides
+    #: the donated carry replicated while the member planes shard;
+    #: ``make_sharded_fleet_run`` composes the r15 scenario axis with the
+    #: member axis on a 2-D mesh (vmap spmd_axis_name over the sharded
+    #: core — zero scenario-axis collectives). None keeps the engine's
+    #: loud single-device refusal for that capability.
+    make_sharded_fused_run: Optional[Callable] = None
+    make_sharded_traced_run: Optional[Callable] = None
+    make_sharded_fleet_run: Optional[Callable] = None
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -363,6 +375,21 @@ def _pview_engine() -> EngineOps:
 
         return make_sharded_pview_adaptive_run(mesh, params, n_ticks)
 
+    def _sharded_fused(mesh, params, n_ticks):
+        from .sharding import make_sharded_pview_fused_run
+
+        return make_sharded_pview_fused_run(mesh, params, n_ticks)
+
+    def _sharded_traced(mesh, params, n_ticks, trace):
+        from .sharding import make_sharded_pview_traced_run
+
+        return make_sharded_pview_traced_run(mesh, params, n_ticks, trace)
+
+    def _sharded_fleet(mesh, params, n_ticks):
+        from .sharding import make_sharded_pview_fleet_run
+
+        return make_sharded_pview_fleet_run(mesh, params, n_ticks)
+
     def _shard_state(state, mesh):
         from .sharding import shard_pview_state
 
@@ -422,6 +449,9 @@ def _pview_engine() -> EngineOps:
         make_fused_adaptive_run=PV.make_pview_fused_adaptive_run,
         make_fused_fleet_run=PV.make_pview_fused_fleet_run,
         make_sharded_adaptive_run=_sharded_adaptive,
+        make_sharded_fused_run=_sharded_fused,
+        make_sharded_traced_run=_sharded_traced,
+        make_sharded_fleet_run=_sharded_fleet,
     )
 
 

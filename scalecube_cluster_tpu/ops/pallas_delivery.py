@@ -17,9 +17,23 @@ same kernel logic through XLA primitives — that is the tier-1
 certification story: interpret-mode output must be bit-equal to the XLA
 spelling (tests/test_fused.py), so the TPU lowering of the *same kernel
 body* computes the same function. Block shapes are TPU-lane friendly
-(row blocks x 32-bit words / rumor lanes); the payload is presented as
-one whole-array block, so at 1M members the TPU lowering wants the
-column split documented in docs/TPU_LAYOUT_NOTES.md.
+(row blocks x 32-bit words / rumor lanes).
+
+r20 adds the membership-word COLUMN SPLIT promised in
+docs/TPU_LAYOUT_NOTES.md: when the whole ``[N, Wt]`` payload block
+would not fit the per-step VMEM budget (it is ~280 MiB at 1M members),
+:func:`delivery_plan` picks a second grid axis over membership-word
+tiles. The payload splits into ``payload_m [N, BCm]`` column tiles
+(membership words — the only part that scales with capacity) plus a
+whole ``payload_tail [N, Wu + R]`` block (packed user-rumor words +
+infected-from lanes, always a handful of words). The OR fold over
+membership words is associative per word with identity 0, so each
+``(row block, col tile)`` grid step folds its tile independently;
+``u_or``/``src_max``/``cnt`` depend only on the tail and are written
+once per row block at col tile 0 (``pl.when``). Nothing about the fold
+changes — only the BlockSpec maps — so bit-exactness versus the XLA
+spelling is preserved (forced-split equality in tests/test_fused.py,
+plus a 1M abstract-lowering proof that the plan actually tiles).
 
 No [N, N] anywhere — everything is [N, Wt], [F, N], or [N, R]
 (``forbid_wide_values`` holds over the kernel-armed program too).
@@ -28,12 +42,47 @@ No [N, N] anywhere — everything is [N, Wt], [F, N], or [N, R]
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from .bitplane import unpack_bits
+
+#: Per-grid-step budget for the payload operand block. 128 MiB leaves
+#: comfortable headroom under a v5e core's VMEM+spill envelope for the
+#: small inv/out blocks riding alongside; interpret mode ignores it for
+#: correctness but uses the same plan so CPU certifies the TPU tiling.
+DEFAULT_VMEM_BUDGET = 128 * 2 ** 20
+
+
+class DeliveryPlan(NamedTuple):
+    """Grid tiling decision for :func:`delivery_combine`.
+
+    ``block_cols is None`` means the whole payload fits one block (the
+    r17 single-axis grid); otherwise the grid gains a second axis of
+    ``n_col_tiles`` membership-word tiles of ``block_cols`` words each
+    (last tile zero-padded — OR identity)."""
+
+    block_rows: int
+    block_cols: Optional[int]
+    n_col_tiles: int
+
+
+def delivery_plan(n: int, Wt: int, Wm: int, *, block_rows: int = 256,
+                  block_cols: Optional[int] = None,
+                  vmem_budget_bytes: int = DEFAULT_VMEM_BUDGET) -> DeliveryPlan:
+    """Pick the kernel grid: row blocks always, column tiles only when
+    the whole ``[n, Wt]`` u32 payload block busts ``vmem_budget_bytes``
+    (or when ``block_cols`` forces a split, for tests)."""
+    BR = min(block_rows, n)
+    if block_cols is None:
+        if n * Wt * 4 <= vmem_budget_bytes or Wm == 0:
+            return DeliveryPlan(BR, None, 1)
+        block_cols = max(1, vmem_budget_bytes // (4 * n))
+    block_cols = max(1, min(block_cols, Wm))
+    return DeliveryPlan(BR, block_cols, -(-Wm // block_cols))
 
 
 def delivery_combine_xla(payload, inv, rumor_origin, Wm: int, R: int):
@@ -115,44 +164,142 @@ def _delivery_kernel(F: int, Wm: int, Wu: int, R: int, BR: int,
     jax.lax.fori_loop(0, BR, row, 0)
 
 
+def _delivery_kernel_cols(F: int, BCm: int, Wu: int, R: int, BR: int,
+                          origin_ref, inv_ref, pm_ref, tail_ref,
+                          u_ref, src_ref, m_ref, cnt_ref):
+    """Column-split body: grid is (row blocks, membership-word tiles).
+
+    Every step folds its [N, BCm] membership tile for the block's BR
+    receivers; the tail fold (user-rumor bits, infected-from lanes —
+    whole [N, Wu + R] block) runs once per row block at col tile 0, so
+    u/src/cnt blocks are written exactly once and then revisited
+    untouched (their index map is col-invariant)."""
+    blk = pl.program_id(0)
+    col = pl.program_id(1)
+
+    def mrow(i, _):
+        mw = jnp.zeros((BCm,), jnp.uint32)
+        for f in range(F):
+            jv = inv_ref[f, i]
+            has = jv >= 0
+            jc = jnp.maximum(jv, 0)
+            pm_row = pm_ref[pl.ds(jc, 1), :][0]
+            mw = mw | jnp.where(has, pm_row, jnp.uint32(0))
+        m_ref[i, :] = mw
+        return 0
+
+    jax.lax.fori_loop(0, BR, mrow, 0)
+
+    @pl.when(col == 0)
+    def _tail_fold():
+        origin = origin_ref[0, :]
+
+        def row(i, _):
+            rid = blk * BR + i
+            u = jnp.zeros((R,), jnp.bool_)
+            src = jnp.full((R,), -1, jnp.int32)
+            cnt = jnp.int32(0)
+            for f in range(F):
+                jv = inv_ref[f, i]
+                has = jv >= 0
+                jc = jnp.maximum(jv, 0)
+                t_row = tail_ref[pl.ds(jc, 1), :][0]
+                yu = unpack_bits(t_row[None, :Wu], R)[0]
+                frm = t_row[Wu:].astype(jnp.int32)
+                deliver = yu & has & (frm != rid) & (origin != rid)
+                u = u | deliver
+                src = jnp.maximum(src, jnp.where(deliver, jc, -1))
+                cnt = cnt + deliver.sum(dtype=jnp.int32)
+            u_ref[i, :] = u
+            src_ref[i, :] = src
+            cnt_ref[i, 0] = cnt
+            return 0
+
+        jax.lax.fori_loop(0, BR, row, 0)
+
+
 def delivery_combine(payload, inv, rumor_origin, Wm: int, R: int, *,
-                     block_rows: int = 256, interpret: bool | None = None):
+                     block_rows: int = 256,
+                     block_cols: int | None = None,
+                     vmem_budget_bytes: int = DEFAULT_VMEM_BUDGET,
+                     interpret: bool | None = None):
     """Pallas spelling of :func:`delivery_combine_xla` — bit-equal
     outputs (certified in tier-1 via ``interpret=True``; the equality IS
     the CPU certification of the TPU kernel body).
 
     Receivers are padded to a multiple of ``block_rows`` with no-sender
-    lanes (``inv = -1`` → every output identity) and sliced back."""
+    lanes (``inv = -1`` → every output identity) and sliced back. When
+    :func:`delivery_plan` decides the whole payload block busts the VMEM
+    budget (auto at 1M members, or forced via ``block_cols``), the
+    membership words are tiled over a second grid axis — same fold, same
+    bits, smaller blocks."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     F, n = inv.shape
     Wt = payload.shape[1]
     Wu = Wt - Wm - R
-    BR = min(block_rows, n)
+    plan = delivery_plan(n, Wt, Wm, block_rows=block_rows,
+                         block_cols=block_cols,
+                         vmem_budget_bytes=vmem_budget_bytes)
+    BR = plan.block_rows
     n_pad = -(-n // BR) * BR
     if n_pad != n:
         inv = jnp.pad(inv, ((0, 0), (0, n_pad - n)), constant_values=-1)
-    kernel = functools.partial(_delivery_kernel, F, Wm, Wu, R, BR)
+
+    if plan.block_cols is None:
+        kernel = functools.partial(_delivery_kernel, F, Wm, Wu, R, BR)
+        u, src, mw, cnt = pl.pallas_call(
+            kernel,
+            grid=(n_pad // BR,),
+            in_specs=[
+                pl.BlockSpec((1, R), lambda b: (0, 0)),
+                pl.BlockSpec((F, BR), lambda b: (0, b)),
+                pl.BlockSpec(payload.shape, lambda b: (0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((BR, R), lambda b: (b, 0)),
+                pl.BlockSpec((BR, R), lambda b: (b, 0)),
+                pl.BlockSpec((BR, Wm), lambda b: (b, 0)),
+                pl.BlockSpec((BR, 1), lambda b: (b, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_pad, R), jnp.bool_),
+                jax.ShapeDtypeStruct((n_pad, R), jnp.int32),
+                jax.ShapeDtypeStruct((n_pad, Wm), jnp.uint32),
+                jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            ],
+            interpret=interpret,
+        )(rumor_origin[None, :], inv, payload)
+        return u[:n], src[:n], mw[:n], cnt[:n, 0].sum()
+
+    BCm = plan.block_cols
+    wm_pad = plan.n_col_tiles * BCm
+    pm = payload[:, :Wm]
+    if wm_pad != Wm:
+        pm = jnp.pad(pm, ((0, 0), (0, wm_pad - Wm)))
+    tail = payload[:, Wm:]
+    kernel = functools.partial(_delivery_kernel_cols, F, BCm, Wu, R, BR)
     u, src, mw, cnt = pl.pallas_call(
         kernel,
-        grid=(n_pad // BR,),
+        grid=(n_pad // BR, plan.n_col_tiles),
         in_specs=[
-            pl.BlockSpec((1, R), lambda b: (0, 0)),
-            pl.BlockSpec((F, BR), lambda b: (0, b)),
-            pl.BlockSpec(payload.shape, lambda b: (0, 0)),
+            pl.BlockSpec((1, R), lambda b, c: (0, 0)),
+            pl.BlockSpec((F, BR), lambda b, c: (0, b)),
+            pl.BlockSpec((pm.shape[0], BCm), lambda b, c: (0, c)),
+            pl.BlockSpec(tail.shape, lambda b, c: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((BR, R), lambda b: (b, 0)),
-            pl.BlockSpec((BR, R), lambda b: (b, 0)),
-            pl.BlockSpec((BR, Wm), lambda b: (b, 0)),
-            pl.BlockSpec((BR, 1), lambda b: (b, 0)),
+            pl.BlockSpec((BR, R), lambda b, c: (b, 0)),
+            pl.BlockSpec((BR, R), lambda b, c: (b, 0)),
+            pl.BlockSpec((BR, BCm), lambda b, c: (b, c)),
+            pl.BlockSpec((BR, 1), lambda b, c: (b, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n_pad, R), jnp.bool_),
             jax.ShapeDtypeStruct((n_pad, R), jnp.int32),
-            jax.ShapeDtypeStruct((n_pad, Wm), jnp.uint32),
+            jax.ShapeDtypeStruct((n_pad, wm_pad), jnp.uint32),
             jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(rumor_origin[None, :], inv, payload)
-    return u[:n], src[:n], mw[:n], cnt[:n, 0].sum()
+    )(rumor_origin[None, :], inv, pm, tail)
+    return u[:n], src[:n], mw[:n, :Wm], cnt[:n, 0].sum()

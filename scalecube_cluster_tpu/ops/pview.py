@@ -89,6 +89,8 @@ Loss(i, j) = max(uniform, part_loss[part_id[i], part_id[j]]).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import functools
 
@@ -130,6 +132,40 @@ NO_CANDIDATE = NO_CANDIDATE_I32
 #: chaos StateTimeline capability flag: Partition events run on the group
 #: model (part_id/part_loss) without an [N, N] link plane
 GROUP_PARTITIONS = True
+
+
+@dataclasses.dataclass(frozen=True)
+class _RaggedDelivery:
+    """Trace-time arming record for the sharded delivery rewrite (r20)."""
+
+    mesh: object
+    axis: str
+    budget: int | None
+
+
+_RAGGED_DELIVERY: contextvars.ContextVar = contextvars.ContextVar(
+    "pview_ragged_delivery", default=None
+)
+
+
+@contextlib.contextmanager
+def ragged_delivery_context(mesh, axis: str, budget: int | None = None):
+    """Arm the ragged all-to-all delivery rewrite for traces entered under
+    this context (r20). While armed, both gossip phases replace the global
+    inverse-sender election + row gather with the shard-local election +
+    record exchange of :mod:`.ragged_a2a`, and surface the bucket-overflow
+    sentinel as the ``delivery_overflow`` metric. Mirrors the sparse
+    engine's ``mesh_context`` pattern: the context must be ACTIVE DURING
+    TRACING, so sharded builders enter it inside the jitted closure."""
+    token = _RAGGED_DELIVERY.set(_RaggedDelivery(mesh, axis, budget))
+    try:
+        yield
+    finally:
+        _RAGGED_DELIVERY.reset(token)
+
+
+def _ragged_ctx() -> _RaggedDelivery | None:
+    return _RAGGED_DELIVERY.get()
 
 
 def _ceil_log2_static(n: int) -> int:
@@ -1365,33 +1401,48 @@ def _gossip_phase(state: PviewState, r, params: PviewParams,
             ok_now_all = ok_all & (d_all == 0)
         else:
             ok_now_all = ok_all
-        inv = (
-            jnp.full((F, n), -1, jnp.int32)
-            .at[jnp.arange(F)[:, None], p_all]
-            .max(jnp.where(ok_now_all, rows[None, :], -1))
-        )
-        j_all = jnp.maximum(inv, 0)
-        has_all = (inv >= 0)[:, :, None]
-        pl_all = payload[j_all]
-        yu_all = _unpack_bits(pl_all[:, :, Wm : Wm + Wu], R)
-        from_all = pl_all[:, :, Wm + Wu :].astype(jnp.int32)
-        deliver_u_all = (
-            yu_all
-            & has_all
-            & (from_all != rows[None, :, None])
-            & (state.rumor_origin[None, None, :] != rows[None, :, None])
-        )
-        recv_u = recv_u | deliver_u_all.any(axis=0)
-        recv_src = jnp.maximum(
-            recv_src,
-            jnp.where(deliver_u_all, j_all[:, :, None], -1).max(axis=0),
-        )
-        recv_m_p = functools.reduce(
-            jnp.bitwise_or,
-            [jnp.where(has_all[s], pl_all[s, :, :Wm], jnp.uint32(0)) for s in range(F)],
-            recv_m_p,
-        )
-        rumor_sent = deliver_u_all.sum()
+        _ragged = _ragged_ctx()
+        if _ragged is not None:
+            # r20 sharded delivery: shard-local election + ragged record
+            # exchange instead of the global scatter-max + row gather.
+            # Bit-identical to the global spelling under the default
+            # (lossless) budget; the overflow sentinel is a metric below.
+            from .ragged_a2a import ragged_delivery_combine
+            u_or, src_max, m_or, rumor_sent, a2a_ovf = ragged_delivery_combine(
+                payload, p_all, ok_now_all, state.rumor_origin, Wm, R,
+                mesh=_ragged.mesh, axis=_ragged.axis, budget=_ragged.budget,
+            )
+            recv_u = recv_u | u_or
+            recv_src = jnp.maximum(recv_src, src_max)
+            recv_m_p = recv_m_p | m_or
+        else:
+            inv = (
+                jnp.full((F, n), -1, jnp.int32)
+                .at[jnp.arange(F)[:, None], p_all]
+                .max(jnp.where(ok_now_all, rows[None, :], -1))
+            )
+            j_all = jnp.maximum(inv, 0)
+            has_all = (inv >= 0)[:, :, None]
+            pl_all = payload[j_all]
+            yu_all = _unpack_bits(pl_all[:, :, Wm : Wm + Wu], R)
+            from_all = pl_all[:, :, Wm + Wu :].astype(jnp.int32)
+            deliver_u_all = (
+                yu_all
+                & has_all
+                & (from_all != rows[None, :, None])
+                & (state.rumor_origin[None, None, :] != rows[None, :, None])
+            )
+            recv_u = recv_u | deliver_u_all.any(axis=0)
+            recv_src = jnp.maximum(
+                recv_src,
+                jnp.where(deliver_u_all, j_all[:, :, None], -1).max(axis=0),
+            )
+            recv_m_p = functools.reduce(
+                jnp.bitwise_or,
+                [jnp.where(has_all[s], pl_all[s, :, :Wm], jnp.uint32(0)) for s in range(F)],
+                recv_m_p,
+            )
+            rumor_sent = deliver_u_all.sum()
         if spec.wants_pull:
             # push-pull reply (DZ-2): each sender whose undelayed contact
             # landed pulls the peer's payload back over the same round
@@ -1560,6 +1611,8 @@ def _gossip_phase(state: PviewState, r, params: PviewParams,
             "mr_deliveries": n_mr_deliveries,
             "mr_accepts": n_mr_accepts,
         }
+        if _ragged is not None:
+            mets["delivery_overflow"] = a2a_ovf
         if adaptive:
             mets["_ad_cnt"] = g_ad_cnt
             mets["_ad_key"] = g_ad_key
@@ -1573,6 +1626,8 @@ def _gossip_phase(state: PviewState, r, params: PviewParams,
             "mr_deliveries": jnp.int32(0),
             "mr_accepts": jnp.int32(0),
         }
+        if _ragged_ctx() is not None:
+            mets["delivery_overflow"] = jnp.int32(0)
         if adaptive:
             mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
             mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
@@ -1791,11 +1846,35 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
     st = st.replace(force_sync=st.force_sync & ~ok_full)
 
     # re-gossip proposals: top-P accepted per participant, REQ receivers
-    # (peers) first then ACK receivers (callers) — [N·P] each direction
+    # (peers) first then ACK receivers (callers) — [N·P] each direction.
+    # The replication constraint on `origs` dodges an XLA:CPU SPMD
+    # partitioner miscompile on 2-D scenarios×members meshes: this vector
+    # is scenario-invariant (vmap leaves it unbatched), and the partitioner
+    # rematerializes the members-sharded unbatched concat to replicated
+    # via per-partition dynamic-update-slice + all-reduce over ALL devices
+    # — each chunk is contributed once per scenario replica, so every
+    # origin came back scaled by the scenario-axis size (mr_origin = 2x
+    # the proposer row on a scenarios=2 mesh). Pinning it replicated makes
+    # every device compute the tiny [N·P] iota locally instead; no-op off
+    # mesh.
+    _ragged = _ragged_ctx()
+    if _ragged is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rows_rep = jax.lax.with_sharding_constraint(
+            rows, NamedSharding(_ragged.mesh, PartitionSpec())
+        )
+    else:
+        rows_rep = rows
+
     def _props(subj2, key2, part_mask):
         subs = jnp.concatenate([subj2[:, p] for p in range(P)])
         keys_ = jnp.concatenate([key2[:, p] for p in range(P)])
-        origs = jnp.concatenate([rows] * P)
+        origs = jnp.concatenate([rows_rep] * P)
+        if _ragged is not None:
+            origs = jax.lax.with_sharding_constraint(
+                origs, NamedSharding(_ragged.mesh, PartitionSpec())
+            )
         vals = jnp.concatenate(
             [part_mask & (key2[:, p] > NO_CANDIDATE) for p in range(P)]
         )
@@ -1803,9 +1882,16 @@ def _sync_phase(state: PviewState, r, params: PviewParams, trace: bool = False,
 
     props_p = _props(req_subj, req_key, req_src >= 0)
     props_c = _props(ack_subj, ack_key, ack_src >= 0)
-    proposals = tuple(
+    proposals = list(
         jnp.concatenate([a, b]) for a, b in zip(props_p, props_c)
     )
+    if _ragged is not None:
+        # same partitioner hazard as `origs` above, one concat later: the
+        # merged [2·N·P] origin vector is still scenario-invariant
+        proposals[2] = jax.lax.with_sharding_constraint(
+            proposals[2], NamedSharding(_ragged.mesh, PartitionSpec())
+        )
+    proposals = tuple(proposals)
     metrics = {"sync_roundtrips": ok.sum()}
     if adaptive:
         metrics["_ad_cnt"] = req_adc + ack_adc
@@ -2136,21 +2222,31 @@ def _gossip_phase_fused(state: PviewState, r, params: PviewParams,
             ok_now_all = ok_all & (d_all == 0)
         else:
             ok_now_all = ok_all
-        inv = (
-            jnp.full((F, n), -1, jnp.int32)
-            .at[jnp.arange(F)[:, None], p_all]
-            .max(jnp.where(ok_now_all, rows[None, :], -1))
-        )
-        from .pallas_delivery import delivery_combine, delivery_combine_xla
-
-        if params.delivery_kernel == "pallas":
-            u_or, src_max, m_or, cnt = delivery_combine(
-                payload, inv, state.rumor_origin, Wm, R
+        _ragged = _ragged_ctx()
+        if _ragged is not None:
+            # r20 sharded delivery (the pallas × mesh combination is
+            # refused at builder time, so only the xla seam lands here)
+            from .ragged_a2a import ragged_delivery_combine
+            u_or, src_max, m_or, cnt, a2a_ovf = ragged_delivery_combine(
+                payload, p_all, ok_now_all, state.rumor_origin, Wm, R,
+                mesh=_ragged.mesh, axis=_ragged.axis, budget=_ragged.budget,
             )
         else:
-            u_or, src_max, m_or, cnt = delivery_combine_xla(
-                payload, inv, state.rumor_origin, Wm, R
+            inv = (
+                jnp.full((F, n), -1, jnp.int32)
+                .at[jnp.arange(F)[:, None], p_all]
+                .max(jnp.where(ok_now_all, rows[None, :], -1))
             )
+            from .pallas_delivery import delivery_combine, delivery_combine_xla
+
+            if params.delivery_kernel == "pallas":
+                u_or, src_max, m_or, cnt = delivery_combine(
+                    payload, inv, state.rumor_origin, Wm, R
+                )
+            else:
+                u_or, src_max, m_or, cnt = delivery_combine_xla(
+                    payload, inv, state.rumor_origin, Wm, R
+                )
         recv_u = recv_u | u_or
         recv_src = jnp.maximum(recv_src, src_max)
         recv_m_p = recv_m_p | m_or
@@ -2252,6 +2348,8 @@ def _gossip_phase_fused(state: PviewState, r, params: PviewParams,
             "mr_deliveries": n_mr_deliveries,
             "mr_accepts": n_mr_accepts,
         }
+        if _ragged is not None:
+            mets["delivery_overflow"] = a2a_ovf
         if adaptive:
             mets["_ad_cnt"] = g_ad_cnt
             mets["_ad_key"] = g_ad_key
@@ -2265,6 +2363,8 @@ def _gossip_phase_fused(state: PviewState, r, params: PviewParams,
             "mr_deliveries": jnp.int32(0),
             "mr_accepts": jnp.int32(0),
         }
+        if _ragged_ctx() is not None:
+            mets["delivery_overflow"] = jnp.int32(0)
         if adaptive:
             mets["_ad_cnt"] = jnp.zeros((n,), jnp.int32)
             mets["_ad_key"] = jnp.full((n,), NO_CANDIDATE, jnp.int32)
@@ -2437,11 +2537,21 @@ def _rumor_sweeps_fused(state: PviewState, params: PviewParams,
     state = state.replace(rumor_active=state.rumor_active & keep_u)
 
     def _sweep_m(state: PviewState):
-        fwd_up = jnp.where(state.up[:, None], fwd_post_p, jnp.uint32(0))
-        fwd_words = jax.lax.reduce(
-            fwd_up, jnp.uint32(0), jax.lax.bitwise_or, (0,)
-        )
-        forwarding_m = _unpack_bits(fwd_words[None, :], m)[0]
+        if _ragged_ctx() is not None:
+            # sharded spelling: the SPMD partitioner cannot lower a custom
+            # u32 bitwise-or reduction across the member axis (XLA:CPU
+            # rejects the cross-shard reduce computation), but unpacking
+            # commutes with OR — the pred any() reduce is bit-identical
+            # and partitions as a standard reduce-or
+            forwarding_m = (
+                _unpack_bits(fwd_post_p, m) & state.up[:, None]
+            ).any(axis=0)
+        else:
+            fwd_up = jnp.where(state.up[:, None], fwd_post_p, jnp.uint32(0))
+            fwd_words = jax.lax.reduce(
+                fwd_up, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+            )
+            forwarding_m = _unpack_bits(fwd_words[None, :], m)[0]
         keep_m = (state.tick - state.mr_created <= sweep) | forwarding_m
         pending_m = (
             state.pending_minf.any(axis=(0, 1))

@@ -1,0 +1,213 @@
+"""Ragged all-to-all delivery exchange for the sharded pview engine (r20).
+
+The pview delivery step is an inverse-sender election: for fanout slot f
+and receiver p, among every sender s with ``ok_now[f, s]`` whose slot-f
+target is p, the MAX sender index wins and its payload row is delivered.
+The single-device spelling (``ops/pview.py`` / ``delivery_combine_xla``)
+computes the [F, N] inverse index with a global scatter-max and then
+gathers whole payload rows — under GSPMD that gather all-gathers the
+row-sharded [N, Wt] payload table onto every shard, which is exactly the
+traffic pattern row sharding exists to avoid.
+
+This module is the shard-local rewrite. Each shard owns L = N/S member
+rows (senders AND receivers — the row shard is the same on both sides):
+
+1. **Record build** — every local sender row j contributes one candidate
+   record per fanout slot: ``(receiver, f, sender, payload_row)``. At
+   most F·L records per shard, by construction.
+2. **Bucketing** — records are grouped by destination shard
+   (``receiver // L``) into a static ``[S, B, 3 + Wt]`` u32 send buffer:
+   budget B slots per destination, deterministic first-B-in-record-order
+   placement (record order is fanout-slot-major, local-row-minor — a
+   pure function of the trace, so drops are reproducible). Records past
+   the budget are COUNTED, not silently lost: the overflow counter is
+   psummed and surfaced as the ``delivery_overflow`` metric — the
+   static-shape sentinel the audit plane can see.
+3. **Exchange** — one ``jax.lax.all_to_all`` (tiled) over the member
+   axis: shard d receives every other shard's bucket-d rows. This is the
+   ONLY member-axis collective the delivery leg needs.
+4. **Shard-local election** — scatter-max of ``sender + 1`` into the
+   local [F, L] inverse table, then a second scatter-max of the unique
+   winner's payload words (a (f, sender) pair targets one receiver, so
+   the winner's record is unique and max == copy). The receiver-side
+   fold (OR / max / count) is then ``delivery_combine_xla``'s exact
+   math on local rows.
+
+**Bit-identity**: with the default budget B = F·L one bucket can hold
+every record a shard can produce, so nothing is ever dropped and the
+elected (sender, payload) per (f, receiver) equals the global election's
+— the sharded trajectory is bit-identical to single-device (proved in
+tests/test_sharding.py). Smaller budgets drop deterministically and fire
+the sentinel (tests/test_ragged_a2a.py holds falsifiability both ways).
+
+No value here carries two capacity-scaled dims: the buffers are
+``[S, B, 3 + Wt]`` / ``[S·B, 3 + Wt]`` with S·B ≤ F·N and Wt capacity-
+independent, so ``forbid_wide_values`` holds over the armed program
+(the r12 ``sharded`` audit variant proves it per-shard).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .bitplane import unpack_bits
+
+#: u32 header words per exchanged record, before the Wt payload words:
+#: local receiver row, fanout slot, sender + 1 (0 = empty bucket slot)
+HEADER_WORDS = 3
+
+
+def default_budget(fanout: int, capacity: int, mesh_size: int) -> int:
+    """The provably-lossless per-(src, dst) bucket budget: one shard
+    emits at most ``fanout * (capacity // mesh_size)`` records TOTAL, so
+    a bucket of that size can never overflow regardless of how skewed
+    the receiver draw is."""
+    return fanout * (capacity // mesh_size)
+
+
+def ragged_delivery_combine(
+    payload: jax.Array,
+    p_all: jax.Array,
+    ok_now_all: jax.Array,
+    rumor_origin: jax.Array,
+    Wm: int,
+    R: int,
+    *,
+    mesh,
+    axis: str,
+    budget: int | None = None,
+):
+    """Shard-local election + ragged all-to-all record exchange.
+
+    Args:
+      payload: [N, Wt] u32 row-sharded sender payload (``Wm`` membership
+        words, Wu packed user-rumor words, R infected-from lanes).
+      p_all: [F, N] i32 per-slot receiver targets (global row ids),
+        sharded on dim 1.
+      ok_now_all: [F, N] bool undelayed-send mask, sharded on dim 1.
+      rumor_origin: [R] i32, replicated.
+      Wm, R: static word/lane splits of the payload.
+      mesh: the device mesh; ``axis`` names its member axis.
+      budget: per-(src, dst) record budget B (None = the lossless
+        ``default_budget`` — bit-identical to the global election).
+
+    Returns ``(u_or [N, R] bool, src_max [N, R] i32, m_or [N, Wm] u32,
+    cnt i32, overflow i32)`` — the first three row-sharded, the counters
+    replicated (psummed). ``overflow`` counts records dropped by budget
+    saturation this tick (0 under the default budget, by construction).
+    """
+    from jax.experimental.shard_map import shard_map
+
+    F, n = p_all.shape
+    S = mesh.shape[axis]
+    if n % S:
+        raise ValueError(
+            f"capacity {n} not divisible by member-mesh size {S}"
+        )
+    L = n // S
+    Wt = payload.shape[1]
+    Wu = Wt - Wm - R
+    B = budget if budget is not None else default_budget(F, n, S)
+    if not (0 < B <= F * L):
+        raise ValueError(
+            f"a2a budget must be in (0, F*L] = (0, {F * L}]: got {B} "
+            "(budgets beyond F*L waste exchange bytes on provably-empty "
+            "slots)"
+        )
+    WREC = HEADER_WORDS + Wt
+
+    def local(payload_l, p_l, ok_l, origin):
+        # payload_l [L, Wt] u32; p_l / ok_l [F, L]; origin [R]
+        me = jax.lax.axis_index(axis)
+        base = (me * L).astype(jnp.int32)
+        # -- 1. records, fanout-slot-major / local-row-minor ----------------
+        recv_g = p_l.reshape(-1)  # [F*L] global receiver ids
+        valid = ok_l.reshape(-1)
+        sender1 = jnp.tile(
+            (base + jnp.arange(L, dtype=jnp.int32) + 1).astype(jnp.uint32), F
+        )
+        fidx = jnp.repeat(jnp.arange(F, dtype=jnp.uint32), L)
+        pl_rec = jnp.broadcast_to(
+            payload_l[None], (F, L, Wt)
+        ).reshape(F * L, Wt)
+        lr = (recv_g % L).astype(jnp.uint32)
+        dest = recv_g // L
+        rec = jnp.concatenate(
+            [
+                lr[:, None],
+                fidx[:, None],
+                jnp.where(valid, sender1, jnp.uint32(0))[:, None],
+                pl_rec,
+            ],
+            axis=1,
+        )
+        # -- 2. bucket by destination shard, static budget B ----------------
+        buf = jnp.zeros((S, B, WREC), jnp.uint32)
+        overflow = jnp.int32(0)
+        for d in range(S):
+            mask = valid & (dest == d)
+            pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+            keep = mask & (pos < B)
+            buf = buf.at[d, jnp.where(keep, pos, B)].max(
+                jnp.where(keep[:, None], rec, jnp.uint32(0)), mode="drop"
+            )
+            overflow = overflow + jnp.maximum(
+                mask.sum(dtype=jnp.int32) - B, 0
+            )
+        # -- 3. the one member-axis collective ------------------------------
+        got = jax.lax.all_to_all(
+            buf, axis, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(S * B, WREC)
+        # -- 4. shard-local election + delivery fold ------------------------
+        r_lr = jnp.minimum(got[:, 0], jnp.uint32(L - 1)).astype(jnp.int32)
+        r_f = jnp.minimum(got[:, 1], jnp.uint32(F - 1)).astype(jnp.int32)
+        r_s1 = got[:, 2]  # sender + 1; 0 = empty slot
+        r_pl = got[:, HEADER_WORDS:]
+        vr = r_s1 > 0
+        inv1 = (
+            jnp.zeros((F, L), jnp.uint32)
+            .at[r_f, r_lr]
+            .max(jnp.where(vr, r_s1, jnp.uint32(0)))
+        )
+        win = vr & (r_s1 == inv1[r_f, r_lr])
+        pl_e = (
+            jnp.zeros((F, L, Wt), jnp.uint32)
+            .at[r_f, r_lr]
+            .max(jnp.where(win[:, None], r_pl, jnp.uint32(0)))
+        )
+        has = (inv1 > 0)[:, :, None]
+        j_all = jnp.maximum(inv1.astype(jnp.int32) - 1, 0)
+        grow = base + jnp.arange(L, dtype=jnp.int32)
+        yu = unpack_bits(pl_e[:, :, Wm : Wm + Wu], R)
+        frm = pl_e[:, :, Wm + Wu :].astype(jnp.int32)
+        deliver = (
+            yu
+            & has
+            & (frm != grow[None, :, None])
+            & (origin[None, None, :] != grow[None, :, None])
+        )
+        u_or = deliver.any(axis=0)
+        src_max = jnp.where(deliver, j_all[:, :, None], -1).max(axis=0)
+        m_or = functools.reduce(
+            jnp.bitwise_or,
+            [
+                jnp.where(has[s], pl_e[s, :, :Wm], jnp.uint32(0))
+                for s in range(F)
+            ],
+            jnp.zeros((L, Wm), jnp.uint32),
+        )
+        cnt = jax.lax.psum(deliver.sum(), axis)
+        overflow = jax.lax.psum(overflow, axis)
+        return u_or, src_max, m_or, cnt, overflow
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis), P(None, axis), P()),
+        out_specs=(P(axis, None), P(axis, None), P(axis, None), P(), P()),
+        check_rep=False,
+    )(payload, p_all, ok_now_all, rumor_origin)

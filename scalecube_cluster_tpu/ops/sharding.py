@@ -291,6 +291,15 @@ def shard_pview_state(state, mesh: Mesh):
     )
 
 
+def member_mesh_size(mesh: Mesh) -> int:
+    """The member-axis extent of ``mesh`` — ``mesh.size`` for a 1-D member
+    mesh, the ``"members"`` component of a 2-D scenarios×members mesh.
+    Meshes built from ``jax.devices()`` span EVERY process (the dcn
+    ``global_mesh``), so this is the GLOBAL shard count the alignment
+    rules bind against — never a per-host device count."""
+    return dict(mesh.shape).get(MEMBER_AXIS, mesh.size)
+
+
 def _check_pview_word_alignment(mesh: Mesh, params) -> None:
     """Pview-tick mesh preconditions: plain row divisibility always, and
     the 32-row word rule in every mode — the pview tick packs member-axis
@@ -299,69 +308,203 @@ def _check_pview_word_alignment(mesh: Mesh, params) -> None:
     membership-delivery planes), so row shards must stay word-aligned or
     GSPMD pads the word axis and the packed sweeps regress into
     per-phase all-gathers (the sparse builders' rule, applied to both
-    key layouts)."""
-    if params.capacity % mesh.size != 0:
+    key layouts). The divisor is the GLOBAL member-axis size: on a dcn
+    multi-process mesh every host sees all processes' devices, so the
+    rule binds the whole job's shard count, not one host's."""
+    size = member_mesh_size(mesh)
+    if params.capacity % size != 0:
         raise ValueError(
-            f"capacity {params.capacity} not divisible by mesh size {mesh.size}"
+            f"capacity {params.capacity} not divisible by mesh size {size}"
         )
-    if params.capacity % (32 * mesh.size) != 0:
+    if params.capacity % (32 * size) != 0:
         raise ValueError(
             f"capacity {params.capacity} must be divisible by 32 * mesh size "
-            f"({32 * mesh.size}): the pview packed bit planes must align "
+            f"({32 * size}): the pview packed bit planes must align "
             "with the row shards (pad capacity up and leave the extra rows "
             "up=False — masks make padding free)"
         )
 
 
-def make_sharded_pview_run(mesh: Mesh, params, n_ticks: int):
-    """jit the batched ``run_pview_ticks`` window over ``mesh`` (r17).
+def _refuse_pallas_on_mesh(params) -> None:
+    if getattr(params, "delivery_kernel", "xla") != "xla":
+        raise ValueError(
+            "delivery_kernel='pallas' is single-device — the mesh "
+            "delivery path is the ragged all-to-all exchange "
+            "(docs/SHARDING.md), which replaces the payload gather the "
+            "kernel spells; use delivery_kernel='xla' on meshes"
+        )
+
+
+def make_sharded_pview_run(mesh: Mesh, params, n_ticks: int,
+                           a2a_budget: int | None = None):
+    """jit the batched ``run_pview_ticks`` window over ``mesh``, with the
+    delivery step rewritten as the shard-local election + ragged
+    all-to-all record exchange (r20, :mod:`.ragged_a2a`).
 
     Input state must already be placed via :func:`shard_pview_state`;
-    GSPMD propagates the row sharding through the scan. The carried state
-    is donated like every window builder. The Pallas delivery kernel is
+    GSPMD propagates the row sharding through the scan, and the
+    :func:`~.pview.ragged_delivery_context` armed INSIDE the jitted
+    closure (the sparse ``mesh_context`` precedent — the context must be
+    active during tracing) swaps the global inverse-sender election for
+    the member-axis exchange. ``a2a_budget`` overrides the per-(src, dst)
+    record budget (None = the lossless default — bit-identical to the
+    single-device trajectory); smaller budgets drop deterministically and
+    surface the ``delivery_overflow`` metric. The carried state is
+    donated like every window builder. The Pallas delivery kernel is
     single-device-only for now — refuse it up front rather than letting
     a whole-payload BlockSpec silently all-gather the table."""
     _check_pview_word_alignment(mesh, params)
-    if getattr(params, "delivery_kernel", "xla") != "xla":
-        raise ValueError(
-            "delivery_kernel='pallas' is single-device for now — the "
-            "kernel's whole-payload block would all-gather the table "
-            "under GSPMD; use delivery_kernel='xla' on meshes"
-        )
-    from .pview import run_pview_ticks
+    _refuse_pallas_on_mesh(params)
+    from .pview import ragged_delivery_context, run_pview_ticks
 
-    return jax.jit(
-        partial(run_pview_ticks, n_ticks=n_ticks, params=params),
-        donate_argnums=0,
-    )
+    def fn(state, key, watch_rows=None):
+        with ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget):
+            return run_pview_ticks(
+                state, key, n_ticks, params, watch_rows=watch_rows
+            )
+
+    return jax.jit(fn, donate_argnums=0)
 
 
-def make_sharded_pview_adaptive_run(mesh: Mesh, params, n_ticks: int):
+def make_sharded_pview_adaptive_run(mesh: Mesh, params, n_ticks: int,
+                                    a2a_budget: int | None = None):
     """Sharded adaptive pview window (r17 — the lift of the r14
     "adaptive is single-device for now" refusal, for this engine): the
     AdaptiveState's three [N] planes ride the donated carry row-sharded
     like every other member-axis tensor (place them with
     :func:`shard_adaptive_state`); argnums (0, 1) donated. Refuses a
     default spec (the legacy sharded window is the byte-identical
-    program for that case)."""
+    program for that case). Delivery runs the r20 ragged exchange like
+    :func:`make_sharded_pview_run`."""
     _check_pview_word_alignment(mesh, params)
-    if getattr(params, "delivery_kernel", "xla") != "xla":
-        raise ValueError(
-            "delivery_kernel='pallas' is single-device for now — use "
-            "delivery_kernel='xla' on meshes"
-        )
+    _refuse_pallas_on_mesh(params)
     if params.adaptive.is_default:
         raise ValueError(
             "make_sharded_pview_adaptive_run needs an enabled AdaptiveSpec "
             "on params — the default spec's program is "
             "make_sharded_pview_run's"
         )
-    from .pview import run_pview_ticks_adaptive
+    from .pview import ragged_delivery_context, run_pview_ticks_adaptive
 
-    return jax.jit(
-        partial(run_pview_ticks_adaptive, n_ticks=n_ticks, params=params),
-        donate_argnums=(0, 1),
-    )
+    def fn(state, ad, key, watch_rows=None):
+        with ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget):
+            return run_pview_ticks_adaptive(
+                state, ad, key, n_ticks, params, watch_rows=watch_rows
+            )
+
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def make_sharded_pview_fused_run(mesh: Mesh, params, n_ticks: int,
+                                 a2a_budget: int | None = None):
+    """Sharded FUSED pview window (r20): the fused tick's delivery seam
+    runs the same ragged exchange as the unfused sharded window (the
+    pallas × mesh combination stays refused), so the sharded fused
+    trajectory is bit-identical to single-device fused — which is itself
+    bit-identical to unfused."""
+    _check_pview_word_alignment(mesh, params)
+    _refuse_pallas_on_mesh(params)
+    from .pview import ragged_delivery_context, run_pview_ticks_fused
+
+    def fn(state, key, watch_rows=None):
+        with ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget):
+            return run_pview_ticks_fused(
+                state, key, n_ticks, params, watch_rows=watch_rows
+            )
+
+    return jax.jit(fn, donate_argnums=0)
+
+
+def make_sharded_pview_traced_run(mesh: Mesh, params, n_ticks: int, trace,
+                                  a2a_budget: int | None = None):
+    """Sharded TRACE-ARMED pview window (r20 — the lift of the r14
+    "trace capture is single-device for now" refusal, for this engine):
+    the trace ring rides the donated carry REPLICATED (place it with
+    :func:`place_replicated`; the ring append is a row-global gather of
+    W tracer rows, which stays a cheap replicated update), while the
+    member planes shard as usual and delivery runs the ragged exchange.
+    Argnums (0, 2) donated — state and ring, the single-device traced
+    window's exact discipline."""
+    _check_pview_word_alignment(mesh, params)
+    _refuse_pallas_on_mesh(params)
+    from .pview import ragged_delivery_context, run_pview_ticks_traced
+
+    def fn(state, key, trace_buf, trace_cursor, watch_rows=None):
+        with ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget):
+            return run_pview_ticks_traced(
+                state, key, trace_buf, trace_cursor, n_ticks, params, trace,
+                watch_rows=watch_rows,
+            )
+
+    return jax.jit(fn, donate_argnums=(0, 2))
+
+
+def make_pview_mesh2d(n_scenarios: int, devices=None) -> Mesh:
+    """A 2-D scenarios×members mesh (r20): the r15 fleet axis composed
+    with the member axis. Scenarios are independent — the scenario axis
+    carries ZERO collectives — and the ragged delivery all-to-all runs
+    on the member axis only, so S_sc × S_m devices advance S_sc clusters
+    of row-sharded members each in one XLA program."""
+    from .fleet import FLEET_AXIS
+
+    devices = list(devices) if devices is not None else jax.devices()
+    if n_scenarios <= 0 or len(devices) % n_scenarios:
+        raise ValueError(
+            f"{len(devices)} devices do not factor into "
+            f"{n_scenarios} scenario rows"
+        )
+    arr = np.asarray(devices).reshape(n_scenarios, len(devices) // n_scenarios)
+    return Mesh(arr, (FLEET_AXIS, MEMBER_AXIS))
+
+
+def shard_pview_fleet(fleet_state, mesh: Mesh):
+    """Commit a stacked [S, ...] pview fleet onto a 2-D scenarios×members
+    mesh: scenario axis on every leaf's dim 0, member axis where the
+    serial placement (:func:`pview_state_shardings`) row-shards — dim 1
+    for planes, dim 2 for the [D, N, ...] pending rings. Zero-size
+    leaves replicate (the :func:`~.fleet.shard_fleet` rule)."""
+    delay_slots = fleet_state.pending_minf.shape[1]
+    base = pview_state_shardings(mesh, False, delay_slots)
+    from .fleet import FLEET_AXIS
+
+    def lift(x, sh):
+        if not x.size:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        return jax.device_put(
+            x, NamedSharding(mesh, P(FLEET_AXIS, *sh.spec))
+        )
+
+    return jax.tree.map(lift, fleet_state, base)
+
+
+def make_sharded_pview_fleet_run(mesh: Mesh, params, n_ticks: int,
+                                 a2a_budget: int | None = None):
+    """Fleet window on the 2-D scenarios×members mesh (r20):
+    ``jit(vmap(core, spmd_axis_name=FLEET_AXIS))`` over the ragged-armed
+    window core. The vmap batch axis is bound to the scenario mesh axis,
+    so the per-scenario shard_map partitions only the member axis — the
+    scenario axis stays collective-free and each scenario's trajectory
+    is bit-identical to its serial sharded run (the r15 fleet contract
+    composed with the r20 sharding contract). Fleet state donated; place
+    it with :func:`shard_pview_fleet`."""
+    from .fleet import FLEET_AXIS
+
+    shape = dict(mesh.shape)
+    if FLEET_AXIS not in shape or MEMBER_AXIS not in shape:
+        raise ValueError(
+            "make_sharded_pview_fleet_run needs a 2-D scenarios×members "
+            f"mesh (make_pview_mesh2d); got axes {tuple(shape)}"
+        )
+    _check_pview_word_alignment(mesh, params)
+    _refuse_pallas_on_mesh(params)
+    from .pview import ragged_delivery_context, run_pview_ticks
+
+    def fn(fleet_state, keys):
+        with ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget):
+            run = partial(run_pview_ticks, n_ticks=n_ticks, params=params)
+            return jax.vmap(run, spmd_axis_name=FLEET_AXIS)(fleet_state, keys)
+
+    return jax.jit(fn, donate_argnums=0)
 
 
 def shard_adaptive_state(ad, mesh: Mesh):

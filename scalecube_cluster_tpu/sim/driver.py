@@ -206,9 +206,9 @@ class SimDriver:
                 "window builders) — construct without mesh="
             )
         # refuse pallas x mesh at construction, not at the first (lazy)
-        # window build — the kernel presents the whole payload as one
-        # block and is single-device until the column split lands
-        # (docs/TPU_LAYOUT_NOTES.md)
+        # window build — the kernel is single-device (the mesh delivery
+        # path is the ragged all-to-all, docs/SHARDING.md; the kernel's
+        # r20 column split covers VMEM, not sharding)
         if mesh is not None and getattr(params, "delivery_kernel", "xla") == "pallas":
             raise ValueError(
                 "delivery_kernel='pallas' is single-device for now — "
@@ -395,9 +395,19 @@ class SimDriver:
         cache_key = (n_ticks, n_watch, traced, adaptive)
         if cache_key not in self._step_cache:
             if traced:
-                self._step_cache[cache_key] = self._eng.make_traced_run(
-                    self.params, n_ticks, self._trace.spec
-                )
+                if self.mesh is not None:
+                    # r20: engines registering a sharded traced builder
+                    # (pview) capture on the mesh — the ring rides the
+                    # donated carry replicated (arm_trace placed it)
+                    self._step_cache[cache_key] = (
+                        self._eng.make_sharded_traced_run(
+                            self.mesh, self.params, n_ticks, self._trace.spec
+                        )
+                    )
+                else:
+                    self._step_cache[cache_key] = self._eng.make_traced_run(
+                        self.params, n_ticks, self._trace.spec
+                    )
             elif adaptive:
                 if self.mesh is not None:
                     self._step_cache[cache_key] = (
@@ -1204,10 +1214,13 @@ class SimDriver:
                     "trace capture and the control plane cannot share a "
                     "driver (the controller may arm adaptive FD)"
                 )
-            if self.mesh is not None:
+            if self.mesh is not None and self._eng.make_sharded_traced_run is None:
+                # capability-named refusal: only engines registering a
+                # sharded traced builder (pview, r20) capture on a mesh
                 raise ValueError(
-                    "trace capture is single-device for now — arm on an "
-                    "unsharded driver (the ring append is row-global)"
+                    f"trace capture is single-device for the {self.engine} "
+                    "engine — arm on an unsharded driver (the ring append "
+                    "is row-global)"
                 )
             if isinstance(config, ClusterConfig):
                 config = config.trace
@@ -1215,6 +1228,15 @@ class SimDriver:
                 self, config=config, tracer_rows=tracer_rows,
                 rumor_slots=rumor_slots,
             )
+            if self.mesh is not None:
+                # r20 trace-on-mesh: the ring must live REPLICATED on the
+                # mesh — a default-device ring would force GSPMD to move
+                # it every window append
+                from ..ops.sharding import place_replicated
+
+                ring = self._trace.ring
+                ring.buf = place_replicated(ring.buf, self.mesh)
+                ring._mesh = self.mesh
             self._publish(
                 "driver", "trace_armed",
                 tracers=list(self._trace.spec.tracer_rows),
@@ -1376,9 +1398,15 @@ class SimDriver:
             if self._control is not None:
                 return self._control
             if self.mesh is not None:
+                # capability-named refusal (r20): the ragged-delivery lift
+                # covers windows, not the control loop — the controller's
+                # escalation rungs arm adaptive FD and swap knobs mid-run,
+                # a host cadence the sharded window cache has no tests for
                 raise ValueError(
-                    "the control plane steers set_adaptive, which is "
-                    "single-device for now — arm on an unsharded driver"
+                    "the closed-loop control plane is single-device for "
+                    "now — its rung escalations re-arm adaptive FD and "
+                    "swap static knobs on the live window cache; arm on "
+                    "an unsharded driver"
                 )
             if self._trace is not None:
                 raise ValueError(

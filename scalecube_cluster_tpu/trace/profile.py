@@ -427,7 +427,12 @@ def profile_driver(driver, n_ticks: int = 32, warmup_ticks: int = 1) -> Dict:
     import jax.numpy as jnp
 
     if driver.mesh is not None:
-        raise ValueError("phase profiling is single-device (mesh unsupported)")
+        raise ValueError(
+            "phase profiling is single-device for now — it re-jits each "
+            "tick phase as its own program without the sharded builders, "
+            "so the copies would silently gather the row-sharded state; "
+            "profile an unsharded driver with the same params"
+        )
     with driver._lock:
         state = jax.tree_util.tree_map(
             lambda x: jnp.array(x, copy=True), driver.state

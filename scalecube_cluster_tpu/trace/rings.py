@@ -27,6 +27,9 @@ class TraceRing:
 
         self.spec = spec
         self.buf = jnp.zeros((spec.ring_len, spec.n_fields), jnp.int32)
+        # set by the driver when tracing on a mesh (r20): clear() must
+        # reallocate the buffer REPLICATED there, not on the default device
+        self._mesh = None
         # records in the CURRENT timeline (cursor = records % ring_len);
         # host state — advanced by the driver after each traced window
         self.records = 0
@@ -57,6 +60,10 @@ class TraceRing:
 
         self.buf = jnp.zeros((self.spec.ring_len, self.spec.n_fields),
                              jnp.int32)
+        if self._mesh is not None:
+            from ..ops.sharding import place_replicated
+
+            self.buf = place_replicated(self.buf, self._mesh)
         self.records = 0
 
     def device_cursor(self):

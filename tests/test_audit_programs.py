@@ -149,6 +149,10 @@ def test_full_matrix_including_sharded_passes():
             "pview/i32/fused-pallas", "pview/i32/fused-adaptive",
             "pview/i32/fused-fleet", "pview/i32/sharded",
             "pview/i16/sharded"} <= names
+    # r20: the sharded twins registered through the descriptor — FUSED
+    # over the member mesh, fleet over the 2-D scenarios×members mesh
+    assert {"pview/i32/sharded-fused", "pview/i16/sharded-fused",
+            "pview/i32/sharded-mesh2d"} <= names
 
 
 # ---------------------------------------------------------------------------
@@ -617,3 +621,53 @@ def test_wide_closure_constant_is_caught_by_forbid_wide_values():
     assert violations, "auditor missed the wide closure constant"
     assert any("CONSTANT" in v.message or "closed over" in v.message
                for v in violations)
+
+
+@pytest.mark.slow
+def test_seeded_sharded_dropped_donation_is_caught():
+    """r20 falsifiability for the MESH programs: the sharded pview window
+    with its donation dropped (a plain ``jax.jit`` of the ragged-armed
+    window — exactly the builder bug the r6 contract exists for) is
+    caught by the same ``check_donation_alias`` pass that certifies the
+    shipped ``make_sharded_pview_run``; the shipped builder stays clean.
+    On the mesh the stakes are per-shard: an undonated carry doubles
+    every shard's resident table set."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    import scalecube_cluster_tpu.ops.sharding as SH
+    from scalecube_cluster_tpu.audit.programs import (
+        _abstract, _key_abstract, _tree_bytes,
+    )
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = SH.make_mesh(jax.devices()[:8])
+    params = PV.PviewParams(
+        capacity=256, rumor_slots=16, mr_slots=128, announce_slots=32,
+    )
+    state = PV.init_pview_state(params, 192, warm=True)
+    shardings = SH.pview_state_shardings(mesh, False, params.delay_slots)
+    abs_state = _abstract(state, shardings)
+
+    def window(st, key):
+        with PV.ragged_delivery_context(mesh, SH.MEMBER_AXIS, None):
+            return PV.run_pview_ticks(st, key, 2, params)
+
+    bad = _program(
+        "seeded/sharded-dropped-donation",
+        jax.jit(window),  # <- dropped donate_argnums
+        (abs_state, _key_abstract()), (0,),
+        basis=_tree_bytes(abs_state, per_device=True),
+        mesh_size=mesh.size,
+    )
+    violations = check_donation_alias(bad)
+    assert violations, "auditor missed the sharded window's dropped donation"
+    assert any("donation" in v.message.lower() for v in violations)
+
+    good = _program(
+        "shipped/sharded-donated",
+        SH.make_sharded_pview_run(mesh, params, 2),
+        (abs_state, _key_abstract()), (0,),
+        basis=_tree_bytes(abs_state, per_device=True),
+        mesh_size=mesh.size,
+    )
+    assert check_donation_alias(good) == []

@@ -15,6 +15,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 _WORKER = r"""
 import sys
 import jax
@@ -79,3 +81,91 @@ def test_two_process_global_mesh_runs_sharded_tick():
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert "DCN-OK" in out, f"rank {rank} output:\n{out}"
+
+
+_PVIEW_WORKER = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import scalecube_cluster_tpu.ops.pview as PV
+from scalecube_cluster_tpu.ops import dcn
+from scalecube_cluster_tpu.ops.sharding import make_sharded_pview_run
+
+port, rank = sys.argv[1], int(sys.argv[2])
+dcn.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+)
+assert jax.process_count() == 2 and jax.device_count() == 2
+
+mesh = dcn.global_mesh()
+params = PV.PviewParams(
+    capacity=64, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+    fd_every=3, sync_every=8, rumor_slots=2, seed_rows=(0, 1),
+)
+state = dcn.make_global_pview_state(params, 48, mesh, uniform_loss=0.05)
+run = make_sharded_pview_run(mesh, params, 6)
+out, key_out, ms, _w = run(state, jax.random.PRNGKey(0))
+
+# single-process reference: the same window, computed locally by each
+# rank — bit-identity of the cross-process run is checked shard-by-shard
+ref0 = PV.init_pview_state(params, 48, uniform_loss=0.05)
+ref, ref_key, ms_ref, _ = PV.make_pview_run(params, 6, donate=False)(
+    ref0, jax.random.PRNGKey(0)
+)
+
+assert np.array_equal(np.asarray(key_out), np.asarray(ref_key))
+for name in ms_ref:  # metrics fold replicated -> materializable anywhere
+    assert np.array_equal(np.asarray(ms[name]), np.asarray(ms_ref[name])), name
+assert int(np.asarray(ms["delivery_overflow"]).sum()) == 0
+
+flat, _ = jax.tree_util.tree_flatten(out)
+flat_ref, _ = jax.tree_util.tree_flatten(ref)
+for garr, rarr in zip(flat, flat_ref):
+    for shard in garr.addressable_shards:
+        assert np.array_equal(
+            np.asarray(shard.data), np.asarray(rarr)[shard.index]
+        ), (garr.shape, shard.index)
+print(f"DCN-PVIEW-OK rank={jax.process_index()}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sharded_pview_window_bit_identical():
+    """r20 multi-process lane: two OS processes, one gloo-backed global
+    mesh, the ragged-delivery pview window SPMD across them — every
+    process-local row shard bit-equal to the single-process trajectory,
+    metrics (replicated psum folds) equal, overflow 0."""
+    from scalecube_cluster_tpu.ops import dcn
+
+    if not dcn.cpu_collectives_available():
+        pytest.skip("gloo CPU collectives unavailable")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PVIEW_WORKER, str(port), str(rank)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "DCN-PVIEW-OK" in out, f"rank {rank} output:\n{out}"

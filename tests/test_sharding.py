@@ -193,3 +193,154 @@ def test_entry_compiles():
     fn, (state, key) = g.entry()
     out, metrics = jax.jit(fn)(state, key)
     assert int(out.tick) == 1
+
+
+# -- r20: sharded pview engine — ragged delivery, 2-D fleet, trace ----------
+
+
+def _pview_params(**kw):
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    base = dict(
+        capacity=256, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+        fd_every=3, sync_every=16, rumor_slots=4, seed_rows=(0, 1),
+    )
+    base.update(kw)
+    return PV.PviewParams(**base)
+
+
+def _pview_state(params):
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    st = PV.init_pview_state(params, n_initial=200, uniform_loss=0.05)
+    st = PV.spread_rumor(st, 0, 5)
+    return PV.crash_rows(st, [6, 17])
+
+
+@pytest.mark.slow
+def test_pview_sharded_fused_window_matches_single_device(mesh):
+    """r20: the fused-phase window rides the ragged exchange too — the
+    armed sweep swaps its custom u32 or-reduce for the unpack-then-any
+    spelling (bit-identical; the partitioner cannot lower the custom
+    reduction across a sharded axis) and the trajectory + metrics match
+    single-device, with the overflow sentinel at 0 under the default
+    lossless budget."""
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    params = _pview_params()
+    key = jax.random.PRNGKey(3)
+    single = PV.make_pview_fused_run(params, 6, donate=False)
+    sharded = SH.make_sharded_pview_fused_run(mesh, params, 6)
+    a, _, ms_a, _ = single(_pview_state(params), key)
+    b, _, ms_b, _ = sharded(SH.shard_pview_state(_pview_state(params), mesh), key)
+    for name, arr in PV.snapshot(a).items():
+        assert np.array_equal(arr, np.asarray(PV.snapshot(b)[name])), name
+    for mk in ms_a:
+        assert np.array_equal(np.asarray(ms_a[mk]), np.asarray(ms_b[mk])), mk
+    assert int(np.asarray(ms_b["delivery_overflow"]).sum()) == 0
+
+
+@pytest.mark.slow
+def test_pview_fleet_mesh2d_matches_per_scenario(mesh):
+    """r20 tentpole: the r15 scenario axis composes with the member axis —
+    a 2-D scenarios×members mesh runs S independent sharded trajectories
+    (vmap with the scenario axis as spmd_axis_name; the ragged exchange
+    stays members-only) bit-identical to running each scenario alone on a
+    single device."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.ops import fleet as FL
+
+    params = _pview_params()
+    mesh2d = SH.make_pview_mesh2d(2, jax.devices()[:8])
+    fleet0 = FL.fleet_stack(
+        [_pview_state(params), PV.spread_rumor(_pview_state(params), 1, 44)]
+    )
+    run = SH.make_sharded_pview_fleet_run(mesh2d, params, 5)
+    out, _, ms_f, _ = run(SH.shard_pview_fleet(fleet0, mesh2d), FL.fleet_keys([7, 9]))
+
+    single = PV.make_pview_run(params, 5, donate=False)
+    for s, (st0, seed) in enumerate(
+        [(_pview_state(params), 7),
+         (PV.spread_rumor(_pview_state(params), 1, 44), 9)]
+    ):
+        ref, _, ms_r, _ = single(st0, jax.random.PRNGKey(seed))
+        row = FL.fleet_row(out, s)
+        for name, arr in PV.snapshot(ref).items():
+            assert np.array_equal(arr, np.asarray(PV.snapshot(row)[name])), (s, name)
+        for mk in ms_r:
+            assert np.array_equal(
+                np.asarray(ms_r[mk]), np.asarray(ms_f[mk])[s]
+            ), (s, mk)
+    assert int(np.asarray(ms_f["delivery_overflow"]).sum()) == 0
+
+
+def test_pview_mesh2d_factoring_refused():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    with pytest.raises(ValueError, match="factor"):
+        SH.make_pview_mesh2d(3, jax.devices()[:8])
+    with pytest.raises(ValueError, match="2-D"):
+        SH.make_sharded_pview_fleet_run(
+            SH.make_mesh(jax.devices()[:8]), _pview_params(), 2
+        )
+
+
+@pytest.mark.slow
+def test_pview_trace_on_mesh_matches_single_device(mesh):
+    """r20 lifts the r14 trace×mesh refusal for pview: the ring buffer is
+    placed replicated on the mesh and the traced sharded window captures
+    the same spans as the single-device one, with identical end states."""
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    params = _pview_params()
+    d_single = SimDriver(params=params, n_initial=200, seed=11)
+    d_mesh = SimDriver(params=params, n_initial=200, seed=11, mesh=mesh)
+    t1 = d_single.arm_trace()
+    t2 = d_mesh.arm_trace()
+    d_single.step(4)
+    d_single.step(3)
+    d_mesh.step(4)
+    d_mesh.step(3)
+    assert np.array_equal(t1.ring.last(), t2.ring.last())
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    s1, s2 = PV.snapshot(d_single.state), PV.snapshot(d_mesh.state)
+    for name in s1:
+        assert np.array_equal(np.asarray(s1[name]), np.asarray(s2[name])), name
+
+
+def test_pview_control_and_profile_refused_on_mesh_loudly(mesh):
+    """The two planes that stay single-device refuse with capability-named
+    errors (satellite: no silent degradation, no stale 'mesh unsupported'
+    blanket messages)."""
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+    from scalecube_cluster_tpu.trace.profile import profile_driver
+
+    d = SimDriver(params=_pview_params(), n_initial=200, seed=0, mesh=mesh)
+    with pytest.raises(ValueError, match="control plane is single-device"):
+        d.arm_control({"slo": {"detect_p99_ticks": 64}})
+    with pytest.raises(ValueError, match="phase profiling is single-device"):
+        profile_driver(d, n_ticks=2)
+
+
+@pytest.mark.slow
+def test_run_scenario_on_sharded_pview_driver(mesh):
+    """r20 satellite: chaos scenarios run unmodified on the mesh-sharded
+    pview driver — fault injection (group partitions, crash, restart) is
+    plain GSPMD ops on the sharded planes and the sentinel report comes
+    back green for a split→heal script."""
+    from scalecube_cluster_tpu.chaos import Partition, Scenario
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    mesh2 = SH.make_mesh(jax.devices()[:2])
+    params = _pview_params(capacity=64, mr_slots=64, sync_every=6, fd_every=2)
+    d = SimDriver(params=params, n_initial=48, seed=0, mesh=mesh2)
+    scn = Scenario(
+        name="split-heal-sharded",
+        events=[Partition(groups=[range(0, 24), range(24, 48)], at=8, heal_at=48)],
+        horizon=160,
+        check_interval=8,
+    )
+    rep = d.run_scenario(scn)
+    assert rep["ok"], rep
+    assert rep["violations"] == 0
