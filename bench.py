@@ -414,6 +414,20 @@ def main() -> None:
             default_out="SERVE_BENCH_r19.json",
         )
 
+    # r21: --obs runs the mesh-observability certification
+    # (benchmarks/config19_obs.py — armed-idle overhead of the sharded
+    # telemetry+control stack, the mesh phase profiler's per-phase
+    # breakdown at N>=65536 sharded, bit-identity neutrality gates, and
+    # the federated /metrics fold) through the same path.
+    if "--obs" in sys.argv:
+        _delegate(
+            "config19_obs.py",
+            ("--n", "--reps", "--profile-ticks", "--overhead-budget",
+             "--out"),
+            passthrough=("--quick",),
+            default_out="OBS_BENCH_r21.json",
+        )
+
     # r20: --shard runs the sharded pview weak-scaling lane
     # (benchmarks/scaling_efficiency.py --shard — the mesh-size ladder on
     # the 8-virtual-device mesh + the 2-process gloo hosts-double cell)

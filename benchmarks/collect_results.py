@@ -513,6 +513,42 @@ def collect_trajectory(root: pathlib.Path) -> list:
     return out
 
 
+def collect_obs_summary(root: pathlib.Path) -> dict:
+    """One-line fold of the standing r21 mesh-observability artifact: the
+    neutrality bit-identity gates, the armed-idle overhead ratio of the
+    sharded telemetry+control stack, the mesh phase profiler's coverage,
+    and the federated-scrape verdict."""
+    path = root / "OBS_BENCH_r21.json"
+    if not path.exists():
+        return {"present": False}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        rec = data.get("result", data)
+        ne = rec.get("neutrality") or {}
+        ov = rec.get("armed_idle_overhead") or {}
+        ph = rec.get("phase_profile") or {}
+        fe = rec.get("federation") or {}
+        return {
+            "present": True,
+            "ok": rec.get("ok"),
+            "backend": rec.get("backend"),
+            "quick": rec.get("quick"),
+            "armed_idle_bit_identical": ne.get("armed_idle_bit_identical"),
+            "fold_bit_identical": ne.get(
+                "fold_bit_identical_to_single_device"
+            ),
+            "overhead_n": ov.get("n"),
+            "armed_idle_ratio": ov.get("ratio"),
+            "overhead_ok": ov.get("ok"),
+            "phase_coverage": ph.get("phase_coverage"),
+            "phases_pct": ph.get("phases_pct"),
+            "federation_ok": fe.get("ok"),
+        }
+    except Exception as exc:  # noqa: BLE001 — aggregation must not die
+        return {"present": True, "error": repr(exc)}
+
+
 def collect_audit_summary(root: pathlib.Path) -> dict:
     """One-line fold of the standing AUDIT artifact (r12): overall verdict
     plus per-program ok flags — enough for a round-over-round diff without
@@ -665,6 +701,12 @@ def main() -> None:
     # artifact as config entries (gate verdicts fold below).
     results += run([py, "benchmarks/scaling_efficiency.py", "--shard",
                     "--shard-out", "SHARD_BENCH_r20.json"], timeout=3000)
+    # r21 mesh observability: neutrality gates (armed-idle + fold
+    # bit-identity), armed-idle overhead, mesh phase profile, federated
+    # scrape (4096-member smoke on --quick; the N>=65536 certified record
+    # belongs to the dedicated run: bench.py --obs)
+    results += run([py, "benchmarks/config19_obs.py", "--quick",
+                    "--out", "OBS_BENCH_r21.json"], timeout=3000)
 
     artifact = {
         "round": args.round,
@@ -705,6 +747,10 @@ def main() -> None:
         # aggregate + two-process gloo per-chip cell (full artifact in
         # SHARD_BENCH_r20.json, refreshed by the --shard run above)
         "shard_bench": collect_shard_summary(ROOT),
+        # r21: mesh-observability gates — armed-idle + fold bit-identity,
+        # armed-idle overhead ratio, phase coverage, federated scrape
+        # (full artifact in OBS_BENCH_r21.json, refreshed above)
+        "obs_bench": collect_obs_summary(ROOT),
     }
     out = ROOT / f"BENCH_RESULTS_r{args.round:02d}.json"
     with open(out, "w") as f:

@@ -1,0 +1,304 @@
+"""Driver config #19: mesh-wide observability (ISSUE 20).
+
+Four sections, one JSON artifact (``OBS_BENCH_r21.json``):
+
+1. **Mesh neutrality gates**: the sharded armed (telemetry + static-rung
+   controller) driver's final state is bit-identical to its unarmed twin,
+   and the folded global ring series is bit-identical to the single-device
+   driver's series on every engine column except the per-shard
+   ``shard_peak_mem_mb`` footprint (small N — the proof is shape-free).
+2. **Armed-idle observability overhead**: interleaved median-of-``--reps``
+   window wall time of a SHARDED pview driver with the full observability
+   stack armed (telemetry ring + metric families + static-ladder
+   controller) vs an identical unarmed sharded driver at ``--n`` members
+   — the standing cost of arming, gated within noise
+   (``--overhead-budget`` ratio).
+3. **Sharded per-phase breakdown**: the r21 mesh phase profiler at
+   ``--n`` sharded — per-phase wall shares plus the r10 20% phase-coverage
+   tolerance, proof that the split programs account for the window.
+4. **Federated scrape**: two in-process mesh drivers folded through
+   ``/metrics/federated`` — both shard labels present on every series,
+   scrape wall time recorded.
+
+    python benchmarks/config19_obs.py [--n 65536] [--reps 5] [--quick]
+        [--out OBS_BENCH_r21.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import pathlib as _p
+import statistics
+import sys as _s
+import time
+
+_s.path.insert(0, str(_p.Path(__file__).parent))          # for common.py
+_s.path.insert(0, str(_p.Path(__file__).parent.parent))   # for the package
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+import numpy as np
+
+from common import emit, log
+
+REPO = _p.Path(__file__).parent.parent
+
+#: capacity must stay word-aligned per shard: N % (32 × mesh) == 0
+MESH_WORD = 256  # 32 words × 8 devices
+
+
+def _pview_params(n: int, full_metrics: bool = False):
+    import scalecube_cluster_tpu.ops.pview as PV
+
+    return PV.PviewParams(
+        capacity=n, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+        fd_every=3, sync_every=16, rumor_slots=2, seed_rows=(0, 1),
+        full_metrics=full_metrics,
+    )
+
+
+def _static_spec():
+    from scalecube_cluster_tpu.control import ControlSpec
+
+    spec = ControlSpec()
+    return dataclasses.replace(
+        spec,
+        ladder=tuple(dataclasses.replace(r, adaptive=False)
+                     for r in spec.ladder),
+    )
+
+
+def _mesh():
+    from scalecube_cluster_tpu.ops.sharding import make_mesh
+
+    return make_mesh(jax.devices()[:8])
+
+
+def neutrality_section(args, artifact):
+    """Section 1: armed-vs-unarmed and sharded-vs-single-device
+    bit-identity of the observability planes (small N)."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.config import TelemetryConfig
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    n = 4096
+    params = _pview_params(n, full_metrics=True)
+    mesh = _mesh()
+
+    armed = SimDriver(params, int(n * 0.9), warm=True, seed=21, mesh=mesh)
+    armed.arm_telemetry(TelemetryConfig(ring_len=16))
+    armed.arm_control(spec=_static_spec())
+    unarmed = SimDriver(params, int(n * 0.9), warm=True, seed=21, mesh=mesh)
+    single = SimDriver(params, int(n * 0.9), warm=True, seed=21)
+    single.arm_telemetry(TelemetryConfig(ring_len=16))
+    for _ in range(3):
+        armed.step(8)
+        unarmed.step(8)
+        single.step(8)
+
+    armed_idle_identical = all(
+        np.array_equal(
+            np.asarray(getattr(armed.state, f.name)),
+            np.asarray(getattr(unarmed.state, f.name)),
+        )
+        for f in dataclasses.fields(PV.PviewState)
+    )
+    snap = armed._telemetry.collect()
+    snap1 = single._telemetry.collect()
+    names = snap["ring"]["names"]
+    rows = np.asarray(snap["ring"]["rows"])
+    rows1 = np.asarray(snap1["ring"]["rows"])
+    cols = [i for i, m in enumerate(names) if m != "shard_peak_mem_mb"]
+    fold_identical = (
+        names == snap1["ring"]["names"]
+        and np.array_equal(rows[:, cols], rows1[:, cols])
+    )
+    ok = armed_idle_identical and fold_identical
+    artifact["neutrality"] = {
+        "n": n, "mesh": mesh.size, "windows": 3,
+        "armed_idle_bit_identical": armed_idle_identical,
+        "fold_bit_identical_to_single_device": fold_identical,
+        "excluded_columns": ["shard_peak_mem_mb"],
+        "ok": ok,
+    }
+    log(f"[obs] neutrality: armed-idle={armed_idle_identical} "
+        f"fold={fold_identical}")
+
+
+def overhead_section(args, artifact):
+    """Section 2: armed-idle observability overhead on the sharded engine
+    at --n members (interleaved median-of-reps)."""
+    from scalecube_cluster_tpu.config import TelemetryConfig
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+
+    n = args.n
+    params = _pview_params(n)
+    mesh = _mesh()
+    log(f"[obs] building sharded armed/plain twins N={n} mesh={mesh.size} …")
+    plain = SimDriver(params, int(n * 0.9), warm=True, seed=3, mesh=mesh)
+    armed = SimDriver(params, int(n * 0.9), warm=True, seed=3, mesh=mesh)
+    armed.arm_telemetry(TelemetryConfig(ring_len=64))
+    armed.arm_control(spec=_static_spec())
+
+    plain.step(8)  # compile
+    armed.step(8)
+    plain.flush()
+    armed.flush()
+
+    tp, ta = [], []
+    for _ in range(args.reps):
+        # interleave rep-by-rep: host drift (GC, page cache) lands on both
+        # lanes instead of biasing whichever ran second
+        t0 = time.perf_counter()
+        plain.step(8)
+        plain.flush()
+        tp.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        armed.step(8)
+        armed.flush()
+        ta.append(time.perf_counter() - t0)
+    t_plain, t_armed = statistics.median(tp), statistics.median(ta)
+    ratio = t_armed / t_plain if t_plain > 0 else float("inf")
+    ok = ratio <= args.overhead_budget
+    artifact["armed_idle_overhead"] = {
+        "n": n, "mesh": mesh.size, "reps": args.reps, "ticks_per_window": 8,
+        "plain_window_ms": round(t_plain * 1e3, 3),
+        "armed_window_ms": round(t_armed * 1e3, 3),
+        "ratio": round(ratio, 4),
+        "budget": args.overhead_budget,
+        "ok": ok,
+    }
+    log(f"[obs] armed-idle: plain={t_plain * 1e3:.2f}ms "
+        f"armed={t_armed * 1e3:.2f}ms ratio={ratio:.3f} ok={ok}")
+
+
+def phase_section(args, artifact):
+    """Section 3: the mesh phase profiler's per-phase breakdown at --n
+    sharded, with the r10 20% phase-coverage tolerance."""
+    import scalecube_cluster_tpu.ops.pview as PV
+    from scalecube_cluster_tpu.ops.sharding import shard_pview_state
+    from scalecube_cluster_tpu.trace.profile import profile_ticks
+
+    n = args.n
+    params = _pview_params(n)
+    mesh = _mesh()
+    st = shard_pview_state(
+        PV.init_pview_state(params, int(n * 0.9), warm=True), mesh
+    )
+    _final, _key, res = profile_ticks(
+        params, st, jax.random.PRNGKey(7), n_ticks=args.profile_ticks,
+        warmup_ticks=1, mesh=mesh,
+    )
+    cov = res["phase_coverage"]
+    ok = cov is not None and abs(cov - 1.0) <= 0.20
+    artifact["phase_profile"] = {
+        "n": n, "mesh": res["mesh"], "ticks": res["ticks"],
+        "wall_s": res["wall_s"],
+        "split_ticks_per_s": res["split_ticks_per_s"],
+        "phases_pct": res["phases_pct"],
+        "phase_coverage": cov,
+        "coverage_tolerance": 0.20,
+        "ok": ok,
+    }
+    log(f"[obs] phase profile: coverage={cov} "
+        f"top={sorted(res['phases_pct'].items(), key=lambda kv: -kv[1])[:3]}")
+
+
+def federation_section(args, artifact):
+    """Section 4: two in-process mesh drivers folded through the federated
+    route — shard labels on every series, scrape wall time."""
+    from scalecube_cluster_tpu.config import TelemetryConfig
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.sim.driver import SimDriver
+    from scalecube_cluster_tpu.telemetry.openmetrics import parse_exposition
+
+    n = 4096
+    params = _pview_params(n)
+    mesh = _mesh()
+    workers = {}
+    for shard, seed in (("w0", 11), ("w1", 12)):
+        d = SimDriver(params, int(n * 0.9), warm=True, seed=seed, mesh=mesh)
+        d.arm_telemetry(TelemetryConfig(ring_len=16))
+        d.step(8)
+        workers[shard] = d
+    server = MonitorServer()
+    server.register_federation({
+        shard: (lambda d=d: d._telemetry.metrics_text())
+        for shard, d in workers.items()
+    })
+    t0 = time.perf_counter()
+    status, body = server._route("/metrics/federated")
+    scrape_s = time.perf_counter() - t0
+    fams = parse_exposition(body.decode())
+    per_series = {
+        f["name"]: {labels.get("shard") for _s2, labels, _v in f["samples"]}
+        for f in fams
+        if f["name"].startswith("scalecube_") and "federation" not in f["name"]
+    }
+    shards_ok = all(s == {"w0", "w1"} for s in per_series.values())
+    ok = status == b"200 OK" and shards_ok
+    artifact["federation"] = {
+        "n": n, "workers": 2,
+        "scrape_ms": round(scrape_s * 1e3, 3),
+        "series": len(per_series),
+        "shard_labels_consistent": shards_ok,
+        "ok": ok,
+    }
+    log(f"[obs] federation: series={len(per_series)} "
+        f"scrape={scrape_s * 1e3:.1f}ms ok={ok}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n", type=int, default=65536,
+                    help="sharded members for the overhead/profile sections "
+                         f"(must be a multiple of {MESH_WORD})")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="interleaved overhead reps (median)")
+    ap.add_argument("--profile-ticks", type=int, default=4)
+    ap.add_argument("--overhead-budget", type=float, default=1.3,
+                    help="armed-idle / plain median window ratio budget")
+    ap.add_argument("--quick", action="store_true",
+                    help="4096-member smoke (never a certified record)")
+    ap.add_argument("--out", default=str(REPO / "OBS_BENCH_r21.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.n = min(args.n, 4096)
+    if args.n % MESH_WORD:
+        ap.error(f"--n must be a multiple of {MESH_WORD} (word-aligned "
+                 "shards on the 8-device mesh)")
+
+    t_start = time.time()
+    artifact = {
+        "config": "config19_obs",
+        "backend": jax.default_backend(),
+        "host_cpus": os.cpu_count(),
+        "quick": bool(args.quick),
+    }
+    neutrality_section(args, artifact)
+    overhead_section(args, artifact)
+    phase_section(args, artifact)
+    federation_section(args, artifact)
+
+    artifact["wall_s"] = round(time.time() - t_start, 1)
+    artifact["ok"] = all(
+        artifact[k]["ok"]
+        for k in ("neutrality", "armed_idle_overhead", "phase_profile",
+                  "federation")
+    )
+    emit(artifact)
+    with open(args.out, "w") as f:
+        json.dump({"result": artifact}, f, indent=1)
+    log(f"[obs] wrote {args.out} ok={artifact['ok']} "
+        f"({artifact['wall_s']}s)")
+    return 0 if artifact["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

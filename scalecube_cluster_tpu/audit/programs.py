@@ -611,6 +611,15 @@ def build_engine_programs(
                 eng, engine_name, kd, sharded_capacity, n_ticks, contracts,
                 mesh2d=kd == dtypes[0],
             ))
+            # r21: the mesh observability twins — the sharded telemetry
+            # row/append (what arming adds on a mesh driver) and, for
+            # pview, one representative sharded phase-split program (the
+            # gossip phase, the one that carries the ragged exchange)
+            if kd == dtypes[0]:
+                programs.extend(_sharded_r21_programs(
+                    eng, engine_name, kd, sharded_capacity, n_ticks,
+                    contracts,
+                ))
 
     return programs
 
@@ -856,6 +865,108 @@ def _sharded_r20_programs(
             budget_basis_bytes=_tree_bytes(abs_fleet, per_device=True),
             wide_threshold=capacity,
             mesh_size=mesh2d.size,
+        ))
+    return out
+
+
+def _sharded_r21_programs(
+    eng, engine_name, kd, capacity, n_ticks, contracts
+) -> List[AuditProgram]:
+    """The r21 mesh-observability twins: ``sharded-telemetry-row`` (the
+    exact ``TelemetryPlane._row_fn`` spelling on a mesh driver — the row
+    reduction over the SHARDED window's metric outputs, pinned replicated
+    on the way out) and ``sharded-telemetry-append`` (the descriptor's
+    ``make_sharded_telemetry_append``, the donated replicated ring write).
+    For pview one sharded phase-split program rides along
+    (``sharded-profile-gossip``): the gossip phase traced under the ragged
+    delivery context, the program the mesh profiler times."""
+    from ..ops.sharding import make_mesh, make_sharded_telemetry_row
+    from ..telemetry.plane import SENTINEL_SERIES
+
+    mesh = make_mesh()
+    params = _audit_params(engine_name, capacity, kd)
+    n_initial = max(2, (capacity * 3) // 4)
+    dense_links = eng.dense_links_default
+    state = eng.init_state(params, n_initial, True, dense_links)
+    shardings = eng.state_shardings(mesh, dense_links, params.delay_slots)
+    abs_state = _abstract(state, shardings)
+    key_abs = _key_abstract()
+
+    # abstract per-window metrics from the SHARDED window's own output
+    # signature — on pview this carries the mesh-only ``delivery_overflow``
+    # column the unsharded window never emits
+    sharded = eng.make_sharded_run(mesh, params, n_ticks, dense_links)
+    out_abs = jax.eval_shape(lambda s, k: sharded(s, k), abs_state, key_abs)
+    ms_abs = out_abs[2]
+
+    vector_fn = eng.telemetry_window_vector
+
+    def _row(ms, st, false_dead, key_regr):
+        return jnp.concatenate([
+            vector_fn(ms, st),
+            jnp.stack([false_dead, key_regr]).astype(jnp.float32),
+        ])
+
+    scalar = jax.ShapeDtypeStruct((), jnp.int32)
+    n_series = len(eng.telemetry_series) + len(SENTINEL_SERIES)
+    ring_len = 64
+    ring_abs = jax.ShapeDtypeStruct((ring_len, n_series), jnp.float32)
+    row_abs = jax.ShapeDtypeStruct((n_series,), jnp.float32)
+
+    out = [
+        AuditProgram(
+            name=f"{engine_name}/{kd}/sharded-telemetry-row",
+            engine=engine_name, variant="sharded", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=make_sharded_telemetry_row(mesh, _row),
+            abstract_args=(ms_abs, abs_state, scalar, scalar),
+            donated_argnums=(),
+            contracts=contracts,
+            budget_basis_bytes=(
+                _tree_bytes(abs_state, per_device=True) + _tree_bytes(ms_abs)
+            ),
+            wide_threshold=capacity,
+            is_window=False,
+            mesh_size=mesh.size,
+        ),
+        AuditProgram(
+            name=f"{engine_name}/{kd}/sharded-telemetry-append",
+            engine=engine_name, variant="sharded", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=eng.make_sharded_telemetry_append(mesh),
+            abstract_args=(ring_abs, row_abs, scalar),
+            donated_argnums=(0,),
+            contracts=contracts,
+            budget_basis_bytes=_tree_bytes(ring_abs),
+            wide_threshold=capacity,
+            is_window=False,
+            mesh_size=mesh.size,
+        ),
+    ]
+
+    if engine_name == "pview":
+        from ..ops.rand import draw_sparse_round
+        from ..trace.profile import _pview_phase_fns
+
+        gossip = _pview_phase_fns(params, mesh=mesh)["gossip"]
+        r_abs = jax.eval_shape(
+            lambda k: draw_sparse_round(
+                k, params.capacity, params.fanout, params.sample_tries
+            ),
+            key_abs,
+        )
+        out.append(AuditProgram(
+            name=f"{engine_name}/{kd}/sharded-profile-gossip",
+            engine=engine_name, variant="sharded", key_dtype=kd,
+            capacity=capacity, n_ticks=n_ticks,
+            fn=gossip,
+            abstract_args=(abs_state, r_abs),
+            donated_argnums=(),
+            contracts=contracts,
+            budget_basis_bytes=_tree_bytes(abs_state, per_device=True),
+            wide_threshold=capacity,
+            is_window=False,
+            mesh_size=mesh.size,
         ))
     return out
 

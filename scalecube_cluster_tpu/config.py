@@ -254,6 +254,11 @@ class ControlConfig:
     #: through the normal dwell machinery. 0.0 (default) keeps the sensor
     #: passive/logged-only, the r16-certified behavior.
     suspect_gate: float = 0.0
+    #: r21: spread-lag gate (ROADMAP item 4) — a view dissemination
+    #: deficit (``convergence_lag``, measured only when
+    #: ``alive_view_fraction`` is live) at or above this votes the target
+    #: ONE rung up through the same dwell machinery. 0.0 keeps it passive.
+    spread_lag_gate: float = 0.0
 
     def replace(self, **kw) -> "ControlConfig":
         return replace(self, **kw)

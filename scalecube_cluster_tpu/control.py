@@ -184,6 +184,11 @@ class ControlSpec:
     #: cannot flap); 0.0 keeps the sensor passive (the r16-certified
     #: single-input policy, bit-for-bit).
     suspect_gate: float = 0.0
+    #: r21: third ladder input (ROADMAP item 4) — ``spread_lag`` (view
+    #: dissemination deficit, see :func:`sensors_from_window`) at or above
+    #: this gate votes the target ONE rung up through the same dwell_up
+    #: machinery as ``suspect_gate``. 0.0 keeps it passive/logged-only.
+    spread_lag_gate: float = 0.0
     #: unclamped-controller proportional gains (fanout / mult per unit
     #: miss rate) — deliberately naive high-gain tuning ("react fast"),
     #: scaled to the post-rescue sensor: a ~0.05 storm signal targets
@@ -211,6 +216,8 @@ class ControlSpec:
             raise ValueError("hysteresis must be in (0, 1]")
         if self.suspect_gate < 0.0:
             raise ValueError("suspect_gate must be >= 0 (0 disables it)")
+        if self.spread_lag_gate < 0.0:
+            raise ValueError("spread_lag_gate must be >= 0 (0 disables it)")
 
     @staticmethod
     def from_config(config) -> "ControlSpec":
@@ -225,6 +232,7 @@ class ControlSpec:
             max_step=cc.max_step,
             hysteresis=cc.hysteresis,
             suspect_gate=getattr(cc, "suspect_gate", 0.0),
+            spread_lag_gate=getattr(cc, "spread_lag_gate", 0.0),
         )
 
 
@@ -281,13 +289,23 @@ def sensors_from_window(ms_sums: dict) -> dict:
     names of the engines' shared metric series). ``miss_rate`` is the
     round-trip probe miss fraction — the ambient-loss proxy;
     ``suspect_rate`` is new suspicions per probe — the false-positive
-    pressure proxy."""
+    pressure proxy; ``spread_lag`` (r21, ROADMAP item 4) is the view
+    dissemination deficit ``convergence_lag``, guarded by
+    ``alive_view_fraction > 0``: engines running ``full_metrics=False``
+    report that fraction as a constant 0 (the lag column is then a
+    constant 1.0, not a measurement), so the sensor stays 0/passive there
+    instead of tripping permanently."""
     probes = float(ms_sums.get("fd_probes", 0.0))
     failed = float(ms_sums.get("fd_failed_probes", 0.0))
     suspects = float(ms_sums.get("fd_new_suspects", 0.0))
+    alive_frac = float(ms_sums.get("alive_view_fraction", 0.0))
+    spread_lag = (
+        float(ms_sums.get("convergence_lag", 0.0)) if alive_frac > 0.0 else 0.0
+    )
     return {
         "miss_rate": failed / max(probes, 1.0),
         "suspect_rate": suspects / max(probes, 1.0),
+        "spread_lag": spread_lag,
         "probes": probes,
     }
 
@@ -361,6 +379,10 @@ def advance(
                 round(sensors.get("suspect_rate", 0.0), 4)
                 if sensors else None
             ),
+            "spread_lag": (
+                round(sensors.get("spread_lag", 0.0), 4)
+                if sensors else None
+            ),
             **extra,
         })
         if len(st.log) > spec.log_keep:
@@ -400,6 +422,16 @@ def advance(
         # never lower a miss-rate target — and the vote still rides the
         # ordinary dwell_up/pend machinery, so a transient suspicion burst
         # cannot flap a certified rung (test_control pins this).
+        target = min(st.rung + 1, len(spec.ladder) - 1)
+    elif (
+        spec.spread_lag_gate > 0.0
+        and sensors.get("spread_lag", 0.0) >= spec.spread_lag_gate
+        and target <= st.rung
+    ):
+        # r21 third ladder input (ROADMAP item 4): dissemination spread
+        # lag votes ONE rung up, same up-only + dwell_up construction as
+        # the suspect gate (elif: the gates are votes for the SAME
+        # one-rung step, never additive).
         target = min(st.rung + 1, len(spec.ladder) - 1)
     if target == st.rung:
         st.pend_target, st.pend_count = None, 0

@@ -142,6 +142,10 @@ class MonitorServer:
         # span trees) and GET /trace/perfetto (Chrome-trace JSON)
         self._trace: Optional[Callable[[], Dict[str, Any]]] = None
         self._trace_perfetto: Optional[Callable[[], Dict[str, Any]]] = None
+        # r21 federation: shard label -> zero-arg fetch returning a worker's
+        # /metrics exposition text; folded at /metrics/federated scrape time
+        self._federation: Optional[Dict[str, Callable[[], str]]] = None
+        self._federation_errors = 0  # lifetime-monotone scrape failures
         self._server: Optional[asyncio.AbstractServer] = None
 
     def register(self, name: str, provider: Callable[[], Dict[str, Any]]) -> None:
@@ -253,6 +257,28 @@ class MonitorServer:
         self._trace = _snapshot
         self._trace_perfetto = _perfetto
 
+    def register_federation(self, sources: Dict[str, Callable[[], str]]) -> None:
+        """Serve ``GET /metrics/federated`` (r21): fold multiple workers'
+        ``/metrics`` expositions into one, each sample re-labelled with its
+        ``shard``. ``sources`` maps a shard label to a zero-arg callable
+        returning the worker's exposition TEXT — callables, not URLs, so
+        in-process planes federate without sockets; use
+        :func:`scrape_metrics` to wrap a worker URL. Values pass through
+        verbatim (per-shard series keep the source counters' lifetime
+        monotonicity); fetch failures are skipped, counted by the monotone
+        ``scalecube_federation_scrape_errors_total``."""
+        self._federation = dict(sources)
+
+    def register_federation_urls(self, urls: Dict[str, str],
+                                 timeout: float = 5.0) -> None:
+        """URL convenience over :meth:`register_federation` — the 2-process
+        gloo lane's shape: shard label -> ``http://host:port`` of a worker
+        monitor (its ``/metrics`` route is scraped on each federated poll)."""
+        self.register_federation({
+            label: (lambda u=url: scrape_metrics(u + "/metrics", timeout))
+            for label, url in urls.items()
+        })
+
     def register_cluster_metrics(self, cluster, bus=None) -> None:
         """Serve OpenMetrics for one scalar-engine Cluster node at
         ``/metrics`` (appended to any sim families already registered)."""
@@ -329,6 +355,7 @@ class MonitorServer:
                 "control": self._control is not None,
                 "whatif": self._whatif is not None,
                 "metrics": bool(self._metric_providers),
+                "federated": self._federation is not None,
                 "events": self._events is not None,
                 "trace": self._trace is not None,
             }
@@ -339,6 +366,33 @@ class MonitorServer:
 
             families = [f for p in self._metric_providers for f in p()]
             return b"200 OK", render(families).encode()
+        if path == "/metrics/federated":
+            if self._federation is None:
+                return b"404 Not Found", {"error": "no federation registered"}
+            from .telemetry.openmetrics import (
+                PREFIX, family, federated_families, render,
+            )
+
+            texts: Dict[str, str] = {}
+            for label, fetch in self._federation.items():
+                try:
+                    texts[label] = fetch()
+                except Exception:  # noqa: BLE001 - a down worker must not 500 the fold
+                    _log.exception("federated scrape of shard %r failed", label)
+                    self._federation_errors += 1
+            fams = federated_families(texts)
+            fams.append(family(
+                f"{PREFIX}_federation_workers", "gauge",
+                "Workers successfully scraped into this federated exposition.",
+                [(f"{PREFIX}_federation_workers", {}, len(texts))],
+            ))
+            fams.append(family(
+                f"{PREFIX}_federation_scrape_errors_total", "counter",
+                "Federated worker scrapes that failed (lifetime).",
+                [(f"{PREFIX}_federation_scrape_errors_total", {},
+                  self._federation_errors)],
+            ))
+            return b"200 OK", render(fams).encode()
         if path == "/events":
             if self._events is None:
                 return b"404 Not Found", {"error": "no event bus registered"}
@@ -402,6 +456,16 @@ class MonitorServer:
             return b"200 OK", self._whatif_post(doc)
         except ReplayError as exc:
             return b"400 Bad Request", {"error": str(exc)}
+
+
+def scrape_metrics(url: str, timeout: float = 5.0) -> str:
+    """Fetch one worker's exposition text (stdlib urllib — the repo rule).
+    The federation route calls these synchronously; workers are expected
+    on the local network (the gloo lane scrapes loopback)."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
 
 
 # -- structured per-tick log -------------------------------------------------

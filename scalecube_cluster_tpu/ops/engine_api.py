@@ -177,6 +177,13 @@ class EngineOps:
     make_sharded_fused_run: Optional[Callable] = None
     make_sharded_traced_run: Optional[Callable] = None
     make_sharded_fleet_run: Optional[Callable] = None
+    #: r21 sharded twin of the donated MetricRing append ((mesh) -> jitted
+    #: ``buf.at[idx].set(row)`` with every operand pinned replicated and the
+    #: ring buffer donated). The ring layout is engine-agnostic, so one
+    #: spelling serves every mesh-capable engine; the audit matrix lowers it
+    #: as the ``sharded-telemetry-append`` variant. None keeps the engine's
+    #: telemetry ring off-mesh.
+    make_sharded_telemetry_append: Optional[Callable] = None
 
 
 # -- shared seams for the two full-view-plane engines (dense + sparse both
@@ -217,6 +224,13 @@ def _plane_sentinel_init(sparse):
     return lambda state, spec: init_sentinel_state(
         state.view_key, spec, sparse=sparse
     )
+
+
+def _sharded_metric_append(mesh):
+    # shared across engines: the metric ring layout is engine-agnostic
+    from .sharding import make_sharded_metric_append
+
+    return make_sharded_metric_append(mesh)
 
 
 def _dense_engine() -> EngineOps:
@@ -285,6 +299,7 @@ def _dense_engine() -> EngineOps:
         make_fused_run=K.make_fused_run,
         make_fused_adaptive_run=K.make_fused_adaptive_run,
         make_fused_fleet_run=K.make_fused_fleet_run,
+        make_sharded_telemetry_append=_sharded_metric_append,
     )
 
 
@@ -351,6 +366,7 @@ def _sparse_engine() -> EngineOps:
         make_fused_run=SP.make_sparse_fused_run,
         make_fused_adaptive_run=SP.make_sparse_fused_adaptive_run,
         make_fused_fleet_run=SP.make_sparse_fused_fleet_run,
+        make_sharded_telemetry_append=_sharded_metric_append,
     )
 
 
@@ -452,6 +468,7 @@ def _pview_engine() -> EngineOps:
         make_sharded_fused_run=_sharded_fused,
         make_sharded_traced_run=_sharded_traced,
         make_sharded_fleet_run=_sharded_fleet,
+        make_sharded_telemetry_append=_sharded_metric_append,
     )
 
 

@@ -852,12 +852,24 @@ def sentinel_init(state: PviewState, spec) -> dict:
     return sent
 
 
-# pview telemetry ring layout: the sparse series verbatim (shared core +
-# pool backpressure — the pool machinery IS the sparse pool).
-TELEMETRY_SERIES = _SPARSE_TELEMETRY_SERIES
+# pview telemetry ring layout: the sparse series (shared core + pool
+# backpressure — the pool machinery IS the sparse pool) plus two r21
+# mesh-observability columns. ``delivery_overflow`` is the ragged
+# all-to-all drop sentinel (already psummed inside the sharded window, so
+# the sum below folds to the same replicated global on every shard; a
+# constant 0 on single-device and unbudgeted runs). ``shard_peak_mem_mb``
+# is the per-shard donated-state footprint, baked in as a trace-time
+# constant — it is the one deployment-dependent column, excluded from
+# sharded-vs-single-device bit-identity comparisons by construction.
+TELEMETRY_SERIES = _SPARSE_TELEMETRY_SERIES + (
+    "delivery_overflow",
+    "shard_peak_mem_mb",
+)
 
 
-def telemetry_window_vector(ms: dict, state: PviewState) -> jax.Array:
+def telemetry_window_vector(
+    ms: dict, state: PviewState, *, shard_mem_mb: float = 0.0
+) -> jax.Array:
     from .kernel import telemetry_window_core
 
     f32 = jnp.float32
@@ -868,6 +880,10 @@ def telemetry_window_vector(ms: dict, state: PviewState) -> jax.Array:
             ms["announce_dropped"].sum().astype(f32),
             ms["pool_evicted"].sum().astype(f32),
             ms["mr_active_count"].max().astype(f32),
+            # the key exists only under an armed ragged-delivery context
+            # (sharded windows); unsharded windows fold the column to 0
+            jnp.asarray(ms.get("delivery_overflow", 0), jnp.int32).sum().astype(f32),
+            jnp.float32(shard_mem_mb),
         ]
     )
     return jnp.stack(vec)

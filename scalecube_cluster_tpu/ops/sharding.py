@@ -531,6 +531,37 @@ def place_replicated(x, mesh: Mesh):
     return jax.device_put(x, replicated_sharding(mesh))
 
 
+def make_sharded_metric_append(mesh: Mesh):
+    """Sharded twin of the donated MetricRing row append (r21).
+
+    Same ``buf.at[idx].set(row)`` spelling as the single-device append, with
+    every operand pinned to the replicated sharding so the update stays a
+    collective-free local write on each chip — placement inference never
+    gets a vote. The ring buffer is donated exactly like its single-device
+    twin (the r12 audit matrix carries this program as
+    ``sharded-telemetry-append`` and proves the alias + transfer-freeness
+    statically)."""
+    rep = replicated_sharding(mesh)
+    return jax.jit(
+        lambda buf, row, idx: buf.at[idx].set(row),
+        donate_argnums=0,
+        in_shardings=(rep, rep, rep),
+        out_shardings=rep,
+    )
+
+
+def make_sharded_telemetry_row(mesh: Mesh, row_fn):
+    """jit a telemetry row reduction with the output pinned replicated (r21).
+
+    ``row_fn`` is the plane's row closure (engine window-vector + sentinel
+    columns). Its inputs are whatever the sharded window produced — stacked
+    per-tick metrics and the post-window state, in their GSPMD-chosen
+    shardings; every reduction inside comes out replicated under GSPMD, and
+    the explicit ``out_shardings`` pin makes that a checked contract instead
+    of an inference accident, so the ring append that follows is local."""
+    return jax.jit(row_fn, out_shardings=replicated_sharding(mesh))
+
+
 def make_sharded_run(mesh: Mesh, params: SimParams, n_ticks: int, dense_links: bool = True):
     """jit the batched ``run_ticks`` window over ``mesh``.
 

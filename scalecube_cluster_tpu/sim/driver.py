@@ -295,6 +295,10 @@ class SimDriver:
             # host-path announce drops (join/leave self-announce finding a
             # pool with no majority-covered victim) — detected in join()
             "announce_dropped_host": 0,
+            # r21: ragged all-to-all budget drops (pview windows emit the
+            # psummed ``delivery_overflow`` sentinel; 0 everywhere else) —
+            # accumulated device-side like every other window counter
+            "delivery_overflow": 0,
         }
         self._pool_high_water = 0
         self._segmentation_warnings = 0
@@ -1398,16 +1402,31 @@ class SimDriver:
             if self._control is not None:
                 return self._control
             if self.mesh is not None:
-                # capability-named refusal (r20): the ragged-delivery lift
-                # covers windows, not the control loop — the controller's
-                # escalation rungs arm adaptive FD and swap knobs mid-run,
-                # a host cadence the sharded window cache has no tests for
-                raise ValueError(
-                    "the closed-loop control plane is single-device for "
-                    "now — its rung escalations re-arm adaptive FD and "
-                    "swap static knobs on the live window cache; arm on "
-                    "an unsharded driver"
-                )
+                # r21 mesh lift: the actuators are mesh-capable now —
+                # set_dissemination / set_protocol_knobs are cache clears
+                # (sharded windows rebuild on the next step) and
+                # set_adaptive shards through make_sharded_adaptive_run.
+                # The one capability still missing is a sharded adaptive
+                # window, so only a ladder whose rungs would arm adaptive
+                # FD keeps a (narrowed, capability-named) refusal.
+                from ..config import ClusterConfig
+                from ..control import ControlSpec
+
+                resolved = spec
+                if resolved is None:
+                    resolved = (
+                        ControlSpec.from_config(config)
+                        if isinstance(config, ClusterConfig) else ControlSpec()
+                    )
+                if self._eng.make_sharded_adaptive_run is None and any(
+                    r.adaptive for r in resolved.ladder
+                ):
+                    raise ValueError(
+                        "this ladder's rungs arm adaptive FD, and the "
+                        f"{self.engine} engine has no sharded adaptive "
+                        "window builder (make_sharded_adaptive_run) — use "
+                        "a static-rung ladder or an unsharded driver"
+                    )
             if self._trace is not None:
                 raise ValueError(
                     "trace capture and the control plane cannot share a "

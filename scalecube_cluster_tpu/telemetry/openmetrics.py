@@ -197,7 +197,29 @@ def driver_families(driver, plane) -> List[dict]:
             [(f"{PREFIX}_pool_evicted_total", base,
               counters.get("pool_evicted", 0))],
         ),
+        # r21: the ragged all-to-all budget-drop sentinel, accumulated
+        # device-side per window like the other counters (0 everywhere
+        # except budgeted sharded pview runs — a live sentinel, always
+        # exposed so dashboards can alert on the first nonzero)
+        family(
+            f"{PREFIX}_delivery_overflow_total", "counter",
+            "Gossip records dropped by the ragged-delivery budget "
+            "(sharded pview windows).",
+            [(f"{PREFIX}_delivery_overflow_total", base,
+              counters.get("delivery_overflow", 0))],
+        ),
     ]
+    if driver.mesh is not None:
+        fams.append(
+            family(
+                f"{PREFIX}_mesh_devices", "gauge",
+                "Devices in the driver's mesh, by axis.",
+                [
+                    (f"{PREFIX}_mesh_devices", {**base, "axis": str(ax)}, int(sz))
+                    for ax, sz in sorted(dict(driver.mesh.shape).items())
+                ],
+            )
+        )
     # newest ring row -> per-series gauges (the live window values; the
     # full retained series rides the flight recorder, not the scrape).
     # NO driver lock (r19): latest_values reads the ring's RETAINED last
@@ -286,6 +308,122 @@ def driver_families(driver, plane) -> List[dict]:
         )
     fams.extend(_bus_families(plane.bus))
     return fams
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    return float(text)
+
+
+def _parse_labels(text: str) -> Dict[str, str]:
+    """Parse a ``k="v",k2="v2"`` label body (the inverse of :func:`render`'s
+    label formatting, including the escape rules)."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(text)
+    while i < n:
+        eq = text.index("=", i)
+        key = text[i:eq].strip().lstrip(",").strip()
+        if text[eq + 1] != '"':
+            raise ValueError(f"unquoted label value at {text[eq:]!r}")
+        j = eq + 2
+        out = []
+        while True:
+            c = text[j]
+            if c == "\\":
+                nxt = text[j + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            elif c == '"':
+                break
+            else:
+                out.append(c)
+                j += 1
+        labels[key] = "".join(out)
+        i = j + 1
+    return labels
+
+
+def parse_exposition(text: str) -> List[dict]:
+    """Parse a Prometheus 0.0.4 text exposition back into family dicts —
+    the inverse of :func:`render`, used by the federation route to fold
+    worker scrapes. Tolerates the trailing ``# EOF`` and unknown comment
+    lines; samples seen before any ``# TYPE`` get type ``untyped``."""
+    fams: List[dict] = []
+    by_name: Dict[str, dict] = {}
+    helps: Dict[str, str] = {}
+
+    def fam_for(sample_name: str) -> dict:
+        # histogram/summary samples attach to their base family name
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in by_name:
+                base = base[: -len(suffix)]
+                break
+        if base not in by_name:
+            by_name[base] = family(
+                base, "untyped", helps.get(base, ""), []
+            )
+            fams.append(by_name[base])
+        return by_name[base]
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                name = parts[2]
+                ftype = parts[3] if len(parts) > 3 else "untyped"
+                if name not in by_name:
+                    by_name[name] = family(name, ftype, helps.get(name, ""), [])
+                    fams.append(by_name[name])
+                else:
+                    by_name[name]["type"] = ftype
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                name = parts[2]
+                help_ = parts[3] if len(parts) > 3 else ""
+                helps[name] = help_
+                if name in by_name:
+                    by_name[name]["help"] = help_
+            continue
+        if "{" in line:
+            brace = line.index("{")
+            sname = line[:brace]
+            close = line.rindex("}")
+            labels = _parse_labels(line[brace + 1:close])
+            value = _parse_value(line[close + 1:].strip().split()[0])
+        else:
+            fields = line.split()
+            sname, value, labels = fields[0], _parse_value(fields[1]), {}
+        fam_for(sname)["samples"].append((sname, labels, value))
+    return fams
+
+
+def federated_families(expositions: Dict[str, str]) -> List[dict]:
+    """Fold per-worker expositions (shard label -> scrape text) into one
+    family list: every sample is re-emitted verbatim with a ``shard``
+    label added, families merged by name (first worker's TYPE/HELP wins,
+    stable order). Values pass through untouched, so each (series, shard)
+    stream keeps the source counter's lifetime monotonicity — the r10
+    Prometheus rule federates shard-wise instead of summing away."""
+    merged: List[dict] = []
+    by_name: Dict[str, dict] = {}
+    for shard, text in expositions.items():
+        for fam in parse_exposition(text):
+            tgt = by_name.get(fam["name"])
+            if tgt is None:
+                tgt = family(fam["name"], fam["type"], fam["help"], [])
+                by_name[fam["name"]] = tgt
+                merged.append(tgt)
+            tgt["samples"].extend(
+                (sname, {**labels, "shard": str(shard)}, value)
+                for sname, labels, value in fam["samples"]
+            )
+    return merged
 
 
 def cluster_families(cluster, bus=None) -> List[dict]:

@@ -62,7 +62,7 @@ class MetricRing:
         self.ring_len = int(ring_len)
         buf = jnp.zeros((self.ring_len, len(self.names)), jnp.float32)
         if mesh is not None:
-            from ..ops.sharding import place_replicated
+            from ..ops.sharding import make_sharded_metric_append, place_replicated
 
             buf = place_replicated(buf, mesh)
         self._buf = buf
@@ -73,10 +73,15 @@ class MetricRing:
         # gives scrapes a lock-free read of the newest complete window
         self._last_row = None
         # donated in-place row write: the ring must never force a copy of
-        # itself per window (it is carried across every step of a run)
-        self._append = jax.jit(
-            lambda buf, row, idx: buf.at[idx].set(row), donate_argnums=0
-        )
+        # itself per window (it is carried across every step of a run).
+        # On a mesh the append is the r21 sharded twin — same spelling with
+        # every operand pinned replicated (collective-free local write)
+        if mesh is not None:
+            self._append = make_sharded_metric_append(mesh)
+        else:
+            self._append = jax.jit(
+                lambda buf, row, idx: buf.at[idx].set(row), donate_argnums=0
+            )
 
     @property
     def windows(self) -> int:

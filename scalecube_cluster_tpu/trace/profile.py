@@ -79,19 +79,44 @@ class _Timer:
         })
 
 
-def _wrap_phase_fns(fns: Dict[str, Callable], fleet: bool) -> Dict[str, Callable]:
+def _wrap_phase_fns(
+    fns: Dict[str, Callable],
+    fleet: bool,
+    ctx_factory: Callable = None,
+    spmd_axis_name: str = None,
+) -> Dict[str, Callable]:
     """jit each phase callable; ``fleet=True`` vmaps it over a leading
     [S] scenario axis first (jit∘vmap — the ops/fleet.py window spelling,
     phase by phase, so the composition is bit-identical to the fleet
-    window exactly as the serial split is to the serial window)."""
+    window exactly as the serial split is to the serial window).
+
+    ``ctx_factory`` (r21, the sharded phase-split builders) is entered
+    INSIDE each jitted body — contexts like the pview
+    ``ragged_delivery_context`` / sparse ``mesh_context`` are trace-time
+    contextvars, and jit traces lazily at first call, so wrapping the jit
+    call site would arm nothing. ``spmd_axis_name`` rides through to vmap
+    for fleet phases on a 2-D mesh (the ``make_sharded_*_fleet_run``
+    spelling, phase by phase)."""
     import jax
 
-    if fleet:
-        return {k: jax.jit(jax.vmap(v)) for k, v in fns.items()}
-    return {k: jax.jit(v) for k, v in fns.items()}
+    def _jit(v):
+        if ctx_factory is None:
+            inner = v
+        else:
+            def inner(*args, _v=v):
+                with ctx_factory():
+                    return _v(*args)
+        if fleet:
+            return jax.jit(jax.vmap(inner, spmd_axis_name=spmd_axis_name))
+        return jax.jit(inner)
+
+    return {k: _jit(v) for k, v in fns.items()}
 
 
-def _dense_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
+def _dense_phase_fns(
+    params, fleet: bool = False, mesh=None, a2a_budget=None,
+    spmd_axis_name: str = None,
+) -> Dict[str, Callable]:
     import jax
     import jax.numpy as jnp
 
@@ -130,8 +155,10 @@ def _dense_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
         "sync": lambda st, r: K._sync_phase(st, r, params),
         "refute": K._refute_phase,
         "sweep": lambda st: K._rumor_sweep(st, params),
+        # no trace-time context: the dense sharded window is a plain jit —
+        # GSPMD propagates the row sharding through each phase unchanged
         "telemetry": lambda st: K.state_metrics(st, params),
-    }, fleet)
+    }, fleet, spmd_axis_name=spmd_axis_name)
 
 
 def _run_dense_tick(fns, timer: _Timer, state, key, t: int):
@@ -162,7 +189,10 @@ def _run_dense_tick(fns, timer: _Timer, state, key, t: int):
     return state, key
 
 
-def _sparse_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
+def _sparse_phase_fns(
+    params, fleet: bool = False, mesh=None, a2a_budget=None,
+    spmd_axis_name: str = None,
+) -> Dict[str, Callable]:
     import jax
     import jax.numpy as jnp
 
@@ -170,6 +200,9 @@ def _sparse_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
     from ..ops.rand import draw_sparse_fd, draw_sparse_round, split_tick_key
 
     n = params.capacity
+    # the sparse sharded window's trace-time context (the word-sharded
+    # apply staging reads the active mesh), entered inside each phase jit
+    ctx = (lambda: SP.mesh_context(mesh)) if mesh is not None else None
 
     def _rand(st, key):
         key, tick_key = jax.random.split(key)
@@ -207,7 +240,7 @@ def _sparse_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
         "sweep": lambda st: SP._rumor_sweeps(st, params),
         "alloc": lambda st, props: SP._alloc_phase(st, props, params),
         "telemetry": lambda st: SP.state_metrics(st, params),
-    }, fleet)
+    }, fleet, ctx_factory=ctx, spmd_axis_name=spmd_axis_name)
 
 
 def _run_sparse_tick(fns, timer: _Timer, state, key, t: int):
@@ -243,7 +276,10 @@ def _run_sparse_tick(fns, timer: _Timer, state, key, t: int):
     return state, key
 
 
-def _pview_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
+def _pview_phase_fns(
+    params, fleet: bool = False, mesh=None, a2a_budget=None,
+    spmd_axis_name: str = None,
+) -> Dict[str, Callable]:
     import jax
     import jax.numpy as jnp
 
@@ -251,6 +287,15 @@ def _pview_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
     from ..ops.rand import draw_sparse_fd, draw_sparse_round, split_tick_key
 
     n = params.capacity
+    # the r20 ragged-delivery rewrite, armed inside each phase jit (the
+    # context is a trace-time contextvar — the sharded window builders'
+    # spelling, phase by phase); None budget = the lossless default, the
+    # exact context the driver's sharded windows trace under
+    ctx = None
+    if mesh is not None:
+        from ..ops.sharding import MEMBER_AXIS
+
+        ctx = lambda: PV.ragged_delivery_context(mesh, MEMBER_AXIS, a2a_budget)
 
     def _rand(st, key):
         key, tick_key = jax.random.split(key)
@@ -290,7 +335,7 @@ def _pview_phase_fns(params, fleet: bool = False) -> Dict[str, Callable]:
         "sweep": lambda st: PV._rumor_sweeps(st, params),
         "alloc": lambda st, props: PV._alloc_phase(st, props, params),
         "telemetry": lambda st: PV.state_metrics(st, params),
-    }, fleet)
+    }, fleet, ctx_factory=ctx, spmd_axis_name=spmd_axis_name)
 
 
 def _run_pview_tick(fns, timer: _Timer, state, key, t: int):
@@ -326,19 +371,37 @@ def _run_pview_tick(fns, timer: _Timer, state, key, t: int):
     return state, key
 
 
-def _engine_fns_and_runner(params, fleet: bool = False):
+def _engine_fns_and_runner(params, fleet: bool = False, mesh=None, a2a_budget=None):
     from ..ops.pview import PviewParams
     from ..ops.sparse import SparseParams
 
+    spmd = None
+    if mesh is not None:
+        # same preconditions as the sharded window builders — fail loudly
+        # up front instead of letting a misaligned shard or a Pallas
+        # delivery table silently gather
+        from ..ops import sharding as SH
+
+        if fleet:
+            spmd = SH.FLEET_AXIS
+        if isinstance(params, PviewParams):
+            SH._check_pview_word_alignment(mesh, params)
+            SH._refuse_pallas_on_mesh(params)
+        elif isinstance(params, SparseParams):
+            SH._check_sparse_word_alignment(mesh, params)
+        else:
+            SH._check_dense_word_alignment(mesh, params)
+    kw = dict(mesh=mesh, a2a_budget=a2a_budget, spmd_axis_name=spmd)
     if isinstance(params, PviewParams):
-        return "pview", _pview_phase_fns(params, fleet), _run_pview_tick
+        return "pview", _pview_phase_fns(params, fleet, **kw), _run_pview_tick
     if isinstance(params, SparseParams):
-        return "sparse", _sparse_phase_fns(params, fleet), _run_sparse_tick
-    return "dense", _dense_phase_fns(params, fleet), _run_dense_tick
+        return "sparse", _sparse_phase_fns(params, fleet, **kw), _run_sparse_tick
+    return "dense", _dense_phase_fns(params, fleet, **kw), _run_dense_tick
 
 
 def profile_ticks(
-    params, state, key, n_ticks: int, warmup_ticks: int = 1
+    params, state, key, n_ticks: int, warmup_ticks: int = 1,
+    mesh=None, a2a_budget=None,
 ) -> Tuple[object, object, Dict]:
     """Run ``n_ticks`` as phase-split jits; returns (state, key, result).
 
@@ -346,8 +409,15 @@ def profile_ticks(
     (same helper functions, same key chain), so the returned state matches
     the fused window's bit-for-bit — tests/test_trace.py pins it. The first
     ``warmup_ticks`` compile every phase program and are EXCLUDED from the
-    per-phase totals and the wall measurement."""
-    engine, fns, run = _engine_fns_and_runner(params)
+    per-phase totals and the wall measurement.
+
+    ``mesh`` (r21) builds the SHARDED phase programs instead: ``state``
+    must already be mesh-placed (``ops.sharding.shard_*_state``), and each
+    phase traces under the engine's sharded-window context (the pview
+    ragged delivery rewrite with ``a2a_budget``, the sparse mesh context),
+    so the split final state is bit-identical to the sharded fused window
+    — tests/test_obs_mesh.py pins it."""
+    engine, fns, run = _engine_fns_and_runner(params, mesh=mesh, a2a_budget=a2a_budget)
     for t in range(warmup_ticks):
         state, key = run(fns, _Timer(), state, key, t)
     timer = _Timer()
@@ -359,6 +429,10 @@ def profile_ticks(
     result = {
         "engine": engine,
         "n": params.capacity,
+        "mesh": (
+            {str(k): int(v) for k, v in dict(mesh.shape).items()}
+            if mesh is not None else None
+        ),
         "ticks": n_ticks,
         "warmup_ticks": warmup_ticks,
         "wall_s": round(wall, 6),
@@ -378,7 +452,8 @@ def profile_ticks(
 
 
 def profile_fleet_ticks(
-    params, fleet_state, keys, n_ticks: int, warmup_ticks: int = 1
+    params, fleet_state, keys, n_ticks: int, warmup_ticks: int = 1,
+    mesh=None, a2a_budget=None,
 ) -> Tuple[object, object, Dict]:
     """Phase-split profile of a FLEET window (r15's ``jit(vmap(core))``):
     each phase program is ``jit(vmap(phase))`` over the leading [S]
@@ -386,10 +461,15 @@ def profile_fleet_ticks(
     window exactly as the serial split is to the serial one (vmap
     composes phase-wise; ``lax.cond`` under vmap runs both branches in
     BOTH spellings). Same result schema as :func:`profile_ticks` plus
-    the scenario count ``s``; engine name suffixed ``-fleet``."""
+    the scenario count ``s``; engine name suffixed ``-fleet``. ``mesh``
+    (r21) must be the 2-D scenarios×members mesh the fleet state is placed
+    on — each phase is then vmapped with ``spmd_axis_name`` over the
+    scenario axis, the ``make_sharded_*_fleet_run`` spelling."""
     from ..ops.fleet import fleet_size
 
-    engine, fns, run = _engine_fns_and_runner(params, fleet=True)
+    engine, fns, run = _engine_fns_and_runner(
+        params, fleet=True, mesh=mesh, a2a_budget=a2a_budget
+    )
     for t in range(warmup_ticks):
         fleet_state, keys = run(fns, _Timer(), fleet_state, keys, t)
     timer = _Timer()
@@ -401,6 +481,10 @@ def profile_fleet_ticks(
     result = {
         "engine": f"{engine}-fleet",
         "n": params.capacity,
+        "mesh": (
+            {str(k): int(v) for k, v in dict(mesh.shape).items()}
+            if mesh is not None else None
+        ),
         "s": fleet_size(fleet_state),
         "ticks": n_ticks,
         "warmup_ticks": warmup_ticks,
@@ -426,19 +510,23 @@ def profile_driver(driver, n_ticks: int = 32, warmup_ticks: int = 1) -> Dict:
     import jax
     import jax.numpy as jnp
 
-    if driver.mesh is not None:
-        raise ValueError(
-            "phase profiling is single-device for now — it re-jits each "
-            "tick phase as its own program without the sharded builders, "
-            "so the copies would silently gather the row-sharded state; "
-            "profile an unsharded driver with the same params"
-        )
     with driver._lock:
         state = jax.tree_util.tree_map(
             lambda x: jnp.array(x, copy=True), driver.state
         )
         key = jnp.array(driver._key, copy=True)
+        if driver.mesh is not None:
+            # r21 mesh lift: re-place the copies on the live shardings —
+            # jnp.array gathers to one device, and the sharded phase
+            # programs must see the row-sharded layout the driver runs
+            # with. One host round-trip per profile call is the microscope
+            # mode's price, never the production path's.
+            state = jax.tree_util.tree_map(
+                lambda c, live: jax.device_put(c, live.sharding),
+                state, driver.state,
+            )
     _st, _k, result = profile_ticks(
-        driver.params, state, key, n_ticks, warmup_ticks=warmup_ticks
+        driver.params, state, key, n_ticks, warmup_ticks=warmup_ticks,
+        mesh=driver.mesh,
     )
     return result

@@ -153,6 +153,15 @@ def test_full_matrix_including_sharded_passes():
     # over the member mesh, fleet over the 2-D scenarios×members mesh
     assert {"pview/i32/sharded-fused", "pview/i16/sharded-fused",
             "pview/i32/sharded-mesh2d"} <= names
+    # r21: the mesh-observability twins — the sharded telemetry row/append
+    # per engine and the pview sharded phase-split gossip program
+    assert {"dense/i32/sharded-telemetry-row",
+            "dense/i32/sharded-telemetry-append",
+            "sparse/i32/sharded-telemetry-row",
+            "sparse/i32/sharded-telemetry-append",
+            "pview/i32/sharded-telemetry-row",
+            "pview/i32/sharded-telemetry-append",
+            "pview/i32/sharded-profile-gossip"} <= names
 
 
 # ---------------------------------------------------------------------------

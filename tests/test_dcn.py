@@ -133,6 +133,163 @@ print(f"DCN-PVIEW-OK rank={jax.process_index()}", flush=True)
 """
 
 
+_FED_WORKER = r"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import scalecube_cluster_tpu.ops.pview as PV
+from scalecube_cluster_tpu.ops import dcn
+from scalecube_cluster_tpu.ops.sharding import make_sharded_pview_run
+
+port, rank, tmp = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+dcn.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=rank
+)
+mesh = dcn.global_mesh()
+params = PV.PviewParams(
+    capacity=64, view_slots=8, active_slots=4, fanout=2, ping_req_k=2,
+    fd_every=3, sync_every=8, rumor_slots=2, seed_rows=(0, 1),
+)
+state = dcn.make_global_pview_state(params, 48, mesh)
+run = make_sharded_pview_run(mesh, params, 6)
+state, _k, ms, _w = run(state, jax.random.PRNGKey(0))
+overflow = int(np.asarray(ms["delivery_overflow"]).sum())
+probes = int(np.asarray(ms["fd_probes"]).sum())
+
+from scalecube_cluster_tpu.monitor import MonitorServer, scrape_metrics
+from scalecube_cluster_tpu.telemetry.openmetrics import (
+    PREFIX, family, parse_exposition, render,
+)
+
+
+def families():
+    return [
+        family(
+            f"{PREFIX}_delivery_overflow_total", "counter",
+            "Gossip records dropped by the ragged-delivery budget.",
+            [(f"{PREFIX}_delivery_overflow_total", {"engine": "pview"},
+              overflow)],
+        ),
+        family(
+            f"{PREFIX}_fd_probes_total", "counter", "FD probes this window.",
+            [(f"{PREFIX}_fd_probes_total", {"engine": "pview"}, probes)],
+        ),
+        family(
+            f"{PREFIX}_mesh_devices", "gauge", "Devices on the mesh axis.",
+            [(f"{PREFIX}_mesh_devices", {"axis": "members"}, mesh.size)],
+        ),
+    ]
+
+
+ready = os.path.join(tmp, "w1-ready.json")
+done = os.path.join(tmp, "fed-done")
+
+if rank == 1:
+    # worker side: serve /metrics over real HTTP until rank 0 is done
+    async def serve():
+        server = await MonitorServer("127.0.0.1", 0).start()
+        server._metric_providers.append(families)
+        with open(ready + ".tmp", "w") as fh:
+            json.dump({"url": server.url}, fh)
+        os.replace(ready + ".tmp", ready)
+        deadline = time.time() + 120
+        while not os.path.exists(done) and time.time() < deadline:
+            await asyncio.sleep(0.1)
+        assert os.path.exists(done), "rank 0 never finished the federated scrape"
+
+    asyncio.run(serve())
+else:
+    deadline = time.time() + 120
+    while not os.path.exists(ready) and time.time() < deadline:
+        time.sleep(0.1)
+    with open(ready) as fh:
+        peer_url = json.load(fh)["url"]
+    server = MonitorServer()
+    server.register_federation({
+        "w0": lambda: render(families()),
+        "w1": lambda: scrape_metrics(peer_url + "/metrics"),
+    })
+    try:
+        status, body = server._route("/metrics/federated")
+        assert status == b"200 OK", status
+        fams = {f["name"]: f for f in parse_exposition(body.decode())}
+        for name in (f"{PREFIX}_delivery_overflow_total",
+                     f"{PREFIX}_fd_probes_total", f"{PREFIX}_mesh_devices"):
+            shards = {
+                labels.get("shard")
+                for _s, labels, _v in fams[name]["samples"]
+            }
+            assert shards == {"w0", "w1"}, (name, shards)
+        # shard-label consistency: both workers ran the SAME SPMD window,
+        # so the replicated folds agree sample-for-sample across shards
+        for name in (f"{PREFIX}_delivery_overflow_total",
+                     f"{PREFIX}_fd_probes_total", f"{PREFIX}_mesh_devices"):
+            by_shard = {
+                labels["shard"]: value
+                for _s, labels, value in fams[name]["samples"]
+            }
+            assert by_shard["w0"] == by_shard["w1"], (name, by_shard)
+        (w,) = fams[f"{PREFIX}_federation_workers"]["samples"]
+        assert w[2] == 2.0, w
+        (e,) = fams[f"{PREFIX}_federation_scrape_errors_total"]["samples"]
+        assert e[2] == 0.0, e
+    finally:
+        with open(done, "w") as fh:
+            fh.write("ok")
+
+print(f"DCN-FED-OK rank={jax.process_index()}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_federated_metrics_scrape(tmp_path):
+    """r21 federation on the gloo lane: both ranks run the sharded pview
+    window over the 2-process global mesh, rank 1 serves its exposition
+    over real HTTP, and rank 0 folds both workers through
+    ``/metrics/federated`` — every series reappears under both shard
+    labels with identical (replicated-fold) values."""
+    from scalecube_cluster_tpu.ops import dcn
+
+    if not dcn.cpu_collectives_available():
+        pytest.skip("gloo CPU collectives unavailable")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _FED_WORKER, str(port), str(rank),
+             str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "DCN-FED-OK" in out, f"rank {rank} output:\n{out}"
+
+
 @pytest.mark.slow
 def test_two_process_sharded_pview_window_bit_identical():
     """r20 multi-process lane: two OS processes, one gloo-backed global

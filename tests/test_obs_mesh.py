@@ -1,0 +1,508 @@
+"""Mesh-wide observability (r21): sharded telemetry/trace/profile planes,
+federated /metrics, and the controller on the sharded engines.
+
+Pins the ISSUE 20 contracts:
+
+* the sharded armed telemetry window's folded global series is
+  bit-identical to the single-device series (every column except the
+  per-shard ``shard_peak_mem_mb`` footprint, deployment-dependent by
+  construction);
+* the mesh phase profiler's split final state is bit-identical to the
+  sharded fused window (the ``profile.py`` mesh-refusal lift);
+* ``/metrics/federated`` folds worker expositions with per-shard labels
+  and the exposition parser round-trips the 0.0.4 grammar;
+* ``arm_control`` on a mesh driver is armed-idle bit-identical, and the
+  dense engine's adaptive-rung ladder still refuses loudly;
+* the spread-lag sensor is a third up-only ladder vote that cannot flap
+  a rung (pure-policy, no devices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import scalecube_cluster_tpu.ops.pview as PV
+import scalecube_cluster_tpu.ops.sharding as SH
+from scalecube_cluster_tpu.config import TelemetryConfig
+from scalecube_cluster_tpu.control import (
+    ControllerState,
+    ControlSpec,
+    advance,
+    sensors_from_window,
+)
+from scalecube_cluster_tpu.sim.driver import SimDriver
+
+PARAMS = PV.PviewParams(capacity=64, view_slots=8, active_slots=4, fanout=2,
+                        ping_req_k=2, fd_every=2, sync_every=8, rumor_slots=2,
+                        seed_rows=(0, 1), full_metrics=True)
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 (virtual) devices")
+    return SH.make_mesh(jax.devices()[:2])  # capacity 64 = 32 words × 2
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return SH.make_mesh(jax.devices()[:8])
+
+
+def _state_cols(snap):
+    return {n: i for i, n in enumerate(snap["ring"]["names"])}
+
+
+# ---------------------------------------------------------------------------
+# 1. sharded telemetry plane
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_telemetry_fold_bit_identical_to_single_device(mesh2):
+    """The tentpole neutrality proof: the mesh driver's ring rows (psum-
+    folded inside the sharded window, appended replicated) equal the
+    single-device driver's rows on every engine column — only the
+    per-shard memory footprint column may differ."""
+    d = SimDriver(PARAMS, 48, warm=True, seed=3, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=8))
+    d2 = SimDriver(PARAMS, 48, warm=True, seed=3)
+    d2.arm_telemetry(TelemetryConfig(ring_len=8))
+    for _ in range(3):
+        d.step(4)
+        d2.step(4)
+    snap, snap2 = d._telemetry.collect(), d2._telemetry.collect()
+    names = snap["ring"]["names"]
+    assert names == snap2["ring"]["names"]
+    assert "delivery_overflow" in names and "shard_peak_mem_mb" in names
+    rows = np.asarray(snap["ring"]["rows"])
+    rows2 = np.asarray(snap2["ring"]["rows"])
+    cols = [i for i, n in enumerate(names) if n != "shard_peak_mem_mb"]
+    assert np.array_equal(rows[:, cols], rows2[:, cols])
+    # the lossless default budget drops nothing — the overflow column is 0
+    assert np.all(rows[:, names.index("delivery_overflow")] == 0.0)
+    # the sharded footprint is a positive per-shard constant, strictly
+    # below the unsharded one (the member planes divide across shards)
+    i_mem = names.index("shard_peak_mem_mb")
+    assert 0.0 < rows[0, i_mem] < rows2[0, i_mem]
+
+
+def test_sharded_telemetry_arming_is_trajectory_neutral(mesh2):
+    """Armed-vs-unarmed bit-identity on the mesh: the plane computes FROM
+    the window's outputs and never feeds back into the tick."""
+    a = SimDriver(PARAMS, 48, warm=True, seed=5, mesh=mesh2)
+    a.arm_telemetry(TelemetryConfig(ring_len=8))
+    b = SimDriver(PARAMS, 48, warm=True, seed=5, mesh=mesh2)
+    for _ in range(2):
+        a.step(4)
+        b.step(4)
+    for f in dataclasses.fields(PV.PviewState):
+        assert np.array_equal(
+            np.asarray(getattr(a.state, f.name)),
+            np.asarray(getattr(b.state, f.name)),
+        ), f.name
+
+
+def test_sharded_ring_buffer_stays_replicated(mesh2):
+    """The ring rides the donated carry replicated — the append must not
+    silently reshard it (a resharded ring would turn every scrape into a
+    cross-device gather)."""
+    d = SimDriver(PARAMS, 48, warm=True, seed=1, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=4))
+    d.step(4)
+    buf = d._telemetry.ring._buf
+    assert buf.sharding.is_fully_replicated
+
+
+def test_health_counters_and_metrics_monotone_across_restore(tmp_path, mesh2):
+    """Satellite (b): ``delivery_overflow`` and the ring cursor/wrap totals
+    expose as valid Prometheus families whose counters never decrease
+    across a checkpoint/restore boundary."""
+    from scalecube_cluster_tpu.telemetry.openmetrics import parse_exposition
+
+    def _counters(text):
+        out = {}
+        for fam in parse_exposition(text):
+            for sname, _labels, value in fam["samples"]:
+                if fam["type"] == "counter":
+                    out[sname] = out.get(sname, 0.0) + value
+        return out
+
+    d = SimDriver(PARAMS, 48, warm=True, seed=2, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=4))
+    d.step(4)
+    d.step(4)
+    text1 = d._telemetry.metrics_text()
+    c1 = _counters(text1)
+    assert "scalecube_delivery_overflow_total" in c1
+    assert "scalecube_ring_wraps_total" in c1
+    assert "scalecube_ring_windows_total" in c1
+
+    ck = str(tmp_path / "obs.npz")
+    d.checkpoint(ck)
+    d.step(4)
+    c2 = _counters(d._telemetry.metrics_text())
+    d.restore(ck)
+    d.step(4)
+    c3 = _counters(d._telemetry.metrics_text())
+    for name in c1:
+        assert c2.get(name, 0.0) >= c1[name], name
+        assert c3.get(name, 0.0) >= c1[name], name
+
+
+# ---------------------------------------------------------------------------
+# 2. exposition grammar + federation
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_parses_and_roundtrips(mesh2):
+    """The scrape text is valid Prometheus 0.0.4: every family renders a
+    HELP+TYPE header, label values round-trip through escaping, and the
+    parser rebuilds the family set ``render`` emitted."""
+    from scalecube_cluster_tpu.telemetry.openmetrics import (
+        family, parse_exposition, render,
+    )
+
+    d = SimDriver(PARAMS, 48, warm=True, seed=4, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=4))
+    d.step(4)
+    text = d._telemetry.metrics_text()
+    assert text.endswith("# EOF\n")
+    fams = parse_exposition(text)
+    names = {f["name"] for f in fams}
+    assert "scalecube_delivery_overflow_total" in names
+    assert "scalecube_mesh_devices" in names
+    for fam in fams:
+        assert fam["type"] in ("counter", "gauge", "histogram", "untyped")
+        assert fam["samples"], fam["name"]
+
+    tricky = family(
+        "scalecube_escape_test", "gauge", 'help with "quotes" and \\ slash',
+        [("scalecube_escape_test", {"k": 'a"b\\c\nd'}, 1.5)],
+    )
+    parsed = parse_exposition(render([tricky]))
+    (fam,) = [f for f in parsed if f["name"] == "scalecube_escape_test"]
+    (sample,) = fam["samples"]
+    assert sample[1] == {"k": 'a"b\\c\nd'}
+    assert sample[2] == 1.5
+
+
+def test_federated_route_folds_workers_with_shard_labels(mesh2):
+    """The /metrics/federated fold: every worker sample reappears labelled
+    with its shard, per-(series, shard) streams keep the source counter
+    values, and the fold stamps worker/error bookkeeping families."""
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.telemetry.openmetrics import parse_exposition
+
+    workers = {}
+    for shard, seed in (("w0", 11), ("w1", 12)):
+        d = SimDriver(PARAMS, 48, warm=True, seed=seed, mesh=mesh2)
+        d.arm_telemetry(TelemetryConfig(ring_len=4))
+        d.step(4)
+        workers[shard] = d
+
+    server = MonitorServer()
+    server.register_federation({
+        shard: (lambda d=d: d._telemetry.metrics_text())
+        for shard, d in workers.items()
+    })
+    status, body = server._route("/metrics/federated")
+    assert status == b"200 OK"
+    text = body.decode()
+    fams = {f["name"]: f for f in parse_exposition(text)}
+
+    fam = fams["scalecube_ring_windows_total"]
+    shards = {labels.get("shard") for _s, labels, _v in fam["samples"]}
+    assert shards == {"w0", "w1"}
+    for _sname, labels, value in fam["samples"]:
+        want = workers[labels["shard"]]._telemetry.ring.windows
+        assert value == float(want)
+
+    (w_sample,) = fams["scalecube_federation_workers"]["samples"]
+    assert w_sample[2] == 2.0
+    (e_sample,) = fams["scalecube_federation_scrape_errors_total"]["samples"]
+    assert e_sample[2] == 0.0
+
+
+def test_federated_route_survives_a_down_worker(mesh2):
+    """A failing worker fetch is skipped and counted — the fold must not
+    500, and the error counter is lifetime-monotone."""
+    from scalecube_cluster_tpu.monitor import MonitorServer
+    from scalecube_cluster_tpu.telemetry.openmetrics import parse_exposition
+
+    d = SimDriver(PARAMS, 48, warm=True, seed=13, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=4))
+    d.step(4)
+
+    def _down():
+        raise OSError("connection refused")
+
+    server = MonitorServer()
+    server.register_federation({
+        "up": lambda: d._telemetry.metrics_text(), "down": _down,
+    })
+    for expect_errors in (1.0, 2.0):
+        status, body = server._route("/metrics/federated")
+        assert status == b"200 OK"
+        fams = {f["name"]: f for f in parse_exposition(body.decode())}
+        (w,) = fams["scalecube_federation_workers"]["samples"]
+        assert w[2] == 1.0
+        (e,) = fams["scalecube_federation_scrape_errors_total"]["samples"]
+        assert e[2] == expect_errors
+        shards = {
+            labels.get("shard")
+            for _s, labels, _v in fams["scalecube_ring_windows_total"]["samples"]
+        }
+        assert shards == {"up"}
+
+
+# ---------------------------------------------------------------------------
+# 3. mesh phase profiler
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_profiler_bit_identical_to_sharded_fused_window(mesh8):
+    """The profile.py mesh-refusal lift: each phase jit traces under the
+    ragged-delivery context, so warmup+measured split ticks compose to the
+    sharded fused window's exact trajectory."""
+    from scalecube_cluster_tpu.trace.profile import profile_ticks
+
+    p = PV.PviewParams(capacity=256, full_metrics=True)
+    key = jax.random.PRNGKey(5)
+    st = SH.shard_pview_state(PV.init_pview_state(p, 64, warm=True), mesh8)
+    final, _key, res = profile_ticks(p, st, key, n_ticks=3, warmup_ticks=1,
+                                     mesh=mesh8)
+    assert res["mesh"] == {str(k): int(v) for k, v in dict(mesh8.shape).items()}
+    assert set(res["phases_s"]) == {
+        "rand", "fd", "suspicion", "gossip", "sync", "refute", "sweep",
+        "alloc", "telemetry",
+    }
+    fused = SH.make_sharded_pview_fused_run(mesh8, p, 4)
+    out = fused(SH.shard_pview_state(PV.init_pview_state(p, 64, warm=True),
+                                     mesh8), key)
+    ref = out[0]
+    for f in dataclasses.fields(PV.PviewState):
+        assert np.array_equal(
+            np.asarray(getattr(final, f.name)), np.asarray(getattr(ref, f.name))
+        ), f.name
+
+
+@pytest.mark.slow
+def test_profile_driver_on_mesh_driver(mesh8):
+    """profile_driver no longer refuses a mesh driver: it deep-copies the
+    live state, re-places it on the driver's shardings, and profiles
+    without perturbing the driver (same post-profile trajectory)."""
+    from scalecube_cluster_tpu.trace.profile import profile_driver
+
+    p = PV.PviewParams(capacity=256, full_metrics=True)
+    d = SimDriver(p, 64, warm=True, seed=9, mesh=mesh8)
+    d.step(4)
+    res = profile_driver(d, n_ticks=2, warmup_ticks=1)
+    assert res["engine"] == "pview"
+    assert res["mesh"] == {str(k): int(v) for k, v in dict(mesh8.shape).items()}
+    assert res["phase_coverage"] is not None
+    # the profile ran on a copy: the driver's own trajectory is untouched
+    d2 = SimDriver(p, 64, warm=True, seed=9, mesh=mesh8)
+    d2.step(4)
+    d.step(4)
+    d2.step(4)
+    for f in dataclasses.fields(PV.PviewState):
+        assert np.array_equal(
+            np.asarray(getattr(d.state, f.name)),
+            np.asarray(getattr(d2.state, f.name)),
+        ), f.name
+
+
+# ---------------------------------------------------------------------------
+# 4. controller on mesh
+# ---------------------------------------------------------------------------
+
+
+def _static_spec(**kw):
+    spec = ControlSpec(**kw)
+    return dataclasses.replace(
+        spec,
+        ladder=tuple(dataclasses.replace(r, adaptive=False)
+                     for r in spec.ladder),
+    )
+
+
+def test_arm_control_on_mesh_is_armed_idle_bit_identical(mesh2):
+    """The arm_control mesh-refusal lift: an armed, never-actuating
+    controller on the sharded pview engine leaves the trajectory
+    bit-identical to an unarmed mesh driver."""
+    a = SimDriver(PARAMS, 48, warm=True, seed=7, mesh=mesh2)
+    a.arm_telemetry(TelemetryConfig(ring_len=8))
+    a.arm_control(spec=_static_spec())
+    b = SimDriver(PARAMS, 48, warm=True, seed=7, mesh=mesh2)
+    b.arm_telemetry(TelemetryConfig(ring_len=8))
+    for _ in range(4):
+        a.step(4)
+        b.step(4)
+    assert a._control.state.actuations == 0
+    for f in dataclasses.fields(PV.PviewState):
+        assert np.array_equal(
+            np.asarray(getattr(a.state, f.name)),
+            np.asarray(getattr(b.state, f.name)),
+        ), f.name
+
+
+def test_arm_control_mesh_refuses_adaptive_ladder_without_builder(mesh2):
+    """The narrowed refusal names the missing capability: a ladder with
+    adaptive rungs cannot arm on an engine that has no sharded adaptive
+    window builder."""
+    from scalecube_cluster_tpu.ops.state import SimParams
+
+    d = SimDriver(SimParams(capacity=64), 48, warm=True, seed=7, mesh=mesh2)
+    with pytest.raises(ValueError, match="make_sharded_adaptive_run"):
+        d.arm_control()
+    # a static-rung ladder arms fine on the same driver
+    d.arm_control(spec=_static_spec())
+
+
+# ---------------------------------------------------------------------------
+# 5. spread-lag sensor (pure policy — no devices)
+# ---------------------------------------------------------------------------
+
+
+def test_spread_lag_sensor_guarded_by_alive_fraction():
+    s = sensors_from_window({
+        "fd_probes": 100.0, "fd_failed_probes": 1.0, "fd_new_suspects": 0.0,
+        "convergence_lag": 0.8, "alive_view_fraction": 0.9,
+    })
+    assert s["spread_lag"] == pytest.approx(0.8)
+    # full_metrics=False: the fraction reports 0 and the lag column is a
+    # constant non-measurement — the sensor must stay passive
+    s0 = sensors_from_window({
+        "fd_probes": 100.0, "fd_failed_probes": 1.0, "fd_new_suspects": 0.0,
+        "convergence_lag": 1.0, "alive_view_fraction": 0.0,
+    })
+    assert s0["spread_lag"] == 0.0
+
+
+def test_spread_lag_gate_votes_one_rung_up_with_dwell_no_flap():
+    """ROADMAP item 4: the spread-lag gate is an up-only one-rung vote
+    riding the ordinary dwell machinery — a transient lag spike cannot
+    actuate, a sustained one steps exactly one rung, and clearing the lag
+    needs dwell_down epochs before stepping back (no rung flapping)."""
+    spec = _static_spec(spread_lag_gate=0.5)
+    st = ControllerState()
+
+    calm = {"miss_rate": 0.0, "suspect_rate": 0.0, "spread_lag": 0.0,
+            "probes": 1000.0}
+    lagging = dict(calm, spread_lag=0.9)
+
+    # transient: one lagging epoch then calm — dwell_up=2 never satisfied
+    assert advance(spec, st, dict(lagging)) is None
+    assert advance(spec, st, dict(calm)) is None
+    assert st.rung == 0 and st.actuations == 0
+
+    # sustained: dwell_up consecutive lagging epochs step exactly ONE rung
+    for _ in range(spec.dwell_up - 1):
+        assert advance(spec, st, dict(lagging)) is None
+    rung = advance(spec, st, dict(lagging))
+    assert rung is not None and st.rung == 1
+
+    # still lagging: the vote targets rung+1 relative to... nothing — the
+    # gate only fires when the miss-rate target is <= current, and it
+    # votes st.rung+1, so a held lag re-arms a pend toward rung 2
+    # gradually; a single calm epoch resets the pend (no flap down either)
+    assert advance(spec, st, dict(calm)) is None  # dwell_down=4: holds
+    assert st.rung == 1
+    for _ in range(spec.dwell_down - 2):
+        assert advance(spec, st, dict(calm)) is None
+    rung = advance(spec, st, dict(calm))
+    assert rung is not None and st.rung == 0
+    assert st.actuations == 2
+
+
+def test_spread_lag_gate_never_lowers_a_miss_target():
+    """The gate is an elif vote for the SAME one-rung step — when the miss
+    rate already calls for a higher rung, the lag adds nothing."""
+    spec = _static_spec(spread_lag_gate=0.5)
+    stormy = {"miss_rate": spec.ladder[-1].enter_miss_rate + 0.1,
+              "suspect_rate": 0.0, "spread_lag": 0.9, "probes": 1000.0}
+    st = ControllerState()
+    for _ in range(spec.dwell_up * len(spec.ladder)):
+        advance(spec, st, dict(stormy))
+    assert st.rung == len(spec.ladder) - 1  # walked the whole ladder
+
+
+def test_spread_lag_gate_validation():
+    with pytest.raises(ValueError, match="spread_lag_gate"):
+        ControlSpec(spread_lag_gate=-0.1)
+
+
+# ---------------------------------------------------------------------------
+# 6. flight recorder on mesh drivers
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_flight_dump_carries_mesh_axes_and_reconstructs(tmp_path, mesh2):
+    """Satellite (c): a flight dump from a sharded driver stamps the mesh
+    shape into the schema-2 reconstruction section (a SIBLING of params),
+    and ``replay.incident_from_flight`` rebuilds the incident UNSHARDED —
+    sound, because sharded trajectories are bit-identical."""
+    from scalecube_cluster_tpu.chaos import Crash, Scenario
+    from scalecube_cluster_tpu.replay import incident_from_flight
+    from scalecube_cluster_tpu.telemetry.flight import load_flight_dump
+
+    d = SimDriver(PARAMS, 48, warm=True, seed=21, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=8, flight_dir=str(tmp_path)))
+    scenario = Scenario(name="mesh-crash", events=[Crash(rows=[3], at=4)],
+                        horizon=24, check_interval=8)
+    d.run_scenario(scenario, max_window=8)
+    path = d._telemetry.flight_record("obs-mesh-test")
+    dump = load_flight_dump(path)
+
+    rec = dump["reconstruction"]
+    assert rec["mesh_axes"] == {
+        str(k): int(v) for k, v in dict(mesh2.shape).items()
+    }
+    assert "mesh_axes" not in rec["params"]  # sibling, never a params field
+
+    inc = incident_from_flight(path)
+    assert inc.engine == "pview"
+    assert inc.seed == 21
+    assert inc.params == d.params
+
+
+def test_unarmed_sharded_flight_dump_stays_partial(tmp_path, mesh2):
+    """Without an armed chaos runner there is no timeline to replay — the
+    mesh stamp must not fabricate a reconstruction section."""
+    from scalecube_cluster_tpu.replay import ReplayError, incident_from_flight
+    from scalecube_cluster_tpu.telemetry.flight import load_flight_dump
+
+    d = SimDriver(PARAMS, 48, warm=True, seed=22, mesh=mesh2)
+    d.arm_telemetry(TelemetryConfig(ring_len=8, flight_dir=str(tmp_path)))
+    d.step(4)
+    path = d._telemetry.flight_record("obs-mesh-partial")
+    dump = load_flight_dump(path)
+    assert not isinstance(dump.get("reconstruction"), dict)
+    with pytest.raises(ReplayError, match="partial|timeline"):
+        incident_from_flight(path)
+
+
+@pytest.mark.slow
+def test_sharded_traced_flight_dump_has_trace_tail(tmp_path, mesh8):
+    """A trace-armed mesh driver's dump carries the causal section: the
+    replicated trace-ring tail rides the dump next to the mesh stamp."""
+    p = PV.PviewParams(capacity=256, full_metrics=True)
+    d = SimDriver(p, 64, warm=True, seed=23, mesh=mesh8)
+    d.arm_telemetry(TelemetryConfig(ring_len=8, flight_dir=str(tmp_path)))
+    d.arm_trace(tracer_rows=[0, 1])
+    d.step(4)
+    path = d._telemetry.flight_record("obs-mesh-traced")
+    from scalecube_cluster_tpu.telemetry.flight import load_flight_dump
+
+    dump = load_flight_dump(path)
+    assert dump["trace"] is not None
+    assert dump["trace"]["records_total"] > 0
+    assert dump["trace"]["tracer_rows"] == [0, 1]
